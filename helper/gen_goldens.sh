@@ -1,0 +1,22 @@
+#!/bin/bash
+# Regenerate golden test fixtures using the reference CLI built from /root/reference.
+# Usage: bash helper/gen_goldens.sh
+set -e
+ROOT=$(cd "$(dirname "$0")/.." && pwd)
+REF=/root/reference
+BUILD=$ROOT/.refbuild
+if [ ! -x $BUILD/lightgbm ]; then
+  mkdir -p $BUILD && cd $BUILD
+  cmake $REF -DCMAKE_BUILD_TYPE=Release -DUSE_OPENMP=ON > cmake.log 2>&1
+  make -j8 > make.log 2>&1
+  # reference CMake drops outputs into the source tree; relocate them
+  mv $REF/lightgbm $REF/lib_lightgbm.so $BUILD/ 2>/dev/null || true
+fi
+LGBM=$BUILD/lightgbm
+mkdir -p $ROOT/.golden/binary && cd $ROOT/.golden/binary
+$LGBM task=train objective=binary metric=binary_logloss,auc metric_freq=1 is_training_metric=true \
+  max_bin=255 data=$REF/examples/binary_classification/binary.train \
+  valid_data=$REF/examples/binary_classification/binary.test \
+  num_trees=20 learning_rate=0.1 num_leaves=31 output_model=golden_model.txt
+$LGBM task=predict data=$REF/examples/binary_classification/binary.test \
+  input_model=golden_model.txt output_result=golden_pred.txt

@@ -1,0 +1,201 @@
+"""Single Config object read by every layer, with the reference's alias table.
+
+Role parity with the reference's include/LightGBM/config.h `struct Config` +
+src/io/config.cpp (Config::Set, alias resolution, interdependent-default
+derivation at config.cpp:280+).  The parameter registry (names, aliases,
+defaults, range checks) is generated from the reference's config.h comments by
+helper/gen_params.py into _params.py, the same way the reference generates
+config_auto.cpp with helper/parameter_generator.py.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Mapping, Optional
+
+from ._params import ALIASES, PARAMS
+from .utils.log import Log
+
+# objective aliases handled specially by the reference's ParseObjectiveAlias
+_OBJECTIVE_ALIASES = {
+    "regression": "regression", "regression_l2": "regression", "mean_squared_error": "regression",
+    "mse": "regression", "l2": "regression", "l2_root": "regression",
+    "root_mean_squared_error": "regression", "rmse": "regression",
+    "regression_l1": "regression_l1", "mean_absolute_error": "regression_l1",
+    "mae": "regression_l1", "l1": "regression_l1",
+    "huber": "huber", "fair": "fair", "poisson": "poisson", "quantile": "quantile",
+    "mape": "mape", "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "tweedie": "tweedie",
+    "binary": "binary", "multiclass": "multiclass", "softmax": "multiclass",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "xentropy": "xentropy", "cross_entropy": "xentropy",
+    "xentlambda": "xentlambda", "cross_entropy_lambda": "xentlambda",
+    "lambdarank": "lambdarank", "rank_xendcg": "lambdarank",
+    "none": "none", "null": "none", "custom": "none", "na": "none",
+}
+
+_METRIC_ALIASES = {
+    "l1": "l1", "mean_absolute_error": "l1", "mae": "l1", "regression_l1": "l1",
+    "l2": "l2", "mean_squared_error": "l2", "mse": "l2", "regression_l2": "l2",
+    "regression": "l2", "l2_root": "rmse", "root_mean_squared_error": "rmse", "rmse": "rmse",
+    "quantile": "quantile", "huber": "huber", "fair": "fair", "poisson": "poisson",
+    "mape": "mape", "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "gamma_deviance": "gamma_deviance", "tweedie": "tweedie",
+    "ndcg": "ndcg", "lambdarank": "ndcg", "map": "map", "mean_average_precision": "map",
+    "auc": "auc", "binary_logloss": "binary_logloss", "binary": "binary_logloss",
+    "binary_error": "binary_error",
+    "multi_logloss": "multi_logloss", "multiclass": "multi_logloss",
+    "softmax": "multi_logloss", "multiclassova": "multi_logloss",
+    "multi_error": "multi_error",
+    "xentropy": "xentropy", "cross_entropy": "xentropy",
+    "xentlambda": "xentlambda", "cross_entropy_lambda": "xentlambda",
+    "kldiv": "kldiv", "kullback_leibler": "kldiv",
+    "none": "", "null": "", "custom": "", "na": "",
+}
+
+
+def _coerce(name: str, value: Any, typ: str) -> Any:
+    if typ == "int":
+        return int(value)
+    if typ == "float":
+        return float(value)
+    if typ == "bool":
+        if isinstance(value, str):
+            return value.lower() in ("true", "1", "+", "yes")
+        return bool(value)
+    if typ == "str":
+        return str(value)
+    if typ.startswith("list"):
+        if value is None or value == "":
+            return []
+        if isinstance(value, str):
+            items = re.split(r"[,\s]+", value.strip())
+        elif isinstance(value, (list, tuple)):
+            items = list(value)
+        else:
+            items = [value]
+        cast = {"list_int": int, "list_float": float, "list_str": str}[typ]
+        return [cast(v) for v in items if v != ""]
+    return value
+
+
+class Config:
+    """Holds every parameter; unknown keys are kept (and warned) like the reference."""
+
+    def __init__(self, params: Optional[Mapping[str, Any]] = None):
+        for name, meta in PARAMS.items():
+            default = meta["default"]
+            if isinstance(default, tuple):
+                default = list(default)
+            setattr(self, name, default)
+        # non-registry knobs the TPU build adds
+        self.tpu_histogram_impl = "auto"  # auto | einsum | pallas
+        self.raw_params: Dict[str, Any] = {}
+        if params:
+            self.set(params)
+
+    # -- param plumbing ------------------------------------------------------
+    @staticmethod
+    def resolve_alias(key: str) -> str:
+        key = key.strip()
+        return ALIASES.get(key, key)
+
+    @staticmethod
+    def str2map(parameters: str) -> Dict[str, str]:
+        """Parse 'k1=v1 k2=v2' CLI/config-file style parameter strings."""
+        out: Dict[str, str] = {}
+        for tok in parameters.split():
+            if "=" in tok:
+                k, v = tok.split("=", 1)
+                out[k] = v
+        return out
+
+    def set(self, params: Mapping[str, Any]) -> None:
+        resolved: Dict[str, Any] = {}
+        for key, value in params.items():
+            name = self.resolve_alias(key)
+            if name in resolved and resolved[name] != value:
+                Log.warning("%s is set with %s, will be overridden by %s", name,
+                            str(resolved[name]), str(value))
+            resolved[name] = value
+        for name, value in resolved.items():
+            self.raw_params[name] = value
+            if name == "objective" and value is not None and not callable(value):
+                value = _OBJECTIVE_ALIASES.get(str(value), str(value))
+            if name == "metric":
+                # remember the user opted out explicitly (metric=none) so
+                # _derive doesn't re-add the objective default (config.cpp GetMetricType)
+                self._metric_explicit = True
+                setattr(self, "metric", self._parse_metrics(value))
+                continue
+            if name in PARAMS:
+                setattr(self, name, _coerce(name, value, PARAMS[name]["type"]))
+            else:
+                setattr(self, name, value)
+        self._check_ranges()
+        self._derive()
+
+    @staticmethod
+    def _parse_metrics(value: Any):
+        if value is None:
+            return []
+        if isinstance(value, str):
+            value = [v for v in re.split(r"[,\s]+", value) if v]
+        out = []
+        for m in value:
+            m = _METRIC_ALIASES.get(str(m), str(m))
+            if m and m not in out:
+                out.append(m)
+        return out
+
+    def _check_ranges(self) -> None:
+        for name, meta in PARAMS.items():
+            for chk in meta["checks"]:
+                m = re.match(r"(<=|>=|<|>)\s*([-\d.eE+]+)", chk)
+                if not m:
+                    continue
+                op, bound = m.group(1), float(m.group(2))
+                val = getattr(self, name)
+                if not isinstance(val, (int, float)) or isinstance(val, bool):
+                    continue
+                ok = {"<": val < bound, "<=": val <= bound,
+                      ">": val > bound, ">=": val >= bound}[op]
+                if not ok:
+                    Log.fatal("Check failed: %s %s %s", name, op, str(bound))
+
+    def _derive(self) -> None:
+        """Interdependent defaults (reference: config.cpp CheckParamConflict/:280+)."""
+        obj = self.objective if isinstance(self.objective, str) else "none"
+        if not self.metric and not getattr(self, "_metric_explicit", False):
+            default_metric = _METRIC_ALIASES.get(obj, "")
+            self.metric = [default_metric] if default_metric else []
+        if obj in ("multiclass", "multiclassova") and self.num_class <= 1:
+            Log.fatal("Number of classes should be specified and greater than 1 for multiclass training")
+        if obj not in ("multiclass", "multiclassova") and self.num_class != 1:
+            if obj != "none":
+                Log.fatal("Number of classes must be 1 for non-multiclass training")
+        self.is_parallel = self.tree_learner in ("feature", "data", "voting") \
+            and self.num_machines > 1
+        if self.tree_learner not in ("serial", "feature", "data", "voting"):
+            # resolve tree_learner aliases like the reference's GetTreeLearnerType
+            tl = {"serial": "serial", "feature": "feature", "feature_parallel": "feature",
+                  "data": "data", "data_parallel": "data", "voting": "voting",
+                  "voting_parallel": "voting"}.get(str(self.tree_learner))
+            if tl is None:
+                Log.fatal("Unknown tree learner type %s", str(self.tree_learner))
+            self.tree_learner = tl
+        if self.bagging_freq > 0 and self.bagging_fraction >= 1.0:
+            self.bagging_freq = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {name: getattr(self, name) for name in PARAMS}
+
+    def to_string(self) -> str:
+        """Serialized `key: value` block used in the model file parameters section."""
+        lines = []
+        for name in PARAMS:
+            val = getattr(self, name)
+            if isinstance(val, list):
+                val = ",".join(str(v) for v in val)
+            lines.append("[%s: %s]" % (name, val))
+        return "\n".join(lines)
