@@ -1,0 +1,16 @@
+"""LightGBM-TPU: a TPU-native gradient-boosting framework (JAX/XLA/Pallas).
+
+Public surface mirrors the reference python-package/lightgbm/__init__.py.
+"""
+from .basic import Booster, Dataset
+from .callback import (early_stopping, log_evaluation, print_evaluation,
+                       record_evaluation, reset_parameter)
+from .config import Config
+from .engine import cv, train
+from .utils.log import LightGBMError
+
+__version__ = "0.1.0"
+
+__all__ = ["Dataset", "Booster", "Config", "train", "cv", "LightGBMError",
+           "early_stopping", "log_evaluation", "print_evaluation",
+           "record_evaluation", "reset_parameter"]

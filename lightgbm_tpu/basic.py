@@ -1,0 +1,262 @@
+"""User-facing Dataset and Booster.
+
+Role parity with the reference Python binding python-package/lightgbm/basic.py
+(Dataset at :683+, Booster at :1412+), minus the ctypes layer: the "native"
+side here is the JAX engine, so handles are plain Python objects.  Lazy
+construction, validation-set alignment to the training mappers, and the
+update/eval/predict/save surface mirror the reference binding.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .boosting.gbdt import GBDT
+from .config import Config
+from .io.dataset import BinnedDataset, Metadata
+from .metric import create_metric
+from .models.gbdt_model import GBDTModel
+from .objective import create_objective, create_objective_from_model_string
+from .utils.log import LightGBMError, Log
+
+
+def _to_2d_float(data) -> np.ndarray:
+    if hasattr(data, "values"):  # pandas
+        data = data.values
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    return arr
+
+
+class Dataset:
+    """Raw data + lazily-constructed binned form (basic.py Dataset semantics)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None, feature_name="auto",
+                 categorical_feature="auto", params: Optional[Dict] = None,
+                 free_raw_data: bool = False):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params) if params else {}
+        self.free_raw_data = free_raw_data
+        self._binned: Optional[BinnedDataset] = None
+        self.used_indices: Optional[np.ndarray] = None
+
+    # -- construction --------------------------------------------------------
+    def construct(self, config: Optional[Config] = None) -> "Dataset":
+        if self._binned is not None:
+            return self
+        if config is None:
+            config = Config(self.params)
+        X = _to_2d_float(self.data)
+        fn = None if self.feature_name == "auto" else list(self.feature_name)
+        cats: Sequence[int] = ()
+        if self.categorical_feature != "auto" and self.categorical_feature:
+            cats = [int(c) for c in self.categorical_feature]
+        ref_mappers = None
+        if self.reference is not None:
+            self.reference.construct(config)
+            ref_mappers = self.reference._binned.bin_mappers
+        self._binned = BinnedDataset.from_matrix(
+            X, config, bin_mappers=ref_mappers, feature_names=fn,
+            categorical_feature=cats)
+        md = self._binned.metadata
+        if self.label is not None:
+            md.set_label(np.asarray(self.label))
+        md.set_weight(self.weight)
+        md.set_init_score(self.init_score)
+        md.set_query(self.group)
+        return self
+
+    @property
+    def binned(self) -> BinnedDataset:
+        if self._binned is None:
+            self.construct()
+        return self._binned
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score, params=params)
+
+    # -- accessors (binding surface) -----------------------------------------
+    def num_data(self) -> int:
+        return self.binned.num_data
+
+    def num_feature(self) -> int:
+        return self.binned.num_features
+
+    def get_label(self) -> np.ndarray:
+        return self.binned.metadata.label
+
+    def get_weight(self):
+        return self.binned.metadata.weight
+
+    def get_group(self):
+        qb = self.binned.metadata.query_boundaries
+        return None if qb is None else np.diff(qb)
+
+    def set_label(self, label) -> None:
+        self.label = label
+        if self._binned is not None:
+            self._binned.metadata.set_label(np.asarray(label))
+
+    def set_weight(self, weight) -> None:
+        self.weight = weight
+        if self._binned is not None:
+            self._binned.metadata.set_weight(weight)
+
+    def set_group(self, group) -> None:
+        self.group = group
+        if self._binned is not None:
+            self._binned.metadata.set_query(group)
+
+    def set_init_score(self, init_score) -> None:
+        self.init_score = init_score
+        if self._binned is not None:
+            self._binned.metadata.set_init_score(init_score)
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        idx = np.asarray(used_indices)
+        X = _to_2d_float(self.data)[idx]
+        y = None if self.label is None else np.asarray(self.label)[idx]
+        w = None if self.weight is None else np.asarray(self.weight)[idx]
+        return Dataset(X, label=y, weight=w, reference=self,
+                       params=params or self.params)
+
+
+class Booster:
+    """Training/prediction handle (basic.py Booster; c_api.cpp Booster)."""
+
+    def __init__(self, params: Optional[Dict] = None, train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None, model_str: Optional[str] = None):
+        params = dict(params) if params else {}
+        self.params = params
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self._valid_names: List[str] = ["training"]
+        self._engine: Optional[GBDT] = None
+        self._model: Optional[GBDTModel] = None
+        self._objective = None
+        self.config: Optional[Config] = None
+
+        if train_set is not None:
+            self.config = Config(params)
+            train_set.construct(self.config)
+            obj = self.config.objective
+            self._objective = create_objective(obj, self.config) \
+                if isinstance(obj, str) else None
+            binned = train_set.binned
+            if self._objective is not None and binned.metadata.label is None:
+                Log.fatal("Label should not be None for training")
+            metrics = [m for m in (create_metric(name, self.config)
+                                   for name in self.config.metric) if m is not None]
+            for m in metrics:
+                m.init(binned.metadata.label, binned.metadata.weight,
+                       binned.metadata.query_boundaries)
+            self._engine = GBDT(self.config, binned, self._objective, metrics)
+            self._model = self._engine.model
+            self.train_set = train_set
+        elif model_file is not None or model_str is not None:
+            text = model_str if model_str is not None else open(model_file).read()
+            self._model = GBDTModel.load_model_from_string(text)
+            self.config = Config(params)
+            self._objective = create_objective_from_model_string(
+                self._model.objective_str, self.config)
+        else:
+            raise LightGBMError("Booster needs train_set or model file")
+
+    # -- training ------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        if self._engine is None:
+            raise LightGBMError("Cannot add validation data to a loaded Booster")
+        data.construct(self.config)
+        if data.reference is None or data.reference is not self.train_set:
+            Log.warning("Validation set was not created with reference=train_set; "
+                        "binning with training mappers")
+            data.reference = self.train_set
+        metrics = [m for m in (create_metric(nm, self.config)
+                               for nm in self.config.metric) if m is not None]
+        self._engine.add_valid(name, data.binned, metrics)
+        self._valid_names.append(name)
+        return self
+
+    def update(self, train_set=None, fobj=None) -> bool:
+        if self._engine is None:
+            raise LightGBMError("Cannot update a loaded Booster")
+        if fobj is not None:
+            grad, hess = fobj(self._engine.raw_train_score().reshape(-1),
+                              self.train_set)
+            return self._engine.train_one_iter(grad, hess)
+        return self._engine.train_one_iter()
+
+    def rollback_one_iter(self) -> "Booster":
+        self._engine.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self) -> int:
+        return self._model.current_iteration
+
+    def num_trees(self) -> int:
+        return self._model.num_total_trees
+
+    # -- evaluation ----------------------------------------------------------
+    def eval_train(self, feval=None) -> List:
+        return self._wrap_eval(self._engine.eval_train(), feval, "training")
+
+    def eval_valid(self, feval=None) -> List:
+        return self._wrap_eval(self._engine.eval_valid(), feval, None)
+
+    def _wrap_eval(self, results, feval, dataset_name):
+        out = [(name, metric, val, hib) for (name, metric, val, hib) in results]
+        if feval is not None:
+            raw = self._engine.raw_train_score().reshape(-1) if dataset_name == "training" \
+                else None
+            if raw is not None:
+                name, val, hib = feval(raw, self.train_set)
+                out.append((dataset_name, name, val, hib))
+        return out
+
+    # -- prediction ----------------------------------------------------------
+    def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        X = _to_2d_float(data)
+        if pred_leaf:
+            return self._model.predict_leaf_index(X, num_iteration)
+        raw = self._model.predict_raw(X, num_iteration=num_iteration)
+        if raw.shape[1] == 1:
+            raw = raw[:, 0]
+        if raw_score or self._objective is None:
+            return raw
+        return self._objective.convert_output(raw)
+
+    # -- model IO ------------------------------------------------------------
+    def save_model(self, filename: str, num_iteration: int = -1,
+                   start_iteration: int = 0) -> "Booster":
+        params = self.config.to_string() if self.config else ""
+        self._model.save_model(filename, start_iteration, num_iteration,
+                               parameters=params)
+        return self
+
+    def model_to_string(self, num_iteration: int = -1, start_iteration: int = 0) -> str:
+        return self._model.save_model_to_string(start_iteration, num_iteration)
+
+    def dump_model(self, num_iteration: int = -1) -> Dict:
+        return self._model.dump_model(num_iteration)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: int = -1) -> np.ndarray:
+        return self._model.feature_importance(iteration, importance_type)
+
+    def feature_name(self) -> List[str]:
+        return list(self._model.feature_names)

@@ -1,0 +1,396 @@
+"""GBDT boosting driver.
+
+Role parity with the reference src/boosting/gbdt.cpp: Init (:64-169),
+TrainOneIter (:387-482), Bagging (:213-295), BoostFromAverage (:363-385),
+UpdateScore / ScoreUpdater (src/boosting/score_updater.hpp), RollbackOneIter
+(:484-500).
+
+TPU-first: raw scores live on device for the whole run; one boosting
+iteration is (jitted gradient) → (jitted tree grower) → (jitted score
+gather-update per dataset).  Only the finished tree's small arrays come back
+to the host, where the reference-format model is assembled.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.binning import BIN_TYPE_CATEGORICAL
+from ..io.dataset import BinnedDataset
+from ..models.gbdt_model import GBDTModel
+from ..models.tree import Tree
+from ..ops.split import FeatureMeta
+from ..utils.log import Log
+from ..utils.random import Random, partition_seed
+from .grower import GrowerConfig, make_tree_grower
+
+K_EPSILON = 1e-15
+
+# Reuse compiled growers across boosters: jax.jit caches per wrapper object,
+# so two boosters with identical feature metadata + config would otherwise
+# recompile the identical program (slow on every lgb.train call).
+_GROWER_CACHE: Dict = {}
+
+
+def _cached_grower(meta_dev: FeatureMeta, cfg, max_num_bin: int, ds: BinnedDataset):
+    key = (cfg, max_num_bin, ds.bins.shape,
+           tuple((m.num_bin, m.missing_type, m.default_bin, m.is_trivial, m.bin_type)
+                 for m in ds.bin_mappers),
+           ds.monotone_constraints.tobytes(), ds.feature_penalty.tobytes())
+    grower = _GROWER_CACHE.get(key)
+    if grower is None:
+        grower = make_tree_grower(meta_dev, cfg, max_num_bin)
+        _GROWER_CACHE[key] = grower
+    return grower
+
+
+def _feature_meta_device(ds: BinnedDataset) -> FeatureMeta:
+    m = ds.bin_mappers
+    return FeatureMeta(
+        num_bin=jnp.asarray([mm.num_bin for mm in m], jnp.int32),
+        missing_type=jnp.asarray([mm.missing_type for mm in m], jnp.int32),
+        default_bin=jnp.asarray([mm.default_bin for mm in m], jnp.int32),
+        is_trivial=jnp.asarray([mm.is_trivial for mm in m], jnp.bool_),
+        is_categorical=jnp.asarray([mm.bin_type == BIN_TYPE_CATEGORICAL for mm in m], jnp.bool_),
+        penalty=jnp.asarray(ds.feature_penalty, jnp.float32),
+        monotone=jnp.asarray(ds.monotone_constraints, jnp.int32),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _make_vals(grads, hesss, mask, k):
+    return jnp.stack([grads[k] * mask, hesss[k] * mask, mask], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _update_score_k(score, leaf_id, leaf_out, k):
+    return score.at[k].add(leaf_out[leaf_id])
+
+
+@functools.partial(jax.jit, static_argnames=("depth_iters", "k"))
+def _traverse_update(bins_v, score_kv, leaf_out, tree_dev, meta: FeatureMeta,
+                     depth_iters: int, k: int):
+    """Add one tree's (shrunk) outputs to row k of a [K, M] score matrix by
+    vectorized bin-level traversal (Tree::DecisionInner semantics,
+    tree.h:234-249 / 288-295)."""
+    M = bins_v.shape[1]
+    rows = jnp.arange(M)
+    sf, sb, dl, lc, rc = (tree_dev["split_feature"], tree_dev["split_bin"],
+                          tree_dev["default_left"], tree_dev["left_child"],
+                          tree_dev["right_child"])
+
+    def body(_, nd):
+        is_leaf = nd < 0
+        ndc = jnp.maximum(nd, 0)
+        f = sf[ndc]
+        fbin = bins_v[f, rows].astype(jnp.int32)
+        mt = meta.missing_type[f]
+        is_missing = ((mt == 2) & (fbin == meta.num_bin[f] - 1)) | \
+                     ((mt == 1) & (fbin == meta.default_bin[f]))
+        go_left = jnp.where(is_missing, dl[ndc], fbin <= sb[ndc])
+        child = jnp.where(go_left, lc[ndc], rc[ndc])
+        return jnp.where(is_leaf, nd, child)
+
+    nd = jax.lax.fori_loop(0, depth_iters, body, jnp.zeros(M, jnp.int32))
+    return score_kv.at[k].add(leaf_out[~nd])
+
+
+class GBDT:
+    """The boosting engine behind Booster."""
+
+    def __init__(self, config, train_set: BinnedDataset, objective,
+                 metrics: List, init_model: Optional[GBDTModel] = None):
+        self.config = config
+        self.train_set = train_set
+        self.objective = objective
+        self.train_metrics = metrics
+        self.iter = 0
+        self.shrinkage_rate = float(config.learning_rate)
+        self.num_class = int(config.num_class)
+        self.num_tree_per_iteration = objective.num_model_per_iteration \
+            if objective is not None else self.num_class
+
+        self.model = init_model if init_model is not None else GBDTModel()
+        self.model.num_class = self.num_class
+        self.model.num_tree_per_iteration = self.num_tree_per_iteration
+        self.model.max_feature_idx = train_set.num_features - 1
+        self.model.feature_names = list(train_set.feature_names)
+        self.model.feature_infos = train_set.feature_infos()
+        if objective is not None:
+            self.model.objective_str = objective.to_string()
+        self.num_init_iteration = self.model.current_iteration
+
+        # -- device state ----------------------------------------------------
+        self.bins_dev = jnp.asarray(train_set.bins)
+        self.meta_dev = _feature_meta_device(train_set)
+        self.valid_mask = jnp.asarray(train_set.valid_row_mask())
+        md = train_set.metadata
+        self.label_dev = jnp.asarray(train_set.padded(md.label))
+        self.weight_dev = jnp.asarray(train_set.padded(
+            md.weight if md.weight is not None else np.ones(train_set.num_data, np.float32)))
+        n_pad = train_set.num_data_padded
+
+        row_chunk = 16384 if n_pad % 16384 == 0 else n_pad
+        self.grower_cfg = GrowerConfig(
+            num_leaves=int(config.num_leaves),
+            max_depth=int(config.max_depth),
+            lambda_l1=float(config.lambda_l1),
+            lambda_l2=float(config.lambda_l2),
+            max_delta_step=float(config.max_delta_step),
+            min_data_in_leaf=int(config.min_data_in_leaf),
+            min_sum_hessian_in_leaf=float(config.min_sum_hessian_in_leaf),
+            min_gain_to_split=float(config.min_gain_to_split),
+            row_chunk=row_chunk)
+        self.grower = _cached_grower(self.meta_dev, self.grower_cfg,
+                                     train_set.max_num_bin, train_set)
+
+        # scores: [K, N_pad] on device
+        K = self.num_tree_per_iteration
+        self.score = jnp.zeros((K, n_pad), jnp.float32)
+        self.init_score_value = 0.0
+        if md.init_score is not None:
+            init = train_set.padded(md.init_score.astype(np.float32))
+            self.score = jnp.broadcast_to(init, (K, n_pad)).astype(jnp.float32)
+        if objective is not None:
+            objective.init(md.label, md.weight, md.query_boundaries)
+
+        # resume (continued training): replay loaded model onto the scores.
+        # Loaded trees carry double thresholds, not train-set bins, so replay
+        # predicts on host raw features (init_model path, engine.py).
+        if self.num_init_iteration > 0:
+            raise NotImplementedError("continued training (init_model) lands with M2")
+
+        # validation sets
+        self.valid_sets: List[Tuple[str, BinnedDataset, jax.Array, jax.Array, List]] = []
+
+        # deterministic per-subsystem RNG (bagging / feature sampling)
+        seed = int(getattr(config, "seed", 0) or 0)
+        self.bagging_rng = Random(partition_seed(seed + int(config.bagging_seed), 1))
+        self.feature_rng = Random(partition_seed(seed + int(config.feature_fraction_seed), 2))
+        self.bag_mask_host = np.ones(n_pad, dtype=np.float32)
+        self.bag_mask_host[train_set.num_data:] = 0.0
+
+        self._boosted_from_average = False
+        self._grad_fn = None
+
+    # -- validation ----------------------------------------------------------
+    def add_valid(self, name: str, valid: BinnedDataset, metrics: List) -> None:
+        bins_v = jnp.asarray(valid.bins)
+        K = self.num_tree_per_iteration
+        score_v = jnp.zeros((K, valid.num_data_padded), jnp.float32)
+        if valid.metadata.init_score is not None:
+            init = valid.padded(valid.metadata.init_score.astype(np.float32))
+            score_v = jnp.broadcast_to(init, score_v.shape).astype(jnp.float32)
+        # replay already-loaded model trees (continued training)
+        if self.model.current_iteration > 0:
+            raise NotImplementedError("add_valid after continued training lands with M2")
+        for m in metrics:
+            m.init(valid.metadata.label, valid.metadata.weight,
+                   valid.metadata.query_boundaries)
+        self.valid_sets.append([name, valid, bins_v, score_v, metrics])
+
+    # -- one boosting iteration (gbdt.cpp:387-482) ---------------------------
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        init_score = 0.0
+        if grad is None or hess is None:
+            init_score = self._boost_from_average()
+            grads, hesss = self._gradients()
+        else:
+            K, n = self.num_tree_per_iteration, self.train_set.num_data
+            grads = jnp.asarray(np.asarray(grad, np.float32).reshape(K, n))
+            hesss = jnp.asarray(np.asarray(hess, np.float32).reshape(K, n))
+            pad = self.train_set.num_data_padded - n
+            if pad:
+                grads = jnp.pad(grads, ((0, 0), (0, pad)))
+                hesss = jnp.pad(hesss, ((0, 0), (0, pad)))
+
+        bag_mask = self._bagging()
+        fmask = self._feature_sample()
+
+        should_continue = False
+        for k in range(self.num_tree_per_iteration):
+            vals = _make_vals(grads, hesss, bag_mask, k)
+            out = self.grower(self.bins_dev, vals, fmask)
+            tree, tree_dev, leaf_out = self._finish_tree(out, init_score)
+            if tree.num_leaves > 1:
+                should_continue = True
+                self.score = _update_score_k(self.score, out["leaf_id"], leaf_out, k)
+                # fixed trip count (num_leaves-1 covers any depth) so the
+                # traversal compiles exactly once per config
+                depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
+                for vs in self.valid_sets:
+                    vs[3] = _traverse_update(vs[2], vs[3], leaf_out, tree_dev,
+                                             self.meta_dev, depth_iters, k)
+            self.model.trees.append(tree)
+        self.iter += 1
+        if not should_continue:
+            Log.warning("Stopped training because there are no more leaves that meet the split requirements")
+        return not should_continue
+
+    def rollback_one_iter(self) -> None:
+        """RollbackOneIter (gbdt.cpp:484-500): drop the last iteration's trees
+        and subtract their contribution from every score vector by re-running
+        the bin-level traversal with negated leaf outputs."""
+        if self.iter <= 0:
+            return
+        K = self.num_tree_per_iteration
+        for k in reversed(range(K)):
+            tree = self.model.trees.pop()
+            if tree.num_leaves <= 1:
+                continue
+            tree_dev, neg_out = self._tree_to_device(tree, negate=True)
+            depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
+            self.score = _traverse_update(self.bins_dev, self.score, neg_out,
+                                          tree_dev, self.meta_dev, depth_iters, k)
+            for vs in self.valid_sets:
+                vs[3] = _traverse_update(vs[2], vs[3], neg_out, tree_dev,
+                                         self.meta_dev, depth_iters, k)
+        self.iter -= 1
+
+    def _tree_to_device(self, tree: Tree, negate: bool = False):
+        """Device arrays for bin-level traversal of a host tree (trees built
+        this run carry bin thresholds)."""
+        ni = max(tree.num_leaves - 1, 1)
+        tree_dev = {
+            "split_feature": jnp.asarray(tree.split_feature[:ni], jnp.int32),
+            "split_bin": jnp.asarray(tree.threshold_in_bin[:ni], jnp.int32),
+            "default_left": jnp.asarray((tree.decision_type[:ni] & 2) != 0),
+            "left_child": jnp.asarray(tree.left_child[:ni], jnp.int32),
+            "right_child": jnp.asarray(tree.right_child[:ni], jnp.int32),
+        }
+        lv = tree.leaf_value[: max(tree.num_leaves, 1)].astype(np.float32)
+        leaf_out = jnp.asarray(-lv if negate else lv)
+        return tree_dev, leaf_out
+
+    # -- internals -----------------------------------------------------------
+    def _gradients(self):
+        if self._grad_fn is None:
+            obj = self.objective
+
+            def gradfn(score, label, weight):
+                grad, hess = obj.get_gradients(score[0], label, weight)
+                return grad[None, :], hess[None, :]
+
+            self._grad_fn = jax.jit(gradfn)
+        return self._grad_fn(self.score, self.label_dev, self.weight_dev)
+
+    def _boost_from_average(self) -> float:
+        if self._boosted_from_average or self.model.current_iteration > 0 \
+                or self.train_set.metadata.init_score is not None \
+                or self.num_class > 1 or self.objective is None:
+            return 0.0
+        self._boosted_from_average = True
+        if not bool(self.config.boost_from_average):
+            return 0.0
+        init = self.objective.boost_from_score()
+        if abs(init) > K_EPSILON:
+            self.score = self.score + jnp.float32(init)
+            for vs in self.valid_sets:
+                vs[3] = vs[3] + jnp.float32(init)
+            Log.info("Start training from score %f", init)
+            self.init_score_value = init
+            return init
+        return 0.0
+
+    def _bagging(self) -> jax.Array:
+        cfg = self.config
+        n = self.train_set.num_data
+        if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
+            if self.iter % cfg.bagging_freq == 0:
+                bag_cnt = int(n * cfg.bagging_fraction)
+                idx = self.bagging_rng.sample(n, bag_cnt)
+                mask = np.zeros(self.train_set.num_data_padded, dtype=np.float32)
+                mask[idx] = 1.0
+                self.bag_mask_host = mask
+        return jnp.asarray(self.bag_mask_host)
+
+    def _feature_sample(self) -> jax.Array:
+        cfg = self.config
+        f = self.train_set.num_features
+        mask = np.zeros(f, dtype=bool)
+        if cfg.feature_fraction < 1.0:
+            used = max(1, int(f * cfg.feature_fraction))
+            mask[self.feature_rng.sample(f, used)] = True
+        else:
+            mask[:] = True
+        return jnp.asarray(mask)
+
+    def _finish_tree(self, out: Dict, init_score: float):
+        """Fetch grower output, assemble the host Tree (reference numbering),
+        apply shrinkage and first-tree bias (gbdt.cpp:450-456)."""
+        host = jax.device_get({k: v for k, v in out.items() if k != "leaf_id"})
+        nl = int(host["num_leaves"])
+        L = self.grower_cfg.num_leaves
+        tree = Tree(max(L, 2))
+        tree.num_leaves = nl
+        lr = self.shrinkage_rate
+        leaf_value_dev_f = out["leaf_value"] * lr  # device outputs, shrunk, no bias
+
+        if nl > 1:
+            ni = nl - 1
+            ds = self.train_set
+            tree.split_feature[:ni] = host["split_feature"][:ni]
+            tree.threshold_in_bin[:ni] = host["split_bin"][:ni]
+            tree.threshold[:ni] = [ds.real_threshold(int(f), int(b))
+                                   for f, b in zip(host["split_feature"][:ni],
+                                                   host["split_bin"][:ni])]
+            tree.split_gain[:ni] = host["split_gain"][:ni]
+            dt = np.zeros(ni, dtype=np.int8)
+            dt |= (host["default_left"][:ni].astype(np.int8) << 1)
+            miss = np.asarray([ds.bin_mappers[int(f)].missing_type
+                               for f in host["split_feature"][:ni]], dtype=np.int8)
+            dt |= (miss << 2)
+            tree.decision_type[:ni] = dt
+            tree.left_child[:ni] = host["left_child"][:ni]
+            tree.right_child[:ni] = host["right_child"][:ni]
+            tree.internal_value[:ni] = host["internal_value"][:ni] * lr
+            tree.internal_count[:ni] = host["internal_count"][:ni].astype(np.int64)
+            tree.leaf_value[:nl] = host["leaf_value"][:nl].astype(np.float64) * lr
+            tree.leaf_count[:nl] = host["leaf_count"][:nl].astype(np.int64)
+            tree.leaf_parent[:] = -1
+            for node in range(ni):
+                for child in (tree.left_child[node], tree.right_child[node]):
+                    if child < 0:
+                        tree.leaf_parent[~child] = node
+            tree.shrinkage = lr
+            if abs(init_score) > K_EPSILON:
+                tree.leaf_value[:nl] += init_score
+                tree.shrinkage = 1.0
+        else:
+            tree.leaf_value[0] = float(host["leaf_value"][0]) * lr + init_score
+            tree.shrinkage = 1.0
+
+        tree_dev = {
+            "split_feature": out["split_feature"],
+            "split_bin": out["split_bin"],
+            "default_left": out["default_left"],
+            "left_child": out["left_child"],
+            "right_child": out["right_child"],
+        }
+        return tree, tree_dev, leaf_value_dev_f
+
+    # -- evaluation ----------------------------------------------------------
+    def raw_train_score(self) -> np.ndarray:
+        return jax.device_get(self.score)[:, : self.train_set.num_data]
+
+    def raw_valid_score(self, i: int) -> np.ndarray:
+        name, valid, _, score_v, _ = self.valid_sets[i]
+        return jax.device_get(score_v)[:, : valid.num_data]
+
+    def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        raw = self.raw_train_score()[0]
+        return [("training", m.name, m.eval(raw, self.objective), m.is_higher_better)
+                for m in self.train_metrics]
+
+    def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        for i, (name, valid, _, _, metrics) in enumerate(self.valid_sets):
+            raw = self.raw_valid_score(i)[0]
+            for m in metrics:
+                out.append((name, m.name, m.eval(raw, self.objective), m.is_higher_better))
+        return out

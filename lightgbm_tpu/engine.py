@@ -1,0 +1,162 @@
+"""train() / cv() drivers.
+
+Role parity with the reference python-package/lightgbm/engine.py
+(train at :18-316, cv at :317+): callback environment, early stopping via
+exception, evaluation-result bookkeeping and best_iteration.
+"""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .callback import CallbackEnv, EarlyStopException, log_evaluation
+from .utils.log import Log
+
+
+def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          fobj=None, feval=None, init_model=None,
+          keep_training_booster: bool = True,
+          callbacks: Optional[List] = None,
+          early_stopping_rounds: Optional[int] = None,
+          verbose_eval=True) -> Booster:
+    params = dict(params)
+    if fobj is not None:
+        params["objective"] = "none"
+    if init_model is not None:
+        raise NotImplementedError("continued training (init_model) lands with M2")
+
+    booster = Booster(params=params, train_set=train_set)
+    is_valid_contain_train = False
+    train_data_name = "training"
+    if valid_sets is not None:
+        for i, valid in enumerate(valid_sets):
+            name = valid_names[i] if valid_names else "valid_%d" % i
+            if valid is train_set:
+                is_valid_contain_train = True
+                train_data_name = name
+                continue
+            booster.add_valid(valid, name)
+
+    callbacks = list(callbacks) if callbacks else []
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        from .callback import early_stopping
+        callbacks.append(early_stopping(early_stopping_rounds, verbose=bool(verbose_eval)))
+    if verbose_eval is True:
+        callbacks.append(log_evaluation(1))
+    elif isinstance(verbose_eval, int) and verbose_eval > 0:
+        callbacks.append(log_evaluation(verbose_eval))
+    callbacks_before = [cb for cb in callbacks if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks if not getattr(cb, "before_iteration", False)]
+
+    evaluation_result_list: List = []
+    for i in range(num_boost_round):
+        env = CallbackEnv(model=booster, params=params, iteration=i,
+                          begin_iteration=0, end_iteration=num_boost_round,
+                          evaluation_result_list=None)
+        for cb in callbacks_before:
+            cb(env)
+        is_finished = booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if is_valid_contain_train:
+            evaluation_result_list.extend(
+                [(train_data_name, m, v, h) for (_, m, v, h) in booster.eval_train(feval)])
+        if booster._engine.valid_sets:
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        env = CallbackEnv(model=booster, params=params, iteration=i,
+                          begin_iteration=0, end_iteration=num_boost_round,
+                          evaluation_result_list=evaluation_result_list)
+        try:
+            for cb in callbacks_after:
+                cb(env)
+        except EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            evaluation_result_list = e.best_score
+            break
+        if is_finished:
+            Log.info("Finished training at iteration %d", i + 1)
+            break
+
+    booster.best_score = collections.defaultdict(dict)
+    for name, metric, value, _ in evaluation_result_list:
+        booster.best_score[name][metric] = value
+    return booster
+
+
+def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       early_stopping_rounds=None, seed: int = 0,
+       callbacks=None, eval_train_metric: bool = False) -> Dict[str, List[float]]:
+    """K-fold cross-validation (engine.py cv:317+)."""
+    train_set.construct()
+    n = train_set.num_data()
+    y = train_set.get_label()
+    rng = np.random.default_rng(seed)
+
+    if folds is None:
+        idx = np.arange(n)
+        if stratified and y is not None and len(np.unique(y)) <= max(2, int(params.get("num_class", 2))):
+            folds = []
+            pieces = [[] for _ in range(nfold)]
+            for cls in np.unique(y):
+                cls_idx = idx[y == cls]
+                if shuffle:
+                    rng.shuffle(cls_idx)
+                for k, part in enumerate(np.array_split(cls_idx, nfold)):
+                    pieces[k].append(part)
+            folds = [(np.setdiff1d(idx, np.concatenate(p)), np.concatenate(p))
+                     for p in pieces]
+        else:
+            if shuffle:
+                rng.shuffle(idx)
+            parts = np.array_split(idx, nfold)
+            folds = [(np.setdiff1d(np.arange(n), p), p) for p in parts]
+
+    boosters = []
+    for train_idx, test_idx in folds:
+        tr = train_set.subset(np.sort(train_idx))
+        te = tr.create_valid(_subset_matrix(train_set, np.sort(test_idx)),
+                             label=np.asarray(y)[np.sort(test_idx)])
+        bst = Booster(params=dict(params), train_set=tr)
+        bst.add_valid(te, "valid")
+        boosters.append(bst)
+
+    results = collections.defaultdict(list)
+    for i in range(num_boost_round):
+        all_evals = collections.defaultdict(list)
+        for bst in boosters:
+            bst.update(fobj=fobj)
+            for (name, metric, value, hib) in bst.eval_valid(feval):
+                all_evals[metric].append((value, hib))
+        stop = False
+        for metric, vals in all_evals.items():
+            mean = float(np.mean([v for v, _ in vals]))
+            std = float(np.std([v for v, _ in vals]))
+            results[metric + "-mean"].append(mean)
+            results[metric + "-stdv"].append(std)
+        if early_stopping_rounds and i >= early_stopping_rounds:
+            for metric, vals in all_evals.items():
+                hib = vals[0][1]
+                series = results[metric + "-mean"]
+                best_idx = int(np.argmax(series)) if hib else int(np.argmin(series))
+                if best_idx <= i - early_stopping_rounds:
+                    stop = True
+        if stop:
+            for key in results:
+                results[key] = results[key][: i + 1]
+            break
+    return dict(results)
+
+
+def _subset_matrix(ds: Dataset, idx: np.ndarray):
+    data = ds.data
+    if hasattr(data, "values"):
+        data = data.values
+    return np.asarray(data, dtype=np.float64)[idx]

@@ -1,0 +1,125 @@
+"""Metrics — role parity with src/metric/ (factory at metric.cpp:11-56).
+
+Host-side numpy implementations operating on raw scores; each returns
+(name, value, is_higher_better).  The full zoo (NDCG, MAP, ...) lands with M2.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.log import Log
+
+
+class Metric:
+    name = "metric"
+    is_higher_better = False
+
+    def __init__(self, config):
+        self.config = config
+
+    def init(self, label: np.ndarray, weight: Optional[np.ndarray],
+             query_boundaries: Optional[np.ndarray] = None) -> None:
+        if label is None:
+            Log.fatal("Label should not be None for metric evaluation")
+        self.label = np.asarray(label, dtype=np.float64)
+        self.weight = None if weight is None else np.asarray(weight, dtype=np.float64)
+        self.sum_weight = float(len(self.label)) if self.weight is None \
+            else float(np.sum(self.weight))
+
+    def _wmean(self, values: np.ndarray) -> float:
+        if self.weight is None:
+            return float(np.mean(values))
+        return float(np.sum(values * self.weight) / self.sum_weight)
+
+    def eval(self, raw_score: np.ndarray, objective) -> float:
+        raise NotImplementedError
+
+
+class L2Metric(Metric):
+    name = "l2"
+
+    def eval(self, raw_score, objective) -> float:
+        pred = objective.convert_output(raw_score) if objective is not None else raw_score
+        return self._wmean((self.label - pred) ** 2)
+
+
+class RMSEMetric(L2Metric):
+    name = "rmse"
+
+    def eval(self, raw_score, objective) -> float:
+        return float(np.sqrt(super().eval(raw_score, objective)))
+
+
+class L1Metric(Metric):
+    name = "l1"
+
+    def eval(self, raw_score, objective) -> float:
+        pred = objective.convert_output(raw_score) if objective is not None else raw_score
+        return self._wmean(np.abs(self.label - pred))
+
+
+class BinaryLoglossMetric(Metric):
+    name = "binary_logloss"
+
+    def eval(self, raw_score, objective) -> float:
+        # objective is None (custom fobj): score is already a probability
+        # (reference binary_metric.hpp Eval, objective==nullptr branch)
+        prob = objective.convert_output(raw_score) if objective is not None else raw_score
+        prob = np.clip(prob, 1e-15, 1.0 - 1e-15)
+        loss = -(self.label * np.log(prob) + (1.0 - self.label) * np.log(1.0 - prob))
+        return self._wmean(loss)
+
+
+class BinaryErrorMetric(Metric):
+    name = "binary_error"
+
+    def eval(self, raw_score, objective) -> float:
+        prob = objective.convert_output(raw_score) if objective is not None else raw_score
+        return self._wmean(((prob > 0.5) != (self.label > 0)).astype(np.float64))
+
+
+class AUCMetric(Metric):
+    name = "auc"
+    is_higher_better = True
+
+    def eval(self, raw_score, objective) -> float:
+        """Weighted ROC-AUC by rank accumulation, tie-aware
+        (src/metric/binary_metric.hpp AUCMetric semantics)."""
+        order = np.argsort(raw_score, kind="mergesort")
+        score = raw_score[order]
+        label = self.label[order]
+        w = np.ones_like(label) if self.weight is None else self.weight[order]
+        pos_w = np.where(label > 0, w, 0.0)
+        neg_w = np.where(label > 0, 0.0, w)
+        boundary = np.nonzero(np.diff(score))[0]
+        seg_id = np.zeros(len(score), dtype=np.int64)
+        seg_id[boundary + 1] = 1
+        seg_id = np.cumsum(seg_id)
+        nseg = int(seg_id[-1]) + 1 if len(score) else 0
+        pos_per = np.bincount(seg_id, weights=pos_w, minlength=nseg)
+        neg_per = np.bincount(seg_id, weights=neg_w, minlength=nseg)
+        cum_neg_before = np.concatenate([[0.0], np.cumsum(neg_per)[:-1]])
+        auc_sum = np.sum(pos_per * (cum_neg_before + 0.5 * neg_per))
+        total_pos = pos_per.sum()
+        total_neg = neg_per.sum()
+        if total_pos <= 0 or total_neg <= 0:
+            Log.warning("AUC undefined: data contains one class only")
+            return 1.0
+        return float(auc_sum / (total_pos * total_neg))
+
+
+_REGISTRY = {
+    "l1": L1Metric, "l2": L2Metric, "rmse": RMSEMetric,
+    "binary_logloss": BinaryLoglossMetric, "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric,
+}
+
+
+def create_metric(name: str, config) -> Optional[Metric]:
+    cls = _REGISTRY.get(name)
+    if cls is None:
+        Log.warning("Unknown metric type name: %s", name)
+        return None
+    return cls(config)

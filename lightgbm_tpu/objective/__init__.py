@@ -1,0 +1,34 @@
+"""Objective factory — reference src/objective/objective_function.cpp:10-47."""
+from __future__ import annotations
+
+from ..utils.log import Log
+from .base import ObjectiveFunction
+from .binary import BinaryLogloss
+from .regression import RegressionL2
+
+_REGISTRY = {
+    "regression": RegressionL2,
+    "binary": BinaryLogloss,
+}
+
+
+def create_objective(name: str, config) -> ObjectiveFunction:
+    if name in _REGISTRY:
+        return _REGISTRY[name](config)
+    if name == "none":
+        return None
+    Log.fatal("Unknown objective type name: %s", name)
+
+
+def create_objective_from_model_string(objective_str: str, config):
+    """Parse 'binary sigmoid:1'-style objective strings from model files."""
+    parts = objective_str.split()
+    name = parts[0] if parts else "regression"
+    for tok in parts[1:]:
+        if ":" in tok:
+            k, v = tok.split(":", 1)
+            try:
+                setattr(config, k, float(v))
+            except ValueError:
+                setattr(config, k, v)
+    return create_objective(name, config)

@@ -1,0 +1,63 @@
+"""Objective function interface.
+
+Role parity with the reference include/LightGBM/objective_function.h and the
+factory src/objective/objective_function.cpp:10-47.  Gradients/hessians are
+computed on-device by a jitted function of the raw score; host-side helpers
+provide init-score boosting and output transforms.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.log import Log
+
+
+class ObjectiveFunction:
+    name = "custom"
+    is_constant_hessian = False
+
+    def __init__(self, config):
+        self.config = config
+        self.num_class = getattr(config, "num_class", 1)
+        self.label: Optional[np.ndarray] = None
+        self.weight: Optional[np.ndarray] = None
+        self.num_data = 0
+
+    @property
+    def num_model_per_iteration(self) -> int:
+        return 1
+
+    def init(self, label: np.ndarray, weight: Optional[np.ndarray],
+             query_boundaries: Optional[np.ndarray] = None) -> None:
+        self.label = np.asarray(label, dtype=np.float64)
+        self.weight = None if weight is None else np.asarray(weight, dtype=np.float64)
+        self.num_data = len(self.label)
+        self.check_label()
+
+    def check_label(self) -> None:
+        pass
+
+    def get_gradients(self, score, label, weight):
+        """Device computation: (grad, hess) from raw scores. score/label/weight
+        are padded jnp arrays; weight is all-ones when unweighted."""
+        raise NotImplementedError
+
+    def boost_from_score(self) -> float:
+        """Initial raw score (BoostFromScore in the reference objectives)."""
+        return 0.0
+
+    def convert_output(self, raw: np.ndarray) -> np.ndarray:
+        return raw
+
+    def renew_tree_output_required(self) -> bool:
+        return False
+
+    def renew_tree_output(self, leaf_value, leaf_index_per_row, score, label, weight,
+                          leaf_count) -> np.ndarray:
+        return leaf_value
+
+    def to_string(self) -> str:
+        return self.name

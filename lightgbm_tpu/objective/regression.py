@@ -1,0 +1,44 @@
+"""Regression objectives: L2 first; the full family lands with M2.
+
+Role parity with the reference src/objective/regression_objective.hpp
+(RegressionL2loss at :15-100, BoostFromScore at :142).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import ObjectiveFunction
+
+
+class RegressionL2(ObjectiveFunction):
+    name = "regression"
+    is_constant_hessian = True  # when unweighted
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = bool(getattr(config, "reg_sqrt", False))
+
+    def init(self, label, weight, query_boundaries=None):
+        super().init(label, weight, query_boundaries)
+        if self.sqrt:
+            self.label = np.sign(label) * np.sqrt(np.abs(label))
+        self.is_constant_hessian = weight is None
+
+    def get_gradients(self, score, label, weight):
+        grad = ((score - label) * weight).astype(jnp.float32)
+        hess = weight.astype(jnp.float32)
+        return grad, hess
+
+    def boost_from_score(self) -> float:
+        if self.weight is not None:
+            return float(np.sum(self.label * self.weight) / np.sum(self.weight))
+        return float(np.mean(self.label))
+
+    def convert_output(self, raw: np.ndarray) -> np.ndarray:
+        if self.sqrt:
+            return np.sign(raw) * raw * raw
+        return raw
+
+    def to_string(self) -> str:
+        return "regression"

@@ -1,0 +1,86 @@
+"""Histogram construction — the hot kernel of histogram GBDT.
+
+Replaces the reference's CPU gather-accumulate (src/io/dense_bin.hpp:66-132
+DenseBin::ConstructHistogram) and the OpenCL kernels
+(src/treelearner/ocl/histogram{16,64,256}.cl) with a TPU-native formulation:
+
+    hist[f, b, :] = sum over rows i with bin(f, i) == b of [grad_i, hess_i, 1_i]
+
+expressed as a one-hot × values batched matmul so the reduction over rows runs
+on the MXU, chunked with `lax.scan` to bound the transient one-hot.  TPU has no
+fast random scatter-add; the one-hot contraction is the idiomatic mapping (the
+compare-and-broadcast producer fuses into the dot on TPU).
+
+A Pallas kernel specialization lives in pallas_histogram.py (selected via
+Config.tpu_histogram_impl) for the largest shapes.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_chunk"))
+def build_histogram(bins: jax.Array, vals: jax.Array, *, num_bins: int,
+                    row_chunk: int = 16384) -> jax.Array:
+    """hist[F, num_bins, 3] from bins[F, N] (integer) and vals[N, 3] float32.
+
+    Rows are masked by zeroing their vals (grad, hess, count-weight) — a row
+    with vals == 0 contributes nothing, which is how leaf masks, bagging and
+    padding are applied without changing this kernel.
+
+    Backend dispatch: on TPU the one-hot MXU contraction; elsewhere (CPU
+    tests, virtual-device meshes) an XLA scatter-add, which is fast on CPU
+    but would serialize on TPU.
+    """
+    F, N = bins.shape
+    assert vals.shape == (N, 3)
+    if jax.default_backend() != "tpu":
+        return _hist_scatter(bins, vals, num_bins)
+    if N <= row_chunk:
+        return _hist_one_chunk(bins, vals, num_bins)
+    assert N % row_chunk == 0, "caller pads N to a multiple of row_chunk"
+    nchunk = N // row_chunk
+    bins_c = bins.reshape(F, nchunk, row_chunk).transpose(1, 0, 2)
+    vals_c = vals.reshape(nchunk, row_chunk, 3)
+
+    def body(acc, xs):
+        b, v = xs
+        return acc + _hist_one_chunk(b, v, num_bins), None
+
+    acc0 = jnp.zeros((F, num_bins, 3), jnp.float32)
+    hist, _ = lax.scan(body, acc0, (bins_c, vals_c))
+    return hist
+
+
+def _hist_scatter(bins: jax.Array, vals: jax.Array, num_bins: int) -> jax.Array:
+    """Scatter-add formulation for CPU backends."""
+    F, N = bins.shape
+    idx = bins.astype(jnp.int32) + jnp.arange(F, dtype=jnp.int32)[:, None] * num_bins
+    updates = jnp.broadcast_to(vals[None, :, :], (F, N, 3)).reshape(-1, 3)
+    flat = jnp.zeros((F * num_bins, 3), jnp.float32)
+    flat = flat.at[idx.reshape(-1)].add(updates)
+    return flat.reshape(F, num_bins, 3)
+
+
+def _hist_one_chunk(bins: jax.Array, vals: jax.Array, num_bins: int) -> jax.Array:
+    """One-hot contraction over a row chunk: [F, C] × [C, 3] → [F, B, 3]."""
+    iota = lax.broadcasted_iota(jnp.int32, (1, 1, num_bins), 2)
+    onehot = (bins.astype(jnp.int32)[:, :, None] == iota).astype(jnp.float32)
+    # batch dim F; contract the row-chunk dim (MXU reduction) with vals
+    return jnp.einsum("fcb,cd->fbd", onehot, vals,
+                      preferred_element_type=jnp.float32)
+
+
+def subtract_histogram(parent: jax.Array, child: jax.Array) -> jax.Array:
+    """Sibling histogram via subtraction (reference FeatureHistogram::Subtract,
+    feature_histogram.hpp:68-74) — compute only the smaller child's histogram
+    and derive the other."""
+    return parent - child

@@ -19,14 +19,29 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 import pytest
 
+# persistent XLA compilation cache: repeated pytest runs skip recompiles
+import jax
+jax.config.update("jax_compilation_cache_dir", "/tmp/lgbtpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 REFERENCE_DIR = "/root/reference"
 GOLDEN_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                           ".golden")
+_NPY_CACHE = "/tmp/lgbtpu_data_cache"
 
 
 def load_svmlight_style(path):
-    """Load the reference example TSV files: first column label, rest features."""
-    data = np.loadtxt(path)
+    """Load the reference example TSV files: first column label, rest features.
+    Parsed arrays are cached as .npy keyed by path."""
+    os.makedirs(_NPY_CACHE, exist_ok=True)
+    import hashlib
+    key = hashlib.sha1(path.encode()).hexdigest()[:16] + ".npy"
+    cached = os.path.join(_NPY_CACHE, key)
+    if os.path.exists(cached) and os.path.getmtime(cached) >= os.path.getmtime(path):
+        data = np.load(cached)
+    else:
+        data = np.loadtxt(path)
+        np.save(cached, data)
     return data[:, 1:], data[:, 0]
 
 

@@ -1,0 +1,196 @@
+"""End-to-end metric-threshold training tests.
+
+Mirrors the reference test strategy (tests/python_package_test/test_engine.py:
+train N iterations, assert the final metric clears a threshold; SURVEY.md §4).
+Thresholds carry margin over observed values and over the reference CLI's own
+results on the same data/params.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def train_binary(binary_data, params=None, rounds=15, with_valid=True):
+    X, y, Xt, yt = binary_data
+    p = {"objective": "binary", "metric": "binary_logloss,auc",
+         "num_leaves": 31, "learning_rate": 0.1, "verbose": -1}
+    if params:
+        p.update(params)
+    train = lgb.Dataset(X, label=y)
+    valid = [lgb.Dataset(Xt, label=yt, reference=train)] if with_valid else None
+    evals = {}
+    bst = lgb.train(p, train, num_boost_round=rounds, valid_sets=valid,
+                    callbacks=[lgb.record_evaluation(evals)], verbose_eval=0)
+    return bst, evals
+
+
+def test_binary(binary_data):
+    # reference CLI @30 iters on this data: valid logloss ~0.536, auc ~0.82
+    bst, evals = train_binary(binary_data)
+    logloss = evals["valid_0"]["binary_logloss"][-1]
+    auc = evals["valid_0"]["auc"][-1]
+    assert logloss < 0.60
+    assert auc > 0.79
+
+
+def test_regression(regression_data):
+    X, y, Xt, yt = regression_data
+    train = lgb.Dataset(X, label=y)
+    valid = lgb.Dataset(Xt, label=yt, reference=train)
+    evals = {}
+    lgb.train({"objective": "regression", "metric": "l2", "verbose": -1},
+              train, num_boost_round=15, valid_sets=[valid],
+              callbacks=[lgb.record_evaluation(evals)], verbose_eval=0)
+    # reference CLI @50 gets 0.1736; @30 ~0.178
+    assert evals["valid_0"]["l2"][-1] < 0.22
+    assert evals["valid_0"]["l2"][-1] < evals["valid_0"]["l2"][0]
+
+
+def test_predict_matches_training_scores(binary_data):
+    """Model predictions on the training matrix must equal the accumulated
+    training scores (score updater vs saved model consistency)."""
+    X, y, _, _ = binary_data
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "verbose": -1}, train,
+                    num_boost_round=10, verbose_eval=0)
+    raw_scores = bst._engine.raw_train_score()[0]
+    pred = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(pred, raw_scores, rtol=1e-4, atol=1e-5)
+
+
+def test_model_string_roundtrip(binary_data):
+    X, y, Xt, _ = binary_data
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "verbose": -1}, train,
+                    num_boost_round=10, verbose_eval=0)
+    text = bst.model_to_string()
+    bst2 = lgb.Booster(model_str=text)
+    np.testing.assert_allclose(bst.predict(Xt), bst2.predict(Xt), atol=1e-12)
+
+
+@pytest.mark.skipif(not os.path.exists("/root/repo/.refbuild/lightgbm"),
+                    reason="reference CLI not built")
+def test_reference_cli_loads_our_trained_model(binary_data, tmp_path):
+    import subprocess
+    X, y, Xt, _ = binary_data
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "verbose": -1}, train,
+                    num_boost_round=10, verbose_eval=0)
+    model_path = tmp_path / "model.txt"
+    out_path = tmp_path / "pred.txt"
+    bst.save_model(str(model_path))
+    subprocess.run(["/root/repo/.refbuild/lightgbm", "task=predict",
+                    "data=/root/reference/examples/binary_classification/binary.test",
+                    "input_model=%s" % model_path, "output_result=%s" % out_path],
+                   check=True, capture_output=True)
+    ref_pred = np.loadtxt(out_path)
+    np.testing.assert_allclose(bst.predict(Xt), ref_pred, atol=1e-10)
+
+
+def test_early_stopping(binary_data):
+    X, y, Xt, yt = binary_data
+    train = lgb.Dataset(X, label=y)
+    valid = lgb.Dataset(Xt, label=yt, reference=train)
+    bst = lgb.train({"objective": "binary", "metric": "binary_logloss", "verbose": -1},
+                    train, num_boost_round=500, valid_sets=[valid],
+                    early_stopping_rounds=3, verbose_eval=0)
+    assert bst.best_iteration > 0
+    assert bst.num_trees() < 500
+
+
+def test_bagging_and_feature_fraction(binary_data):
+    bst, evals = train_binary(binary_data, params={
+        "bagging_fraction": 0.7, "bagging_freq": 1, "feature_fraction": 0.8},
+        rounds=15)
+    assert evals["valid_0"]["auc"][-1] > 0.78
+
+
+def test_custom_objective(binary_data):
+    X, y, Xt, yt = binary_data
+
+    def logloss_obj(raw, dataset):
+        label = dataset.get_label()
+        prob = 1.0 / (1.0 + np.exp(-raw))
+        return prob - label, prob * (1.0 - prob)
+
+    train = lgb.Dataset(X, label=y)
+    valid = lgb.Dataset(Xt, label=yt, reference=train)
+    evals = {}
+    lgb.train({"objective": "none", "metric": "auc", "verbose": -1}, train,
+              num_boost_round=15, valid_sets=[valid], fobj=logloss_obj,
+              callbacks=[lgb.record_evaluation(evals)], verbose_eval=0)
+    assert evals["valid_0"]["auc"][-1] > 0.78
+
+
+def test_weighted_training(binary_data):
+    X, y, Xt, yt = binary_data
+    w = np.where(y > 0, 2.0, 1.0)
+    train = lgb.Dataset(X, label=y, weight=w)
+    bst = lgb.train({"objective": "binary", "verbose": -1}, train,
+                    num_boost_round=10, verbose_eval=0)
+    pred = bst.predict(Xt)
+    # upweighting positives must raise the average predicted probability
+    train0 = lgb.Dataset(X, label=y)
+    bst0 = lgb.train({"objective": "binary", "verbose": -1}, train0,
+                     num_boost_round=10, verbose_eval=0)
+    assert pred.mean() > bst0.predict(Xt).mean()
+
+
+def test_missing_values(binary_data):
+    X, y, Xt, yt = binary_data
+    rng = np.random.default_rng(0)
+    Xm = X.copy()
+    Xm[rng.random(Xm.shape) < 0.1] = np.nan
+    train = lgb.Dataset(Xm, label=y)
+    bst = lgb.train({"objective": "binary", "verbose": -1}, train,
+                    num_boost_round=10, verbose_eval=0)
+    Xt_nan = Xt.copy()
+    Xt_nan[rng.random(Xt_nan.shape) < 0.1] = np.nan
+    pred = bst.predict(Xt_nan)
+    assert np.all(np.isfinite(pred))
+    from lightgbm_tpu.metric import AUCMetric
+    m = AUCMetric(None)
+    m.init(yt, None)
+    assert m.eval(bst.predict(Xt_nan, raw_score=True), None) > 0.75
+
+
+def test_min_data_in_leaf_respected(binary_data):
+    X, y, _, _ = binary_data
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "min_data_in_leaf": 200, "verbose": -1},
+                    train, num_boost_round=5, verbose_eval=0)
+    for tree in bst._model.trees:
+        counts = tree.leaf_count[: tree.num_leaves]
+        assert counts.min() >= 200
+
+
+def test_max_depth(binary_data):
+    X, y, _, _ = binary_data
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "max_depth": 3, "num_leaves": 31,
+                     "verbose": -1}, train, num_boost_round=5, verbose_eval=0)
+    dump = bst.dump_model()
+
+    def depth(node, d=0):
+        if "leaf_value" in node and "left_child" not in node:
+            return d
+        return max(depth(node["left_child"], d + 1), depth(node["right_child"], d + 1))
+
+    for info in dump["tree_info"]:
+        assert depth(info["tree_structure"]) <= 3
+
+
+def test_rollback_one_iter(binary_data):
+    X, y, _, _ = binary_data
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.Booster({"objective": "binary", "verbose": -1}, train)
+    for _ in range(3):
+        bst.update()
+    score3 = bst._engine.raw_train_score().copy()
+    bst.update()
+    bst.rollback_one_iter()
+    np.testing.assert_allclose(bst._engine.raw_train_score(), score3, atol=1e-6)
+    assert bst.num_trees() == 3
