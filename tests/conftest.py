@@ -8,7 +8,6 @@ cluster).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -23,11 +22,10 @@ import pytest
 import jax
 jax.config.update("jax_compilation_cache_dir", "/tmp/lgbtpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-# the axon TPU plugin ignores the JAX_PLATFORMS env var; force CPU via config
-# so tests run on the 8-device virtual host mesh.  An explicit env override
-# (e.g. JAX_PLATFORMS=tpu) still wins, to allow running the suite on hardware.
-if "JAX_PLATFORMS" not in os.environ or os.environ["JAX_PLATFORMS"] == "cpu":
-    jax.config.update("jax_platforms", "cpu")
+# The session environment pins JAX_PLATFORMS=axon (the TPU tunnel), so tests
+# force the 8-device virtual CPU mesh via jax.config.  Set
+# LGBTPU_TEST_PLATFORM=tpu (or axon) to run the suite on real hardware.
+jax.config.update("jax_platforms", os.environ.get("LGBTPU_TEST_PLATFORM", "cpu"))
 
 REFERENCE_DIR = "/root/reference"
 GOLDEN_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
