@@ -212,11 +212,15 @@ class GBDT:
         bag_mask = self._bagging()
         fmask = self._feature_sample()
 
+        renew = self.objective is not None and self.objective.renew_tree_output_required()
         should_continue = False
         for k in range(self.num_tree_per_iteration):
             vals = _make_vals(grads, hesss, bag_mask, k)
             out = self.grower(self.bins_dev, vals, fmask)
-            tree, tree_dev, leaf_out = self._finish_tree(out, init_score)
+            renewed = None
+            if renew:
+                renewed = self._renew_leaf_values(out, k)
+            tree, tree_dev, leaf_out = self._finish_tree(out, init_score, renewed)
             if tree.num_leaves > 1:
                 should_continue = True
                 self.score = _update_score_k(self.score, out["leaf_id"], leaf_out, k)
@@ -320,7 +324,22 @@ class GBDT:
             mask[:] = True
         return jnp.asarray(mask)
 
-    def _finish_tree(self, out: Dict, init_score: float):
+    def _renew_leaf_values(self, out: Dict, k: int) -> Optional[np.ndarray]:
+        """RenewTreeOutput wiring (gbdt.cpp:441-448 →
+        serial_tree_learner.cpp:780-818): replace leaf outputs with the
+        objective's robust statistic (e.g. L1 median of residuals) computed
+        over the bagged rows of each leaf, before shrinkage."""
+        nl = int(jax.device_get(out["num_leaves"]))
+        if nl <= 1:
+            return None
+        leaf_id = np.asarray(jax.device_get(out["leaf_id"]))
+        pred_k = np.asarray(jax.device_get(self.score[k]), dtype=np.float64)
+        lv = np.asarray(jax.device_get(out["leaf_value"]), dtype=np.float64)
+        in_bag = self.bag_mask_host > 0
+        return self.objective.renew_leaf_values(lv[:nl], leaf_id, pred_k, in_bag)
+
+    def _finish_tree(self, out: Dict, init_score: float,
+                     renewed: Optional[np.ndarray] = None):
         """Fetch grower output, assemble the host Tree (reference numbering),
         apply shrinkage and first-tree bias (gbdt.cpp:450-456)."""
         host = jax.device_get({k: v for k, v in out.items() if k != "leaf_id"})
@@ -329,7 +348,13 @@ class GBDT:
         tree = Tree(max(L, 2))
         tree.num_leaves = nl
         lr = self.shrinkage_rate
-        leaf_value_dev_f = out["leaf_value"] * lr  # device outputs, shrunk, no bias
+        if renewed is not None:
+            host["leaf_value"] = host["leaf_value"].copy()
+            host["leaf_value"][: len(renewed)] = renewed
+            leaf_value_dev_f = jnp.asarray(
+                (host["leaf_value"] * lr).astype(np.float32))
+        else:
+            leaf_value_dev_f = out["leaf_value"] * lr  # device outputs, shrunk, no bias
 
         if nl > 1:
             ni = nl - 1

@@ -60,6 +60,97 @@ class L1Metric(Metric):
         return self._wmean(np.abs(self.label - pred))
 
 
+class QuantileMetric(Metric):
+    """Pinball loss (regression_metric.hpp:141-158)."""
+    name = "quantile"
+
+    def eval(self, raw_score, objective) -> float:
+        pred = objective.convert_output(raw_score) if objective is not None else raw_score
+        alpha = float(getattr(self.config, "alpha", 0.9))
+        delta = self.label - pred
+        return self._wmean(np.where(delta < 0, (alpha - 1.0) * delta, alpha * delta))
+
+
+class HuberMetric(Metric):
+    """Huber loss (regression_metric.hpp:175-192)."""
+    name = "huber"
+
+    def eval(self, raw_score, objective) -> float:
+        pred = objective.convert_output(raw_score) if objective is not None else raw_score
+        a = float(getattr(self.config, "alpha", 0.9))
+        diff = pred - self.label
+        loss = np.where(np.abs(diff) <= a, 0.5 * diff * diff,
+                        a * (np.abs(diff) - 0.5 * a))
+        return self._wmean(loss)
+
+
+class FairMetric(Metric):
+    """Fair loss (regression_metric.hpp:196-210)."""
+    name = "fair"
+
+    def eval(self, raw_score, objective) -> float:
+        pred = objective.convert_output(raw_score) if objective is not None else raw_score
+        c = float(getattr(self.config, "fair_c", 1.0))
+        x = np.abs(pred - self.label)
+        return self._wmean(c * x - c * c * np.log(1.0 + x / c))
+
+
+class PoissonMetric(Metric):
+    """Poisson negative log-likelihood (regression_metric.hpp:213-228)."""
+    name = "poisson"
+
+    def eval(self, raw_score, objective) -> float:
+        pred = objective.convert_output(raw_score) if objective is not None else raw_score
+        pred = np.maximum(pred, 1e-10)
+        return self._wmean(pred - self.label * np.log(pred))
+
+
+class MAPEMetric(Metric):
+    """MAPE with |label| clamped to >= 1 (regression_metric.hpp:232-243)."""
+    name = "mape"
+
+    def eval(self, raw_score, objective) -> float:
+        pred = objective.convert_output(raw_score) if objective is not None else raw_score
+        return self._wmean(np.abs(self.label - pred) / np.maximum(1.0, np.abs(self.label)))
+
+
+class GammaMetric(Metric):
+    """Gamma negative log-likelihood with psi=1 (regression_metric.hpp:245-261);
+    at psi=1 the reference formula reduces to label/pred + log(pred)."""
+    name = "gamma"
+
+    def eval(self, raw_score, objective) -> float:
+        pred = objective.convert_output(raw_score) if objective is not None else raw_score
+        return self._wmean(self.label / pred + np.log(pred))
+
+
+class GammaDevianceMetric(Metric):
+    """2 * sum(label/pred - log(label/pred) - 1); a sum, not a weighted mean
+    (regression_metric.hpp:264-279, AverageLoss override)."""
+    name = "gamma-deviance"
+
+    def eval(self, raw_score, objective) -> float:
+        pred = objective.convert_output(raw_score) if objective is not None else raw_score
+        tmp = self.label / (pred + 1e-9)
+        loss = tmp - np.log(tmp) - 1.0
+        if self.weight is not None:
+            loss = loss * self.weight
+        return float(2.0 * np.sum(loss))
+
+
+class TweedieMetric(Metric):
+    """Tweedie deviance-like loss (regression_metric.hpp:282-299)."""
+    name = "tweedie"
+
+    def eval(self, raw_score, objective) -> float:
+        pred = objective.convert_output(raw_score) if objective is not None else raw_score
+        rho = float(getattr(self.config, "tweedie_variance_power", 1.5))
+        pred = np.maximum(pred, 1e-10)
+        a = self.label * np.exp((1.0 - rho) * np.log(pred)) / (1.0 - rho)
+        b = np.exp((2.0 - rho) * np.log(pred)) / (2.0 - rho)
+        return self._wmean(-a + b)
+
+
 class BinaryLoglossMetric(Metric):
     name = "binary_logloss"
 
@@ -112,6 +203,10 @@ class AUCMetric(Metric):
 
 _REGISTRY = {
     "l1": L1Metric, "l2": L2Metric, "rmse": RMSEMetric,
+    "quantile": QuantileMetric, "huber": HuberMetric, "fair": FairMetric,
+    "poisson": PoissonMetric, "mape": MAPEMetric,
+    "gamma": GammaMetric, "gamma_deviance": GammaDevianceMetric,
+    "tweedie": TweedieMetric,
     "binary_logloss": BinaryLoglossMetric, "binary_error": BinaryErrorMetric,
     "auc": AUCMetric,
 }

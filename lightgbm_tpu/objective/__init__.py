@@ -4,10 +4,21 @@ from __future__ import annotations
 from ..utils.log import Log
 from .base import ObjectiveFunction
 from .binary import BinaryLogloss
-from .regression import RegressionL2
+from .regression import (RegressionFair, RegressionGamma, RegressionHuber,
+                         RegressionL1, RegressionL2, RegressionMAPE,
+                         RegressionPoisson, RegressionQuantile,
+                         RegressionTweedie)
 
 _REGISTRY = {
     "regression": RegressionL2,
+    "regression_l1": RegressionL1,
+    "huber": RegressionHuber,
+    "fair": RegressionFair,
+    "poisson": RegressionPoisson,
+    "quantile": RegressionQuantile,
+    "mape": RegressionMAPE,
+    "gamma": RegressionGamma,
+    "tweedie": RegressionTweedie,
     "binary": BinaryLogloss,
 }
 

@@ -53,11 +53,16 @@ class ObjectiveFunction:
         return raw
 
     def renew_tree_output_required(self) -> bool:
+        """IsRenewTreeOutput (objective_function.h): objectives that replace
+        leaf outputs with a robust statistic after the tree is grown."""
         return False
 
-    def renew_tree_output(self, leaf_value, leaf_index_per_row, score, label, weight,
-                          leaf_count) -> np.ndarray:
-        return leaf_value
+    def renew_leaf_values(self, leaf_values: np.ndarray, leaf_ids: np.ndarray,
+                          pred: np.ndarray, in_bag: np.ndarray) -> np.ndarray:
+        """RenewTreeOutput: leaf_values [L] (unshrunk), leaf_ids [N_pad] row →
+        leaf assignment, pred [N_pad] raw scores before this tree, in_bag [N_pad]
+        bagging mask.  Returns renewed leaf values."""
+        return leaf_values
 
     def to_string(self) -> str:
         return self.name

@@ -1,0 +1,139 @@
+"""Objective-family tests, reference test style: train N iterations, assert the
+final metric clears a threshold (tests/python_package_test/test_engine.py in
+the reference: test_regression_l1 style metric-threshold checks)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _fit_eval(params, X, y, Xt, yt, rounds=25):
+    train = lgb.Dataset(X, label=y)
+    valid = lgb.Dataset(Xt, label=yt, reference=train)
+    evals = {}
+    bst = lgb.train(dict(params, verbose=-1), train, num_boost_round=rounds,
+                    valid_sets=[valid], callbacks=[lgb.record_evaluation(evals)],
+                    verbose_eval=0)
+    return bst, evals["valid_0"]
+
+
+@pytest.fixture(scope="module")
+def counts_data():
+    """Poisson-style count targets with a log-linear signal."""
+    rng = np.random.default_rng(7)
+    n, f = 2000, 10
+    X = rng.normal(size=(n, f))
+    rate = np.exp(0.4 * X[:, 0] - 0.3 * X[:, 1] + 0.1)
+    y = rng.poisson(rate).astype(np.float64)
+    return X[:1500], y[:1500], X[1500:], y[1500:]
+
+
+def test_regression_l1(regression_data):
+    X, y, Xt, yt = regression_data
+    bst, ev = _fit_eval({"objective": "regression_l1", "metric": "l1"},
+                        X, y, Xt, yt, rounds=25)
+    assert ev["l1"][-1] < ev["l1"][0]
+    assert ev["l1"][-1] < 0.37  # reference l1 on this data plateaus ~0.33-0.35
+    # leaf renewal keeps leaf outputs at residual medians -> preds bounded sanely
+    pred = bst.predict(Xt)
+    assert np.all(np.isfinite(pred))
+
+
+def test_huber(regression_data):
+    X, y, Xt, yt = regression_data
+    _, ev = _fit_eval({"objective": "huber", "metric": "huber", "alpha": 0.5},
+                      X, y, Xt, yt)
+    assert ev["huber"][-1] < ev["huber"][0]
+
+
+def test_fair(regression_data):
+    X, y, Xt, yt = regression_data
+    _, ev = _fit_eval({"objective": "fair", "metric": "fair"}, X, y, Xt, yt)
+    assert ev["fair"][-1] < ev["fair"][0]
+
+
+def test_poisson(counts_data):
+    X, y, Xt, yt = counts_data
+    bst, ev = _fit_eval({"objective": "poisson", "metric": "poisson",
+                         "min_data_in_leaf": 50}, X, y, Xt, yt)
+    assert ev["poisson"][-1] < ev["poisson"][0]
+    pred = bst.predict(Xt)
+    assert np.all(pred > 0)  # exp output
+    # predictions should correlate with the true rate signal
+    assert np.corrcoef(pred, np.exp(0.4 * Xt[:, 0] - 0.3 * Xt[:, 1]))[0, 1] > 0.7
+
+
+def test_quantile():
+    # continuous heteroscedastic targets (the reference regression example's
+    # labels are binary, which degenerates low quantiles to 0)
+    rng = np.random.default_rng(11)
+    n = 3000
+    X = rng.normal(size=(n, 8))
+    y = 2.0 * X[:, 0] + rng.normal(scale=1.0 + 0.5 * np.abs(X[:, 1]), size=n)
+    Xt, yt = X[2200:], y[2200:]
+    X, y = X[:2200], y[:2200]
+    for alpha, lo, hi in ((0.1, 0.03, 0.25), (0.9, 0.75, 0.97)):
+        bst, ev = _fit_eval({"objective": "quantile", "alpha": alpha,
+                             "metric": "quantile", "min_data_in_leaf": 40},
+                            X, y, Xt, yt, rounds=40)
+        assert ev["quantile"][-1] < ev["quantile"][0]
+        cover = float(np.mean(yt <= bst.predict(Xt)))
+        assert lo < cover < hi, "alpha=%s coverage=%s" % (alpha, cover)
+
+
+def test_mape(regression_data):
+    X, y, Xt, yt = regression_data
+    # shift labels away from 0 so MAPE weighting is meaningful
+    _, ev = _fit_eval({"objective": "mape", "metric": "mape"},
+                      X, y + 5.0, Xt, yt + 5.0)
+    assert ev["mape"][-1] < ev["mape"][0]
+
+
+def test_gamma(counts_data):
+    X, y, Xt, yt = counts_data
+    yg = y + 0.5  # gamma needs positive targets
+    _, ev = _fit_eval({"objective": "gamma", "metric": "gamma,gamma_deviance",
+                       "min_data_in_leaf": 50}, X, yg, Xt, yt + 0.5)
+    assert ev["gamma"][-1] < ev["gamma"][0]
+    assert ev["gamma-deviance"][-1] < ev["gamma-deviance"][0]
+
+
+def test_tweedie(counts_data):
+    X, y, Xt, yt = counts_data
+    _, ev = _fit_eval({"objective": "tweedie", "metric": "tweedie",
+                       "min_data_in_leaf": 50}, X, y + 0.1, Xt, yt + 0.1)
+    assert ev["tweedie"][-1] < ev["tweedie"][0]
+
+
+def test_reg_sqrt(regression_data):
+    X, y, Xt, yt = regression_data
+    yy = y * 4.0
+    bst, ev = _fit_eval({"objective": "regression", "reg_sqrt": True,
+                         "metric": "l2"}, X, yy, Xt, yt * 4.0)
+    assert ev["l2"][-1] < ev["l2"][0]
+    # ConvertOutput squares: predictions on the original label scale
+    assert abs(np.mean(bst.predict(Xt)) - np.mean(yt * 4.0)) < 1.0
+
+
+def test_objective_aliases():
+    cfg = lgb.Config({"objective": "mae"})
+    assert cfg.objective == "regression_l1"
+    cfg = lgb.Config({"objective": "mse"})
+    assert cfg.objective == "regression"
+    cfg = lgb.Config({"objective": "mean_absolute_percentage_error"})
+    assert cfg.objective == "mape"
+
+
+def test_percentile_matches_numpy_median():
+    from lightgbm_tpu.objective.regression import percentile, weighted_percentile
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=101)
+    # the reference interpolates between adjacent descending ranks, so it is
+    # within one order-statistic gap of the numpy median, not identical
+    a = np.sort(data)
+    assert a[49] <= percentile(data, 0.5) <= a[52]
+    w = np.ones_like(data)
+    assert a[49] <= weighted_percentile(data, w, 0.5) <= a[52]
+    # extremes: alpha near 1 -> max side, alpha near 0 -> min side
+    assert percentile(data, 0.999) == a[-1]
+    assert a[0] <= percentile(data, 0.001) <= a[1]
