@@ -277,8 +277,7 @@ class GBDT:
             obj = self.objective
 
             def gradfn(score, label, weight):
-                grad, hess = obj.get_gradients(score[0], label, weight)
-                return grad[None, :], hess[None, :]
+                return obj.get_gradients_multi(score, label, weight)
 
             self._grad_fn = jax.jit(gradfn)
         return self._grad_fn(self.score, self.label_dev, self.weight_dev)
@@ -407,15 +406,23 @@ class GBDT:
         name, valid, _, score_v, _ = self.valid_sets[i]
         return jax.device_get(score_v)[:, : valid.num_data]
 
+    @staticmethod
+    def _metric_input(raw: np.ndarray, m) -> np.ndarray:
+        """Metrics see the 1D score plane, except multiclass metrics which
+        consume the full [K, N] matrix (multiclass_metric.hpp Eval)."""
+        return raw if getattr(m, "multiclass", False) else raw[0]
+
     def eval_train(self) -> List[Tuple[str, str, float, bool]]:
-        raw = self.raw_train_score()[0]
-        return [("training", m.name, m.eval(raw, self.objective), m.is_higher_better)
+        raw = self.raw_train_score()
+        return [("training", m.name, m.eval(self._metric_input(raw, m), self.objective),
+                 m.is_higher_better)
                 for m in self.train_metrics]
 
     def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
         out = []
         for i, (name, valid, _, _, metrics) in enumerate(self.valid_sets):
-            raw = self.raw_valid_score(i)[0]
+            raw = self.raw_valid_score(i)
             for m in metrics:
-                out.append((name, m.name, m.eval(raw, self.objective), m.is_higher_better))
+                out.append((name, m.name, m.eval(self._metric_input(raw, m), self.objective),
+                            m.is_higher_better))
         return out

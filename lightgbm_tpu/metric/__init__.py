@@ -15,6 +15,7 @@ from ..utils.log import Log
 class Metric:
     name = "metric"
     is_higher_better = False
+    multiclass = False  # True -> eval() receives the full [K, N] score matrix
 
     def __init__(self, config):
         self.config = config
@@ -201,8 +202,42 @@ class AUCMetric(Metric):
         return float(auc_sum / (total_pos * total_neg))
 
 
+class MultiLoglossMetric(Metric):
+    """Softmax logloss over [K, N] raw scores (multiclass_metric.hpp
+    MultiSoftmaxLoglossMetric)."""
+    name = "multi_logloss"
+    multiclass = True
+
+    def eval(self, raw_score, objective) -> float:
+        # raw_score [K, N] -> probabilities [N, K] via the objective transform
+        raw = np.asarray(raw_score, dtype=np.float64).T
+        prob = objective.convert_output(raw) if objective is not None else raw
+        k = self.label.astype(np.int64)
+        p = prob[np.arange(len(k)), k]
+        return self._wmean(-np.log(np.maximum(p, 1e-15)))
+
+
+class MultiErrorMetric(Metric):
+    """Top-1 error with the reference's tie rule: any other class with
+    score >= the true class counts as an error (multiclass_metric.hpp
+    MultiErrorMetric)."""
+    name = "multi_error"
+    multiclass = True
+
+    def eval(self, raw_score, objective) -> float:
+        raw = np.asarray(raw_score, dtype=np.float64).T
+        prob = objective.convert_output(raw) if objective is not None else raw
+        k = self.label.astype(np.int64)
+        true_p = prob[np.arange(len(k)), k]
+        others = prob.copy()
+        others[np.arange(len(k)), k] = -np.inf
+        err = (np.max(others, axis=1) >= true_p).astype(np.float64)
+        return self._wmean(err)
+
+
 _REGISTRY = {
     "l1": L1Metric, "l2": L2Metric, "rmse": RMSEMetric,
+    "multi_logloss": MultiLoglossMetric, "multi_error": MultiErrorMetric,
     "quantile": QuantileMetric, "huber": HuberMetric, "fair": FairMetric,
     "poisson": PoissonMetric, "mape": MAPEMetric,
     "gamma": GammaMetric, "gamma_deviance": GammaDevianceMetric,

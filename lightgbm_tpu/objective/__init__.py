@@ -4,6 +4,7 @@ from __future__ import annotations
 from ..utils.log import Log
 from .base import ObjectiveFunction
 from .binary import BinaryLogloss
+from .multiclass import MulticlassOVA, MulticlassSoftmax
 from .regression import (RegressionFair, RegressionGamma, RegressionHuber,
                          RegressionL1, RegressionL2, RegressionMAPE,
                          RegressionPoisson, RegressionQuantile,
@@ -20,6 +21,8 @@ _REGISTRY = {
     "gamma": RegressionGamma,
     "tweedie": RegressionTweedie,
     "binary": BinaryLogloss,
+    "multiclass": MulticlassSoftmax,
+    "multiclassova": MulticlassOVA,
 }
 
 
@@ -39,7 +42,12 @@ def create_objective_from_model_string(objective_str: str, config):
         if ":" in tok:
             k, v = tok.split(":", 1)
             try:
-                setattr(config, k, float(v))
+                setattr(config, k, int(v))
             except ValueError:
-                setattr(config, k, v)
+                try:
+                    setattr(config, k, float(v))
+                except ValueError:
+                    setattr(config, k, v)
+        elif tok == "sqrt":
+            config.reg_sqrt = True
     return create_objective(name, config)

@@ -45,6 +45,13 @@ class ObjectiveFunction:
         are padded jnp arrays; weight is all-ones when unweighted."""
         raise NotImplementedError
 
+    def get_gradients_multi(self, score, label, weight):
+        """Device computation over the full [K, N] score matrix.  Single-model
+        objectives wrap get_gradients on the one score plane; multiclass
+        objectives override with a vectorized softmax/OVA computation."""
+        grad, hess = self.get_gradients(score[0], label, weight)
+        return grad[None, :], hess[None, :]
+
     def boost_from_score(self) -> float:
         """Initial raw score (BoostFromScore in the reference objectives)."""
         return 0.0

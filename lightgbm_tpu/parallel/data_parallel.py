@@ -45,6 +45,12 @@ def make_data_parallel_train_step(meta: FeatureMeta, cfg: GrowerConfig,
         from ..config import Config
         from ..objective.binary import BinaryLogloss
         objective = BinaryLogloss(Config({"objective": "binary"}))
+    if objective.num_model_per_iteration > 1:
+        from ..utils.log import LightGBMError
+        raise LightGBMError(
+            "data-parallel train step handles one score plane; drive multiclass "
+            "by calling it per class plane (num_model_per_iteration=%d)"
+            % objective.num_model_per_iteration)
     grow = make_tree_grower(meta, cfg, num_bins_max, axis_name=DATA_AXIS,
                             jit=False)
 

@@ -1,0 +1,77 @@
+"""Multiclass training tests (reference test_engine.py test_multiclass style:
+metric thresholds on the examples/multiclass_classification data, 5 classes)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _fit(params, data, rounds=15):
+    X, y, Xt, yt = data
+    train = lgb.Dataset(X, label=y)
+    valid = lgb.Dataset(Xt, label=yt, reference=train)
+    evals = {}
+    bst = lgb.train(dict(params, verbose=-1), train, num_boost_round=rounds,
+                    valid_sets=[valid], callbacks=[lgb.record_evaluation(evals)],
+                    verbose_eval=0)
+    return bst, evals["valid_0"]
+
+
+def test_multiclass_softmax(multiclass_data):
+    bst, ev = _fit({"objective": "multiclass", "num_class": 5,
+                    "metric": "multi_logloss,multi_error"}, multiclass_data)
+    assert ev["multi_logloss"][-1] < ev["multi_logloss"][0]
+    # reference CLI with identical params reaches 1.4678 @15 iters on this data
+    assert ev["multi_logloss"][-1] < 1.50
+    assert ev["multi_error"][-1] < 0.65
+
+    X, y, Xt, yt = multiclass_data
+    prob = bst.predict(Xt)
+    assert prob.shape == (len(yt), 5)
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-6)
+    acc = np.mean(np.argmax(prob, axis=1) == yt)
+    assert acc > 0.38
+
+
+def test_multiclass_ova(multiclass_data):
+    # multi_logloss on OVA rises initially while each sigmoid plane calibrates
+    # to its ~20% base rate, so assert on classification error instead
+    bst, ev = _fit({"objective": "multiclassova", "num_class": 5,
+                    "metric": "multi_error"}, multiclass_data)
+    assert ev["multi_error"][-1] < ev["multi_error"][0]
+    X, y, Xt, yt = multiclass_data
+    prob = bst.predict(Xt)
+    assert prob.shape == (len(yt), 5)
+    # OVA probabilities are per-class sigmoids (don't sum to 1)
+    assert np.all((prob > 0) & (prob < 1))
+    assert np.mean(np.argmax(prob, axis=1) == yt) > 0.38
+
+
+def test_multiclass_model_roundtrip(multiclass_data):
+    X, y, Xt, yt = multiclass_data
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "multiclass", "num_class": 5, "verbose": -1},
+                    train, num_boost_round=5, verbose_eval=0)
+    assert bst.num_trees() == 25  # 5 trees per iteration
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(bst.predict(Xt), bst2.predict(Xt), atol=1e-12)
+
+
+def test_multiclass_reference_cli_interop(multiclass_data, tmp_path):
+    import os
+    import subprocess
+    if not os.path.exists("/root/repo/.refbuild/lightgbm"):
+        import pytest
+        pytest.skip("reference CLI not built")
+    X, y, Xt, yt = multiclass_data
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "multiclass", "num_class": 5, "verbose": -1},
+                    train, num_boost_round=5, verbose_eval=0)
+    model_path = tmp_path / "model.txt"
+    out_path = tmp_path / "pred.txt"
+    bst.save_model(str(model_path))
+    subprocess.run(["/root/repo/.refbuild/lightgbm", "task=predict",
+                    "data=/root/reference/examples/multiclass_classification/multiclass.test",
+                    "input_model=%s" % model_path, "output_result=%s" % out_path],
+                   check=True, capture_output=True)
+    ref_pred = np.loadtxt(out_path)
+    np.testing.assert_allclose(bst.predict(Xt), ref_pred, atol=1e-9)
