@@ -16,7 +16,7 @@ import numpy as np
 from .boosting.gbdt import GBDT
 from .config import Config
 from .io.dataset import BinnedDataset, Metadata
-from .metric import create_metric
+from .metric import create_metrics
 from .models.gbdt_model import GBDTModel
 from .objective import create_objective, create_objective_from_model_string
 from .utils.log import LightGBMError, Log
@@ -158,8 +158,7 @@ class Booster:
             binned = train_set.binned
             if self._objective is not None and binned.metadata.label is None:
                 Log.fatal("Label should not be None for training")
-            metrics = [m for m in (create_metric(name, self.config)
-                                   for name in self.config.metric) if m is not None]
+            metrics = create_metrics(self.config.metric, self.config)
             for m in metrics:
                 m.init(binned.metadata.label, binned.metadata.weight,
                        binned.metadata.query_boundaries)
@@ -184,8 +183,7 @@ class Booster:
             Log.warning("Validation set was not created with reference=train_set; "
                         "binning with training mappers")
             data.reference = self.train_set
-        metrics = [m for m in (create_metric(nm, self.config)
-                               for nm in self.config.metric) if m is not None]
+        metrics = create_metrics(self.config.metric, self.config)
         self._engine.add_valid(name, data.binned, metrics)
         self._valid_names.append(name)
         return self

@@ -235,6 +235,8 @@ class MultiErrorMetric(Metric):
         return self._wmean(err)
 
 
+from .rank import MAPAtK, NDCGAtK
+
 _REGISTRY = {
     "l1": L1Metric, "l2": L2Metric, "rmse": RMSEMetric,
     "multi_logloss": MultiLoglossMetric, "multi_error": MultiErrorMetric,
@@ -247,9 +249,35 @@ _REGISTRY = {
 }
 
 
+_RANK_METRICS = {"ndcg": NDCGAtK, "map": MAPAtK}
+
+
+def _eval_positions(config) -> List[int]:
+    """eval_at with the reference default 1..5 (DCGCalculator::DefaultEvalAt)."""
+    at = list(getattr(config, "eval_at", ()) or ())
+    return [int(k) for k in at] if at else [1, 2, 3, 4, 5]
+
+
 def create_metric(name: str, config) -> Optional[Metric]:
     cls = _REGISTRY.get(name)
     if cls is None:
         Log.warning("Unknown metric type name: %s", name)
         return None
     return cls(config)
+
+
+def create_metrics(names, config) -> List:
+    """Expand metric names into instances; rank metrics ('ndcg', 'map',
+    'ndcg@3') expand over eval_at positions (rank_metric.hpp:20, metric.cpp)."""
+    out: List = []
+    for name in names:
+        base, _, at = str(name).partition("@")
+        if base in _RANK_METRICS:
+            cls = _RANK_METRICS[base]
+            ks = [int(k) for k in at.split(",")] if at else _eval_positions(config)
+            out.extend(cls(config, k) for k in ks)
+        else:
+            m = create_metric(name, config)
+            if m is not None:
+                out.append(m)
+    return out

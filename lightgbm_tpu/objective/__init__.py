@@ -5,6 +5,7 @@ from ..utils.log import Log
 from .base import ObjectiveFunction
 from .binary import BinaryLogloss
 from .multiclass import MulticlassOVA, MulticlassSoftmax
+from .rank import LambdarankNDCG
 from .regression import (RegressionFair, RegressionGamma, RegressionHuber,
                          RegressionL1, RegressionL2, RegressionMAPE,
                          RegressionPoisson, RegressionQuantile,
@@ -23,6 +24,7 @@ _REGISTRY = {
     "binary": BinaryLogloss,
     "multiclass": MulticlassSoftmax,
     "multiclassova": MulticlassOVA,
+    "lambdarank": LambdarankNDCG,
 }
 
 

@@ -66,6 +66,54 @@ def regression_data():
     return X_train, y_train, X_test, y_test
 
 
+def load_libsvm(path, num_features=None):
+    """Sparse LibSVM `label idx:val ...` loader (reference lambdarank data)."""
+    os.makedirs(_NPY_CACHE, exist_ok=True)
+    import hashlib
+    key = hashlib.sha1(("%s|libsvm|%s" % (path, num_features)).encode()).hexdigest()[:16] + ".npz"
+    cached = os.path.join(_NPY_CACHE, key)
+    if os.path.exists(cached) and os.path.getmtime(cached) >= os.path.getmtime(path):
+        d = np.load(cached)
+        return d["X"], d["y"]
+    rows = []
+    labels = []
+    maxf = 0
+    with open(path) as fh:
+        for line in fh:
+            parts = line.split()
+            if not parts:
+                continue
+            labels.append(float(parts[0]))
+            feats = {}
+            for tok in parts[1:]:
+                k, v = tok.split(":")
+                feats[int(k)] = float(v)
+                maxf = max(maxf, int(k))
+            rows.append(feats)
+    nf = num_features or (maxf + 1)
+    X = np.zeros((len(rows), nf))
+    for i, feats in enumerate(rows):
+        for k, v in feats.items():
+            X[i, k] = v
+    y = np.asarray(labels)
+    np.savez(cached, X=X, y=y)
+    return X, y
+
+
+@pytest.fixture(scope="session")
+def rank_data():
+    X_train, y_train = load_libsvm(
+        os.path.join(REFERENCE_DIR, "examples/lambdarank/rank.train"))
+    X_test, y_test = load_libsvm(
+        os.path.join(REFERENCE_DIR, "examples/lambdarank/rank.test"),
+        num_features=X_train.shape[1])
+    q_train = np.loadtxt(
+        os.path.join(REFERENCE_DIR, "examples/lambdarank/rank.train.query"))
+    q_test = np.loadtxt(
+        os.path.join(REFERENCE_DIR, "examples/lambdarank/rank.test.query"))
+    return X_train, y_train, q_train, X_test, y_test, q_test
+
+
 @pytest.fixture(scope="session")
 def multiclass_data():
     X_train, y_train = load_svmlight_style(

@@ -1,0 +1,128 @@
+"""LambdaRank objective + NDCG/MAP metric tests.
+
+Gradient parity is checked against a direct numpy port of the reference
+per-query pairwise loop (rank_objective.hpp GetGradientsForOneQuery), and
+end-to-end training must lift NDCG on the reference lambdarank example.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.metric import create_metrics
+from lightgbm_tpu.objective.rank import (LambdarankNDCG, default_label_gain,
+                                         max_dcg_at_k, position_discounts)
+
+
+def reference_lambdas(score, label, qb, sigmoid=1.0, max_position=20):
+    """Straight numpy port of the reference pairwise loop for parity checks."""
+    gains = default_label_gain()
+    n = len(score)
+    lam = np.zeros(n)
+    hes = np.zeros(n)
+    for q in range(len(qb) - 1):
+        lo, hi = qb[q], qb[q + 1]
+        cnt = hi - lo
+        s = score[lo:hi]
+        l = label[lo:hi].astype(int)
+        mdcg = max_dcg_at_k(max_position, label[lo:hi], gains)
+        inv = 1.0 / mdcg if mdcg > 0 else 0.0
+        sorted_idx = np.argsort(-s, kind="stable")
+        disc = position_discounts(cnt)
+        best, worst = s[sorted_idx[0]], s[sorted_idx[-1]]
+        for i in range(cnt):
+            hi_i = sorted_idx[i]
+            for j in range(cnt):
+                if i == j:
+                    continue
+                lo_j = sorted_idx[j]
+                if l[hi_i] <= l[lo_j]:
+                    continue
+                ds = s[hi_i] - s[lo_j]
+                dcg_gap = gains[l[hi_i]] - gains[l[lo_j]]
+                pd = abs(disc[i] - disc[j])
+                delta = dcg_gap * pd * inv
+                if best != worst:
+                    delta /= (0.01 + abs(ds))
+                sig = 2.0 / (1.0 + np.exp(2.0 * ds * sigmoid))
+                p_lambda = -delta * sig
+                p_hess = 2.0 * delta * sig * (2.0 - sig)
+                lam[lo + hi_i] += p_lambda
+                hes[lo + hi_i] += p_hess
+                lam[lo + lo_j] -= p_lambda
+                hes[lo + lo_j] += p_hess
+    return lam, hes
+
+
+def test_lambdarank_gradient_parity():
+    rng = np.random.default_rng(3)
+    sizes = [7, 1, 12, 5, 9]
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    n = int(qb[-1])
+    label = rng.integers(0, 4, n).astype(np.float64)
+    score = rng.normal(size=n)
+
+    cfg = Config({"objective": "lambdarank"})
+    obj = LambdarankNDCG(cfg)
+    obj.init(label, None, qb)
+    import jax.numpy as jnp
+    g, h = obj.get_gradients(jnp.asarray(score, jnp.float32),
+                             None, jnp.ones(n, jnp.float32))
+    g_ref, h_ref = reference_lambdas(score.astype(np.float32).astype(np.float64),
+                                     label, qb)
+    np.testing.assert_allclose(np.asarray(g), g_ref, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(h), h_ref, rtol=2e-4, atol=2e-5)
+
+
+def test_lambdarank_training(rank_data):
+    X, y, q, Xt, yt, qt = rank_data
+    train = lgb.Dataset(X, label=y, group=q)
+    valid = lgb.Dataset(Xt, label=yt, group=qt, reference=train)
+    evals = {}
+    bst = lgb.train({"objective": "lambdarank", "metric": "ndcg", "eval_at": [1, 3, 5],
+                     "num_leaves": 31, "learning_rate": 0.1, "min_data_in_leaf": 1,
+                     "verbose": -1},
+                    train, num_boost_round=30, valid_sets=[valid],
+                    callbacks=[lgb.record_evaluation(evals)], verbose_eval=0)
+    ndcg3 = evals["valid_0"]["ndcg@3"]
+    # reference CLI on this example converges to ndcg@3 ~0.79+; demand a clear
+    # lift over the untrained ranking and a sane absolute level
+    assert ndcg3[-1] > 0.60
+    assert ndcg3[-1] > ndcg3[0]
+
+
+def test_ndcg_metric_perfect_and_worst():
+    cfg = Config({})
+    (m,) = create_metrics(["ndcg@3"], cfg)
+    label = np.array([3, 2, 1, 0, 0, 1], dtype=np.float64)
+    qb = np.array([0, 4, 6])
+    m.init(label, None, qb)
+    perfect = m.eval(np.array([4.0, 3.0, 2.0, 1.0, 0.0, 1.0]), None)
+    assert perfect == pytest.approx(1.0)
+    worst = m.eval(np.array([1.0, 2.0, 3.0, 4.0, 1.0, 0.0]), None)
+    assert worst < 1.0
+
+
+def test_map_metric():
+    cfg = Config({})
+    (m,) = create_metrics(["map@2"], cfg)
+    label = np.array([1, 0, 0, 1], dtype=np.float64)
+    qb = np.array([0, 2, 4])
+    m.init(label, None, qb)
+    # q0: hit at pos 1 -> ap = 1/1 / min(1,2) = 1; q1: hit at pos 2 -> 0.5
+    val = m.eval(np.array([2.0, 1.0, 2.0, 1.0]), None)
+    assert val == pytest.approx(0.75)
+
+
+def test_query_weighted_ndcg():
+    cfg = Config({})
+    (m,) = create_metrics(["ndcg@2"], cfg)
+    label = np.array([1, 0, 1, 0], dtype=np.float64)
+    qb = np.array([0, 2, 4])
+    weight = np.array([2.0, 2.0, 1.0, 1.0])
+    m.init(label, weight, qb)
+    # q0 perfect (w=2), q1 inverted; weighted mean must exceed plain mean of q1
+    val = m.eval(np.array([2.0, 1.0, 1.0, 2.0]), None)
+    plain_q1 = position_discounts(2)[1] / position_discounts(1)[0]
+    expected = (2.0 * 1.0 + 1.0 * plain_q1) / 3.0
+    assert val == pytest.approx(expected, rel=1e-6)
