@@ -202,6 +202,55 @@ class AUCMetric(Metric):
         return float(auc_sum / (total_pos * total_neg))
 
 
+def _xent_loss(label: np.ndarray, prob: np.ndarray) -> np.ndarray:
+    """XentLoss with the reference's 1e-12 log-argument clamp
+    (xentropy_metric.hpp:31-46)."""
+    eps = 1.0e-12
+    a = label * np.log(np.maximum(prob, eps))
+    b = (1.0 - label) * np.log(np.maximum(1.0 - prob, eps))
+    return -(a + b)
+
+
+class CrossEntropyMetric(Metric):
+    """xentropy: weighted mean of XentLoss over p = ConvertOutput(score)
+    (xentropy_metric.hpp:67-146)."""
+    name = "xentropy"
+
+    def eval(self, raw_score, objective) -> float:
+        p = objective.convert_output(raw_score) if objective is not None else raw_score
+        return self._wmean(_xent_loss(self.label, p))
+
+
+class CrossEntropyLambdaMetric(Metric):
+    """xentlambda: XentLoss on p = 1 - exp(-w * hhat), averaged over #data
+    regardless of weights (xentropy_metric.hpp:162-221)."""
+    name = "xentlambda"
+
+    def eval(self, raw_score, objective) -> float:
+        hhat = objective.convert_output(raw_score) if objective is not None \
+            else np.log1p(np.exp(raw_score))
+        w = self.weight if self.weight is not None else 1.0
+        p = 1.0 - np.exp(-w * hhat)
+        return float(np.mean(_xent_loss(self.label, p)))
+
+
+class KLDivergenceMetric(Metric):
+    """kldiv: cross-entropy plus the precomputed label-entropy offset
+    (xentropy_metric.hpp:246-340)."""
+    name = "kldiv"
+
+    def init(self, label, weight, query_boundaries=None) -> None:
+        super().init(label, weight, query_boundaries)
+        p = self.label
+        hp = np.where(p > 0, p * np.log(np.maximum(p, 1e-300)), 0.0) + \
+            np.where(1.0 - p > 0, (1.0 - p) * np.log(np.maximum(1.0 - p, 1e-300)), 0.0)
+        self.presum_label_entropy = self._wmean(hp)
+
+    def eval(self, raw_score, objective) -> float:
+        p = objective.convert_output(raw_score) if objective is not None else raw_score
+        return self.presum_label_entropy + self._wmean(_xent_loss(self.label, p))
+
+
 class MultiLoglossMetric(Metric):
     """Softmax logloss over [K, N] raw scores (multiclass_metric.hpp
     MultiSoftmaxLoglossMetric)."""
@@ -246,6 +295,8 @@ _REGISTRY = {
     "tweedie": TweedieMetric,
     "binary_logloss": BinaryLoglossMetric, "binary_error": BinaryErrorMetric,
     "auc": AUCMetric,
+    "xentropy": CrossEntropyMetric, "xentlambda": CrossEntropyLambdaMetric,
+    "kldiv": KLDivergenceMetric,
 }
 
 
@@ -274,7 +325,11 @@ def create_metrics(names, config) -> List:
         base, _, at = str(name).partition("@")
         if base in _RANK_METRICS:
             cls = _RANK_METRICS[base]
-            ks = [int(k) for k in at.split(",")] if at else _eval_positions(config)
+            try:
+                ks = [int(k) for k in at.split(",")] if at else _eval_positions(config)
+            except ValueError:
+                Log.warning("Unknown metric type name: %s", name)
+                continue
             out.extend(cls(config, k) for k in ks)
         else:
             m = create_metric(name, config)

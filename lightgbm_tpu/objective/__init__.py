@@ -6,6 +6,7 @@ from .base import ObjectiveFunction
 from .binary import BinaryLogloss
 from .multiclass import MulticlassOVA, MulticlassSoftmax
 from .rank import LambdarankNDCG
+from .xentropy import CrossEntropy, CrossEntropyLambda
 from .regression import (RegressionFair, RegressionGamma, RegressionHuber,
                          RegressionL1, RegressionL2, RegressionMAPE,
                          RegressionPoisson, RegressionQuantile,
@@ -25,6 +26,8 @@ _REGISTRY = {
     "multiclass": MulticlassSoftmax,
     "multiclassova": MulticlassOVA,
     "lambdarank": LambdarankNDCG,
+    "xentropy": CrossEntropy,
+    "xentlambda": CrossEntropyLambda,
 }
 
 

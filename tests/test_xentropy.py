@@ -1,0 +1,112 @@
+"""Cross-entropy objective family tests (xentropy, xentlambda, kldiv)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.metric import create_metrics
+from lightgbm_tpu.objective import create_objective
+
+
+def test_xentropy_continuous_labels(binary_data):
+    """Continuous soft labels in [0,1] train and reduce the loss."""
+    X, y, Xt, yt = binary_data
+    rng = np.random.default_rng(7)
+    y_soft = np.clip(y * 0.9 + rng.uniform(0.0, 0.1, len(y)), 0.0, 1.0)
+    train = lgb.Dataset(X, label=y_soft)
+    evals = {}
+    lgb.train({"objective": "xentropy", "verbose": -1}, train,
+              num_boost_round=10, valid_sets=[train],
+              callbacks=[lgb.record_evaluation(evals)], verbose_eval=0)
+    series = evals["valid_0"]["xentropy"]
+    assert series[-1] < series[0]
+
+
+def test_xentropy_matches_binary_on_hard_labels(binary_data):
+    """With 0/1 labels and no weights, xentropy boosting ~= binary logloss
+    boosting (same formulae modulo the binary objective's y in {-1,1} form)."""
+    X, y, _, _ = binary_data
+    evals_x, evals_b = {}, {}
+    lgb.train({"objective": "xentropy", "metric": "xentropy", "verbose": -1},
+              lgb.Dataset(X, label=y), num_boost_round=8,
+              valid_sets=[lgb.Dataset(X, label=y)],
+              callbacks=[lgb.record_evaluation(evals_x)], verbose_eval=0)
+    lgb.train({"objective": "binary", "metric": "binary_logloss", "verbose": -1},
+              lgb.Dataset(X, label=y), num_boost_round=8,
+              valid_sets=[lgb.Dataset(X, label=y)],
+              callbacks=[lgb.record_evaluation(evals_b)], verbose_eval=0)
+    assert evals_x["valid_0"]["xentropy"][-1] == pytest.approx(
+        evals_b["valid_0"]["binary_logloss"][-1], rel=1e-5)
+
+
+def test_xentlambda_unit_weight_equals_xentropy_gradients():
+    import jax.numpy as jnp
+    cfg = Config({})
+    n = 64
+    rng = np.random.default_rng(0)
+    label = rng.uniform(0, 1, n)
+    score = jnp.asarray(rng.normal(size=n), jnp.float32)
+    w = jnp.ones(n, jnp.float32)
+    ox = create_objective("xentropy", cfg)
+    ol = create_objective("xentlambda", cfg)
+    ox.init(label, None)
+    ol.init(label, None)
+    gx, hx = ox.get_gradients(score, jnp.asarray(label, jnp.float32), w)
+    gl, hl = ol.get_gradients(score, jnp.asarray(label, jnp.float32), w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gl), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(hx), np.asarray(hl), rtol=1e-6)
+
+
+def test_xentlambda_weighted_gradients_match_reference_formula():
+    """Weighted xentlambda grad/hess parity with the reference closed form
+    (xentropy_objective.hpp:195-211)."""
+    import jax.numpy as jnp
+    cfg = Config({})
+    n = 32
+    rng = np.random.default_rng(1)
+    label = rng.uniform(0, 1, n)
+    weight = rng.uniform(0.5, 2.0, n)
+    score = rng.normal(size=n) * 0.5
+    obj = create_objective("xentlambda", cfg)
+    obj.init(label, weight)
+    g, h = obj.get_gradients(jnp.asarray(score, jnp.float32),
+                             jnp.asarray(label, jnp.float32),
+                             jnp.asarray(weight, jnp.float32))
+    # numpy reimplementation
+    epf = np.exp(score)
+    hhat = np.log1p(epf)
+    z = 1.0 - np.exp(-weight * hhat)
+    g_ref = (1.0 - label / z) * weight / (1.0 + 1.0 / epf)
+    c = 1.0 / (1.0 - z)
+    a = weight * epf / (1.0 + epf) ** 2
+    b = (c / (c - 1.0) ** 2) * (1.0 + weight * epf - c)
+    h_ref = a * (1.0 + label * b)
+    np.testing.assert_allclose(np.asarray(g), g_ref, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(h), h_ref, rtol=1e-4)
+
+
+def test_kldiv_is_xentropy_plus_label_entropy():
+    cfg = Config({})
+    label = np.array([0.0, 0.3, 0.7, 1.0])
+    raw = np.array([-1.0, 0.0, 0.5, 2.0])
+    obj = create_objective("xentropy", cfg)
+    obj.init(label, None)
+    xent, kldiv = create_metrics(["xentropy", "kldiv"], cfg)
+    xent.init(label, None)
+    kldiv.init(label, None)
+    ent = np.mean([p * np.log(p) + (1 - p) * np.log(1 - p)
+                   for p in label if 0 < p < 1] + [0.0, 0.0])
+    assert kldiv.eval(raw, obj) == pytest.approx(xent.eval(raw, obj) + ent, rel=1e-9)
+
+
+def test_xentlambda_training_weighted(binary_data):
+    X, y, _, _ = binary_data
+    rng = np.random.default_rng(3)
+    w = rng.uniform(0.5, 2.0, len(y))
+    train = lgb.Dataset(X, label=y, weight=w)
+    evals = {}
+    lgb.train({"objective": "xentlambda", "metric": "xentlambda", "verbose": -1},
+              train, num_boost_round=10, valid_sets=[train],
+              callbacks=[lgb.record_evaluation(evals)], verbose_eval=0)
+    series = evals["valid_0"]["xentlambda"]
+    assert series[-1] < series[0]
