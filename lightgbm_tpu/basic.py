@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from .boosting.gbdt import GBDT
+from .boosting.variants import create_boosting
 from .config import Config
 from .io.dataset import BinnedDataset, Metadata
 from .metric import create_metrics
@@ -162,7 +163,8 @@ class Booster:
             for m in metrics:
                 m.init(binned.metadata.label, binned.metadata.weight,
                        binned.metadata.query_boundaries)
-            self._engine = GBDT(self.config, binned, self._objective, metrics)
+            self._engine = create_boosting(str(self.config.boosting), self.config,
+                                           binned, self._objective, metrics)
             self._model = self._engine.model
             self.train_set = train_set
         elif model_file is not None or model_str is not None:
@@ -234,7 +236,13 @@ class Booster:
         raw = self._model.predict_raw(X, num_iteration=num_iteration)
         if raw.shape[1] == 1:
             raw = raw[:, 0]
-        if raw_score or self._objective is None:
+        if raw_score:
+            return raw
+        if self._model.average_output:
+            # averaged pre-converted outputs; no ConvertOutput on top
+            # (gbdt_prediction.cpp Predict, average_output_ branch)
+            return raw / self._model.num_prediction_iterations(0, num_iteration)
+        if self._objective is None:
             return raw
         return self._objective.convert_output(raw)
 

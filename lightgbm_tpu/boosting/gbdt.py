@@ -62,8 +62,11 @@ def _feature_meta_device(ds: BinnedDataset) -> FeatureMeta:
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
-def _make_vals(grads, hesss, mask, k):
-    return jnp.stack([grads[k] * mask, hesss[k] * mask, mask], axis=1)
+def _make_vals(grads, hesss, gmask, cmask, k):
+    """Per-row (grad, hess, count) columns for the histogram kernel.  gmask
+    scales gradient/hessian mass (bagging zeroes, GOSS amplifies), cmask is
+    the 0/1 row-count weight (min_data_in_leaf, leaf counts)."""
+    return jnp.stack([grads[k] * gmask, hesss[k] * gmask, cmask], axis=1)
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -176,6 +179,11 @@ class GBDT:
 
         self._boosted_from_average = False
         self._grad_fn = None
+        self._leaf_transform = None
+        self._bag_cmask = jnp.asarray(self.bag_mask_host)
+        # RF evaluates metrics with objective=None: scores already hold
+        # converted outputs (rf.hpp EvalOneMetric)
+        self._metric_objective = objective
 
     # -- validation ----------------------------------------------------------
     def add_valid(self, name: str, valid: BinnedDataset, metrics: List) -> None:
@@ -201,21 +209,16 @@ class GBDT:
             init_score = self._boost_from_average()
             grads, hesss = self._gradients()
         else:
-            K, n = self.num_tree_per_iteration, self.train_set.num_data
-            grads = jnp.asarray(np.asarray(grad, np.float32).reshape(K, n))
-            hesss = jnp.asarray(np.asarray(hess, np.float32).reshape(K, n))
-            pad = self.train_set.num_data_padded - n
-            if pad:
-                grads = jnp.pad(grads, ((0, 0), (0, pad)))
-                hesss = jnp.pad(hesss, ((0, 0), (0, pad)))
+            grads, hesss = self._pad_custom_gradients(grad, hess)
 
-        bag_mask = self._bagging()
+        gmask, cmask = self._bagging_masks(grads, hesss)
+        self._bag_cmask = cmask
         fmask = self._feature_sample()
 
         renew = self.objective is not None and self.objective.renew_tree_output_required()
         should_continue = False
         for k in range(self.num_tree_per_iteration):
-            vals = _make_vals(grads, hesss, bag_mask, k)
+            vals = _make_vals(grads, hesss, gmask, cmask, k)
             out = self.grower(self.bins_dev, vals, fmask)
             renewed = None
             if renew:
@@ -256,6 +259,36 @@ class GBDT:
                                          self.meta_dev, depth_iters, k)
         self.iter -= 1
 
+    def _add_tree_to_train_score(self, tree: Tree, k: int, scale: float) -> None:
+        """score[k] += scale * tree(x) over the training bins (DART drop /
+        normalize, RF running average)."""
+        if tree.num_leaves <= 1:
+            self.score = self.score.at[k].add(jnp.float32(scale * tree.leaf_value[0]))
+            return
+        tree_dev, leaf_out = self._tree_to_device(tree)
+        depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
+        self.score = _traverse_update(self.bins_dev, self.score,
+                                      leaf_out * jnp.float32(scale), tree_dev,
+                                      self.meta_dev, depth_iters, k)
+
+    def _add_tree_to_valid_scores(self, tree: Tree, k: int, scale: float) -> None:
+        if tree.num_leaves <= 1:
+            for vs in self.valid_sets:
+                vs[3] = vs[3].at[k].add(jnp.float32(scale * tree.leaf_value[0]))
+            return
+        depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
+        tree_dev, leaf_out = self._tree_to_device(tree)
+        leaf_out = leaf_out * jnp.float32(scale)
+        for vs in self.valid_sets:
+            vs[3] = _traverse_update(vs[2], vs[3], leaf_out, tree_dev,
+                                     self.meta_dev, depth_iters, k)
+
+    def _multiply_scores(self, k: int, factor: float) -> None:
+        """ScoreUpdater::MultiplyScore on plane k, train + valid (rf.hpp)."""
+        self.score = self.score.at[k].multiply(jnp.float32(factor))
+        for vs in self.valid_sets:
+            vs[3] = vs[3].at[k].multiply(jnp.float32(factor))
+
     def _tree_to_device(self, tree: Tree, negate: bool = False):
         """Device arrays for bin-level traversal of a host tree (trees built
         this run carry bin thresholds)."""
@@ -272,6 +305,17 @@ class GBDT:
         return tree_dev, leaf_out
 
     # -- internals -----------------------------------------------------------
+    def _pad_custom_gradients(self, grad, hess):
+        """Reshape caller-supplied fobj gradients to the padded [K, N] layout."""
+        K, n = self.num_tree_per_iteration, self.train_set.num_data
+        grads = jnp.asarray(np.asarray(grad, np.float32).reshape(K, n))
+        hesss = jnp.asarray(np.asarray(hess, np.float32).reshape(K, n))
+        pad = self.train_set.num_data_padded - n
+        if pad:
+            grads = jnp.pad(grads, ((0, 0), (0, pad)))
+            hesss = jnp.pad(hesss, ((0, 0), (0, pad)))
+        return grads, hesss
+
     def _gradients(self):
         if self._grad_fn is None:
             obj = self.objective
@@ -312,6 +356,13 @@ class GBDT:
                 self.bag_mask_host = mask
         return jnp.asarray(self.bag_mask_host)
 
+    def _bagging_masks(self, grads, hesss):
+        """(gradient-scale mask, count mask) per row.  Plain bagging uses the
+        same 0/1 mask for both; GOSS overrides with an amplified gradient mask
+        (goss.hpp BaggingHelper)."""
+        m = self._bagging()
+        return m, m
+
     def _feature_sample(self) -> jax.Array:
         cfg = self.config
         f = self.train_set.num_features
@@ -334,7 +385,7 @@ class GBDT:
         leaf_id = np.asarray(jax.device_get(out["leaf_id"]))
         pred_k = np.asarray(jax.device_get(self.score[k]), dtype=np.float64)
         lv = np.asarray(jax.device_get(out["leaf_value"]), dtype=np.float64)
-        in_bag = self.bag_mask_host > 0
+        in_bag = np.asarray(jax.device_get(self._bag_cmask)) > 0
         return self.objective.renew_leaf_values(lv[:nl], leaf_id, pred_k, in_bag)
 
     def _finish_tree(self, out: Dict, init_score: float,
@@ -347,11 +398,17 @@ class GBDT:
         tree = Tree(max(L, 2))
         tree.num_leaves = nl
         lr = self.shrinkage_rate
+        host_lv = host["leaf_value"]
         if renewed is not None:
-            host["leaf_value"] = host["leaf_value"].copy()
-            host["leaf_value"][: len(renewed)] = renewed
-            leaf_value_dev_f = jnp.asarray(
-                (host["leaf_value"] * lr).astype(np.float32))
+            host_lv = host_lv.copy()
+            host_lv[: len(renewed)] = renewed
+        if self._leaf_transform is not None:
+            # RF converts leaf outputs through the objective before scoring
+            # (rf.hpp ConvertTreeOutput)
+            host_lv = self._leaf_transform(np.asarray(host_lv, np.float64))
+        if renewed is not None or self._leaf_transform is not None:
+            host["leaf_value"] = host_lv
+            leaf_value_dev_f = jnp.asarray((host_lv * lr).astype(np.float32))
         else:
             leaf_value_dev_f = out["leaf_value"] * lr  # device outputs, shrunk, no bias
 
@@ -414,7 +471,8 @@ class GBDT:
 
     def eval_train(self) -> List[Tuple[str, str, float, bool]]:
         raw = self.raw_train_score()
-        return [("training", m.name, m.eval(self._metric_input(raw, m), self.objective),
+        return [("training", m.name,
+                 m.eval(self._metric_input(raw, m), self._metric_objective),
                  m.is_higher_better)
                 for m in self.train_metrics]
 
@@ -423,6 +481,7 @@ class GBDT:
         for i, (name, valid, _, _, metrics) in enumerate(self.valid_sets):
             raw = self.raw_valid_score(i)
             for m in metrics:
-                out.append((name, m.name, m.eval(self._metric_input(raw, m), self.objective),
+                out.append((name, m.name,
+                            m.eval(self._metric_input(raw, m), self._metric_objective),
                             m.is_higher_better))
         return out

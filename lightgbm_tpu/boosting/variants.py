@@ -1,0 +1,254 @@
+"""Boosting variants: GOSS, DART, RF.
+
+Role parity with the reference src/boosting/goss.hpp (gradient-based one-side
+sampling), dart.hpp (dropout boosting with tree-weight renormalization) and
+rf.hpp (random forest: bagged trees of the zero-score gradients, running
+average of converted outputs).  Factory in create_boosting below mirrors
+src/boosting/boosting.cpp:30-64.
+
+TPU-first notes: GOSS's per-thread reservoir walk (goss.hpp BaggingHelper)
+becomes one jitted top-k + masked uniform draw over the padded row vector —
+the amplification (1-a)/b rides the gradient-scale mask consumed by the
+histogram kernel, while the count mask stays 0/1.  DART/RF reuse the bin-level
+tree traversal to replay score adjustments entirely on device.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.log import Log
+from ..utils.random import Random, partition_seed
+from .gbdt import GBDT
+
+
+class GOSS(GBDT):
+    """Gradient-based One-Side Sampling (goss.hpp:26-210)."""
+
+    def __init__(self, config, train_set, objective, metrics, init_model=None):
+        super().__init__(config, train_set, objective, metrics, init_model)
+        if config.top_rate + config.other_rate > 1.0:
+            Log.fatal("top_rate + other_rate cannot be larger than 1.0")
+        if config.top_rate <= 0.0 or config.other_rate <= 0.0:
+            Log.fatal("top_rate and other_rate must be positive for GOSS")
+        if config.bagging_freq > 0 and config.bagging_fraction != 1.0:
+            Log.fatal("Cannot use bagging in GOSS")
+        Log.info("Using GOSS")
+        self._goss_key = jax.random.PRNGKey(
+            partition_seed(int(config.seed or 0) + int(config.bagging_seed), 3))
+
+    def _bagging_masks(self, grads, hesss):
+        cfg = self.config
+        n = self.train_set.num_data
+        # no subsampling for the first 1/learning_rate iterations (goss.hpp:137)
+        if self.iter < int(1.0 / cfg.learning_rate):
+            m = jnp.asarray(self.bag_mask_host)
+            return m, m
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = max(1, int(n * cfg.other_rate))
+        multiply = (n - top_k) / other_k
+        key = jax.random.fold_in(self._goss_key, self.iter)
+        valid = jnp.asarray(self.bag_mask_host) > 0
+        gmask, cmask = _goss_masks(grads, hesss, valid, key, top_k, other_k,
+                                   float(multiply))
+        return gmask, cmask
+
+
+@functools.partial(jax.jit, static_argnames=("top_k", "other_k"))
+def _goss_masks(grads, hesss, valid, key, top_k: int, other_k: int, multiply):
+    """Select the top_k rows by sum_k |g*h|, sample other_k of the rest
+    uniformly, amplify the sampled rest by (n - top_k) / other_k."""
+    gh = jnp.sum(jnp.abs(grads * hesss), axis=0)
+    gh = jnp.where(valid, gh, -jnp.inf)
+    thresh = jax.lax.top_k(gh, top_k)[0][-1]
+    is_top = valid & (gh >= thresh)
+    rest = valid & ~is_top
+    # draw exactly other_k of the rest: rank random draws, keep the smallest
+    r = jax.random.uniform(key, gh.shape)
+    r = jnp.where(rest, r, jnp.inf)
+    kth = -jax.lax.top_k(-r, other_k)[0][-1]
+    sampled = rest & (r <= kth)
+    gmask = jnp.where(is_top, 1.0, jnp.where(sampled, multiply, 0.0)).astype(jnp.float32)
+    cmask = (is_top | sampled).astype(jnp.float32)
+    return gmask, cmask
+
+
+class DART(GBDT):
+    """Dropout boosting (dart.hpp:17-200): drop a random subset of existing
+    trees before computing gradients, shrink the new tree by lr/(1+k), then
+    renormalize the dropped trees so train/valid scores stay consistent."""
+
+    def __init__(self, config, train_set, objective, metrics, init_model=None):
+        super().__init__(config, train_set, objective, metrics, init_model)
+        self.random_for_drop = Random(int(config.drop_seed))
+        self.tree_weight: list = []
+        self.sum_weight = 0.0
+        self.drop_index: list = []
+        Log.info("Using DART")
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        self._dropping_trees()
+        stopped = super().train_one_iter(grad, hess)
+        if stopped:
+            return stopped
+        self._normalize()
+        if not bool(self.config.uniform_drop):
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        return False
+
+    def _dropping_trees(self) -> None:
+        cfg = self.config
+        K = self.num_tree_per_iteration
+        self.drop_index = []
+        is_skip = self.random_for_drop.next_float() < float(cfg.skip_drop)
+        n_iter = self.iter
+        if not is_skip and n_iter > 0:
+            drop_rate = float(cfg.drop_rate)
+            max_drop = int(cfg.max_drop)
+            if not bool(cfg.uniform_drop):
+                if self.sum_weight > 0:
+                    inv_avg = len(self.tree_weight) / self.sum_weight
+                    if max_drop > 0:
+                        drop_rate = min(drop_rate, max_drop * inv_avg / self.sum_weight)
+                    for i in range(n_iter):
+                        if self.random_for_drop.next_float() < \
+                                drop_rate * self.tree_weight[i] * inv_avg:
+                            self.drop_index.append(i)
+                            if max_drop > 0 and len(self.drop_index) >= max_drop:
+                                break
+            else:
+                if max_drop > 0:
+                    drop_rate = min(drop_rate, max_drop / float(n_iter))
+                for i in range(n_iter):
+                    if self.random_for_drop.next_float() < drop_rate:
+                        self.drop_index.append(i)
+                        if max_drop > 0 and len(self.drop_index) >= max_drop:
+                            break
+        # remove dropped trees from the training score (dart.hpp:119-126)
+        for i in self.drop_index:
+            for k in range(K):
+                self._add_tree_to_train_score(self.model.trees[i * K + k], k, -1.0)
+        k_cnt = float(len(self.drop_index))
+        lr = float(self.config.learning_rate)
+        if not bool(cfg.xgboost_dart_mode):
+            self.shrinkage_rate = lr / (1.0 + k_cnt)
+        else:
+            self.shrinkage_rate = lr if not self.drop_index else lr / (lr + k_cnt)
+
+    def _normalize(self) -> None:
+        """dart.hpp Normalize: dropped trees end rescaled by k/(k+1)
+        (or k/(k+lr) in xgboost mode); train score regains factor*tree, valid
+        score loses (1-factor)*tree."""
+        k = float(len(self.drop_index))
+        if k == 0:
+            return
+        cfg = self.config
+        lr = float(cfg.learning_rate)
+        K = self.num_tree_per_iteration
+        if not bool(cfg.xgboost_dart_mode):
+            factor = k / (k + 1.0)
+            weight_scale = k / (k + 1.0)
+            weight_sub = 1.0 / (k + 1.0)
+        else:
+            factor = k / (k + lr)
+            weight_scale = k / (k + lr)
+            weight_sub = 1.0 / (k + lr)
+        for i in self.drop_index:
+            for kk in range(K):
+                tree = self.model.trees[i * K + kk]
+                self._add_tree_to_valid_scores(tree, kk, factor - 1.0)
+                self._add_tree_to_train_score(tree, kk, factor)
+                tree.apply_shrinkage(factor)
+            if not bool(cfg.uniform_drop):
+                self.sum_weight -= self.tree_weight[i] * weight_sub
+                self.tree_weight[i] *= weight_scale
+
+
+class RF(GBDT):
+    """Random forest mode (rf.hpp:18-207): every tree fits the gradients of
+    the zero score, bagging + feature sampling are mandatory, leaf outputs are
+    converted through the objective, and the score is the running average."""
+
+    def __init__(self, config, train_set, objective, metrics, init_model=None):
+        if not (config.bagging_freq > 0 and 0.0 < config.bagging_fraction < 1.0):
+            Log.fatal("RF mode requires bagging (bagging_freq > 0, bagging_fraction in (0,1))")
+        if not (0.0 < config.feature_fraction < 1.0):
+            Log.fatal("RF mode requires feature_fraction in (0, 1)")
+        if objective is None:
+            Log.fatal("RF mode requires an objective function (no custom fobj)")
+        super().__init__(config, train_set, objective, metrics, init_model)
+        if self.num_tree_per_iteration != 1:
+            Log.fatal("Cannot use RF for multi-class")
+        if train_set.metadata.init_score is not None:
+            Log.fatal("Cannot use init_score in RF mode")
+        self.shrinkage_rate = 1.0
+        self.model.average_output = True
+        obj = self.objective
+        self._leaf_transform = lambda lv: obj.convert_output(lv)
+        self._metric_objective = None
+        Log.info("Using RF")
+
+    def _boost_from_average(self) -> float:
+        return 0.0
+
+    def _gradients(self):
+        # gradients of the zero score, every iteration (rf.hpp Boosting)
+        if self._grad_fn is None:
+            obj = self.objective
+
+            def gradfn(score, label, weight):
+                return obj.get_gradients_multi(jnp.zeros_like(score), label, weight)
+
+            self._grad_fn = jax.jit(gradfn)
+        return self._grad_fn(self.score, self.label_dev, self.weight_dev)
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        from .gbdt import _make_vals, _update_score_k, _traverse_update
+        if grad is None or hess is None:
+            grads, hesss = self._gradients()
+        else:
+            grads, hesss = self._pad_custom_gradients(grad, hess)
+        gmask, cmask = self._bagging_masks(grads, hesss)
+        self._bag_cmask = cmask
+        fmask = self._feature_sample()
+        m = float(self.iter)
+        for k in range(self.num_tree_per_iteration):
+            vals = _make_vals(grads, hesss, gmask, cmask, k)
+            out = self.grower(self.bins_dev, vals, fmask)
+            tree, tree_dev, leaf_out = self._finish_tree(out, 0.0, None)
+            if tree.num_leaves > 1:
+                # running average: score = (score*m + tree) / (m+1) (rf.hpp:118-122)
+                self._multiply_scores(k, m)
+                self.score = _update_score_k(self.score, out["leaf_id"], leaf_out, k)
+                depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
+                for vs in self.valid_sets:
+                    vs[3] = _traverse_update(vs[2], vs[3], leaf_out, tree_dev,
+                                             self.meta_dev, depth_iters, k)
+                self._multiply_scores(k, 1.0 / (m + 1.0))
+            else:
+                # reference appends a fresh zero stump when no split is found
+                # (rf.hpp:100-131) — undo the leaf transform so prediction's
+                # sum/average sees a 0 contribution like the training score
+                tree.leaf_value[0] = 0.0
+            self.model.trees.append(tree)
+        self.iter += 1
+        return False
+
+
+def create_boosting(boosting_type: str, config, train_set, objective, metrics,
+                    init_model=None) -> GBDT:
+    """Factory keyed on config.boosting (boosting.cpp:30-64)."""
+    if boosting_type == "gbdt" or boosting_type == "gbrt":
+        return GBDT(config, train_set, objective, metrics, init_model)
+    if boosting_type == "dart":
+        return DART(config, train_set, objective, metrics, init_model)
+    if boosting_type == "goss":
+        return GOSS(config, train_set, objective, metrics, init_model)
+    if boosting_type in ("rf", "random_forest"):
+        return RF(config, train_set, objective, metrics, init_model)
+    Log.fatal("Unknown boosting type %s", boosting_type)

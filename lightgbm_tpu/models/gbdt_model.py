@@ -58,9 +58,14 @@ class GBDTModel:
         for it in range(start_iteration, end):
             for j in range(k):
                 out[:, j] += self.trees[it * k + j].predict(X)
-        if self.average_output and end > start_iteration:
-            out /= (end - start_iteration)
         return out
+
+    def num_prediction_iterations(self, start_iteration: int = 0,
+                                  num_iteration: int = -1) -> int:
+        total_iter = self.current_iteration
+        if num_iteration is None or num_iteration <= 0:
+            num_iteration = total_iter
+        return max(min(start_iteration + num_iteration, total_iter) - start_iteration, 1)
 
     def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
         total_iter = self.current_iteration

@@ -1,0 +1,98 @@
+"""GOSS / DART / RF boosting-variant tests (goss.hpp, dart.hpp, rf.hpp)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _train(params, X, y, Xt, yt, rounds=20):
+    p = {"objective": "binary", "metric": "binary_logloss,auc", "verbose": -1,
+         "num_leaves": 31, "learning_rate": 0.1}
+    p.update(params)
+    train = lgb.Dataset(X, label=y)
+    valid = lgb.Dataset(Xt, label=yt, reference=train)
+    evals = {}
+    bst = lgb.train(p, train, num_boost_round=rounds, valid_sets=[valid],
+                    callbacks=[lgb.record_evaluation(evals)], verbose_eval=0)
+    return bst, evals
+
+
+def test_goss(binary_data):
+    X, y, Xt, yt = binary_data
+    bst, evals = _train({"boosting": "goss", "top_rate": 0.2, "other_rate": 0.1},
+                        X, y, Xt, yt)
+    assert evals["valid_0"]["auc"][-1] > 0.78
+    assert evals["valid_0"]["binary_logloss"][-1] < 0.62
+
+
+def test_goss_kicks_in_after_warmup(binary_data):
+    """For iter < 1/learning_rate GOSS keeps all rows; after that it samples
+    top_rate+other_rate of them (goss.hpp:135-138)."""
+    X, y, _, _ = binary_data
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "boosting": "goss", "verbose": -1,
+                     "learning_rate": 0.5, "top_rate": 0.2, "other_rate": 0.1},
+                    train, num_boost_round=4, verbose_eval=0)
+    eng = bst._engine
+    import jax
+    cmask = np.asarray(jax.device_get(eng._bag_cmask))
+    n = train.num_data()
+    kept = int(cmask.sum())
+    expected = max(1, int(n * 0.2)) + max(1, int(n * 0.1))
+    assert kept == pytest.approx(expected, abs=2)
+
+
+def test_goss_rejects_bagging(binary_data):
+    X, y, _, _ = binary_data
+    with pytest.raises(Exception):
+        lgb.train({"objective": "binary", "boosting": "goss", "verbose": -1,
+                   "bagging_freq": 1, "bagging_fraction": 0.5},
+                  lgb.Dataset(X, label=y), num_boost_round=2, verbose_eval=0)
+
+
+def test_dart(binary_data):
+    X, y, Xt, yt = binary_data
+    bst, evals = _train({"boosting": "dart", "drop_rate": 0.5, "skip_drop": 0.0},
+                        X, y, Xt, yt, rounds=20)
+    assert evals["valid_0"]["auc"][-1] > 0.75
+    # model predictions must equal accumulated training scores after all the
+    # drop/normalize traffic (consistency of the normalization bookkeeping)
+    raw_scores = bst._engine.raw_train_score()[0]
+    pred = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(pred, raw_scores, rtol=2e-4, atol=2e-5)
+
+
+def test_dart_uniform_xgboost_mode(binary_data):
+    X, y, Xt, yt = binary_data
+    bst, evals = _train({"boosting": "dart", "drop_rate": 0.3, "skip_drop": 0.2,
+                         "uniform_drop": True, "xgboost_dart_mode": True},
+                        X, y, Xt, yt, rounds=12)
+    assert evals["valid_0"]["auc"][-1] > 0.72
+    raw_scores = bst._engine.raw_train_score()[0]
+    pred = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(pred, raw_scores, rtol=2e-4, atol=2e-5)
+
+
+def test_rf(binary_data):
+    X, y, Xt, yt = binary_data
+    bst, evals = _train({"boosting": "rf", "bagging_freq": 1,
+                         "bagging_fraction": 0.632, "feature_fraction": 0.7},
+                        X, y, Xt, yt, rounds=20)
+    # RF scores are averaged probabilities; logloss evaluated directly on them
+    assert evals["valid_0"]["auc"][-1] > 0.75
+    # predictions: average of per-tree converted outputs, in (0, 1)
+    pred = bst.predict(Xt)
+    assert np.all((pred >= 0) & (pred <= 1))
+    # average_output flag survives the model file round trip
+    s = bst.model_to_string()
+    assert "average_output" in s
+    reloaded = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(reloaded.predict(Xt, raw_score=True),
+                               bst.predict(Xt, raw_score=True), rtol=1e-6)
+
+
+def test_rf_requires_bagging(binary_data):
+    X, y, _, _ = binary_data
+    with pytest.raises(Exception):
+        lgb.train({"objective": "binary", "boosting": "rf", "verbose": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=2, verbose_eval=0)
