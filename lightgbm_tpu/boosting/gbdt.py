@@ -30,6 +30,16 @@ from .grower import GrowerConfig, make_tree_grower
 
 K_EPSILON = 1e-15
 
+
+def _construct_bitset(vals) -> list:
+    """Common::ConstructBitset — uint32 words spanning [0, max(vals)]."""
+    if not vals:
+        return []
+    words = [0] * (max(vals) // 32 + 1)
+    for v in vals:
+        words[v // 32] |= 1 << (v % 32)
+    return words
+
 # Reuse compiled growers across boosters: jax.jit caches per wrapper object,
 # so two boosters with identical feature metadata + config would otherwise
 # recompile the identical program (slow on every lgb.train call).
@@ -85,6 +95,8 @@ def _traverse_update(bins_v, score_kv, leaf_out, tree_dev, meta: FeatureMeta,
     sf, sb, dl, lc, rc = (tree_dev["split_feature"], tree_dev["split_bin"],
                           tree_dev["default_left"], tree_dev["left_child"],
                           tree_dev["right_child"])
+    is_cat = tree_dev["split_is_cat"]
+    cat_bitset = tree_dev["split_cat_bitset"]
 
     def body(_, nd):
         is_leaf = nd < 0
@@ -94,7 +106,8 @@ def _traverse_update(bins_v, score_kv, leaf_out, tree_dev, meta: FeatureMeta,
         mt = meta.missing_type[f]
         is_missing = ((mt == 2) & (fbin == meta.num_bin[f] - 1)) | \
                      ((mt == 1) & (fbin == meta.default_bin[f]))
-        go_left = jnp.where(is_missing, dl[ndc], fbin <= sb[ndc])
+        go_left_num = jnp.where(is_missing, dl[ndc], fbin <= sb[ndc])
+        go_left = jnp.where(is_cat[ndc], cat_bitset[ndc, fbin], go_left_num)
         child = jnp.where(go_left, lc[ndc], rc[ndc])
         return jnp.where(is_leaf, nd, child)
 
@@ -138,6 +151,8 @@ class GBDT:
         n_pad = train_set.num_data_padded
 
         row_chunk = 16384 if n_pad % 16384 == 0 else n_pad
+        has_cat = any(m.bin_type == BIN_TYPE_CATEGORICAL and not m.is_trivial
+                      for m in train_set.bin_mappers)
         self.grower_cfg = GrowerConfig(
             num_leaves=int(config.num_leaves),
             max_depth=int(config.max_depth),
@@ -147,7 +162,13 @@ class GBDT:
             min_data_in_leaf=int(config.min_data_in_leaf),
             min_sum_hessian_in_leaf=float(config.min_sum_hessian_in_leaf),
             min_gain_to_split=float(config.min_gain_to_split),
-            row_chunk=row_chunk)
+            row_chunk=row_chunk,
+            with_categorical=has_cat,
+            max_cat_threshold=int(config.max_cat_threshold),
+            cat_l2=float(config.cat_l2),
+            cat_smooth=float(config.cat_smooth),
+            max_cat_to_onehot=int(config.max_cat_to_onehot),
+            min_data_per_group=int(config.min_data_per_group))
         self.grower = _cached_grower(self.meta_dev, self.grower_cfg,
                                      train_set.max_num_bin, train_set)
 
@@ -291,12 +312,27 @@ class GBDT:
 
     def _tree_to_device(self, tree: Tree, negate: bool = False):
         """Device arrays for bin-level traversal of a host tree (trees built
-        this run carry bin thresholds)."""
+        this run carry bin thresholds + inner categorical bitsets)."""
         ni = max(tree.num_leaves - 1, 1)
+        B = self.train_set.max_num_bin
+        is_cat = (tree.decision_type[:ni] & 1) != 0
+        bitset = np.zeros((ni, B), dtype=bool)
+        for node in np.nonzero(is_cat)[0]:
+            ci = int(tree.threshold_in_bin[node])
+            lo, hi = tree.cat_boundaries_inner[ci], tree.cat_boundaries_inner[ci + 1]
+            for wi in range(lo, hi):
+                word = tree.cat_threshold_inner[wi]
+                for bit in range(32):
+                    b = (wi - lo) * 32 + bit
+                    if b < B and (word >> bit) & 1:
+                        bitset[node, b] = True
         tree_dev = {
             "split_feature": jnp.asarray(tree.split_feature[:ni], jnp.int32),
-            "split_bin": jnp.asarray(tree.threshold_in_bin[:ni], jnp.int32),
+            "split_bin": jnp.asarray(np.where(is_cat, 0, tree.threshold_in_bin[:ni]),
+                                     jnp.int32),
             "default_left": jnp.asarray((tree.decision_type[:ni] & 2) != 0),
+            "split_is_cat": jnp.asarray(is_cat),
+            "split_cat_bitset": jnp.asarray(bitset),
             "left_child": jnp.asarray(tree.left_child[:ni], jnp.int32),
             "right_child": jnp.asarray(tree.right_child[:ni], jnp.int32),
         }
@@ -416,17 +452,39 @@ class GBDT:
             ni = nl - 1
             ds = self.train_set
             tree.split_feature[:ni] = host["split_feature"][:ni]
-            tree.threshold_in_bin[:ni] = host["split_bin"][:ni]
-            tree.threshold[:ni] = [ds.real_threshold(int(f), int(b))
-                                   for f, b in zip(host["split_feature"][:ni],
-                                                   host["split_bin"][:ni])]
+            is_cat_nodes = host["split_is_cat"][:ni].astype(bool)
             tree.split_gain[:ni] = host["split_gain"][:ni]
             dt = np.zeros(ni, dtype=np.int8)
-            dt |= (host["default_left"][:ni].astype(np.int8) << 1)
+            dt |= np.where(is_cat_nodes, 0,
+                           host["default_left"][:ni].astype(np.int8) << 1)
+            dt |= np.where(is_cat_nodes, 1, 0).astype(np.int8)
             miss = np.asarray([ds.bin_mappers[int(f)].missing_type
                                for f in host["split_feature"][:ni]], dtype=np.int8)
             dt |= (miss << 2)
             tree.decision_type[:ni] = dt
+            for node in range(ni):
+                f = int(host["split_feature"][node])
+                if is_cat_nodes[node]:
+                    # categorical: threshold slots hold the cat index; bitsets
+                    # over bins (training traversal) and over category values
+                    # (raw prediction + model file), tree.cpp SplitCategorical
+                    chosen = np.nonzero(host["split_cat_bitset"][node])[0]
+                    cat_idx = tree.num_cat
+                    tree.threshold_in_bin[node] = cat_idx
+                    tree.threshold[node] = float(cat_idx)
+                    tree.num_cat += 1
+                    mapper = ds.bin_mappers[f]
+                    vals = [int(mapper.bin_2_categorical[int(b)]) for b in chosen
+                            if int(b) < len(mapper.bin_2_categorical)]
+                    tree.cat_threshold.extend(_construct_bitset(vals))
+                    tree.cat_boundaries.append(len(tree.cat_threshold))
+                    tree.cat_threshold_inner.extend(
+                        _construct_bitset([int(b) for b in chosen]))
+                    tree.cat_boundaries_inner.append(len(tree.cat_threshold_inner))
+                else:
+                    b = int(host["split_bin"][node])
+                    tree.threshold_in_bin[node] = b
+                    tree.threshold[node] = ds.real_threshold(f, b)
             tree.left_child[:ni] = host["left_child"][:ni]
             tree.right_child[:ni] = host["right_child"][:ni]
             tree.internal_value[:ni] = host["internal_value"][:ni] * lr
@@ -450,6 +508,8 @@ class GBDT:
             "split_feature": out["split_feature"],
             "split_bin": out["split_bin"],
             "default_left": out["default_left"],
+            "split_is_cat": out["split_is_cat"],
+            "split_cat_bitset": out["split_cat_bitset"],
             "left_child": out["left_child"],
             "right_child": out["right_child"],
         }

@@ -41,6 +41,13 @@ class GrowerConfig(NamedTuple):
     min_sum_hessian_in_leaf: float
     min_gain_to_split: float
     row_chunk: int = 16384
+    # categorical split knobs (feature_histogram.hpp:112-273)
+    with_categorical: bool = False
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    min_data_per_group: int = 100
 
 
 def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
@@ -67,7 +74,11 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
         l1=cfg.lambda_l1, l2=cfg.lambda_l2, max_delta_step=cfg.max_delta_step,
         min_data_in_leaf=cfg.min_data_in_leaf,
         min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
-        min_gain_to_split=cfg.min_gain_to_split)
+        min_gain_to_split=cfg.min_gain_to_split,
+        max_cat_threshold=cfg.max_cat_threshold, cat_l2=cfg.cat_l2,
+        cat_smooth=cfg.cat_smooth, max_cat_to_onehot=cfg.max_cat_to_onehot,
+        min_data_per_group=cfg.min_data_per_group,
+        with_categorical=cfg.with_categorical)
 
     out_fn = functools.partial(leaf_output, l1=cfg.lambda_l1, l2=cfg.lambda_l2,
                                max_delta_step=cfg.max_delta_step)
@@ -94,6 +105,11 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
             "sum_g": jnp.zeros(L, jnp.float32).at[0].set(root_g),
             "sum_h": jnp.zeros(L, jnp.float32).at[0].set(root_h),
             "cnt": jnp.zeros(L, jnp.float32).at[0].set(root_c),
+            # value assigned to each leaf at creation (reference Tree keeps
+            # leaf_value_, seeded 0 for the root, set by Split for children —
+            # sorted-subset categorical children carry the cat_l2-regularized
+            # output, so the value is bound at split time, not recomputed)
+            "leaf_val": jnp.zeros(L, jnp.float32),
             "bgain": jnp.full(L, K_MIN_SCORE, jnp.float32).at[0].set(res0.gain),
             "bfeat": jnp.zeros(L, jnp.int32).at[0].set(res0.feature),
             "bbin": jnp.zeros(L, jnp.int32).at[0].set(res0.threshold_bin),
@@ -101,12 +117,18 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
             "blg": jnp.zeros(L, jnp.float32).at[0].set(res0.left_sum_g),
             "blh": jnp.zeros(L, jnp.float32).at[0].set(res0.left_sum_h),
             "blc": jnp.zeros(L, jnp.float32).at[0].set(res0.left_count),
+            "bcat": jnp.zeros(L, jnp.bool_).at[0].set(res0.is_cat),
+            "bbitset": jnp.zeros((L, B), jnp.bool_).at[0].set(res0.cat_bitset),
+            "blo": jnp.zeros(L, jnp.float32).at[0].set(res0.left_output),
+            "bro": jnp.zeros(L, jnp.float32).at[0].set(res0.right_output),
             "leaf_depth": jnp.zeros(L, jnp.int32),
             "leaf_parent": jnp.full(L, -1, jnp.int32),
             "split_feature": jnp.zeros(ni, jnp.int32),
             "split_bin": jnp.zeros(ni, jnp.int32),
             "split_gain": jnp.zeros(ni, jnp.float32),
             "default_left": jnp.zeros(ni, jnp.bool_),
+            "split_is_cat": jnp.zeros(ni, jnp.bool_),
+            "split_cat_bitset": jnp.zeros((ni, B), jnp.bool_),
             "left_child": jnp.zeros(ni, jnp.int32),
             "right_child": jnp.zeros(ni, jnp.int32),
             "internal_value": jnp.zeros(ni, jnp.float32),
@@ -124,13 +146,17 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
             f = st["bfeat"][best_leaf]
             t = st["bbin"][best_leaf]
             dl = st["bdleft"][best_leaf]
+            cat = st["bcat"][best_leaf]
+            bitset = st["bbitset"][best_leaf]
 
-            # -- partition rows of the split leaf (DataPartition::Split) ------
+            # -- partition rows of the split leaf (DataPartition::Split /
+            #    Bin::Split[Categorical], dense_bin.hpp:190-283) -------------
             fbin = bins[f].astype(jnp.int32)
             mt = meta.missing_type[f]
             is_missing_bin = ((mt == MISSING_NAN) & (fbin == meta.num_bin[f] - 1)) | \
                              ((mt == MISSING_ZERO) & (fbin == meta.default_bin[f]))
-            go_left = jnp.where(is_missing_bin, dl, fbin <= t)
+            go_left_num = jnp.where(is_missing_bin, dl, fbin <= t)
+            go_left = jnp.where(cat, bitset[fbin], go_left_num)
             in_leaf = st["leaf_id"] == best_leaf
             leaf_id = jnp.where(do & in_leaf & ~go_left, s, st["leaf_id"])
 
@@ -181,6 +207,15 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
             st_new["blg"] = set2(st["blg"], res_l.left_sum_g, res_r.left_sum_g)
             st_new["blh"] = set2(st["blh"], res_l.left_sum_h, res_r.left_sum_h)
             st_new["blc"] = set2(st["blc"], res_l.left_count, res_r.left_count)
+            st_new["bcat"] = set2(st["bcat"], res_l.is_cat, res_r.is_cat)
+            bs = st["bbitset"]
+            bs = bs.at[best_leaf].set(jnp.where(do, res_l.cat_bitset, bs[best_leaf]))
+            st_new["bbitset"] = bs.at[s].set(jnp.where(do, res_r.cat_bitset, bs[s]))
+            st_new["blo"] = set2(st["blo"], res_l.left_output, res_r.left_output)
+            st_new["bro"] = set2(st["bro"], res_l.right_output, res_r.right_output)
+            # children take the value their creating split computed
+            st_new["leaf_val"] = set2(st["leaf_val"], st["blo"][best_leaf],
+                                      st["bro"][best_leaf])
             st_new["leaf_depth"] = set2(st["leaf_depth"], child_depth, child_depth)
 
             # -- record the internal node (Tree::Split, tree.h:404-448) -------
@@ -191,7 +226,12 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
             st_new["split_bin"] = setn(st["split_bin"], t)
             st_new["split_gain"] = setn(st["split_gain"], gain)
             st_new["default_left"] = setn(st["default_left"], dl)
-            st_new["internal_value"] = setn(st["internal_value"], out_fn(pg, ph))
+            st_new["split_is_cat"] = setn(st["split_is_cat"], cat)
+            st_new["split_cat_bitset"] = st["split_cat_bitset"].at[node].set(
+                jnp.where(do, bitset, st["split_cat_bitset"][node]))
+            # internal_value = the split leaf's creation value (tree.cpp:419)
+            st_new["internal_value"] = setn(st["internal_value"],
+                                            st["leaf_val"][best_leaf])
             st_new["internal_count"] = setn(st["internal_count"], pc)
             left_child = setn(st["left_child"], ~best_leaf)
             right_child = setn(st["right_child"], ~s)
@@ -214,7 +254,11 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
 
         st = lax.fori_loop(1, L, body, state) if L > 1 else state
 
-        leaf_value = out_fn(st["sum_g"], st["sum_h"])
+        # leaves keep the value bound at their creating split; an unsplit root
+        # (stump) falls back to its own Newton step
+        leaf_value = jnp.where(
+            (jnp.arange(L) == 0) & (st["num_leaves"] == 1),
+            out_fn(st["sum_g"], st["sum_h"]), st["leaf_val"])
         return {
             "num_leaves": st["num_leaves"],
             "leaf_id": st["leaf_id"],
@@ -226,6 +270,8 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
             "split_bin": st["split_bin"],
             "split_gain": st["split_gain"],
             "default_left": st["default_left"],
+            "split_is_cat": st["split_is_cat"],
+            "split_cat_bitset": st["split_cat_bitset"],
             "left_child": st["left_child"],
             "right_child": st["right_child"],
             "internal_value": st["internal_value"],

@@ -183,7 +183,8 @@ class BinMapper:
             self.num_bin = len(bounds)
             self.default_bin = self.value_to_bin(0.0)
         else:
-            self._find_bin_categorical(distinct, counts, max_bin, total_sample_cnt, na_cnt)
+            self._find_bin_categorical(distinct, counts, max_bin, total_sample_cnt,
+                                       na_cnt, min_data_in_bin)
 
         self.is_trivial = self.num_bin <= 1
         counts_per_bin = self._cnt_in_bin(distinct, counts, na_cnt)
@@ -210,36 +211,60 @@ class BinMapper:
         return distinct, counts
 
     def _find_bin_categorical(self, distinct: np.ndarray, counts: np.ndarray,
-                              max_bin: int, total_cnt: int, na_cnt: int) -> None:
-        """Count-sorted categorical bins (bin.cpp:303-360): most frequent
-        category ↔ bin 0; rare tail (and negatives) fold to NaN/other."""
+                              max_bin: int, total_cnt: int, na_cnt: int,
+                              min_data_in_bin: int = 3) -> None:
+        """Count-sorted categorical bins (bin.cpp:303-371): most frequent
+        category ↔ bin 0 (but never category 0, which stays off bin 0 for the
+        sparse default), rare tail / negatives / NaN fold to the LAST bin,
+        which split finding excludes unless missing_type is None."""
         ints = distinct.astype(np.int64)
         neg = ints < 0
         if neg.any():
             Log.warning("Met negative value in categorical features, will convert it to NaN")
+            na_cnt += int(np.asarray(counts)[neg].sum())
         ints, counts = ints[~neg], np.asarray(counts)[~neg]
         agg: dict = {}
         for v, c in zip(ints, counts):
             agg[int(v)] = agg.get(int(v), 0) + int(c)
-        cats = sorted(agg.items(), key=lambda kv: -kv[1])
-        # cut rare categories: keep 99% mass, at most max_bin categories
-        cut_cnt = max(int(total_cnt * 0.99), total_cnt - na_cnt)
-        keep: List[Tuple[int, int]] = []
-        used = 0
-        for v, c in cats:
-            if len(keep) >= max_bin - 1 and used >= cut_cnt:
-                break
-            if len(keep) >= max_bin:
-                break
-            keep.append((v, c))
-            used += c
-        if keep and keep[0][0] == 0 and len(keep) == 1:
-            keep.append((1, 0))
-        self.bin_2_categorical = [v for v, _ in keep]
-        self.categorical_2_bin = {v: i for i, (v, _) in enumerate(keep)}
-        self.num_bin = len(keep)
-        self.missing_type = MISSING_NAN
-        self.default_bin = self.categorical_2_bin.get(0, 0)
+        vals = sorted(agg, key=lambda v: -agg[v])
+        cnts = [agg[v] for v in vals]
+        rest_cnt = total_cnt - na_cnt
+        self.num_bin = 0
+        self.bin_2_categorical = []
+        self.categorical_2_bin = {}
+        if rest_cnt > 0 and vals:
+            # avoid first bin being category zero (bin.cpp:325-333)
+            if vals[0] == 0:
+                if len(vals) == 1:
+                    vals.append(1)
+                    cnts.append(0)
+                vals[0], vals[1] = vals[1], vals[0]
+                cnts[0], cnts[1] = cnts[1], cnts[0]
+            cut_cnt = int(rest_cnt * 0.99)
+            max_bin_eff = min(len(vals), max_bin)
+            used = 0
+            cur = 0
+            while cur < len(vals) and (used < cut_cnt or self.num_bin < max_bin_eff):
+                if cnts[cur] < min_data_in_bin and cur > 1:
+                    break
+                self.bin_2_categorical.append(vals[cur])
+                self.categorical_2_bin[vals[cur]] = self.num_bin
+                used += cnts[cur]
+                self.num_bin += 1
+                cur += 1
+            if cur == len(vals) and na_cnt > 0:
+                # dedicated NaN bin, category -1 (bin.cpp:354-360)
+                self.bin_2_categorical.append(-1)
+                self.categorical_2_bin[-1] = self.num_bin
+                self.num_bin += 1
+            if cur == len(vals) and na_cnt == 0:
+                self.missing_type = MISSING_NONE
+            elif na_cnt == 0:
+                self.missing_type = MISSING_ZERO
+            else:
+                self.missing_type = MISSING_NAN
+        # ValueToBin(0): category 0's bin, or the overflow (last) bin
+        self.default_bin = self.categorical_2_bin.get(0, max(self.num_bin - 1, 0))
 
     def _cnt_in_bin(self, distinct: np.ndarray, counts: np.ndarray, na_cnt: int) -> np.ndarray:
         out = np.zeros(max(self.num_bin, 1), dtype=np.int64)
@@ -264,12 +289,17 @@ class BinMapper:
         """Vectorized raw value → bin index."""
         values = np.asarray(values, dtype=np.float64)
         if self.bin_type == BIN_TYPE_CATEGORICAL:
-            out = np.zeros(len(values), dtype=np.int32)
+            # negative / unseen -> last bin; NaN -> last bin when
+            # missing_type is NaN, else treated as category 0
+            # (bin.h ValueToBin:452-487)
+            last = max(self.num_bin - 1, 0)
+            out = np.full(len(values), last, dtype=np.int32)
             for i, v in enumerate(values):
-                if np.isnan(v) or int(v) < 0:
-                    out[i] = 0
-                else:
-                    out[i] = self.categorical_2_bin.get(int(v), 0)
+                if np.isnan(v):
+                    if self.missing_type != MISSING_NAN:
+                        out[i] = self.categorical_2_bin.get(0, last)
+                elif int(v) >= 0:
+                    out[i] = self.categorical_2_bin.get(int(v), last)
             return out
         nan_mask = np.isnan(values)
         if self.missing_type == MISSING_NAN:
@@ -295,7 +325,8 @@ class BinMapper:
             return "none"
         if self.bin_type == BIN_TYPE_NUMERICAL:
             return "[%s:%s]" % (repr(self.min_val), repr(self.max_val))
-        return ":".join(str(c) for c in sorted(self.bin_2_categorical))
+        # bin order, not sorted (bin.h bin_info:176-185)
+        return ":".join(str(c) for c in self.bin_2_categorical)
 
     # -- serialization for distributed find-bin ------------------------------
     def to_arrays(self):

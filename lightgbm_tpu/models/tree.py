@@ -61,6 +61,10 @@ class Tree:
         self.leaf_depth = np.zeros(max_leaves, dtype=np.int32)
         self.cat_boundaries: List[int] = [0]
         self.cat_threshold: List[int] = []
+        # inner (bin-id) bitsets, training-side only — not serialized
+        # (tree.h cat_boundaries_inner_/cat_threshold_inner_)
+        self.cat_boundaries_inner: List[int] = [0]
+        self.cat_threshold_inner: List[int] = []
         self.shrinkage = 1.0
 
     # -- training-side mutation ---------------------------------------------
@@ -109,12 +113,15 @@ class Tree:
                           gain: float, missing_type: int) -> int:
         node = self.split(leaf, feature, 0, 0.0, left_value, right_value,
                           left_cnt, right_cnt, gain, missing_type, False)
+        self.decision_type[node] &= ~_K_DEFAULT_LEFT_MASK
         self.decision_type[node] |= _K_CATEGORICAL_MASK
         self.threshold_in_bin[node] = self.num_cat
         self.threshold[node] = self.num_cat
         self.num_cat += 1
         self.cat_threshold.extend(threshold_cat_bitset)
         self.cat_boundaries.append(len(self.cat_threshold))
+        self.cat_threshold_inner.extend(threshold_bin_bitset)
+        self.cat_boundaries_inner.append(len(self.cat_threshold_inner))
         return node
 
     def apply_shrinkage(self, rate: float) -> None:

@@ -57,6 +57,10 @@ class SplitResult(NamedTuple):
     left_sum_g: jax.Array
     left_sum_h: jax.Array
     left_count: jax.Array    # f32
+    is_cat: jax.Array        # scalar bool — categorical subset split
+    cat_bitset: jax.Array    # [B] bool — bins routed left (categorical only)
+    left_output: jax.Array   # child outputs computed with the split's own
+    right_output: jax.Array  # regularization (cat_l2 for sorted-subset splits)
 
 
 def threshold_l1(s, l1):
@@ -78,9 +82,138 @@ def _leaf_split_gain(sum_g, sum_h, l1, l2, max_delta_step):
     return -(2.0 * sg_l1 * out + (sum_h + l2) * out * out)
 
 
+def _categorical_best(g, h, c, sum_g, sum_h, num_data, cat_mask, *, meta,
+                      l1, l2, max_delta_step, min_data_in_leaf,
+                      min_sum_hessian_in_leaf, max_cat_threshold, cat_l2,
+                      cat_smooth, max_cat_to_onehot, min_data_per_group):
+    """Best categorical split per feature (FindBestThresholdCategorical,
+    feature_histogram.hpp:112-273).
+
+    One-hot mode (num_bin <= max_cat_to_onehot) scans single-bin lefts as one
+    [F, B] vector op.  Sorted-subset mode sorts bins by sum_g/(sum_h +
+    cat_smooth) and scans bounded prefixes from both ends; the reference's
+    sequential walk (min_data_per_group grouping, break-on-starved-right)
+    becomes a batched `lax.scan` with [F] carries.
+
+    Returns per-feature (raw_gain [F], bitset [F, B], left_g, left_h(+eps),
+    left_c, used_sorted [F] bool).
+    """
+    F, B = g.shape
+    eps = K_EPSILON
+    bins = jnp.arange(B, dtype=jnp.int32)[None, :]
+    # used_bin = num_bin - 1 + (missing_type == None) (feature_histogram.hpp:125-126)
+    used_bin = (meta.num_bin - 1 +
+                (meta.missing_type == MISSING_NONE).astype(jnp.int32))[:, None]
+    valid_t = (bins < used_bin) & cat_mask[:, None]
+
+    def pair_gain(lg, lh, rg, rh, l2_eff):
+        return _leaf_split_gain(lg, lh, l1, l2_eff, max_delta_step) + \
+               _leaf_split_gain(rg, rh, l1, l2_eff, max_delta_step)
+
+    # ---- one-hot: left = single bin t ------------------------------------
+    other_g = sum_g - g
+    other_h = sum_h - h - eps
+    other_c = num_data - c
+    ok_oh = valid_t & (c >= min_data_in_leaf) & (h >= min_sum_hessian_in_leaf) \
+        & (other_c >= min_data_in_leaf) & (other_h >= min_sum_hessian_in_leaf)
+    gain_oh = jnp.where(ok_oh, pair_gain(g, h + eps, other_g, other_h, l2),
+                        K_MIN_SCORE)
+    t_oh = jnp.argmax(gain_oh, axis=1).astype(jnp.int32)          # [F]
+    best_oh = jnp.take_along_axis(gain_oh, t_oh[:, None], 1)[:, 0]
+
+    # ---- sorted subset ----------------------------------------------------
+    keep = valid_t & (c >= cat_smooth)
+    ctr = jnp.where(keep, g / (h + cat_smooth), jnp.inf)
+    order = jnp.argsort(ctr, axis=1).astype(jnp.int32)            # [F, B]
+    used = jnp.sum(keep, axis=1).astype(jnp.int32)                # [F]
+    max_cat = jnp.minimum(max_cat_threshold, (used + 1) // 2)     # [F]
+    l2s = l2 + cat_l2
+    gs = jnp.take_along_axis(g, order, 1)
+    hs = jnp.take_along_axis(h, order, 1)
+    cs = jnp.take_along_axis(c, order, 1)
+    slot_valid = bins < used[:, None]
+    gs = jnp.where(slot_valid, gs, 0.0)
+    hs = jnp.where(slot_valid, hs, 0.0)
+    cs = jnp.where(slot_valid, cs, 0.0)
+
+    def scan_dir(flip: bool):
+        if flip:
+            # direction -1 walks sorted bins from the top (position used-1-i)
+            pos = used[:, None] - 1 - bins
+            posc = jnp.clip(pos, 0, B - 1)
+            gd = jnp.take_along_axis(gs, posc, 1)
+            hd = jnp.take_along_axis(hs, posc, 1)
+            cd = jnp.take_along_axis(cs, posc, 1)
+        else:
+            gd, hd, cd = gs, hs, cs
+
+        def step(carry, xs):
+            lg, lh, lc, grp, stopped, bg, bi, blg, blh, blc = carry
+            gi, hi, ci, i = xs
+            stepping = (i < used) & (i < max_cat)
+            lg = jnp.where(stepping, lg + gi, lg)
+            lh = jnp.where(stepping, lh + hi, lh)
+            lc = jnp.where(stepping, lc + ci, lc)
+            grp = jnp.where(stepping, grp + ci, grp)
+            cont1 = (lc < min_data_in_leaf) | (lh < min_sum_hessian_in_leaf)
+            rc = num_data - lc
+            rh = sum_h - lh
+            brk = (rc < min_data_in_leaf) | (rc < min_data_per_group) | \
+                  (rh < min_sum_hessian_in_leaf)
+            # break only evaluated when the left side qualifies (reference
+            # `continue`s before the break checks, :205-212)
+            stopped_new = stopped | (stepping & ~cont1 & brk)
+            candidate = stepping & ~stopped & ~cont1 & ~brk & \
+                (grp >= min_data_per_group)
+            grp = jnp.where(candidate, 0.0, grp)
+            gain_i = pair_gain(lg, lh, sum_g - lg, rh, l2s)
+            take = candidate & (gain_i > bg)
+            bg = jnp.where(take, gain_i, bg)
+            bi = jnp.where(take, i, bi)
+            blg = jnp.where(take, lg, blg)
+            blh = jnp.where(take, lh, blh)
+            blc = jnp.where(take, lc, blc)
+            return (lg, lh, lc, grp, stopped_new, bg, bi, blg, blh, blc), None
+
+        zero = jnp.zeros(F, jnp.float32)
+        carry0 = (zero, jnp.full(F, eps, jnp.float32), zero, zero,
+                  jnp.zeros(F, bool), jnp.full(F, K_MIN_SCORE, jnp.float32),
+                  jnp.full(F, -1, jnp.int32), zero, zero, zero)
+        xs = (gd.T, hd.T, cd.T, jnp.arange(B, dtype=jnp.int32))
+        carry, _ = jax.lax.scan(step, carry0, xs)
+        _, _, _, _, _, bg, bi, blg, blh, blc = carry
+        return bg, bi, blg, blh, blc
+
+    bg1, bi1, blg1, blh1, blc1 = scan_dir(False)
+    bg2, bi2, blg2, blh2, blc2 = scan_dir(True)
+    use2 = bg2 > bg1
+    bg_s = jnp.where(use2, bg2, bg1)
+    bi_s = jnp.where(use2, bi2, bi1)
+    blg_s = jnp.where(use2, blg2, blg1)
+    blh_s = jnp.where(use2, blh2, blh1)
+    blc_s = jnp.where(use2, blc2, blc1)
+    # bitset: first bi+1 sorted bins (dir +1) or last bi+1 (dir -1) go left
+    rank = jnp.argsort(order, axis=1)                             # position of bin b
+    rank_dir = jnp.where(use2[:, None], used[:, None] - 1 - rank, rank)
+    bitset_s = keep & (rank_dir <= bi_s[:, None]) & (rank_dir >= 0)
+
+    # ---- choose one-hot vs sorted per feature ----------------------------
+    use_onehot = (meta.num_bin <= max_cat_to_onehot)
+    raw_gain = jnp.where(use_onehot, best_oh, bg_s)
+    bitset = jnp.where(use_onehot[:, None], bins == t_oh[:, None], bitset_s)
+    lg = jnp.where(use_onehot, jnp.take_along_axis(g, t_oh[:, None], 1)[:, 0], blg_s)
+    lh = jnp.where(use_onehot,
+                   jnp.take_along_axis(h, t_oh[:, None], 1)[:, 0] + eps, blh_s)
+    lc = jnp.where(use_onehot, jnp.take_along_axis(c, t_oh[:, None], 1)[:, 0], blc_s)
+    return raw_gain, bitset, lg, lh, lc, ~use_onehot
+
+
 def find_best_split(hist, sum_g, sum_h, num_data, feature_mask, *,
                     meta: FeatureMeta, l1, l2, max_delta_step, min_data_in_leaf,
-                    min_sum_hessian_in_leaf, min_gain_to_split) -> SplitResult:
+                    min_sum_hessian_in_leaf, min_gain_to_split,
+                    max_cat_threshold=32, cat_l2=10.0, cat_smooth=10.0,
+                    max_cat_to_onehot=4, min_data_per_group=100,
+                    with_categorical: bool = False) -> SplitResult:
     """Best split for one leaf given its histogram.
 
     hist: [F, B, 3] f32; sum_g/sum_h/num_data: leaf totals (scalars);
@@ -167,12 +300,51 @@ def find_best_split(hist, sum_g, sum_h, num_data, feature_mask, *,
     lhs = jnp.stack([lh2, lh1], axis=1)
     lcs = jnp.stack([lc2, lc1], axis=1)
     left_g = lgs[f, d, t]
-    left_h = lhs[f, d, t] - eps
+    left_h = lhs[f, d, t]  # includes the kEpsilon seed
     left_c = lcs[f, d, t]
+    l2_eff = jnp.float32(l2)
+    is_cat = jnp.bool_(False)
+    cat_bitset = jnp.zeros(B, bool)
+
+    if with_categorical:
+        cat_mask = meta.is_categorical & ~meta.is_trivial & feature_mask
+        raw_cat, bitset_cat, clg, clh, clc, sorted_mode = _categorical_best(
+            g, h, c, sum_g, total_h, num_data, cat_mask, meta=meta,
+            l1=l1, l2=l2, max_delta_step=max_delta_step,
+            min_data_in_leaf=min_data_in_leaf,
+            min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
+            max_cat_threshold=max_cat_threshold, cat_l2=cat_l2,
+            cat_smooth=cat_smooth, max_cat_to_onehot=max_cat_to_onehot,
+            min_data_per_group=min_data_per_group)
+        gain_cat = jnp.where(raw_cat > min_gain_shift,
+                             (raw_cat - min_gain_shift) * meta.penalty,
+                             K_MIN_SCORE)
+        fc = jnp.argmax(gain_cat).astype(jnp.int32)
+        best_cat = gain_cat[fc]
+        cat_wins = best_cat > best_gain
+        best_gain = jnp.where(cat_wins, best_cat, best_gain)
+        f = jnp.where(cat_wins, fc, f)
+        t = jnp.where(cat_wins, 0, t)
+        default_left = jnp.where(cat_wins, False, default_left)
+        left_g = jnp.where(cat_wins, clg[fc], left_g)
+        left_h = jnp.where(cat_wins, clh[fc], left_h)
+        left_c = jnp.where(cat_wins, clc[fc], left_c)
+        is_cat = cat_wins
+        cat_bitset = jnp.where(cat_wins, bitset_cat[fc], cat_bitset)
+        # sorted-subset splits regularize child outputs with l2 + cat_l2
+        l2_eff = jnp.where(cat_wins & sorted_mode[fc],
+                           jnp.float32(l2 + cat_l2), l2_eff)
+
+    right_g = sum_g - left_g
+    right_h = total_h - left_h
+    lo = leaf_output(left_g, left_h, l1, l2_eff, max_delta_step)
+    ro = leaf_output(right_g, right_h, l1, l2_eff, max_delta_step)
 
     return SplitResult(
         gain=best_gain,
         feature=f.astype(jnp.int32),
         threshold_bin=t.astype(jnp.int32),
         default_left=default_left,
-        left_sum_g=left_g, left_sum_h=left_h, left_count=left_c)
+        left_sum_g=left_g, left_sum_h=left_h - eps, left_count=left_c,
+        is_cat=is_cat, cat_bitset=cat_bitset,
+        left_output=lo, right_output=ro)

@@ -1,0 +1,131 @@
+"""Categorical split tests (feature_histogram.hpp FindBestThresholdCategorical,
+tree.cpp SplitCategorical, dense_bin.hpp SplitCategorical)."""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+REFBIN = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      ".refbuild", "lightgbm")
+
+
+def _cat_data(seed=0, n=2000, k=12):
+    rng = np.random.default_rng(seed)
+    cat = rng.integers(0, k, n)
+    x1 = rng.normal(size=n)
+    y = (np.isin(cat, [2, 5, 7]).astype(float) * 2.0 + x1 * 0.3 +
+         rng.normal(scale=0.1, size=n))
+    X = np.column_stack([cat.astype(float), x1])
+    return X, y
+
+
+PARAMS = {"objective": "regression", "verbose": -1, "num_leaves": 15,
+          "min_data_in_leaf": 5, "min_data_per_group": 5, "cat_smooth": 1.0}
+
+
+def test_categorical_sorted_subset_split():
+    X, y = _cat_data()
+    train = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train(dict(PARAMS), train, num_boost_round=20, verbose_eval=0)
+    assert sum(t.num_cat for t in bst._engine.model.trees) > 0
+    pred = bst.predict(X)
+    # raw-value traversal (value bitsets) agrees with bin-level training scores
+    scores = bst._engine.raw_train_score()[0]
+    np.testing.assert_allclose(pred, scores, rtol=1e-4, atol=1e-5)
+    assert np.mean((pred - y) ** 2) < 0.1
+
+
+def test_categorical_beats_numerical_treatment():
+    """Membership targets need subset splits; treating the id column as
+    numerical must fit notably worse at equal budget."""
+    X, y = _cat_data(seed=3)
+    as_cat = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y, categorical_feature=[0]),
+                       num_boost_round=10, verbose_eval=0)
+    as_num = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
+                       num_boost_round=10, verbose_eval=0)
+    l2_cat = np.mean((as_cat.predict(X) - y) ** 2)
+    l2_num = np.mean((as_num.predict(X) - y) ** 2)
+    assert l2_cat < l2_num
+
+
+def test_categorical_onehot_mode():
+    """num_bin <= max_cat_to_onehot uses single-category splits
+    (feature_histogram.hpp:132-163): every cat node then carries exactly one
+    category in its bitset."""
+    rng = np.random.default_rng(1)
+    n = 1200
+    cat = rng.integers(0, 3, n)
+    y = (cat == 1).astype(float) * 3.0 + rng.normal(scale=0.1, size=n)
+    X = np.column_stack([cat.astype(float), rng.normal(size=n)])
+    train = lgb.Dataset(X, label=y, categorical_feature=[0])
+    p = dict(PARAMS)
+    p["max_cat_to_onehot"] = 4
+    bst = lgb.train(p, train, num_boost_round=5, verbose_eval=0)
+    found_cat = False
+    for t in bst._engine.model.trees:
+        for ci in range(t.num_cat):
+            lo, hi = t.cat_boundaries[ci], t.cat_boundaries[ci + 1]
+            ncats = sum(bin(w).count("1") for w in t.cat_threshold[lo:hi])
+            assert ncats == 1
+            found_cat = True
+    assert found_cat
+
+
+def test_categorical_model_file_round_trip():
+    X, y = _cat_data(seed=5)
+    train = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train(dict(PARAMS), train, num_boost_round=10, verbose_eval=0)
+    s = bst.model_to_string()
+    assert "num_cat=" in s
+    reloaded = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(reloaded.predict(X, raw_score=True),
+                               bst.predict(X, raw_score=True), rtol=1e-9)
+
+
+@pytest.mark.skipif(not os.path.exists(REFBIN), reason="reference CLI not built")
+def test_categorical_reference_cli_interop(tmp_path):
+    X, y = _cat_data(seed=7)
+    train = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train(dict(PARAMS), train, num_boost_round=10, verbose_eval=0)
+    model_f = tmp_path / "cat_model.txt"
+    data_f = tmp_path / "cat_data.tsv"
+    out_f = tmp_path / "cat_pred.txt"
+    bst.save_model(str(model_f))
+    np.savetxt(data_f, np.column_stack([y, X]), delimiter="\t", fmt="%.10g")
+    subprocess.run([REFBIN, "task=predict", "input_model=%s" % model_f,
+                    "data=%s" % data_f, "output_result=%s" % out_f,
+                    "categorical_feature=0"], check=True, capture_output=True)
+    ref = np.loadtxt(out_f)
+    np.testing.assert_allclose(bst.predict(X), ref, atol=1e-10)
+
+
+def test_nan_categories_train_predict_consistency():
+    """NaN categorical values must route identically in bin-level training
+    traversal and raw-value prediction (both to the NaN bin / right side) —
+    the training scores and saved-model predictions must agree."""
+    rng = np.random.default_rng(11)
+    n = 1500
+    cat = rng.integers(0, 8, n).astype(float)
+    cat[rng.random(n) < 0.15] = np.nan
+    y = np.nan_to_num(np.isin(cat, [1, 3]).astype(float)) * 2.0 + \
+        rng.normal(scale=0.1, size=n)
+    X = np.column_stack([cat, rng.normal(size=n)])
+    train = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train(dict(PARAMS), train, num_boost_round=10, verbose_eval=0)
+    scores = bst._engine.raw_train_score()[0]
+    pred = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(pred, scores, rtol=1e-4, atol=1e-5)
+
+
+def test_unseen_category_prediction():
+    """Categories never seen in training route right (not in any bitset)."""
+    X, y = _cat_data(seed=9)
+    train = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train(dict(PARAMS), train, num_boost_round=5, verbose_eval=0)
+    X_unseen = X.copy()
+    X_unseen[:5, 0] = 99.0  # unseen category
+    pred = bst.predict(X_unseen)
+    assert np.all(np.isfinite(pred))
