@@ -139,7 +139,8 @@ class Booster:
     """Training/prediction handle (basic.py Booster; c_api.cpp Booster)."""
 
     def __init__(self, params: Optional[Dict] = None, train_set: Optional[Dataset] = None,
-                 model_file: Optional[str] = None, model_str: Optional[str] = None):
+                 model_file: Optional[str] = None, model_str: Optional[str] = None,
+                 init_model: Optional[GBDTModel] = None):
         params = dict(params) if params else {}
         self.params = params
         self.best_iteration = -1
@@ -164,7 +165,9 @@ class Booster:
                 m.init(binned.metadata.label, binned.metadata.weight,
                        binned.metadata.query_boundaries)
             self._engine = create_boosting(str(self.config.boosting), self.config,
-                                           binned, self._objective, metrics)
+                                           binned, self._objective, metrics,
+                                           init_model=copy.deepcopy(init_model)
+                                           if init_model is not None else None)
             self._model = self._engine.model
             self.train_set = train_set
         elif model_file is not None or model_str is not None:
@@ -229,11 +232,25 @@ class Booster:
 
     # -- prediction ----------------------------------------------------------
     def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
-                pred_leaf: bool = False, pred_contrib: bool = False, **kwargs) -> np.ndarray:
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                pred_early_stop: bool = False, pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0, **kwargs) -> np.ndarray:
         X = _to_2d_float(data)
         if pred_leaf:
             return self._model.predict_leaf_index(X, num_iteration)
-        raw = self._model.predict_raw(X, num_iteration=num_iteration)
+        early = None
+        # reference gates early stop on NeedAccuratePrediction: only binary /
+        # multiclass / ranking objectives tolerate truncated sums
+        # (predictor.hpp:46-52, objective NeedAccuratePrediction overrides)
+        obj_kind = str(self._model.objective_str).split()[0] \
+            if self._model.objective_str else ""
+        if pred_early_stop and not self._model.average_output and \
+                obj_kind in ("binary", "multiclass", "multiclassova", "lambdarank"):
+            early = "multiclass" if self._model.num_tree_per_iteration > 1 else "binary"
+        raw = self._model.predict_raw(X, num_iteration=num_iteration,
+                                      early_stop=early,
+                                      early_stop_freq=pred_early_stop_freq,
+                                      early_stop_margin=pred_early_stop_margin)
         if raw.shape[1] == 1:
             raw = raw[:, 0]
         if raw_score:
@@ -245,6 +262,62 @@ class Booster:
         if self._objective is None:
             return raw
         return self._objective.convert_output(raw)
+
+    def refit(self, data, label, weight=None, group=None,
+              decay_rate: Optional[float] = None) -> "Booster":
+        """Refit existing tree structures to new data (gbdt.cpp RefitTree
+        :338-361 + FitByExistingTree, serial_tree_learner.cpp:223-248): keep
+        every split, recompute leaf values from the new data's gradients with
+        leaf_output = decay*old + (1-decay)*new*shrinkage, iterating so later
+        trees see the refit scores of earlier ones."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._objective is None:
+            raise LightGBMError("Cannot refit with a custom objective")
+        X = _to_2d_float(data)
+        label = np.asarray(label, dtype=np.float64).reshape(-1)
+        n = X.shape[0]
+        model = copy.deepcopy(self._model)
+        cfg = self.config
+        decay = float(cfg.refit_decay_rate) if decay_rate is None else float(decay_rate)
+        l1, l2 = float(cfg.lambda_l1), float(cfg.lambda_l2)
+        mds = float(cfg.max_delta_step)
+        K = model.num_tree_per_iteration
+        num_iters = model.current_iteration
+
+        objective = create_objective(self.config.objective, self.config) \
+            if isinstance(self.config.objective, str) else self._objective
+        qb = None
+        if group is not None:
+            qb = np.concatenate([[0], np.cumsum(np.asarray(group, np.int64))])
+        objective.init(label, weight, qb)
+        leaf_pred = model.predict_leaf_index(X).astype(np.int64)   # [n, T]
+        w_dev = jnp.asarray(np.ones(n, np.float32) if weight is None
+                            else np.asarray(weight, np.float32))
+        label_dev = jnp.asarray(label.astype(np.float32))
+        scores = np.zeros((K, n), dtype=np.float64)
+
+        for it in range(num_iters):
+            g, h = objective.get_gradients_multi(
+                jnp.asarray(scores.astype(np.float32)), label_dev, w_dev)
+            g = np.asarray(jax.device_get(g), np.float64)
+            h = np.asarray(jax.device_get(h), np.float64)
+            for k in range(K):
+                tree = model.trees[it * K + k]
+                nl = tree.num_leaves
+                leaves = leaf_pred[:, it * K + k]
+                sum_g = np.bincount(leaves, weights=g[k], minlength=nl)[:nl]
+                sum_h = np.bincount(leaves, weights=h[k], minlength=nl)[:nl] + 1e-15
+                out = -np.sign(sum_g) * np.maximum(np.abs(sum_g) - l1, 0.0) / (sum_h + l2)
+                if mds > 0.0:
+                    out = np.clip(out, -mds, mds)
+                tree.leaf_value[:nl] = decay * tree.leaf_value[:nl] + \
+                    (1.0 - decay) * out * tree.shrinkage
+                scores[k] += tree.leaf_value[leaves]
+        new_booster = Booster(params=dict(self.params),
+                              model_str=model.save_model_to_string())
+        return new_booster
 
     # -- model IO ------------------------------------------------------------
     def save_model(self, filename: str, num_iteration: int = -1,

@@ -182,12 +182,6 @@ class GBDT:
         if objective is not None:
             objective.init(md.label, md.weight, md.query_boundaries)
 
-        # resume (continued training): replay loaded model onto the scores.
-        # Loaded trees carry double thresholds, not train-set bins, so replay
-        # predicts on host raw features (init_model path, engine.py).
-        if self.num_init_iteration > 0:
-            raise NotImplementedError("continued training (init_model) lands with M2")
-
         # validation sets
         self.valid_sets: List[Tuple[str, BinnedDataset, jax.Array, jax.Array, List]] = []
 
@@ -206,6 +200,16 @@ class GBDT:
         # converted outputs (rf.hpp EvalOneMetric)
         self._metric_objective = objective
 
+        # continued training (input_model / init_model, gbdt.cpp:64-169 with
+        # num_init_iteration_ > 0): map the loaded trees' double thresholds
+        # back onto this dataset's bins, then replay them onto the score
+        # entirely on device
+        if self.num_init_iteration > 0:
+            K = self.num_tree_per_iteration
+            for idx, tree in enumerate(self.model.trees):
+                tree.set_bin_thresholds(train_set.bin_mappers)
+                self._add_tree_to_train_score(tree, idx % K, 1.0)
+
     # -- validation ----------------------------------------------------------
     def add_valid(self, name: str, valid: BinnedDataset, metrics: List) -> None:
         bins_v = jnp.asarray(valid.bins)
@@ -214,9 +218,16 @@ class GBDT:
         if valid.metadata.init_score is not None:
             init = valid.padded(valid.metadata.init_score.astype(np.float32))
             score_v = jnp.broadcast_to(init, score_v.shape).astype(jnp.float32)
-        # replay already-loaded model trees (continued training)
-        if self.model.current_iteration > 0:
-            raise NotImplementedError("add_valid after continued training lands with M2")
+        # replay every existing tree (loaded model and/or earlier iterations)
+        # onto the new validation score
+        for idx, tree in enumerate(self.model.trees):
+            if tree.num_leaves <= 1:
+                score_v = score_v.at[idx % K].add(jnp.float32(tree.leaf_value[0]))
+                continue
+            tree_dev, leaf_out = self._tree_to_device(tree)
+            score_v = _traverse_update(bins_v, score_v, leaf_out, tree_dev,
+                                       self.meta_dev, self._depth_iters(tree),
+                                       idx % K)
         for m in metrics:
             m.init(valid.metadata.label, valid.metadata.weight,
                    valid.metadata.query_boundaries)
@@ -280,24 +291,28 @@ class GBDT:
                                          self.meta_dev, depth_iters, k)
         self.iter -= 1
 
+    def _depth_iters(self, tree: Tree) -> int:
+        """Traversal trip count covering this run's grower and any loaded
+        tree (which may be larger than the current num_leaves)."""
+        return max(self.grower_cfg.num_leaves - 1, tree.num_leaves - 1, 1)
+
     def _add_tree_to_train_score(self, tree: Tree, k: int, scale: float) -> None:
         """score[k] += scale * tree(x) over the training bins (DART drop /
-        normalize, RF running average)."""
+        normalize, RF running average, continued-training replay)."""
         if tree.num_leaves <= 1:
             self.score = self.score.at[k].add(jnp.float32(scale * tree.leaf_value[0]))
             return
         tree_dev, leaf_out = self._tree_to_device(tree)
-        depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
         self.score = _traverse_update(self.bins_dev, self.score,
                                       leaf_out * jnp.float32(scale), tree_dev,
-                                      self.meta_dev, depth_iters, k)
+                                      self.meta_dev, self._depth_iters(tree), k)
 
     def _add_tree_to_valid_scores(self, tree: Tree, k: int, scale: float) -> None:
         if tree.num_leaves <= 1:
             for vs in self.valid_sets:
                 vs[3] = vs[3].at[k].add(jnp.float32(scale * tree.leaf_value[0]))
             return
-        depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
+        depth_iters = self._depth_iters(tree)
         tree_dev, leaf_out = self._tree_to_device(tree)
         leaf_out = leaf_out * jnp.float32(scale)
         for vs in self.valid_sets:

@@ -90,6 +90,11 @@ class DART(GBDT):
         self.drop_index: list = []
         Log.info("Using DART")
 
+    def _run_tree(self, i: int, k: int):
+        """Tree k of this run's iteration i, past any loaded model's trees."""
+        K = self.num_tree_per_iteration
+        return self.model.trees[(self.num_init_iteration + i) * K + k]
+
     def train_one_iter(self, grad=None, hess=None) -> bool:
         self._dropping_trees()
         stopped = super().train_one_iter(grad, hess)
@@ -129,10 +134,12 @@ class DART(GBDT):
                         self.drop_index.append(i)
                         if max_drop > 0 and len(self.drop_index) >= max_drop:
                             break
-        # remove dropped trees from the training score (dart.hpp:119-126)
+        # remove dropped trees from the training score (dart.hpp:119-126);
+        # drop candidates are this run's trees, offset past any loaded model
+        # (dart.hpp pushes num_init_iteration_ + i)
         for i in self.drop_index:
             for k in range(K):
-                self._add_tree_to_train_score(self.model.trees[i * K + k], k, -1.0)
+                self._add_tree_to_train_score(self._run_tree(i, k), k, -1.0)
         k_cnt = float(len(self.drop_index))
         lr = float(self.config.learning_rate)
         if not bool(cfg.xgboost_dart_mode):
@@ -160,7 +167,7 @@ class DART(GBDT):
             weight_sub = 1.0 / (k + lr)
         for i in self.drop_index:
             for kk in range(K):
-                tree = self.model.trees[i * K + kk]
+                tree = self._run_tree(i, kk)
                 self._add_tree_to_valid_scores(tree, kk, factor - 1.0)
                 self._add_tree_to_train_score(tree, kk, factor)
                 tree.apply_shrinkage(factor)
@@ -188,6 +195,10 @@ class RF(GBDT):
             Log.fatal("Cannot use init_score in RF mode")
         self.shrinkage_rate = 1.0
         self.model.average_output = True
+        # continued training: GBDT.__init__ replayed the loaded trees as a
+        # SUM; RF scores are running averages (rf.hpp:33-38)
+        if self.num_init_iteration > 0:
+            self._multiply_scores(0, 1.0 / self.num_init_iteration)
         obj = self.objective
         self._leaf_transform = lambda lv: obj.convert_output(lv)
         self._metric_objective = None
@@ -216,7 +227,7 @@ class RF(GBDT):
         gmask, cmask = self._bagging_masks(grads, hesss)
         self._bag_cmask = cmask
         fmask = self._feature_sample()
-        m = float(self.iter)
+        m = float(self.iter + self.num_init_iteration)
         for k in range(self.num_tree_per_iteration):
             vals = _make_vals(grads, hesss, gmask, cmask, k)
             out = self.grower(self.bins_dev, vals, fmask)

@@ -28,10 +28,18 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     params = dict(params)
     if fobj is not None:
         params["objective"] = "none"
+    init = None
     if init_model is not None:
-        raise NotImplementedError("continued training (init_model) lands with M2")
+        # continued training: accept a filename, Booster or raw model
+        if isinstance(init_model, str):
+            from .models.gbdt_model import GBDTModel
+            init = GBDTModel.load_model(init_model)
+        elif isinstance(init_model, Booster):
+            init = init_model._model
+        else:
+            init = init_model
 
-    booster = Booster(params=params, train_set=train_set)
+    booster = Booster(params=params, train_set=train_set, init_model=init)
     is_valid_contain_train = False
     train_data_name = "training"
     if valid_sets is not None:

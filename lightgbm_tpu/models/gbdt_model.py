@@ -46,8 +46,15 @@ class GBDTModel:
 
     # -- prediction ----------------------------------------------------------
     def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
-                    num_iteration: int = -1) -> np.ndarray:
-        """Raw margin scores [n, num_tree_per_iteration] by summing trees."""
+                    num_iteration: int = -1, early_stop: Optional[str] = None,
+                    early_stop_freq: int = 10,
+                    early_stop_margin: float = 10.0) -> np.ndarray:
+        """Raw margin scores [n, num_tree_per_iteration] by summing trees.
+
+        early_stop: None/'none', 'binary' (stop a row once 2*|margin| exceeds
+        early_stop_margin) or 'multiclass' (top1-top2 gap) — vectorized form
+        of src/boosting/prediction_early_stop.cpp, checked every
+        early_stop_freq iterations per row."""
         n = X.shape[0]
         k = self.num_tree_per_iteration
         out = np.zeros((n, k), dtype=np.float64)
@@ -55,9 +62,38 @@ class GBDTModel:
         if num_iteration is None or num_iteration <= 0:
             num_iteration = total_iter
         end = min(start_iteration + num_iteration, total_iter)
+        use_early = early_stop in ("binary", "multiclass")
+        if use_early and early_stop == "multiclass" and k < 2:
+            Log.fatal("Multiclass early stopping needs predictions of length >= 2")
+        if use_early and early_stop == "binary" and k != 1:
+            Log.fatal("Binary early stopping needs predictions of length one")
+        active = np.ones(n, dtype=bool)
+        all_active = True  # avoid per-iteration fancy-index copies until a row stops
+        rounds_since_check = 0
         for it in range(start_iteration, end):
+            if use_early and not all_active:
+                rows = X[active]
+                if rows.shape[0] == 0:
+                    break
+            else:
+                rows = X
             for j in range(k):
-                out[:, j] += self.trees[it * k + j].predict(X)
+                pred = self.trees[it * k + j].predict(rows)
+                if use_early and not all_active:
+                    out[active, j] += pred
+                else:
+                    out[:, j] += pred
+            if use_early:
+                rounds_since_check += 1
+                if rounds_since_check == early_stop_freq:
+                    rounds_since_check = 0
+                    if early_stop == "binary":
+                        margin = 2.0 * np.abs(out[:, 0])
+                    else:
+                        part = np.partition(out, k - 2, axis=1)
+                        margin = part[:, k - 1] - part[:, k - 2]
+                    active &= ~(margin > early_stop_margin)
+                    all_active = bool(active.all())
         return out
 
     def num_prediction_iterations(self, start_iteration: int = 0,

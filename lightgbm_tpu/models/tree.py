@@ -200,6 +200,36 @@ class Tree:
             return leaf.astype(np.float64)
         return self.leaf_value[leaf]
 
+    def set_bin_thresholds(self, bin_mappers) -> None:
+        """Map double thresholds back to bin thresholds against a training
+        dataset's mappers so a loaded model can be replayed on binned data
+        (continued training / validation replay).  Inverse of RealThreshold:
+        the stored double threshold is the upper bound of its bin, so
+        values_to_bins maps it exactly onto that bin."""
+        ni = self.num_leaves - 1
+        self.cat_boundaries_inner = [0]
+        self.cat_threshold_inner = []
+        for node in range(ni):
+            f = int(self.split_feature[node])
+            mapper = bin_mappers[f]
+            dt = int(self.decision_type[node])
+            if dt & _K_CATEGORICAL_MASK:
+                ci = int(self.threshold[node])
+                self.threshold_in_bin[node] = ci
+                lo, hi = self.cat_boundaries[ci], self.cat_boundaries[ci + 1]
+                cats = [(i - lo) * 32 + j for i in range(lo, hi) for j in range(32)
+                        if (self.cat_threshold[i] >> j) & 1]
+                bins = sorted(mapper.categorical_2_bin[c] for c in cats
+                              if c in mapper.categorical_2_bin)
+                words = [0] * ((max(bins) // 32 + 1) if bins else 0)
+                for b in bins:
+                    words[b // 32] |= 1 << (b % 32)
+                self.cat_threshold_inner.extend(words)
+                self.cat_boundaries_inner.append(len(self.cat_threshold_inner))
+            else:
+                self.threshold_in_bin[node] = int(
+                    mapper.values_to_bins(np.array([self.threshold[node]]))[0])
+
     def expected_value(self) -> float:
         if self.num_leaves == 1:
             return float(self.leaf_value[0])

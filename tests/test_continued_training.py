@@ -1,0 +1,156 @@
+"""Continued training (init_model), validation replay, and refit tests
+(gbdt.cpp num_init_iteration_, RefitTree; reference test_engine.py
+continued-training cases)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def test_init_model_continues_training(binary_data):
+    X, y, Xt, yt = binary_data
+    p = {"objective": "binary", "metric": "binary_logloss", "verbose": -1}
+    train = lgb.Dataset(X, label=y)
+    bst1 = lgb.train(dict(p), train, num_boost_round=10, verbose_eval=0)
+    logloss_10 = _logloss(bst1.predict(Xt), yt)
+
+    # continue 10 more iterations from the first booster
+    train2 = lgb.Dataset(X, label=y)
+    bst2 = lgb.train(dict(p), train2, num_boost_round=10, init_model=bst1,
+                     verbose_eval=0)
+    assert bst2.num_trees() == 20
+    logloss_20 = _logloss(bst2.predict(Xt), yt)
+    assert logloss_20 < logloss_10
+
+    # a fresh 20-iteration run should closely match the 10+10 continuation
+    bst_ref = lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=20,
+                        verbose_eval=0)
+    logloss_ref = _logloss(bst_ref.predict(Xt), yt)
+    assert abs(logloss_20 - logloss_ref) < 0.02
+
+
+def test_init_model_from_file(binary_data, tmp_path):
+    X, y, _, _ = binary_data
+    p = {"objective": "binary", "verbose": -1}
+    bst1 = lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=5,
+                     verbose_eval=0)
+    f = tmp_path / "model.txt"
+    bst1.save_model(str(f))
+    bst2 = lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=5,
+                     init_model=str(f), verbose_eval=0)
+    assert bst2.num_trees() == 10
+    # first five trees identical to the saved model
+    s1 = bst1.model_to_string()
+    s2 = bst2.model_to_string()
+    assert s1.split("Tree=1")[1].split("Tree=2")[0] in s2
+
+
+def test_continued_training_valid_replay(binary_data):
+    """Validation scores after continuation must equal full-model predictions
+    on the validation set."""
+    X, y, Xt, yt = binary_data
+    p = {"objective": "binary", "metric": "binary_logloss", "verbose": -1}
+    bst1 = lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=6,
+                     verbose_eval=0)
+    train2 = lgb.Dataset(X, label=y)
+    valid2 = lgb.Dataset(Xt, label=yt, reference=train2)
+    evals = {}
+    bst2 = lgb.train(dict(p), train2, num_boost_round=6, init_model=bst1,
+                     valid_sets=[valid2],
+                     callbacks=[lgb.record_evaluation(evals)], verbose_eval=0)
+    final_pred = bst2.predict(Xt)
+    final_logloss = _logloss(final_pred, yt)
+    assert evals["valid_0"]["binary_logloss"][-1] == pytest.approx(
+        final_logloss, rel=1e-4)
+
+
+def test_refit(binary_data):
+    X, y, Xt, yt = binary_data
+    p = {"objective": "binary", "verbose": -1}
+    bst = lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=10,
+                    verbose_eval=0)
+    refit = bst.refit(Xt, yt)
+    # structures unchanged
+    assert refit.num_trees() == bst.num_trees()
+    d_old = bst.dump_model()
+    d_new = refit.dump_model()
+    for t_old, t_new in zip(d_old["tree_info"], d_new["tree_info"]):
+        assert t_old["num_leaves"] == t_new["num_leaves"]
+    # leaf values moved toward the new data: better logloss there
+    assert _logloss(refit.predict(Xt), yt) < _logloss(bst.predict(Xt), yt)
+    # decay_rate=1 keeps the model unchanged
+    same = bst.refit(Xt, yt, decay_rate=1.0)
+    np.testing.assert_allclose(same.predict(Xt, raw_score=True),
+                               bst.predict(Xt, raw_score=True), rtol=1e-9)
+
+
+def test_rollback_after_continuation(binary_data):
+    X, y, _, _ = binary_data
+    p = {"objective": "binary", "verbose": -1}
+    bst1 = lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=4,
+                     verbose_eval=0)
+    train2 = lgb.Dataset(X, label=y)
+    bst2 = lgb.train(dict(p), train2, num_boost_round=3, init_model=bst1,
+                     verbose_eval=0)
+    before = bst2.num_trees()
+    bst2.rollback_one_iter()
+    assert bst2.num_trees() == before - 1
+
+
+def _logloss(p, y):
+    p = np.clip(p, 1e-15, 1 - 1e-15)
+    return float(np.mean(-(y * np.log(p) + (1 - y) * np.log(1 - p))))
+
+
+def test_prediction_early_stop(binary_data):
+    """pred_early_stop returns partial sums for confident rows that agree in
+    sign/class with the full prediction (prediction_early_stop.cpp)."""
+    X, y, Xt, yt = binary_data
+    bst = lgb.train({"objective": "binary", "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=30, verbose_eval=0)
+    full = bst.predict(Xt, raw_score=True)
+    es = bst.predict(Xt, raw_score=True, pred_early_stop=True,
+                     pred_early_stop_freq=5, pred_early_stop_margin=1.0)
+    # early-stopped rows keep the decision: same sign for confident rows
+    confident = np.abs(es) * 2.0 > 1.0
+    assert np.all(np.sign(es[confident]) == np.sign(full[confident]))
+    # huge margin => no early stop => identical output
+    same = bst.predict(Xt, raw_score=True, pred_early_stop=True,
+                       pred_early_stop_margin=1e9)
+    np.testing.assert_allclose(same, full, rtol=1e-12)
+
+
+def test_rf_continued_training(binary_data):
+    """RF continuation: the running-average score must match predictions over
+    all (old + new) trees (rf.hpp Init MultiplyScore by 1/num_init)."""
+    X, y, _, _ = binary_data
+    p = {"objective": "binary", "boosting": "rf", "verbose": -1,
+         "bagging_freq": 1, "bagging_fraction": 0.632, "feature_fraction": 0.7}
+    bst1 = lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=5,
+                     verbose_eval=0)
+    bst2 = lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=5,
+                     init_model=bst1, verbose_eval=0)
+    assert bst2.num_trees() == 10
+    scores = bst2._engine.raw_train_score()[0]
+    pred = bst2.predict(X)  # averaged over all 10 trees
+    np.testing.assert_allclose(pred, scores, rtol=1e-4, atol=1e-5)
+
+
+def test_dart_continued_training(binary_data):
+    """DART continuation drops only this run's trees and keeps score/model
+    bookkeeping consistent."""
+    X, y, _, _ = binary_data
+    p = {"objective": "binary", "boosting": "dart", "drop_rate": 0.5,
+         "skip_drop": 0.0, "verbose": -1}
+    bst1 = lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=5,
+                     verbose_eval=0)
+    saved = bst1.model_to_string()
+    bst2 = lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=5,
+                     init_model=bst1, verbose_eval=0)
+    assert bst2.num_trees() == 10
+    # loaded trees must not have been renormalized by this run's dropout
+    first_loaded = bst2.model_to_string().split("Tree=1\n")[1].split("Tree=2")[0]
+    assert first_loaded == saved.split("Tree=1\n")[1].split("Tree=2")[0]
+    scores = bst2._engine.raw_train_score()[0]
+    pred = bst2.predict(X, raw_score=True)
+    np.testing.assert_allclose(pred, scores, rtol=2e-4, atol=2e-5)
