@@ -238,6 +238,8 @@ class Booster:
         X = _to_2d_float(data)
         if pred_leaf:
             return self._model.predict_leaf_index(X, num_iteration)
+        if pred_contrib:
+            return self._model.predict_contrib(X, num_iteration)
         early = None
         # reference gates early stop on NeedAccuratePrediction: only binary /
         # multiclass / ranking objectives tolerate truncated sums

@@ -58,10 +58,7 @@ class GBDTModel:
         n = X.shape[0]
         k = self.num_tree_per_iteration
         out = np.zeros((n, k), dtype=np.float64)
-        total_iter = self.current_iteration
-        if num_iteration is None or num_iteration <= 0:
-            num_iteration = total_iter
-        end = min(start_iteration + num_iteration, total_iter)
+        end = self._resolve_end_iteration(start_iteration, num_iteration)
         use_early = early_stop in ("binary", "multiclass")
         if use_early and early_stop == "multiclass" and k < 2:
             Log.fatal("Multiclass early stopping needs predictions of length >= 2")
@@ -98,16 +95,32 @@ class GBDTModel:
 
     def num_prediction_iterations(self, start_iteration: int = 0,
                                   num_iteration: int = -1) -> int:
+        return max(self._resolve_end_iteration(start_iteration, num_iteration)
+                   - start_iteration, 1)
+
+    def _resolve_end_iteration(self, start_iteration: int, num_iteration) -> int:
+        """'<= 0 means all' + clamp rule shared by every prediction entry."""
         total_iter = self.current_iteration
         if num_iteration is None or num_iteration <= 0:
             num_iteration = total_iter
-        return max(min(start_iteration + num_iteration, total_iter) - start_iteration, 1)
+        return min(start_iteration + num_iteration, total_iter)
+
+    def predict_contrib(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        """SHAP feature contributions summed over trees: [n, F+1] for one
+        model per iteration, [n, K*(F+1)] for multiclass (c_api predict
+        CONTRIB layout)."""
+        n = X.shape[0]
+        F = self.max_feature_idx + 1
+        k = self.num_tree_per_iteration
+        end = self._resolve_end_iteration(0, num_iteration)
+        out = np.zeros((n, k, F + 1))
+        for it in range(end):
+            for j in range(k):
+                out[:, j, :] += self.trees[it * k + j].predict_contrib(X, F)
+        return out[:, 0, :] if k == 1 else out.reshape(n, k * (F + 1))
 
     def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
-        total_iter = self.current_iteration
-        if num_iteration is None or num_iteration <= 0:
-            num_iteration = total_iter
-        end = min(num_iteration, total_iter) * self.num_tree_per_iteration
+        end = self._resolve_end_iteration(0, num_iteration) * self.num_tree_per_iteration
         outs = [self.trees[i].predict_leaf_index(X) for i in range(end)]
         return np.stack(outs, axis=1) if outs else np.zeros((X.shape[0], 0))
 

@@ -230,6 +230,97 @@ class Tree:
                 self.threshold_in_bin[node] = int(
                     mapper.values_to_bins(np.array([self.threshold[node]]))[0])
 
+    # -- SHAP contributions (tree.cpp TreeSHAP:591-698, arXiv:1706.06060) ----
+    def _decide_one(self, node: int, fval: float) -> int:
+        """Single-value decision -> child node id (Tree::Decision)."""
+        dt = int(self.decision_type[node])
+        if dt & _K_CATEGORICAL_MASK:
+            mt = (dt >> 2) & 3
+            if np.isnan(fval):
+                v = -1 if mt == MISSING_NAN else 0
+            else:
+                v = int(fval) if np.isfinite(fval) else -1
+            go_left = False
+            if v >= 0:
+                ci = int(self.threshold_in_bin[node])
+                lo, hi = self.cat_boundaries[ci], self.cat_boundaries[ci + 1]
+                i1, i2 = v // 32, v % 32
+                go_left = lo + i1 < hi and bool((self.cat_threshold[lo + i1] >> i2) & 1)
+        else:
+            mt = (dt >> 2) & 3
+            default_left = bool(dt & _K_DEFAULT_LEFT_MASK)
+            if np.isnan(fval) and mt != MISSING_NAN:
+                fval = 0.0
+            if (mt == MISSING_ZERO and abs(fval) <= _K_ZERO_THRESHOLD) or \
+                    (mt == MISSING_NAN and np.isnan(fval)):
+                go_left = default_left
+            else:
+                go_left = fval <= self.threshold[node]
+        return int(self.left_child[node] if go_left else self.right_child[node])
+
+    def _data_count(self, node: int) -> float:
+        if node < 0:
+            return float(self.leaf_count[~node])
+        return float(self.internal_count[node])
+
+    def predict_contrib(self, X: np.ndarray, num_features: int) -> np.ndarray:
+        """Per-feature SHAP contributions [n, num_features + 1]; the last
+        column accumulates the expected value (Tree::PredictContrib,
+        tree.h:466-475)."""
+        n = X.shape[0]
+        phi = np.zeros((n, num_features + 1))
+        phi[:, num_features] += self.expected_value()
+        if self.num_leaves > 1:
+            for row in range(n):
+                self._tree_shap(X[row], phi[row], 0, 0, [], 1.0, 1.0, -1)
+        return phi
+
+    def _tree_shap(self, x, phi, node, unique_depth, parent_path,
+                   parent_zero_fraction, parent_one_fraction,
+                   parent_feature_index) -> None:
+        # each frame owns a copy of the path prefix (reference keeps one big
+        # buffer with std::copy per level)
+        path = [list(el) for el in parent_path[:unique_depth]]
+        path.append([parent_feature_index, parent_zero_fraction,
+                     parent_one_fraction, 1.0 if unique_depth == 0 else 0.0])
+        for i in range(unique_depth - 1, -1, -1):
+            path[i + 1][3] += parent_one_fraction * path[i][3] * (i + 1) / (unique_depth + 1.0)
+            path[i][3] = parent_zero_fraction * path[i][3] * (unique_depth - i) / (unique_depth + 1.0)
+
+        if node < 0:
+            for i in range(1, unique_depth + 1):
+                w = _unwound_path_sum(path, unique_depth, i)
+                fi, one_f, zero_f = path[i][0], path[i][2], path[i][1]
+                phi[fi] += w * (one_f - zero_f) * self.leaf_value[~node]
+            return
+
+        f = int(self.split_feature[node])
+        hot_index = self._decide_one(node, float(x[f]))
+        cold_index = int(self.right_child[node]) if hot_index == int(self.left_child[node]) \
+            else int(self.left_child[node])
+        w = self._data_count(node)
+        hot_zero_fraction = self._data_count(hot_index) / w
+        cold_zero_fraction = self._data_count(cold_index) / w
+        incoming_zero_fraction = 1.0
+        incoming_one_fraction = 1.0
+
+        path_index = 0
+        while path_index <= unique_depth:
+            if path[path_index][0] == f:
+                break
+            path_index += 1
+        if path_index != unique_depth + 1:
+            incoming_zero_fraction = path[path_index][1]
+            incoming_one_fraction = path[path_index][2]
+            _unwind_path(path, unique_depth, path_index)
+            unique_depth -= 1
+
+        self._tree_shap(x, phi, hot_index, unique_depth + 1, path,
+                        hot_zero_fraction * incoming_zero_fraction,
+                        incoming_one_fraction, f)
+        self._tree_shap(x, phi, cold_index, unique_depth + 1, path,
+                        cold_zero_fraction * incoming_zero_fraction, 0.0, f)
+
     def expected_value(self) -> float:
         if self.num_leaves == 1:
             return float(self.leaf_value[0])
@@ -353,3 +444,39 @@ class Tree:
 
 def _fmt_float32(v) -> str:
     return repr(round(float(v), 6)) if v == v else "nan"
+
+
+def _unwind_path(path, unique_depth, path_index) -> None:
+    """Tree::UnwindPath (tree.cpp:605-628)."""
+    one_fraction = path[path_index][2]
+    zero_fraction = path[path_index][1]
+    next_one_portion = path[unique_depth][3]
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = path[i][3]
+            path[i][3] = next_one_portion * (unique_depth + 1.0) / ((i + 1) * one_fraction)
+            next_one_portion = tmp - path[i][3] * zero_fraction * (unique_depth - i) / (unique_depth + 1.0)
+        else:
+            path[i][3] = path[i][3] * (unique_depth + 1.0) / (zero_fraction * (unique_depth - i))
+    for i in range(path_index, unique_depth):
+        path[i][0] = path[i + 1][0]
+        path[i][1] = path[i + 1][1]
+        path[i][2] = path[i + 1][2]
+
+
+def _unwound_path_sum(path, unique_depth, path_index) -> float:
+    """Tree::UnwoundPathSum (tree.cpp:630-649)."""
+    one_fraction = path[path_index][2]
+    zero_fraction = path[path_index][1]
+    next_one_portion = path[unique_depth][3]
+    total = 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = next_one_portion * (unique_depth + 1.0) / ((i + 1) * one_fraction)
+            total += tmp
+            next_one_portion = path[i][3] - tmp * zero_fraction * \
+                ((unique_depth - i) / (unique_depth + 1.0))
+        else:
+            total += (path[i][3] / zero_fraction) / \
+                ((unique_depth - i) / (unique_depth + 1.0))
+    return total

@@ -1,0 +1,59 @@
+"""SHAP contribution tests (Tree::TreeSHAP, tree.cpp:591-698) — the key
+invariant mirrors reference test_engine.py:528: contribs sum to raw score."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def test_contrib_sums_to_raw_score(binary_data):
+    X, y, Xt, yt = binary_data
+    bst = lgb.train({"objective": "binary", "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=10, verbose_eval=0)
+    sub = Xt[:50]
+    contrib = bst.predict(sub, pred_contrib=True)
+    assert contrib.shape == (50, X.shape[1] + 1)
+    raw = bst.predict(sub, raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-6, atol=1e-8)
+
+
+def test_contrib_multiclass(multiclass_data):
+    X, y, Xt, yt = multiclass_data
+    bst = lgb.train({"objective": "multiclass", "num_class": 5, "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5, verbose_eval=0)
+    sub = Xt[:20]
+    contrib = bst.predict(sub, pred_contrib=True)
+    F = X.shape[1]
+    assert contrib.shape == (20, 5 * (F + 1))
+    raw = bst.predict(sub, raw_score=True)  # [n, 5]
+    sums = contrib.reshape(20, 5, F + 1).sum(axis=2)
+    np.testing.assert_allclose(sums, raw, rtol=1e-6, atol=1e-8)
+
+
+def test_contrib_unused_feature_is_zero():
+    rng = np.random.default_rng(0)
+    n = 500
+    X = np.column_stack([rng.normal(size=n), np.zeros(n)])  # feature 1 constant
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5, verbose_eval=0)
+    contrib = bst.predict(X[:10], pred_contrib=True)
+    np.testing.assert_allclose(contrib[:, 1], 0.0, atol=1e-12)
+    assert np.any(np.abs(contrib[:, 0]) > 0)
+
+
+def test_contrib_categorical():
+    """TreeSHAP over categorical splits also sums to the raw score."""
+    rng = np.random.default_rng(2)
+    n = 800
+    cat = rng.integers(0, 10, n).astype(float)
+    y = np.isin(cat, [2, 5]).astype(float)
+    X = np.column_stack([cat, rng.normal(size=n)])
+    bst = lgb.train({"objective": "binary", "verbose": -1, "min_data_in_leaf": 5,
+                     "min_data_per_group": 5, "cat_smooth": 1.0},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]),
+                    num_boost_round=5, verbose_eval=0)
+    sub = X[:30]
+    contrib = bst.predict(sub, pred_contrib=True)
+    raw = bst.predict(sub, raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-6, atol=1e-8)
