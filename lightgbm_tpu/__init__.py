@@ -7,10 +7,12 @@ from .callback import (early_stopping, log_evaluation, print_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
 from .engine import cv, train
+from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 from .utils.log import LightGBMError
 
 __version__ = "0.1.0"
 
 __all__ = ["Dataset", "Booster", "Config", "train", "cv", "LightGBMError",
            "early_stopping", "log_evaluation", "print_evaluation",
-           "record_evaluation", "reset_parameter"]
+           "record_evaluation", "reset_parameter",
+           "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"]

@@ -146,6 +146,7 @@ class Booster:
         self.best_iteration = -1
         self.best_score: Dict = {}
         self._valid_names: List[str] = ["training"]
+        self._valid_data: List = []
         self._engine: Optional[GBDT] = None
         self._model: Optional[GBDTModel] = None
         self._objective = None
@@ -179,6 +180,29 @@ class Booster:
         else:
             raise LightGBMError("Booster needs train_set or model file")
 
+    # -- pickling (reference basic.py Booster __getstate__/__setstate__:
+    # serialize as the model string; the engine/device state is not portable)
+    def __getstate__(self) -> Dict:
+        state = self.__dict__.copy()
+        state.pop("_engine", None)
+        state.pop("train_set", None)
+        state.pop("_objective", None)
+        if self._model is not None:
+            state["_model_str"] = self._model.save_model_to_string()
+        state.pop("_model", None)
+        return state
+
+    def __setstate__(self, state: Dict) -> None:
+        model_str = state.pop("_model_str", None)
+        self.__dict__.update(state)
+        self._engine = None
+        self.train_set = None
+        self._model = GBDTModel.load_model_from_string(model_str) \
+            if model_str is not None else None
+        cfg = self.config if self.config is not None else Config({})
+        self._objective = create_objective_from_model_string(
+            self._model.objective_str, cfg) if self._model is not None else None
+
     # -- training ------------------------------------------------------------
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         if self._engine is None:
@@ -191,6 +215,7 @@ class Booster:
         metrics = create_metrics(self.config.metric, self.config)
         self._engine.add_valid(name, data.binned, metrics)
         self._valid_names.append(name)
+        self._valid_data.append((name, data))
         return self
 
     def update(self, train_set=None, fobj=None) -> bool:
@@ -218,7 +243,16 @@ class Booster:
         return self._wrap_eval(self._engine.eval_train(), feval, "training")
 
     def eval_valid(self, feval=None) -> List:
-        return self._wrap_eval(self._engine.eval_valid(), feval, None)
+        out = self._wrap_eval(self._engine.eval_valid(), None, None)
+        if feval is not None:
+            # custom metric runs on every validation set too (engine.py
+            # _agg_standard_result over all eval sets in the reference)
+            for i, (name, ds) in enumerate(self._valid_data):
+                raw = self._engine.raw_valid_score(i)
+                preds = raw[0] if raw.shape[0] == 1 else raw.reshape(-1)
+                mname, val, hib = feval(preds, ds)
+                out.append((name, mname, val, hib))
+        return out
 
     def _wrap_eval(self, results, feval, dataset_name):
         out = [(name, metric, val, hib) for (name, metric, val, hib) in results]

@@ -1,0 +1,140 @@
+"""sklearn wrapper tests (reference tests/python_package_test/test_sklearn.py)."""
+import pickle
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def test_regressor(regression_data):
+    X, y, Xt, yt = regression_data
+    reg = lgb.LGBMRegressor(n_estimators=15, num_leaves=31)
+    reg.fit(X, y)
+    pred = reg.predict(Xt)
+    assert np.mean((pred - yt) ** 2) < 0.25
+    assert reg.n_features_ == X.shape[1]
+    imp = reg.feature_importances_
+    assert imp.shape == (X.shape[1],)
+    assert imp.sum() > 0
+
+
+def test_classifier_binary(binary_data):
+    X, y, Xt, yt = binary_data
+    clf = lgb.LGBMClassifier(n_estimators=15)
+    clf.fit(X, y)
+    assert list(clf.classes_) == [0.0, 1.0]
+    proba = clf.predict_proba(Xt)
+    assert proba.shape == (len(yt), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    labels = clf.predict(Xt)
+    acc = np.mean(labels == yt)
+    assert acc > 0.7
+
+
+def test_classifier_multiclass(multiclass_data):
+    X, y, Xt, yt = multiclass_data
+    clf = lgb.LGBMClassifier(n_estimators=20)
+    clf.fit(X, y)
+    assert clf.n_classes_ == 5
+    proba = clf.predict_proba(Xt)
+    assert proba.shape == (len(yt), 5)
+    labels = clf.predict(Xt)
+    assert np.mean(labels == yt) > 0.4
+
+
+def test_classifier_string_labels(binary_data):
+    X, y, _, _ = binary_data
+    y_str = np.where(y > 0, "pos", "neg")
+    clf = lgb.LGBMClassifier(n_estimators=5)
+    clf.fit(X, y_str)
+    labels = clf.predict(X)
+    assert set(labels) <= {"pos", "neg"}
+    assert np.mean(labels == y_str) > 0.7
+
+
+def test_ranker(rank_data):
+    X, y, q, Xt, yt, qt = rank_data
+    rk = lgb.LGBMRanker(n_estimators=15, min_child_samples=1)
+    rk.fit(X, y, group=q, eval_set=[(Xt, yt)], eval_group=[qt],
+           eval_metric="ndcg")
+    assert "ndcg@1" in rk.evals_result_["valid_0"]
+    scores = rk.predict(Xt)
+    assert scores.shape == (len(yt),)
+
+
+def test_custom_objective(regression_data):
+    X, y, Xt, yt = regression_data
+
+    def l2_obj(y_true, y_pred):
+        return y_pred - y_true, np.ones_like(y_true)
+
+    reg = lgb.LGBMRegressor(n_estimators=10, objective=l2_obj)
+    reg.fit(X, y)
+    pred = reg.predict(Xt)
+    # matches built-in l2 training reasonably well
+    builtin = lgb.LGBMRegressor(n_estimators=10).fit(X, y).predict(Xt)
+    assert np.mean((pred - yt) ** 2) < np.mean((builtin - yt) ** 2) + 0.1
+
+
+def test_early_stopping_sklearn(binary_data):
+    X, y, Xt, yt = binary_data
+    clf = lgb.LGBMClassifier(n_estimators=100, learning_rate=0.3)
+    clf.fit(X, y, eval_set=[(Xt, yt)], eval_metric="binary_logloss",
+            early_stopping_rounds=3)
+    assert clf.best_iteration_ > 0
+    assert clf.booster_.num_trees() < 100
+
+
+def test_pickle_round_trip(binary_data):
+    X, y, Xt, _ = binary_data
+    clf = lgb.LGBMClassifier(n_estimators=5)
+    clf.fit(X, y)
+    blob = pickle.dumps(clf)
+    clone = pickle.loads(blob)
+    np.testing.assert_allclose(clone.predict_proba(Xt), clf.predict_proba(Xt))
+
+
+def test_get_set_params():
+    reg = lgb.LGBMRegressor(num_leaves=15, learning_rate=0.2, max_bin=63)
+    params = reg.get_params()
+    assert params["num_leaves"] == 15
+    assert params["learning_rate"] == 0.2
+    reg.set_params(num_leaves=7)
+    assert reg.num_leaves == 7
+    reg2 = lgb.LGBMRegressor(**{k: v for k, v in params.items()})
+    assert reg2.num_leaves == 15
+
+
+def test_class_weight_balanced(binary_data):
+    X, y, _, _ = binary_data
+    # drop most positives to create imbalance
+    keep = (y == 0) | (np.arange(len(y)) % 10 == 0)
+    Xi, yi = X[keep], y[keep]
+    plain = lgb.LGBMClassifier(n_estimators=10).fit(Xi, yi)
+    balanced = lgb.LGBMClassifier(n_estimators=10, class_weight="balanced").fit(Xi, yi)
+    # balanced model predicts the minority class more often
+    assert balanced.predict(Xi).sum() > plain.predict(Xi).sum()
+
+
+def test_refit_with_fewer_classes_resets_num_class(multiclass_data, binary_data):
+    Xm, ym, _, _ = multiclass_data
+    Xb, yb, _, _ = binary_data
+    clf = lgb.LGBMClassifier(n_estimators=3)
+    clf.fit(Xm, ym)
+    assert clf.n_classes_ == 5
+    clf.fit(Xb, yb)  # must not keep num_class=5
+    assert clf.n_classes_ == 2
+    labels = clf.predict(Xb)
+    assert set(np.unique(labels)) <= {0.0, 1.0}
+
+
+def test_custom_eval_metric_on_valid(binary_data):
+    X, y, Xt, yt = binary_data
+
+    def neg_count(preds, dataset):
+        return "neg_count", float(np.sum(preds < 0)), False
+
+    clf = lgb.LGBMClassifier(n_estimators=5)
+    clf.fit(X, y, eval_set=[(Xt, yt)], eval_metric=neg_count)
+    assert "neg_count" in clf.evals_result_["valid_0"]
