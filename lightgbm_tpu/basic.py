@@ -186,6 +186,7 @@ class Booster:
         state = self.__dict__.copy()
         state.pop("_engine", None)
         state.pop("train_set", None)
+        state.pop("_valid_data", None)  # holds full datasets via .reference
         state.pop("_objective", None)
         if self._model is not None:
             state["_model_str"] = self._model.save_model_to_string()
@@ -197,6 +198,7 @@ class Booster:
         self.__dict__.update(state)
         self._engine = None
         self.train_set = None
+        self._valid_data = []
         self._model = GBDTModel.load_model_from_string(model_str) \
             if model_str is not None else None
         cfg = self.config if self.config is not None else Config({})

@@ -27,7 +27,8 @@ from jax import lax
 
 from ..ops.histogram import build_histogram
 from ..ops.split import (FeatureMeta, K_MIN_SCORE, MISSING_NAN, MISSING_ZERO,
-                         SplitResult, find_best_split, leaf_output)
+                         SplitResult, find_best_split, leaf_output,
+                         per_feature_best_gains)
 
 
 class GrowerConfig(NamedTuple):
@@ -51,26 +52,43 @@ class GrowerConfig(NamedTuple):
 
 
 def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
-                     axis_name: str = None, jit: bool = True):
+                     axis_name: str = None, jit: bool = True,
+                     mode: str = "data", num_machines: int = 1,
+                     top_k: int = 20):
     """Returns grow(bins[F,N], vals[N,3], feature_mask[F]) -> tree arrays dict,
     jit-compiled once per (shape, config).
 
-    axis_name: when set, the grower runs as the *data-parallel tree learner*
-    inside shard_map over that mesh axis — rows are sharded, every histogram
-    is an XLA `psum` over ICI, and all per-leaf state stays replicated.  This
-    is the TPU-native equivalent of the reference DataParallelTreeLearner's
-    ReduceScatter of histograms + replicated split application
-    (src/treelearner/data_parallel_tree_learner.cpp:147-246), with XLA owning
-    the collective algorithm instead of src/network/.
+    axis_name: when set, the grower runs as a *parallel tree learner* inside
+    shard_map over that mesh axis, in one of three modes mirroring the
+    reference's parallel learners with XLA collectives in place of
+    src/network/:
+
+    - mode="data" (DataParallelTreeLearner, data_parallel_tree_learner.cpp:
+      147-246): rows sharded, histograms `psum`ed over ICI, replicated split
+      application.
+    - mode="feature" (FeatureParallelTreeLearner, feature_parallel_tree_
+      learner.cpp:21-69): features sharded, rows replicated; each shard finds
+      the best split over its own features, the winner is chosen by a
+      gain-keyed pmax/pmin pair (the SyncUpGlobalBestSplit allreduce-max) and
+      its row partition is broadcast from the owning shard with one psum.
+    - mode="voting" (VotingParallelTreeLearner, voting_parallel_tree_
+      learner.cpp / PV-Tree): rows sharded but histograms stay LOCAL; each
+      shard votes its top_k features by local split gain, the global top-2k
+      vote winners' histograms alone are `psum`ed, and the best split is
+      found on that subset — bounding the wire volume exactly like the
+      reference's selective ReduceScatter.  Local vote constraints are
+      scaled by 1/num_machines (:53-55).
     """
     L = cfg.num_leaves
     B = num_bins_max
+    feature_mode = axis_name is not None and mode == "feature"
+    voting_mode = axis_name is not None and mode == "voting"
 
     def reduce_hist(h):
-        return lax.psum(h, axis_name) if axis_name else h
+        return lax.psum(h, axis_name) if (axis_name and not feature_mode
+                                          and not voting_mode) else h
 
-    find = functools.partial(
-        find_best_split, meta=meta,
+    find_kwargs = dict(
         l1=cfg.lambda_l1, l2=cfg.lambda_l2, max_delta_step=cfg.max_delta_step,
         min_data_in_leaf=cfg.min_data_in_leaf,
         min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
@@ -79,25 +97,111 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
         cat_smooth=cfg.cat_smooth, max_cat_to_onehot=cfg.max_cat_to_onehot,
         min_data_per_group=cfg.min_data_per_group,
         with_categorical=cfg.with_categorical)
+    find = functools.partial(find_best_split, meta=meta, **find_kwargs)
 
     out_fn = functools.partial(leaf_output, l1=cfg.lambda_l1, l2=cfg.lambda_l2,
                                max_delta_step=cfg.max_delta_step)
 
     def grow(bins: jax.Array, vals: jax.Array, feature_mask: jax.Array) -> Dict[str, jax.Array]:
         F, N = bins.shape
+
+        if feature_mode:
+            my = lax.axis_index(axis_name)
+            f_offset = my * F
+            meta_local = FeatureMeta(*[lax.dynamic_slice_in_dim(a, f_offset, F)
+                                       for a in meta])
+            find_local = functools.partial(find_best_split, meta=meta_local,
+                                           **find_kwargs)
+
+            def bcast_from_winner(res):
+                """SyncUpGlobalBestSplit (parallel_tree_learner.h:183-206):
+                gain pmax + lowest-shard tie-break, then the whole SplitResult
+                packed into ONE f32 buffer for a single one-hot psum (the
+                reference likewise ships a fixed-size SplitInfo blob).
+                Integer fields (feature, bin) are exact in f32 below 2^24."""
+                gain_max = lax.pmax(res.gain, axis_name)
+                big = jnp.int32(1 << 30)
+                winner = lax.pmin(jnp.where(res.gain == gain_max, my, big),
+                                  axis_name)
+                is_w = my == winner
+                payload = jnp.concatenate([
+                    jnp.stack([
+                        res.gain,
+                        (res.feature + f_offset).astype(jnp.float32),
+                        res.threshold_bin.astype(jnp.float32),
+                        res.default_left.astype(jnp.float32),
+                        res.left_sum_g, res.left_sum_h, res.left_count,
+                        res.is_cat.astype(jnp.float32),
+                        res.left_output, res.right_output,
+                    ]),
+                    res.cat_bitset.astype(jnp.float32)])
+                payload = lax.psum(jnp.where(is_w, payload,
+                                             jnp.zeros_like(payload)), axis_name)
+                return SplitResult(
+                    gain=payload[0],
+                    feature=payload[1].astype(jnp.int32),
+                    threshold_bin=payload[2].astype(jnp.int32),
+                    default_left=payload[3] > 0,
+                    left_sum_g=payload[4],
+                    left_sum_h=payload[5],
+                    left_count=payload[6],
+                    is_cat=payload[7] > 0,
+                    cat_bitset=payload[10:] > 0,
+                    left_output=payload[8],
+                    right_output=payload[9])
+
+            def find_split(hist, sg, sh, cnt, fmask):
+                return bcast_from_winner(find_local(hist, sg, sh, cnt, fmask))
+
+        elif voting_mode:
+            k_vote = min(top_k, F)
+            S = min(2 * k_vote, F)
+            vote_kwargs = dict(find_kwargs)
+            vote_kwargs["min_data_in_leaf"] = cfg.min_data_in_leaf / max(num_machines, 1)
+            vote_kwargs["min_sum_hessian_in_leaf"] = \
+                cfg.min_sum_hessian_in_leaf / max(num_machines, 1)
+
+            def find_split(hist_local, sg, sh, cnt, fmask):
+                # phase 1: vote top_k features by LOCAL split gain with
+                # 1/num_machines-scaled constraints (:53-55, :322-342)
+                local_tot = jnp.sum(hist_local[0], axis=0)
+                local_gains = per_feature_best_gains(
+                    hist_local, local_tot[0], local_tot[1], local_tot[2],
+                    fmask, meta=meta, **vote_kwargs)
+                top_vals, top_idx = lax.top_k(local_gains, k_vote)
+                # a shard with no valid local split casts no votes (the
+                # reference only votes splittable features)
+                valid_vote = (top_vals > K_MIN_SCORE).astype(jnp.int32)
+                all_top = lax.all_gather(top_idx, axis_name)
+                all_valid = lax.all_gather(valid_vote, axis_name)
+                votes = jnp.zeros(F, jnp.int32).at[all_top.reshape(-1)].add(
+                    all_valid.reshape(-1))
+                _, sel = lax.top_k(votes, S)
+                # phase 2: reduce ONLY the winners' histograms, find on them
+                hsel = lax.psum(hist_local[sel], axis_name)
+                meta_sel = FeatureMeta(*[a[sel] for a in meta])
+                res = find_best_split(hsel, sg, sh, cnt, fmask[sel],
+                                      meta=meta_sel, **find_kwargs)
+                return res._replace(feature=sel[res.feature])
+
+        else:
+            def find_split(hist, sg, sh, cnt, fmask):
+                return find(hist, sg, sh, cnt, fmask)
+
         totals = jnp.sum(vals, axis=0)
-        if axis_name:
+        if axis_name and not feature_mode:
             totals = lax.psum(totals, axis_name)
         root_g, root_h, root_c = totals[0], totals[1], totals[2]
         hist_root = reduce_hist(
             build_histogram(bins, vals, num_bins=B, row_chunk=cfg.row_chunk))
-        res0 = find(hist_root, root_g, root_h, root_c, feature_mask)
+        res0 = find_split(hist_root, root_g, root_h, root_c, feature_mask)
 
         ni = max(L - 1, 1)
         leaf_id0 = jnp.zeros(N, jnp.int32)
-        if axis_name:
+        if axis_name and not feature_mode:
             # mark the per-row carry device-varying so shard_map's replication
-            # checker tracks it correctly through the fori_loop
+            # checker tracks it correctly through the fori_loop (rows are
+            # sharded; in feature mode rows are replicated instead)
             leaf_id0 = lax.pvary(leaf_id0, axis_name)
         state = {
             "hist": jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(hist_root),
@@ -151,12 +255,24 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
 
             # -- partition rows of the split leaf (DataPartition::Split /
             #    Bin::Split[Categorical], dense_bin.hpp:190-283) -------------
-            fbin = bins[f].astype(jnp.int32)
+            if feature_mode:
+                # only the shard owning the winning feature has its bin
+                # column; it computes the row routing and broadcasts it (the
+                # reference needs no exchange because every rank holds full
+                # data — here the one-psum broadcast replaces that copy)
+                owner = (f // F) == my
+                f_loc = jnp.clip(f - f_offset, 0, F - 1)
+                fbin = bins[f_loc].astype(jnp.int32)
+            else:
+                fbin = bins[f].astype(jnp.int32)
             mt = meta.missing_type[f]
             is_missing_bin = ((mt == MISSING_NAN) & (fbin == meta.num_bin[f] - 1)) | \
                              ((mt == MISSING_ZERO) & (fbin == meta.default_bin[f]))
             go_left_num = jnp.where(is_missing_bin, dl, fbin <= t)
             go_left = jnp.where(cat, bitset[fbin], go_left_num)
+            if feature_mode:
+                go_left = lax.psum(jnp.where(owner, go_left.astype(jnp.int32), 0),
+                                   axis_name) > 0
             in_leaf = st["leaf_id"] == best_leaf
             leaf_id = jnp.where(do & in_leaf & ~go_left, s, st["leaf_id"])
 
@@ -181,8 +297,8 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
 
             # -- best splits of the two children ------------------------------
             child_depth = st["leaf_depth"][best_leaf] + 1
-            res_l = find(new_left, lg, lh, lcnt, feature_mask)
-            res_r = find(new_right, rg, rh, rcnt, feature_mask)
+            res_l = find_split(new_left, lg, lh, lcnt, feature_mask)
+            res_r = find_split(new_right, rg, rh, rcnt, feature_mask)
             if cfg.max_depth > 0:
                 depth_ok = child_depth < cfg.max_depth
             else:

@@ -82,6 +82,115 @@ def _leaf_split_gain(sum_g, sum_h, l1, l2, max_delta_step):
     return -(2.0 * sg_l1 * out + (sum_h + l2) * out * out)
 
 
+def _numerical_gain_tensor(g, h, c, sum_g, total_h, num_data, feature_mask, *,
+                           meta, l1, l2, max_delta_step, min_data_in_leaf,
+                           min_sum_hessian_in_leaf, min_gain_to_split):
+    """Shifted+penalized numerical split gains [F, 2, B] (dir -1 first) plus
+    the stacked left-side aggregates [F, 2, B] and min_gain_shift.  Shared by
+    the global argmax (find_best_split) and the per-feature reduction used by
+    the voting-parallel learner."""
+    B = g.shape[1]
+    bins = jnp.arange(B, dtype=jnp.int32)[None, :]          # [1, B]
+    nb = meta.num_bin[:, None]                               # [F, 1]
+    valid_bin = bins < nb
+
+    is_nan = (meta.missing_type == MISSING_NAN)[:, None]
+    is_zero = (meta.missing_type == MISSING_ZERO)[:, None]
+    two_scan = ((meta.num_bin > 2) & (meta.missing_type != MISSING_NONE))[:, None]
+
+    # mass excluded from the scanned prefix: it follows the default direction
+    excl = (is_nan & (bins == nb - 1)) | (is_zero & (bins == meta.default_bin[:, None]))
+    excl = excl & two_scan  # the single-scan fallback scans everything
+
+    gm = jnp.where(excl | ~valid_bin, 0.0, g)
+    hm = jnp.where(excl | ~valid_bin, 0.0, h)
+    cm = jnp.where(excl | ~valid_bin, 0.0, c)
+    pg = jnp.cumsum(gm, axis=1)
+    ph = jnp.cumsum(hm, axis=1)
+    pc = jnp.cumsum(cm, axis=1)
+
+    eps = K_EPSILON
+    sum_g = jnp.asarray(sum_g)
+    # dir = +1: left(t) = scanned prefix; missing mass implicitly right
+    lg1, lh1, lc1 = pg, ph + eps, pc
+    rg1, rh1, rc1 = sum_g - lg1, total_h - lh1, num_data - lc1
+    # dir = -1: right(t) = scanned suffix; missing mass implicitly left
+    sg_tot, sh_tot, sc_tot = pg[:, -1:], ph[:, -1:], pc[:, -1:]
+    rg2, rh2, rc2 = sg_tot - pg, (sh_tot - ph) + eps, sc_tot - pc
+    lg2, lh2, lc2 = sum_g - rg2, total_h - rh2, num_data - rc2
+
+    # candidate thresholds: t <= num_bin-2, not the zero-skip bin, real feature
+    tmask = (bins <= nb - 2) & valid_bin
+    tmask &= ~(is_zero & (bins == meta.default_bin[:, None]) & two_scan)
+    tmask &= (~meta.is_trivial & ~meta.is_categorical & feature_mask)[:, None]
+
+    def direction(lg, lh, lc, rg, rh, rc, extra_mask):
+        ok = (tmask & extra_mask
+              & (lc >= min_data_in_leaf) & (rc >= min_data_in_leaf)
+              & (lh >= min_sum_hessian_in_leaf) & (rh >= min_sum_hessian_in_leaf))
+        lo = leaf_output(lg, lh, l1, l2, max_delta_step)
+        ro = leaf_output(rg, rh, l1, l2, max_delta_step)
+        mono = meta.monotone[:, None]
+        mono_bad = ((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro))
+        sgl = threshold_l1(lg, l1)
+        sgr = threshold_l1(rg, l1)
+        gain = -(2.0 * sgl * lo + (lh + l2) * lo * lo) \
+               - (2.0 * sgr * ro + (rh + l2) * ro * ro)
+        gain = jnp.where(mono_bad, 0.0, gain)
+        return jnp.where(ok, gain, K_MIN_SCORE)
+
+    gain_shift = _leaf_split_gain(sum_g, total_h, l1, l2, max_delta_step)
+    min_gain_shift = gain_shift + min_gain_to_split
+
+    gain2 = direction(lg2, lh2, lc2, rg2, rh2, rc2, jnp.ones_like(tmask))  # dir -1 always runs
+    gain1 = direction(lg1, lh1, lc1, rg1, rh1, rc1, two_scan)              # dir +1 only when two-scan
+    gains = jnp.stack([gain2, gain1], axis=1)                              # [F, 2, B]; -1 first (tie-break)
+    # shift by the no-split gain, then penalize (reference order:
+    # FindBestThresholdNumerical subtracts, FindBestThreshold multiplies)
+    gains = jnp.where(gains > min_gain_shift,
+                      (gains - min_gain_shift) * meta.penalty[:, None, None],
+                      K_MIN_SCORE)
+    lgs = jnp.stack([lg2, lg1], axis=1)
+    lhs = jnp.stack([lh2, lh1], axis=1)
+    lcs = jnp.stack([lc2, lc1], axis=1)
+    return gains, (lgs, lhs, lcs), min_gain_shift
+
+
+def per_feature_best_gains(hist, sum_g, sum_h, num_data, feature_mask, *,
+                           meta: FeatureMeta, l1, l2, max_delta_step,
+                           min_data_in_leaf, min_sum_hessian_in_leaf,
+                           min_gain_to_split, max_cat_threshold=32,
+                           cat_l2=10.0, cat_smooth=10.0, max_cat_to_onehot=4,
+                           min_data_per_group=100,
+                           with_categorical: bool = False) -> jax.Array:
+    """Best gain per feature [F] — the vote statistic of the voting-parallel
+    learner (voting_parallel_tree_learner.cpp local FindBestSplits)."""
+    g, h, c = hist[:, :, 0], hist[:, :, 1], hist[:, :, 2]
+    total_h = sum_h + 2 * K_EPSILON
+    gains, _, min_gain_shift = _numerical_gain_tensor(
+        g, h, c, sum_g, total_h, num_data, feature_mask, meta=meta,
+        l1=l1, l2=l2, max_delta_step=max_delta_step,
+        min_data_in_leaf=min_data_in_leaf,
+        min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
+        min_gain_to_split=min_gain_to_split)
+    best = jnp.max(gains, axis=(1, 2))
+    if with_categorical:
+        cat_mask = meta.is_categorical & ~meta.is_trivial & feature_mask
+        raw_cat, _, _, _, _, _ = _categorical_best(
+            g, h, c, sum_g, total_h, num_data, cat_mask, meta=meta,
+            l1=l1, l2=l2, max_delta_step=max_delta_step,
+            min_data_in_leaf=min_data_in_leaf,
+            min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
+            max_cat_threshold=max_cat_threshold, cat_l2=cat_l2,
+            cat_smooth=cat_smooth, max_cat_to_onehot=max_cat_to_onehot,
+            min_data_per_group=min_data_per_group)
+        gain_cat = jnp.where(raw_cat > min_gain_shift,
+                             (raw_cat - min_gain_shift) * meta.penalty,
+                             K_MIN_SCORE)
+        best = jnp.maximum(best, gain_cat)
+    return best
+
+
 def _categorical_best(g, h, c, sum_g, sum_h, num_data, cat_mask, *, meta,
                       l1, l2, max_delta_step, min_data_in_leaf,
                       min_sum_hessian_in_leaf, max_cat_threshold, cat_l2,
@@ -223,67 +332,15 @@ def find_best_split(hist, sum_g, sum_h, num_data, feature_mask, *,
     g = hist[:, :, 0]
     h = hist[:, :, 1]
     c = hist[:, :, 2]
-    F, B = g.shape
-    bins = jnp.arange(B, dtype=jnp.int32)[None, :]          # [1, B]
-    nb = meta.num_bin[:, None]                               # [F, 1]
-    valid_bin = bins < nb
-
-    is_nan = (meta.missing_type == MISSING_NAN)[:, None]
-    is_zero = (meta.missing_type == MISSING_ZERO)[:, None]
-    two_scan = ((meta.num_bin > 2) & (meta.missing_type != MISSING_NONE))[:, None]
-
-    # mass excluded from the scanned prefix: it follows the default direction
-    excl = (is_nan & (bins == nb - 1)) | (is_zero & (bins == meta.default_bin[:, None]))
-    excl = excl & two_scan  # the single-scan fallback scans everything
-
-    gm = jnp.where(excl | ~valid_bin, 0.0, g)
-    hm = jnp.where(excl | ~valid_bin, 0.0, h)
-    cm = jnp.where(excl | ~valid_bin, 0.0, c)
-    pg = jnp.cumsum(gm, axis=1)
-    ph = jnp.cumsum(hm, axis=1)
-    pc = jnp.cumsum(cm, axis=1)
-
+    B = g.shape[1]
     eps = K_EPSILON
     total_h = sum_h + 2 * eps
-    # dir = +1: left(t) = scanned prefix; missing mass implicitly right
-    lg1, lh1, lc1 = pg, ph + eps, pc
-    rg1, rh1, rc1 = sum_g - lg1, total_h - lh1, num_data - lc1
-    # dir = -1: right(t) = scanned suffix; missing mass implicitly left
-    sg_tot, sh_tot, sc_tot = pg[:, -1:], ph[:, -1:], pc[:, -1:]
-    rg2, rh2, rc2 = sg_tot - pg, (sh_tot - ph) + eps, sc_tot - pc
-    lg2, lh2, lc2 = sum_g - rg2, total_h - rh2, num_data - rc2
-
-    # candidate thresholds: t <= num_bin-2, not the zero-skip bin, real feature
-    tmask = (bins <= nb - 2) & valid_bin
-    tmask &= ~(is_zero & (bins == meta.default_bin[:, None]) & two_scan)
-    tmask &= (~meta.is_trivial & ~meta.is_categorical & feature_mask)[:, None]
-
-    def direction(lg, lh, lc, rg, rh, rc, extra_mask):
-        ok = (tmask & extra_mask
-              & (lc >= min_data_in_leaf) & (rc >= min_data_in_leaf)
-              & (lh >= min_sum_hessian_in_leaf) & (rh >= min_sum_hessian_in_leaf))
-        lo = leaf_output(lg, lh, l1, l2, max_delta_step)
-        ro = leaf_output(rg, rh, l1, l2, max_delta_step)
-        mono = meta.monotone[:, None]
-        mono_bad = ((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro))
-        sgl = threshold_l1(lg, l1)
-        sgr = threshold_l1(rg, l1)
-        gain = -(2.0 * sgl * lo + (lh + l2) * lo * lo) \
-               - (2.0 * sgr * ro + (rh + l2) * ro * ro)
-        gain = jnp.where(mono_bad, 0.0, gain)
-        return jnp.where(ok, gain, K_MIN_SCORE)
-
-    gain_shift = _leaf_split_gain(sum_g, total_h, l1, l2, max_delta_step)
-    min_gain_shift = gain_shift + min_gain_to_split
-
-    gain2 = direction(lg2, lh2, lc2, rg2, rh2, rc2, jnp.ones_like(tmask))  # dir -1 always runs
-    gain1 = direction(lg1, lh1, lc1, rg1, rh1, rc1, two_scan)              # dir +1 only when two-scan
-    gains = jnp.stack([gain2, gain1], axis=1)                              # [F, 2, B]; -1 first (tie-break)
-    # shift by the no-split gain, then penalize (reference order:
-    # FindBestThresholdNumerical subtracts, FindBestThreshold multiplies)
-    gains = jnp.where(gains > min_gain_shift,
-                      (gains - min_gain_shift) * meta.penalty[:, None, None],
-                      K_MIN_SCORE)
+    gains, (lgs, lhs, lcs), min_gain_shift = _numerical_gain_tensor(
+        g, h, c, sum_g, total_h, num_data, feature_mask, meta=meta,
+        l1=l1, l2=l2, max_delta_step=max_delta_step,
+        min_data_in_leaf=min_data_in_leaf,
+        min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
+        min_gain_to_split=min_gain_to_split)
 
     flat = gains.reshape(-1)
     idx = jnp.argmax(flat)
@@ -296,9 +353,6 @@ def find_best_split(hist, sum_g, sum_h, num_data, feature_mask, *,
     force_right = (meta.num_bin[f] <= 2) & (meta.missing_type[f] == MISSING_NAN)
     default_left = (d == 0) & ~force_right
 
-    lgs = jnp.stack([lg2, lg1], axis=1)
-    lhs = jnp.stack([lh2, lh1], axis=1)
-    lcs = jnp.stack([lc2, lc1], axis=1)
     left_g = lgs[f, d, t]
     left_h = lhs[f, d, t]  # includes the kEpsilon seed
     left_c = lcs[f, d, t]

@@ -182,8 +182,8 @@ class LGBMModel(_Base):
             eval_group=None, eval_metric=None, early_stopping_rounds=None,
             verbose: bool = False, feature_name="auto",
             categorical_feature="auto", callbacks=None) -> "LGBMModel":
-        if self._objective is None:
-            self._objective = self.objective
+        # re-read every fit so set_params(objective=...) takes effect
+        self._objective = self.objective
         fobj = _ObjectiveFunctionWrapper(self._objective) if callable(self._objective) else None
         feval = _EvalFunctionWrapper(eval_metric) if callable(eval_metric) else None
         params = self._engine_params()
@@ -192,6 +192,7 @@ class LGBMModel(_Base):
         elif isinstance(eval_metric, (list, tuple)):
             params["metric"] = ",".join(eval_metric)
 
+        X_orig = X
         X = np.asarray(X, dtype=np.float64) if not hasattr(X, "values") else X
         self._n_features = np.asarray(X).shape[1]
         train_set = Dataset(X, label=y, weight=sample_weight, group=group,
@@ -202,7 +203,7 @@ class LGBMModel(_Base):
             if isinstance(eval_set, tuple):
                 eval_set = [eval_set]
             for i, (vx, vy) in enumerate(eval_set):
-                if vx is X and vy is y:
+                if (vx is X or vx is X_orig) and vy is y:
                     valid_sets.append(train_set)
                     continue
                 vw = eval_sample_weight[i] if eval_sample_weight else None

@@ -96,3 +96,128 @@ def test_entry_compiles():
     fn, args = g.entry()
     out = jax.jit(fn)(*args)
     assert int(out["num_leaves"]) >= 2
+
+
+def test_feature_parallel_matches_serial():
+    from lightgbm_tpu.parallel.feature_parallel import (
+        FEATURE_AXIS, make_feature_parallel_train_step, pad_feature_meta,
+        pad_features, shard_features)
+    devices = jax.devices()
+    if len(devices) < NDEV:
+        pytest.skip("needs %d devices" % NDEV)
+    fmesh = Mesh(np.array(devices[:NDEV]), (FEATURE_AXIS,))
+    n = 1024
+    X, y = _problem(n=n, f=6)
+    config = Config({"objective": "binary", "max_bin": 32, "num_leaves": 16,
+                     "min_data_in_leaf": 5})
+    ds = BinnedDataset.from_matrix(X, config, row_chunk=n)
+    meta = _feature_meta_device(ds)
+    n_pad = ds.num_data_padded
+    gcfg = GrowerConfig(num_leaves=16, max_depth=-1, lambda_l1=0.0, lambda_l2=0.0,
+                        max_delta_step=0.0, min_data_in_leaf=5,
+                        min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0,
+                        row_chunk=n_pad)
+
+    label = ds.padded(y)
+    score = np.zeros(n_pad, np.float32)
+    weight = np.ones(n_pad, np.float32)
+    mask = ds.valid_row_mask()
+    fmask = np.ones(ds.num_features, bool)
+
+    # serial reference tree
+    grow = make_tree_grower(meta, gcfg, ds.max_num_bin)
+    yy = np.where(label > 0, 1.0, -1.0)
+    resp = -yy / (1.0 + np.exp(yy * score))
+    grad = (resp * weight).astype(np.float32)
+    hess = (np.abs(resp) * (1 - np.abs(resp)) * weight).astype(np.float32)
+    vals = jnp.asarray(np.stack([grad * mask, hess * mask, mask], axis=1))
+    serial = grow(jnp.asarray(ds.bins), vals, jnp.asarray(fmask))
+
+    bins_p, fmask_p, f_padded = pad_features(ds.bins, fmask, NDEV)
+    meta_p = pad_feature_meta(meta, f_padded)
+    step = make_feature_parallel_train_step(meta_p, gcfg, ds.max_num_bin,
+                                            fmesh, learning_rate=0.1)
+    bins_s, fmask_s, score_s, label_s, weight_s, mask_s = shard_features(
+        fmesh, bins_p, fmask_p, score, label, weight, mask)
+    new_score, tree = step(bins_s, score_s, label_s, weight_s, mask_s, fmask_s)
+
+    assert int(tree["num_leaves"]) == int(serial["num_leaves"])
+    np.testing.assert_array_equal(np.asarray(tree["split_feature"]),
+                                  np.asarray(serial["split_feature"]))
+    np.testing.assert_array_equal(np.asarray(tree["split_bin"]),
+                                  np.asarray(serial["split_bin"]))
+    np.testing.assert_allclose(np.asarray(tree["leaf_value"]),
+                               np.asarray(serial["leaf_value"]), rtol=1e-4, atol=1e-6)
+
+
+def test_voting_parallel_matches_serial_with_full_vote(mesh):
+    """With 2*top_k >= F the voted subset covers every feature, so the voting
+    learner must reproduce the serial tree exactly."""
+    from lightgbm_tpu.parallel.voting_parallel import make_voting_parallel_train_step
+    n = 128 * NDEV
+    X, y = _problem(n=n, f=6)
+    config = Config({"objective": "binary", "max_bin": 32, "num_leaves": 16,
+                     "min_data_in_leaf": 5})
+    ds = BinnedDataset.from_matrix(X, config, row_chunk=n)
+    meta = _feature_meta_device(ds)
+    n_pad = ds.num_data_padded
+    gcfg = GrowerConfig(num_leaves=16, max_depth=-1, lambda_l1=0.0, lambda_l2=0.0,
+                        max_delta_step=0.0, min_data_in_leaf=5,
+                        min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0,
+                        row_chunk=n_pad // NDEV)
+    label = ds.padded(y)
+    score = np.zeros(n_pad, np.float32)
+    weight = np.ones(n_pad, np.float32)
+    mask = ds.valid_row_mask()
+    fmask = jnp.ones(ds.num_features, bool)
+
+    grow = make_tree_grower(meta, GrowerConfig(**{**gcfg._asdict(), "row_chunk": n_pad}),
+                            ds.max_num_bin)
+    yy = np.where(label > 0, 1.0, -1.0)
+    resp = -yy / (1.0 + np.exp(yy * score))
+    grad = (resp * weight).astype(np.float32)
+    hess = (np.abs(resp) * (1 - np.abs(resp)) * weight).astype(np.float32)
+    vals = jnp.asarray(np.stack([grad * mask, hess * mask, mask], axis=1))
+    serial = grow(jnp.asarray(ds.bins), vals, fmask)
+
+    step = make_voting_parallel_train_step(meta, gcfg, ds.max_num_bin, mesh,
+                                           learning_rate=0.1, top_k=6)
+    bins_s, score_s, label_s, weight_s, mask_s = shard_rows(
+        mesh, ds.bins, score, label, weight, mask)
+    new_score, tree = step(bins_s, score_s, label_s, weight_s, mask_s, fmask)
+
+    assert int(tree["num_leaves"]) == int(serial["num_leaves"])
+    np.testing.assert_array_equal(np.asarray(tree["split_feature"]),
+                                  np.asarray(serial["split_feature"]))
+    np.testing.assert_allclose(np.asarray(tree["leaf_value"]),
+                               np.asarray(serial["leaf_value"]), rtol=1e-4, atol=1e-6)
+
+
+def test_voting_parallel_restricted_vote_trains(mesh):
+    """With a tight vote budget (2k < F) the tree may differ from serial but
+    must still be a valid, finite, multi-leaf tree."""
+    from lightgbm_tpu.parallel.voting_parallel import make_voting_parallel_train_step
+    n = 128 * NDEV
+    X, y = _problem(n=n, f=12, seed=9)
+    config = Config({"objective": "binary", "max_bin": 32, "num_leaves": 8,
+                     "min_data_in_leaf": 5})
+    ds = BinnedDataset.from_matrix(X, config, row_chunk=n)
+    meta = _feature_meta_device(ds)
+    n_pad = ds.num_data_padded
+    gcfg = GrowerConfig(num_leaves=8, max_depth=-1, lambda_l1=0.0, lambda_l2=0.0,
+                        max_delta_step=0.0, min_data_in_leaf=5,
+                        min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0,
+                        row_chunk=n_pad // NDEV)
+    step = make_voting_parallel_train_step(meta, gcfg, ds.max_num_bin, mesh,
+                                           learning_rate=0.1, top_k=2)
+    label = ds.padded(y)
+    score = np.zeros(n_pad, np.float32)
+    weight = np.ones(n_pad, np.float32)
+    mask = ds.valid_row_mask()
+    bins_s, score_s, label_s, weight_s, mask_s = shard_rows(
+        mesh, ds.bins, score, label, weight, mask)
+    new_score, tree = step(bins_s, score_s, label_s, weight_s, mask_s,
+                           jnp.ones(ds.num_features, bool))
+    assert int(tree["num_leaves"]) > 1
+    assert np.isfinite(np.asarray(tree["leaf_value"])).all()
+    assert np.isfinite(np.asarray(new_score)).all()
