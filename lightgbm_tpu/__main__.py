@@ -1,0 +1,4 @@
+"""`python -m lightgbm_tpu` — the CLI entry (reference src/main.cpp)."""
+from .application import main
+
+main()

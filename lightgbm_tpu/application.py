@@ -1,0 +1,259 @@
+"""CLI application: task=train / predict / convert_model / refit.
+
+Role parity with the reference src/application/application.cpp and main.cpp:
+parameters from `k=v` argv entries plus a `config=<file>` of `key = value`
+lines (argv wins, application.cpp:48-81); training loads data (+ optional
+<data>.weight / <data>.query sidecars), runs the engine, saves the model and
+periodic snapshots (gbdt.cpp:330-334); prediction writes one converted score
+per row (src/application/predictor.hpp); convert_model emits the model as
+C++ if-else code (gbdt_model_text.cpp ModelToIfElse).
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .callback import record_evaluation
+from .config import Config
+from .engine import train as engine_train
+from .io.parser import load_sidecar, parse_file
+from .models.gbdt_model import GBDTModel
+from .utils.log import LightGBMError, Log
+
+
+def parse_parameters(argv: List[str]) -> Dict[str, str]:
+    """argv `k=v` pairs > config file lines (application.cpp LoadParameters)."""
+    cli: Dict[str, str] = {}
+    for arg in argv:
+        if "=" not in arg:
+            continue
+        k, v = arg.split("=", 1)
+        cli[k.strip()] = v.strip()
+    params: Dict[str, str] = {}
+    config_path = cli.get("config", cli.get("config_file"))
+    if config_path:
+        with open(config_path) as fh:
+            for line in fh:
+                line = line.split("#", 1)[0].strip()
+                if not line or "=" not in line:
+                    continue
+                k, v = line.split("=", 1)
+                params[k.strip()] = v.strip()
+    params.update(cli)
+    params.pop("config", None)
+    params.pop("config_file", None)
+    return params
+
+
+class Application:
+    def __init__(self, argv: List[str]):
+        self.raw_params = parse_parameters(argv)
+        self.task = self.raw_params.pop("task", "train")
+
+    def run(self) -> None:
+        if self.task == "train":
+            self.train()
+        elif self.task in ("predict", "prediction", "test"):
+            self.predict()
+        elif self.task == "convert_model":
+            self.convert_model()
+        elif self.task == "refit":
+            self.refit()
+        else:
+            Log.fatal("Unknown task type %s", self.task)
+
+    # -- data loading --------------------------------------------------------
+    def _load(self, path: str, num_features: Optional[int] = None):
+        params = self.raw_params
+        label_column = 0
+        lc = params.get("label_column", params.get("label", ""))
+        if lc.startswith("name:"):
+            Log.fatal("label_column by name requires a header; use an index")
+        elif lc:
+            label_column = int(lc)
+        has_header = None
+        if params.get("has_header", params.get("header", "")).lower() in ("true", "1"):
+            has_header = True
+        X, y = parse_file(path, label_column=label_column, has_header=has_header,
+                          num_features=num_features)
+        weight = load_sidecar(path + ".weight")
+        query = load_sidecar(path + ".query")
+        return X, y, weight, query
+
+    # -- tasks ---------------------------------------------------------------
+    def train(self) -> None:
+        params = dict(self.raw_params)
+        data_path = params.pop("data", params.pop("train_data", None))
+        if not data_path:
+            Log.fatal("No training data, set data=<file>")
+        valid_paths = [p for p in
+                       params.pop("valid", params.pop("valid_data", "")).split(",") if p]
+        output_model = params.pop("output_model", "LightGBM_model.txt")
+        input_model = params.pop("input_model", None)
+        num_rounds = int(params.pop("num_iterations",
+                         params.pop("num_trees", params.pop("num_boost_round", 100))))
+        snapshot_freq = int(params.pop("snapshot_freq", -1))
+        early_stopping = int(params.pop("early_stopping_round",
+                             params.pop("early_stopping_rounds", 0)))
+
+        X, y, weight, query = self._load(data_path)
+        group = None
+        if query is not None:
+            group = query.astype(np.int64)
+        train_set = Dataset(X, label=y, weight=weight, group=group, params=params)
+        valid_sets = []
+        valid_names = []
+        for i, vp in enumerate(valid_paths):
+            vX, vy, vweight, vquery = self._load(vp, num_features=X.shape[1])
+            vgroup = vquery.astype(np.int64) if vquery is not None else None
+            valid_sets.append(train_set.create_valid(vX, label=vy, weight=vweight,
+                                                     group=vgroup))
+            valid_names.append(os.path.basename(vp))
+
+        callbacks = []
+        if snapshot_freq > 0:
+            def snapshot(env):
+                if (env.iteration + 1) % snapshot_freq == 0:
+                    env.model.save_model("%s.snapshot_iter_%d"
+                                         % (output_model, env.iteration + 1))
+            callbacks.append(snapshot)
+        evals: Dict = {}
+        callbacks.append(record_evaluation(evals))
+
+        booster = engine_train(
+            params, train_set, num_boost_round=num_rounds,
+            valid_sets=valid_sets or None, valid_names=valid_names or None,
+            init_model=input_model, callbacks=callbacks,
+            early_stopping_rounds=early_stopping if early_stopping > 0 else None,
+            verbose_eval=int(params.get("metric_freq", 1)))
+        booster.save_model(output_model)
+        Log.info("Finished training, model saved to %s", output_model)
+
+    def predict(self) -> None:
+        params = dict(self.raw_params)
+        data_path = params.pop("data", None)
+        input_model = params.pop("input_model", None)
+        output_result = params.pop("output_result", "LightGBM_predict_result.txt")
+        if not data_path or not input_model:
+            Log.fatal("Prediction needs data=<file> and input_model=<file>")
+        booster = Booster(params=params, model_file=input_model)
+        num_feat = booster._model.max_feature_idx + 1
+        X, _, _, _ = self._load(data_path, num_features=num_feat)
+        raw_score = params.get("predict_raw_score", "").lower() in ("true", "1")
+        pred_leaf = params.get("predict_leaf_index", "").lower() in ("true", "1")
+        pred_contrib = params.get("predict_contrib", "").lower() in ("true", "1")
+        out = booster.predict(X, raw_score=raw_score, pred_leaf=pred_leaf,
+                              pred_contrib=pred_contrib)
+        out = np.asarray(out)
+        with open(output_result, "w") as fh:
+            if out.ndim == 1:
+                for v in out:
+                    fh.write("%.18g\n" % v)
+            else:
+                for row in out:
+                    fh.write("\t".join("%.18g" % v for v in row) + "\n")
+        Log.info("Finished prediction, results saved to %s", output_result)
+
+    def convert_model(self) -> None:
+        params = dict(self.raw_params)
+        input_model = params.pop("input_model", None)
+        out_path = params.pop("convert_model_file",
+                              params.pop("output_model", "gbdt_prediction.cpp"))
+        if not input_model:
+            Log.fatal("convert_model needs input_model=<file>")
+        model = GBDTModel.load_model(input_model)
+        with open(out_path, "w") as fh:
+            fh.write(model_to_ifelse(model))
+        Log.info("Finished converting model, saved to %s", out_path)
+
+    def refit(self) -> None:
+        params = dict(self.raw_params)
+        data_path = params.pop("data", None)
+        input_model = params.pop("input_model", None)
+        output_model = params.pop("output_model", "LightGBM_model.txt")
+        if not data_path or not input_model:
+            Log.fatal("Refit needs data=<file> and input_model=<file>")
+        booster = Booster(params=params, model_file=input_model)
+        num_feat = booster._model.max_feature_idx + 1
+        X, y, weight, query = self._load(data_path, num_features=num_feat)
+        group = query.astype(np.int64) if query is not None else None
+        new_booster = booster.refit(X, y, weight=weight, group=group)
+        new_booster.save_model(output_model)
+        Log.info("Finished refit, model saved to %s", output_model)
+
+
+def model_to_ifelse(model: GBDTModel) -> str:
+    """C++ codegen of the model (gbdt_model_text.cpp ModelToIfElse:240+):
+    one PredictTreeN function per tree plus a summing Predict entry."""
+    lines = ["#include <cmath>", "#include <cstdio>", "", "namespace {", ""]
+
+    def node_code(tree, node: int, depth: int) -> List[str]:
+        pad = "  " * (depth + 1)
+        if node < 0:
+            return ["%sreturn %.17g;" % (pad, tree.leaf_value[~node])]
+        dt = int(tree.decision_type[node])
+        f = int(tree.split_feature[node])
+        out = []
+        if dt & 1:  # categorical
+            ci = int(tree.threshold_in_bin[node])
+            lo, hi = tree.cat_boundaries[ci], tree.cat_boundaries[ci + 1]
+            cats = [(i - lo) * 32 + j for i in range(lo, hi) for j in range(32)
+                    if (tree.cat_threshold[i] >> j) & 1]
+            cond = " || ".join("static_cast<int>(arr[%d]) == %d" % (f, c)
+                               for c in cats) or "false"
+            out.append("%sif (%s) {" % (pad, cond))
+        else:
+            missing_type = (dt >> 2) & 3
+            default_left = bool(dt & 2)
+            thr = "%.17g" % tree.threshold[node]
+            if missing_type == 2:  # NaN
+                if default_left:
+                    cond = "(std::isnan(arr[%d]) || arr[%d] <= %s)" % (f, f, thr)
+                else:
+                    cond = "(!std::isnan(arr[%d]) && arr[%d] <= %s)" % (f, f, thr)
+            elif missing_type == 1:  # Zero
+                if default_left:
+                    cond = "(std::fabs(arr[%d]) <= 1e-35 || arr[%d] <= %s)" % (f, f, thr)
+                else:
+                    cond = "(std::fabs(arr[%d]) > 1e-35 && arr[%d] <= %s)" % (f, f, thr)
+            else:
+                cond = "(arr[%d] <= %s)" % (f, thr)
+            out.append("%sif %s {" % (pad, cond))
+        out.extend(node_code(tree, int(tree.left_child[node]), depth + 1))
+        out.append("%s} else {" % pad)
+        out.extend(node_code(tree, int(tree.right_child[node]), depth + 1))
+        out.append("%s}" % pad)
+        return out
+
+    for i, tree in enumerate(model.trees):
+        lines.append("double PredictTree%d(const double* arr) {" % i)
+        if tree.num_leaves <= 1:
+            lines.append("  return %.17g;" % tree.leaf_value[0])
+        else:
+            lines.extend(node_code(tree, 0, 0))
+        lines.append("}")
+        lines.append("")
+    lines.append("}  // namespace")
+    lines.append("")
+    lines.append("double Predict(const double* arr) {")
+    lines.append("  double sum = 0.0;")
+    for i in range(len(model.trees)):
+        lines.append("  sum += PredictTree%d(arr);" % i)
+    if model.average_output and model.trees:
+        lines.append("  sum /= %d.0;" % model.current_iteration)
+    lines.append("  return sum;")
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m lightgbm_tpu task=<train|predict|convert_model|refit> "
+              "[config=<file>] [key=value ...]")
+        return
+    Application(argv).run()

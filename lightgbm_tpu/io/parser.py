@@ -1,0 +1,151 @@
+"""Text data parsers: CSV / TSV / LibSVM with auto-detection.
+
+Role parity with the reference Parser (src/io/parser.cpp:169 CreateParser,
+include/LightGBM/dataset.h:252-277): sniff the format from sample lines,
+parse label + features into a dense matrix.  Host-side ingest (numpy); the
+result feeds BinnedDataset.from_matrix.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils.log import Log
+
+
+def _is_libsvm_pair(tok: str) -> bool:
+    """True only for `<int>:<number>` — a colon inside a timestamp or URL
+    must not flip the whole file to libsvm."""
+    k, sep, v = tok.partition(":")
+    if not sep:
+        return False
+    try:
+        int(k)
+        float(v)
+        return True
+    except ValueError:
+        return False
+
+
+def detect_format(sample_lines) -> str:
+    """'libsvm' | 'tsv' | 'csv' (parser.cpp GetDataType semantics: index:value
+    pairs -> libsvm, tabs -> tsv, commas -> csv)."""
+    for line in sample_lines:
+        line = line.strip()
+        if not line:
+            continue
+        tokens = line.replace("\t", " ").replace(",", " ").split()
+        if any(_is_libsvm_pair(t) for t in tokens[1:]):
+            return "libsvm"
+        if "\t" in line:
+            return "tsv"
+        if "," in line:
+            return "csv"
+    return "tsv"
+
+
+def _is_number(tok: str) -> bool:
+    try:
+        float(tok)
+        return True
+    except ValueError:
+        return False
+
+
+def parse_file(path: str, label_column: int = 0, has_header: Optional[bool] = None,
+               num_features: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse a data file -> (X [n, F], y [n]).  Auto-detects format and
+    header; missing values ('', 'na', 'nan', 'null') become NaN."""
+    with open(path) as fh:
+        lines = [l for l in fh.readlines() if l.strip()]
+    head = lines[:20]
+    fmt = detect_format(head)
+    if has_header is None:
+        first = head[0].strip() if head else ""
+        seps = {"csv": ",", "tsv": "\t"}
+        toks = first.split(seps[fmt]) if fmt in seps else first.split()
+        # a header needs a token that is neither numeric nor a missing marker
+        has_header = bool(toks) and not all(
+            _is_number(t.split(":")[0]) or t.strip().lower() in _MISSING
+            for t in toks if True)
+    body = lines[1:] if has_header else lines
+
+    if fmt == "libsvm":
+        return _parse_libsvm(body, num_features)
+    sep = "," if fmt == "csv" else "\t"
+    return _parse_delimited(body, sep, label_column, num_features)
+
+
+_MISSING = {"", "na", "nan", "null", "n/a", "none", "?"}
+
+
+def _parse_value(tok: str) -> float:
+    tok = tok.strip()
+    if tok.lower() in _MISSING:
+        return np.nan
+    return float(tok)
+
+
+def _parse_delimited(lines, sep, label_column, num_features):
+    rows = []
+    labels = []
+    for line in lines:
+        line = line.rstrip("\n\r")
+        if not line.strip():
+            continue
+        toks = line.split(sep)
+        vals = [_parse_value(t) for t in toks]
+        labels.append(vals[label_column])
+        del vals[label_column]
+        rows.append(vals)
+    if not rows:
+        Log.fatal("Data file is empty or unparseable")
+    F = num_features if num_features else max(len(r) for r in rows)
+    X = np.full((len(rows), F), np.nan)
+    for i, r in enumerate(rows):
+        X[i, :min(len(r), F)] = r[:F]
+    return X, np.asarray(labels, dtype=np.float64)
+
+
+def _parse_libsvm(lines, num_features):
+    rows = []
+    labels = []
+    maxf = -1
+    for line in lines:
+        parts = line.split()
+        if not parts:
+            continue
+        labels.append(float(parts[0]))
+        feats = {}
+        for tok in parts[1:]:
+            if ":" not in tok:
+                continue
+            k, v = tok.split(":", 1)
+            feats[int(k)] = _parse_value(v)
+            maxf = max(maxf, int(k))
+        rows.append(feats)
+    if not rows:
+        Log.fatal("Data file is empty or unparseable")
+    F = num_features if num_features else maxf + 1
+    X = np.zeros((len(rows), F))
+    for i, feats in enumerate(rows):
+        for k, v in feats.items():
+            if k < F:
+                X[i, k] = v
+    return X, np.asarray(labels, dtype=np.float64)
+
+
+def load_sidecar(path: str) -> Optional[np.ndarray]:
+    """Optional one-value-per-line sidecar (<data>.weight / <data>.query,
+    metadata.cpp LoadWeights/LoadQueryBoundaries)."""
+    import os
+    if not os.path.exists(path):
+        return None
+    vals = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                vals.append(float(line))
+    return np.asarray(vals, dtype=np.float64) if vals else None

@@ -1,0 +1,34 @@
+"""Shared plumbing for the parallel tree-learner train steps."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def resolve_objective(objective):
+    """Default to binary logloss; reject multiclass objectives — every
+    parallel step drives ONE score plane (call per class plane instead)."""
+    if objective is None:
+        from ..config import Config
+        from ..objective.binary import BinaryLogloss
+        objective = BinaryLogloss(Config({"objective": "binary"}))
+    if objective.num_model_per_iteration > 1:
+        from ..utils.log import LightGBMError
+        raise LightGBMError(
+            "parallel train steps handle one score plane; drive multiclass "
+            "by calling them per class plane (num_model_per_iteration=%d)"
+            % objective.num_model_per_iteration)
+    return objective
+
+
+def make_step(grow, objective, learning_rate: float):
+    """gradients -> grow -> score update, shared by data/feature/voting."""
+
+    def step(bins, score, label, weight, mask, feature_mask):
+        grad, hess = objective.get_gradients(score, label, weight)
+        vals = jnp.stack([grad * mask, hess * mask, mask], axis=1)
+        out = grow(bins, vals, feature_mask)
+        new_score = score + learning_rate * out["leaf_value"][out["leaf_id"]]
+        tree = {k: v for k, v in out.items() if k != "leaf_id"}
+        return new_score, tree
+
+    return step

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..boosting.grower import GrowerConfig, make_tree_grower
 from ..ops.split import FeatureMeta
+from ._common import make_step, resolve_objective
 
 DATA_AXIS = "data"
 
@@ -41,27 +42,10 @@ def make_data_parallel_train_step(meta: FeatureMeta, cfg: GrowerConfig,
     objective except ranking, which is query-sharded); defaults to binary
     logloss.
     """
-    if objective is None:
-        from ..config import Config
-        from ..objective.binary import BinaryLogloss
-        objective = BinaryLogloss(Config({"objective": "binary"}))
-    if objective.num_model_per_iteration > 1:
-        from ..utils.log import LightGBMError
-        raise LightGBMError(
-            "data-parallel train step handles one score plane; drive multiclass "
-            "by calling it per class plane (num_model_per_iteration=%d)"
-            % objective.num_model_per_iteration)
+    objective = resolve_objective(objective)
     grow = make_tree_grower(meta, cfg, num_bins_max, axis_name=DATA_AXIS,
                             jit=False)
-
-    def step(bins, score, label, weight, mask, feature_mask):
-        grad, hess = objective.get_gradients(score, label, weight)
-        vals = jnp.stack([grad * mask, hess * mask, mask], axis=1)
-        out = grow(bins, vals, feature_mask)
-        new_score = score + learning_rate * out["leaf_value"][out["leaf_id"]]
-        tree = {k: v for k, v in out.items() if k != "leaf_id"}
-        return new_score, tree
-
+    step = make_step(grow, objective, learning_rate)
     sharded = jax.shard_map(
         step, mesh=mesh,
         in_specs=(P(None, DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),

@@ -18,6 +18,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..boosting.grower import GrowerConfig, make_tree_grower
 from ..ops.split import FeatureMeta
+from ._common import make_step, resolve_objective
 
 FEATURE_AXIS = "feature"
 
@@ -63,21 +64,10 @@ def make_feature_parallel_train_step(meta: FeatureMeta, cfg: GrowerConfig,
     [N] replicated, feature_mask [F] sharded.  meta must cover the padded
     feature count (pad_feature_meta).
     """
-    if objective is None:
-        from ..config import Config
-        from ..objective.binary import BinaryLogloss
-        objective = BinaryLogloss(Config({"objective": "binary"}))
+    objective = resolve_objective(objective)
     grow = make_tree_grower(meta, cfg, num_bins_max, axis_name=FEATURE_AXIS,
                             jit=False, mode="feature")
-
-    def step(bins, score, label, weight, mask, feature_mask):
-        grad, hess = objective.get_gradients(score, label, weight)
-        vals = jnp.stack([grad * mask, hess * mask, mask], axis=1)
-        out = grow(bins, vals, feature_mask)
-        new_score = score + learning_rate * out["leaf_value"][out["leaf_id"]]
-        tree = {k: v for k, v in out.items() if k != "leaf_id"}
-        return new_score, tree
-
+    step = make_step(grow, objective, learning_rate)
     sharded = jax.shard_map(
         step, mesh=mesh,
         in_specs=(P(FEATURE_AXIS, None), P(), P(), P(), P(), P(FEATURE_AXIS)),

@@ -17,6 +17,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..boosting.grower import GrowerConfig, make_tree_grower
 from ..ops.split import FeatureMeta
+from ._common import make_step, resolve_objective
 
 DATA_AXIS = "data"
 
@@ -28,23 +29,12 @@ def make_voting_parallel_train_step(meta: FeatureMeta, cfg: GrowerConfig,
     """One boosting step, rows sharded, histogram exchange bounded by voting.
 
     Same input/output contract as make_data_parallel_train_step."""
-    if objective is None:
-        from ..config import Config
-        from ..objective.binary import BinaryLogloss
-        objective = BinaryLogloss(Config({"objective": "binary"}))
+    objective = resolve_objective(objective)
     num_machines = mesh.shape[DATA_AXIS]
     grow = make_tree_grower(meta, cfg, num_bins_max, axis_name=DATA_AXIS,
                             jit=False, mode="voting",
                             num_machines=num_machines, top_k=top_k)
-
-    def step(bins, score, label, weight, mask, feature_mask):
-        grad, hess = objective.get_gradients(score, label, weight)
-        vals = jnp.stack([grad * mask, hess * mask, mask], axis=1)
-        out = grow(bins, vals, feature_mask)
-        new_score = score + learning_rate * out["leaf_value"][out["leaf_id"]]
-        tree = {k: v for k, v in out.items() if k != "leaf_id"}
-        return new_score, tree
-
+    step = make_step(grow, objective, learning_rate)
     # check_vma off: the vote (all_gather -> identical top-2k set on every
     # shard) and the psum'ed subset histograms are replicated in value, but
     # the varying-axes tracker cannot prove it through the scan carry

@@ -1,0 +1,150 @@
+"""CLI application tests (application.cpp tasks, parser.cpp auto-detection,
+gbdt_model_text.cpp ModelToIfElse)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.application import Application, model_to_ifelse, parse_parameters
+from lightgbm_tpu.io.parser import detect_format, parse_file
+
+REFERENCE_DIR = "/root/reference"
+REFBIN = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      ".refbuild", "lightgbm")
+
+
+def test_parse_parameters_precedence(tmp_path):
+    conf = tmp_path / "train.conf"
+    conf.write_text("task = train\nnum_leaves = 63  # comment\nlearning_rate = 0.05\n")
+    params = parse_parameters(["config=%s" % conf, "num_leaves=31"])
+    assert params["num_leaves"] == "31"        # argv wins
+    assert params["learning_rate"] == "0.05"   # file value kept
+    assert "config" not in params
+
+
+def test_parser_format_detection():
+    assert detect_format(["1.0\t2.0\t3.0"]) == "tsv"
+    assert detect_format(["1.0,2.0,3.0"]) == "csv"
+    assert detect_format(["1 3:0.5 7:1.2"]) == "libsvm"
+
+
+def test_parse_csv_with_header_and_missing(tmp_path):
+    f = tmp_path / "d.csv"
+    f.write_text("label,f1,f2\n1,0.5,na\n0,,2.5\n")
+    X, y = parse_file(str(f))
+    assert X.shape == (2, 2)
+    np.testing.assert_array_equal(y, [1, 0])
+    assert np.isnan(X[0, 1]) and np.isnan(X[1, 0])
+
+
+def test_cli_train_predict_round_trip(tmp_path):
+    data = np.loadtxt(os.path.join(
+        REFERENCE_DIR, "examples/binary_classification/binary.train"))
+    train_f = tmp_path / "d.tsv"
+    np.savetxt(train_f, data[:1000], delimiter="\t", fmt="%.10g")
+    model_f = tmp_path / "model.txt"
+    out_f = tmp_path / "pred.txt"
+    Application(["task=train", "data=%s" % train_f, "objective=binary",
+                 "num_trees=5", "output_model=%s" % model_f, "verbose=-1"]).run()
+    assert model_f.exists()
+    Application(["task=predict", "data=%s" % train_f, "input_model=%s" % model_f,
+                 "output_result=%s" % out_f]).run()
+    pred = np.loadtxt(out_f)
+    assert pred.shape == (1000,)
+    assert np.all((pred > 0) & (pred < 1))
+    # parity with the in-process API
+    bst = lgb.Booster(model_file=str(model_f))
+    np.testing.assert_allclose(pred, bst.predict(data[:1000, 1:]), rtol=1e-12)
+
+
+def test_cli_snapshot_and_continue(tmp_path):
+    data = np.loadtxt(os.path.join(
+        REFERENCE_DIR, "examples/binary_classification/binary.train"))
+    train_f = tmp_path / "d.tsv"
+    np.savetxt(train_f, data[:800], delimiter="\t", fmt="%.10g")
+    model_f = tmp_path / "model.txt"
+    Application(["task=train", "data=%s" % train_f, "objective=binary",
+                 "num_trees=4", "snapshot_freq=2",
+                 "output_model=%s" % model_f, "verbose=-1"]).run()
+    assert (tmp_path / "model.txt.snapshot_iter_2").exists()
+    # continue training from the saved model
+    model2_f = tmp_path / "model2.txt"
+    Application(["task=train", "data=%s" % train_f, "objective=binary",
+                 "num_trees=3", "input_model=%s" % model_f,
+                 "output_model=%s" % model2_f, "verbose=-1"]).run()
+    b2 = lgb.Booster(model_file=str(model2_f))
+    assert b2.num_trees() == 7
+
+
+def test_cli_refit(tmp_path):
+    data = np.loadtxt(os.path.join(
+        REFERENCE_DIR, "examples/binary_classification/binary.train"))
+    train_f = tmp_path / "d.tsv"
+    np.savetxt(train_f, data[:500], delimiter="\t", fmt="%.10g")
+    model_f = tmp_path / "model.txt"
+    refit_f = tmp_path / "refit.txt"
+    Application(["task=train", "data=%s" % train_f, "objective=binary",
+                 "num_trees=3", "output_model=%s" % model_f, "verbose=-1"]).run()
+    Application(["task=refit", "data=%s" % train_f, "input_model=%s" % model_f,
+                 "output_model=%s" % refit_f, "objective=binary",
+                 "verbose=-1"]).run()
+    assert refit_f.exists()
+    assert lgb.Booster(model_file=str(refit_f)).num_trees() == 3
+
+
+def test_convert_model_compiles_and_matches(tmp_path):
+    """ModelToIfElse output compiles with g++ and predicts identically."""
+    data = np.loadtxt(os.path.join(
+        REFERENCE_DIR, "examples/binary_classification/binary.train"))
+    X, y = data[:500, 1:], data[:500, 0]
+    bst = lgb.train({"objective": "binary", "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=3, verbose_eval=0)
+    code = model_to_ifelse(bst._engine.model)
+    src = tmp_path / "model.cpp"
+    main_src = tmp_path / "main.cpp"
+    src.write_text(code)
+    main_src.write_text("""
+#include <cstdio>
+#include <cstdlib>
+double Predict(const double* arr);
+int main(int argc, char** argv) {
+  double arr[64] = {0};
+  for (int i = 1; i < argc && i <= 64; ++i) arr[i-1] = atof(argv[i]);
+  printf("%.17g\\n", Predict(arr));
+  return 0;
+}
+""")
+    exe = tmp_path / "predictor"
+    subprocess.run(["g++", "-O1", "-o", str(exe), str(src), str(main_src)],
+                   check=True, capture_output=True)
+    for row in X[:5]:
+        out = subprocess.run([str(exe)] + ["%.10g" % v for v in row],
+                             check=True, capture_output=True, text=True)
+        cpp_pred = float(out.stdout.strip())
+        py_pred = float(bst.predict(row.reshape(1, -1), raw_score=True)[0])
+        assert abs(cpp_pred - py_pred) < 1e-10
+
+
+def test_headerless_first_row_with_missing_token(tmp_path):
+    """A missing-value token in row 0 must not be mistaken for a header."""
+    f = tmp_path / "d.csv"
+    f.write_text("1,na,2.5\n0,1.0,2.0\n0,2.0,3.0\n")
+    X, y = parse_file(str(f))
+    assert X.shape == (3, 2)
+    np.testing.assert_array_equal(y, [1, 0, 0])
+
+
+def test_colon_in_field_not_libsvm():
+    assert detect_format(["1.0\t12:30:00\t5"]) == "tsv"
+    assert detect_format(["1 3:0.5"]) == "libsvm"
+
+
+def test_header_after_blank_lines(tmp_path):
+    f = tmp_path / "d.csv"
+    f.write_text("\nlabel,f1\n1,2.5\n0,1.0\n")
+    X, y = parse_file(str(f))
+    assert X.shape == (2, 1)
+    np.testing.assert_array_equal(y, [1, 0])
