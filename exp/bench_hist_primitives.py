@@ -1,0 +1,182 @@
+"""Measure TPU primitive throughput to pick the histogram architecture.
+
+Candidates for the hot path (reference: dense_bin.hpp ConstructHistogram,
+ocl/histogram256.cl):
+  A. one-hot einsum variants (current approach, f32 vs bf16, layout flips)
+  B. Pallas chunked one-hot-in-VMEM kernel
+  C. row gather (physical DataPartition) feasibility: jnp.take throughput
+  D. scatter-add, sort, cumsum (partition machinery)
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+N = 2 ** 21
+F = 28
+B = 256
+CHUNK = 16384
+
+rng = np.random.default_rng(0)
+bins_np = rng.integers(0, B, size=(F, N), dtype=np.uint8)
+vals_np = rng.standard_normal((N, 3)).astype(np.float32)
+
+bins = jnp.asarray(bins_np)
+vals = jnp.asarray(vals_np)
+
+
+def timeit(name, fn, *args, reps=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:50s} {dt*1e3:10.2f} ms")
+    return dt
+
+
+# ---- A. einsum one-hot variants ------------------------------------------
+@jax.jit
+def hist_einsum_f32(bins, vals):
+    nchunk = N // CHUNK
+    bins_c = bins.reshape(F, nchunk, CHUNK).transpose(1, 0, 2)
+    vals_c = vals.reshape(nchunk, CHUNK, 3)
+
+    def body(acc, xs):
+        b, v = xs
+        iota = lax.broadcasted_iota(jnp.int32, (1, 1, B), 2)
+        onehot = (b.astype(jnp.int32)[:, :, None] == iota).astype(jnp.float32)
+        return acc + jnp.einsum("fcb,cd->fbd", onehot, v,
+                                preferred_element_type=jnp.float32), None
+
+    acc0 = jnp.zeros((F, B, 3), jnp.float32)
+    h, _ = lax.scan(body, acc0, (bins_c, vals_c))
+    return h
+
+
+@jax.jit
+def hist_einsum_bf16(bins, vals):
+    nchunk = N // CHUNK
+    bins_c = bins.reshape(F, nchunk, CHUNK).transpose(1, 0, 2)
+    vals_c = vals.astype(jnp.bfloat16).reshape(nchunk, CHUNK, 3)
+
+    def body(acc, xs):
+        b, v = xs
+        iota = lax.broadcasted_iota(jnp.int32, (1, 1, B), 2)
+        onehot = (b.astype(jnp.int32)[:, :, None] == iota).astype(jnp.bfloat16)
+        return acc + jnp.einsum("fcb,cd->fbd", onehot, v,
+                                preferred_element_type=jnp.float32), None
+
+    acc0 = jnp.zeros((F, B, 3), jnp.float32)
+    h, _ = lax.scan(body, acc0, (bins_c, vals_c))
+    return h
+
+
+@jax.jit
+def hist_einsum_valsT(bins, vals):
+    # output [F, 3, B]: per feature [3, C] x [C, B]; output sublane dim = 3
+    nchunk = N // CHUNK
+    bins_c = bins.reshape(F, nchunk, CHUNK).transpose(1, 0, 2)
+    valsT = vals.T.astype(jnp.bfloat16)  # [3, N]
+    valsT_c = valsT.reshape(3, nchunk, CHUNK).transpose(1, 0, 2)
+
+    def body(acc, xs):
+        b, vT = xs
+        iota = lax.broadcasted_iota(jnp.int32, (1, 1, B), 2)
+        onehot = (b.astype(jnp.int32)[:, :, None] == iota).astype(jnp.bfloat16)
+        return acc + jnp.einsum("dc,fcb->fdb", vT, onehot,
+                                preferred_element_type=jnp.float32), None
+
+    acc0 = jnp.zeros((F, 3, B), jnp.float32)
+    h, _ = lax.scan(body, acc0, (bins_c, valsT_c))
+    return h
+
+
+# ---- B. Pallas chunked kernel --------------------------------------------
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+PCHUNK = 2048
+
+def _hist_kernel(bins_ref, vals_ref, out_ref):
+    # bins_ref [F, PCHUNK] int32 block; vals_ref [8, PCHUNK] bf16 (3 used rows)
+    # out_ref [F, 8, B] f32 accumulated across grid
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+    vT = vals_ref[:]  # [8, PCHUNK] bf16
+    iota = lax.broadcasted_iota(jnp.int32, (PCHUNK, B), 1)
+    for f in range(F):
+        onehot = (bins_ref[f, :][:, None] == iota).astype(jnp.bfloat16)
+        out_ref[f] += jnp.dot(vT, onehot, preferred_element_type=jnp.float32)
+
+
+@jax.jit
+def hist_pallas(bins, vals):
+    nchunk = N // PCHUNK
+    valsT = jnp.zeros((8, N), jnp.bfloat16).at[:3].set(vals.T.astype(jnp.bfloat16))
+    grid = (nchunk,)
+    out = pl.pallas_call(
+        _hist_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((F, PCHUNK), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, PCHUNK), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((F, 8, B), lambda i: (0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((F, 8, B), jnp.float32),
+    )(bins.astype(jnp.int32), valsT)
+    return out
+
+
+# ---- C/D. partition machinery --------------------------------------------
+idx_np = rng.permutation(N).astype(np.int32)
+idx = jnp.asarray(idx_np)
+bins_rows_np = np.ascontiguousarray(
+    np.pad(bins_np.T, ((0, 0), (0, 4))))  # [N, 32] uint8
+bins_rows = jnp.asarray(bins_rows_np)
+
+take_rows = jax.jit(lambda a, i: jnp.take(a, i, axis=0))
+take_minor = jax.jit(lambda a, i: jnp.take(a, i, axis=1))
+take_1d = jax.jit(lambda a, i: jnp.take(a, i))
+
+
+@jax.jit
+def scatter_add_1d(idx, v):
+    return jnp.zeros(N, jnp.float32).at[idx].add(v)
+
+
+@jax.jit
+def sort_pair(keys, payload):
+    return lax.sort((keys, payload), num_keys=1)
+
+
+@jax.jit
+def cumsum_n(v):
+    return jnp.cumsum(v)
+
+
+if __name__ == "__main__":
+    print("backend:", jax.default_backend())
+    timeit("einsum one-hot f32 (current)", hist_einsum_f32, bins, vals)
+    timeit("einsum one-hot bf16", hist_einsum_bf16, bins, vals)
+    timeit("einsum valsT bf16 [3,C]x[C,B]", hist_einsum_valsT, bins, vals)
+    try:
+        h = hist_pallas(bins, vals)
+        href = hist_einsum_f32(bins, vals)
+        err = float(jnp.max(jnp.abs(h[:, :3].transpose(0, 2, 1) - href)))
+        print("pallas max err vs f32:", err)
+        timeit("pallas chunked bf16 dot", hist_pallas, bins, vals)
+    except Exception as e:
+        print("pallas failed:", repr(e))
+    timeit("take rows [N,32]u8 random", take_rows, bins_rows, idx)
+    timeit("take minor [F,N]u8 random", take_minor, bins, idx)
+    timeit("take 1d f32 random", take_1d, vals[:, 0], idx)
+    timeit("scatter-add 1d f32 random", scatter_add_1d, idx, vals[:, 0])
+    timeit("lax.sort (u8 key, i32 payload)", sort_pair,
+           bins[0], jnp.arange(N, dtype=jnp.int32))
+    timeit("cumsum f32 N", cumsum_n, vals[:, 0])

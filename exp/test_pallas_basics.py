@@ -1,0 +1,116 @@
+"""Validate the Pallas building blocks for the segment-histogram kernel:
+scalar SMEM operands, dynamic fori_loop trip count, manual HBM->VMEM DMA at
+dynamic offsets, joint one-hot dot with f32 accumulation."""
+import functools
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+F = 28
+B = 256
+C = 512          # rows per chunk
+FB = F * B
+
+N = 2 ** 21
+rng = np.random.default_rng(0)
+bins_np = rng.integers(0, B, size=(N, F), dtype=np.int32)
+P = F + 4  # bins + grad, hess, mask, pad
+payload_np = np.zeros((N, P), np.float32)
+payload_np[:, :F] = bins_np
+payload_np[:, F + 0] = rng.standard_normal(N)
+payload_np[:, F + 1] = rng.random(N)
+payload_np[:, F + 2] = 1.0
+payload = jnp.asarray(payload_np)
+
+
+def _kernel(scalars_ref, payload_hbm, out_ref, chunk_vmem, sem):
+    start = scalars_ref[0]
+    nchunks = scalars_ref[1]
+
+    @pl.when(True)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    def body(k, _):
+        dma = pltpu.make_async_copy(
+            payload_hbm.at[pl.ds(start + k * C, C), :],
+            chunk_vmem, sem)
+        dma.start()
+        dma.wait()
+        chunk = chunk_vmem[:]
+        binsf = chunk[:, :F].astype(jnp.int32)          # [C, F]
+        jidx = binsf + lax.broadcasted_iota(jnp.int32, (C, F), 1) * B
+        iota_fb = lax.broadcasted_iota(jnp.int32, (C, FB), 1)
+        onehot = (jidx[:, :, None] ==
+                  iota_fb.reshape(C, F, B)).astype(jnp.bfloat16).reshape(C, FB)
+        vals = jnp.concatenate(
+            [chunk[:, F:F + 3], jnp.zeros((C, 5), jnp.float32)], axis=1)
+        acc = lax.dot_general(
+            onehot, vals.astype(jnp.bfloat16),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [FB, 8]
+        out_ref[:] += acc
+        return 0
+
+    lax.fori_loop(0, nchunks, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def segment_hist(payload, start, nchunks):
+    scalars = jnp.stack([start, nchunks]).astype(jnp.int32)
+    return pl.pallas_call(
+        _kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((C, P), jnp.float32),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((FB, 8), jnp.float32),
+    )(scalars, payload)
+
+
+def ref_hist(payload, start, count):
+    seg = np.asarray(payload)[start:start + count]
+    hist = np.zeros((F, B, 3), np.float32)
+    for f in range(F):
+        for d in range(3):
+            np.add.at(hist[f, :, d], seg[:, f].astype(np.int64),
+                      seg[:, F + d])
+    return hist
+
+
+if __name__ == "__main__":
+    print("backend:", jax.default_backend())
+    # correctness on a small segment
+    start, count = 1024, 4 * C
+    h = segment_hist(payload, jnp.int32(start), jnp.int32(count // C))
+    h = np.asarray(h)[:, :3].reshape(F, B, 3)
+    hr = ref_hist(payload, start, count)
+    err = np.abs(h - hr).max()
+    print("max abs err (bf16 vals):", err, "rel:",
+          err / (np.abs(hr).max() + 1e-9))
+
+    # timing: full-N pass
+    nch = jnp.int32(N // C)
+    out = segment_hist(payload, jnp.int32(0), nch)
+    jax.block_until_ready(out)
+    for r in range(3):
+        t0 = time.perf_counter()
+        out = segment_hist(payload, jnp.int32(0), nch)
+        jax.block_until_ready(out)
+        print("full-N pass: %.2f ms" % ((time.perf_counter() - t0) * 1e3))
+    # timing: small segment (64 chunks = 32k rows)
+    for r in range(3):
+        t0 = time.perf_counter()
+        out = segment_hist(payload, jnp.int32(12345 // C * C), jnp.int32(64))
+        jax.block_until_ready(out)
+        print("64-chunk segment: %.3f ms" % ((time.perf_counter() - t0) * 1e3))

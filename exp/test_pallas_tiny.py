@@ -1,0 +1,60 @@
+import sys, time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+print("backend:", jax.default_backend(), flush=True)
+
+# 1. trivial kernel
+def k1(x_ref, o_ref):
+    o_ref[:] = x_ref[:] * 2.0
+
+x = jnp.ones((256, 128), jnp.float32)
+t0 = time.time()
+out = pl.pallas_call(
+    k1, out_shape=jax.ShapeDtypeStruct((256, 128), jnp.float32),
+    in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+    out_specs=pl.BlockSpec(memory_space=pltpu.VMEM))(x)
+jax.block_until_ready(out)
+print("trivial kernel ok, %.1fs, sum=%s" % (time.time() - t0, out.sum()), flush=True)
+
+# 2. scalar prefetch + manual DMA at dynamic offset + dynamic fori bound
+C = 512
+N = 2 ** 15
+P = 32
+payload = jnp.asarray(np.random.default_rng(0).standard_normal((N, P)), jnp.float32)
+
+def k2(scalars_ref, hbm_ref, o_ref, chunk, sem):
+    start = scalars_ref[0]
+    nchunks = scalars_ref[1]
+    o_ref[:] = jnp.zeros_like(o_ref)
+
+    def body(k, _):
+        dma = pltpu.make_async_copy(
+            hbm_ref.at[pl.ds(start + k * C, C), :], chunk, sem)
+        dma.start()
+        dma.wait()
+        o_ref[:] += jnp.sum(chunk[:], axis=0, keepdims=True)
+        return 0
+
+    lax.fori_loop(0, nchunks, body, 0)
+
+t0 = time.time()
+fn = jax.jit(lambda p, s, n: pl.pallas_call(
+    k2,
+    grid_spec=pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((C, P), jnp.float32),
+                        pltpu.SemaphoreType.DMA(())]),
+    out_shape=jax.ShapeDtypeStruct((1, P), jnp.float32),
+)(jnp.stack([s, n]).astype(jnp.int32), p))
+out = fn(payload, jnp.int32(1024), jnp.int32(8))
+jax.block_until_ready(out)
+ref = np.asarray(payload)[1024:1024 + 8 * C].sum(axis=0)
+print("dma kernel ok, %.1fs, err=%.2e" % (
+    time.time() - t0, np.abs(np.asarray(out)[0] - ref).max()), flush=True)

@@ -1,0 +1,182 @@
+"""Segment engine: O(rows-touched) histogram + partition over a row-payload.
+
+The reference keeps rows of each leaf contiguous through DataPartition
+(src/treelearner/data_partition.hpp) so ConstructHistogram only scans the
+split leaf's rows (src/io/dense_bin.hpp:66-132, ordered gather
+src/io/dataset.cpp:664-678).  TPUs have no fast random scatter/gather, so the
+same idea is re-expressed in MXU-native primitives:
+
+- training rows live in ONE row-major payload matrix [N_pad + C, P] (f32):
+  bin columns, then value columns (grad/hess/count-mask/leaf-value/...);
+  rows of every tree leaf are kept physically contiguous;
+- a split's stable partition is three chunked passes (compact-left,
+  compact-right, blended copy-back), each chunk compacted by a one-hot
+  permutation matrix applied as a matmul — a scatter the MXU can run;
+- a leaf's histogram is built by walking only that leaf's chunks and
+  contracting a joint (feature, bin) one-hot with the value columns.
+
+This module is the portable lax implementation used on CPU meshes and as
+the semantic reference; `ops.pallas_histogram` / `ops.pallas_partition`
+override the two hot kernels on TPU with VMEM-resident one-hots.
+
+Chunks are fixed at C rows; `start`/`count` are dynamic scalars, so every
+pass is a `lax.while_loop` with a data-dependent trip count — no
+recompilation per segment size.  The payload carries a C-row guard at the
+end: compact passes may write up to C garbage rows past a segment into the
+scratch buffer, and the copy-back blends row-exactly, so no pass ever needs
+a partial-chunk write.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .split import MISSING_NAN, MISSING_ZERO
+
+# rows per chunk: small enough that the joint one-hot [C, F*B] and the
+# permutation matrix [C, C] sit comfortably in VMEM on the Pallas path
+CHUNK = 256
+
+
+class SplitPredicate(NamedTuple):
+    """Scalars describing one split's routing decision
+    (Bin::Split semantics, src/io/dense_bin.hpp:190-283)."""
+    feature: jax.Array       # i32 column index into the bin columns
+    threshold: jax.Array     # i32 bin threshold (numerical)
+    default_left: jax.Array  # bool — where missing rows go
+    is_cat: jax.Array        # bool — categorical bitset split
+    bitset: jax.Array        # [B] bool — bins routed left (categorical)
+    missing_type: jax.Array  # i32 (of the split feature)
+    num_bin: jax.Array       # i32
+    default_bin: jax.Array   # i32
+
+
+def go_left_chunk(chunk: jax.Array, pred: SplitPredicate) -> jax.Array:
+    """[C] bool routing for one payload chunk (bin cols at [:, :F])."""
+    C = chunk.shape[0]
+    fcol = lax.dynamic_slice(chunk, (0, pred.feature), (C, 1))[:, 0]
+    fbin = fcol.astype(jnp.int32)
+    miss = ((pred.missing_type == MISSING_NAN) & (fbin == pred.num_bin - 1)) | \
+           ((pred.missing_type == MISSING_ZERO) & (fbin == pred.default_bin))
+    gl_num = jnp.where(miss, pred.default_left, fbin <= pred.threshold)
+    B = pred.bitset.shape[0]
+    onehot = fbin[:, None] == jnp.arange(B, dtype=jnp.int32)[None, :]
+    gl_cat = jnp.sum(onehot & pred.bitset[None, :], axis=1) > 0
+    return jnp.where(pred.is_cat, gl_cat, gl_num)
+
+
+def _compact_matmul(chunk: jax.Array, keep: jax.Array) -> jax.Array:
+    """Stable-compact kept rows to the front via a one-hot permutation
+    matmul — the TPU-native scatter."""
+    C = chunk.shape[0]
+    dest = jnp.cumsum(keep.astype(jnp.int32)) - keep.astype(jnp.int32)
+    perm = ((dest[None, :] == jnp.arange(C, dtype=jnp.int32)[:, None])
+            & keep[None, :]).astype(chunk.dtype)
+    return perm @ chunk
+
+
+def partition_segment(payload: jax.Array, aux: jax.Array, start: jax.Array,
+                      count: jax.Array, pred: SplitPredicate,
+                      left_value: jax.Array, right_value: jax.Array,
+                      value_col: int):
+    """Stably partition payload rows [start, start+count) by the predicate:
+    left rows first.  Writes the children's leaf outputs into `value_col`.
+    Returns (payload, aux, num_left) — num_left counts only rows whose
+    count-mask survives in the caller's accounting; here it is the raw
+    routed-row count used for segment offsets.
+    """
+    C = CHUNK
+    nch = (count + C - 1) // C
+
+    def read(buf, k):
+        return lax.dynamic_slice(buf, (start + k * C, 0),
+                                 (C, buf.shape[1]))
+
+    def valid_rows(k):
+        return jnp.arange(C, dtype=jnp.int32) < (count - k * C)
+
+    # pass A: compact LEFT rows of each chunk, append at aux[start + running)
+    def body_a(carry):
+        k, nl, aux = carry
+        chunk = read(payload, k)
+        keep = go_left_chunk(chunk, pred) & valid_rows(k)
+        compact = _compact_matmul(chunk, keep)
+        aux = lax.dynamic_update_slice(aux, compact, (start + nl, 0))
+        return k + 1, nl + jnp.sum(keep.astype(jnp.int32)), aux
+
+    _, num_left, aux = lax.while_loop(lambda c: c[0] < nch, body_a,
+                                      (jnp.int32(0), jnp.int32(0), aux))
+
+    # pass B: compact RIGHT rows, append at aux[start + num_left + running)
+    def body_b(carry):
+        k, nr, aux = carry
+        chunk = read(payload, k)
+        keep = (~go_left_chunk(chunk, pred)) & valid_rows(k)
+        compact = _compact_matmul(chunk, keep)
+        aux = lax.dynamic_update_slice(aux, compact,
+                                       (start + num_left + nr, 0))
+        return k + 1, nr + jnp.sum(keep.astype(jnp.int32)), aux
+
+    _, _, aux = lax.while_loop(lambda c: c[0] < nch, body_b,
+                               (jnp.int32(0), jnp.int32(0), aux))
+
+    # pass C: blended copy-back aux -> payload over [start, start+count),
+    # writing the children's creation values (Tree::Split leaf_value_) into
+    # the value column on the way through
+    vcol_onehot = (jnp.arange(payload.shape[1]) == value_col)[None, :]
+
+    def body_c(carry):
+        k, payload = carry
+        src = read(aux, k)
+        dst = read(payload, k)
+        ok = valid_rows(k)[:, None]
+        pos = start + k * C + jnp.arange(C, dtype=jnp.int32)
+        val = jnp.where(pos < start + num_left, left_value, right_value)
+        src = jnp.where(vcol_onehot, val[:, None], src)
+        blended = jnp.where(ok, src, dst)
+        payload = lax.dynamic_update_slice(payload, blended,
+                                           (start + k * C, 0))
+        return k + 1, payload
+
+    _, payload = lax.while_loop(lambda c: c[0] < nch, body_c,
+                                (jnp.int32(0), payload))
+    return payload, aux, num_left
+
+
+def segment_histogram(payload: jax.Array, start: jax.Array, count: jax.Array,
+                      *, num_features: int, num_bins: int,
+                      grad_col: int, hess_col: int, cnt_col: int) -> jax.Array:
+    """hist[F, B, 3] over payload rows [start, start+count).
+
+    Only ceil(count / CHUNK) chunks are touched — the O(rows-touched)
+    guarantee of the reference's ordered bins, with the scatter-free joint
+    (feature, bin) one-hot contraction in place of per-row accumulation.
+    """
+    C = CHUNK
+    F, B = num_features, num_bins
+    P = payload.shape[1]
+    nch = (count + C - 1) // C
+    iota_b = jnp.arange(B, dtype=jnp.int32)
+
+    def body(carry):
+        k, hist = carry
+        chunk = lax.dynamic_slice(payload, (start + k * C, 0), (C, P))
+        ok = (jnp.arange(C, dtype=jnp.int32) < (count - k * C)).astype(
+            payload.dtype)
+        binsf = chunk[:, :F].astype(jnp.int32)                 # [C, F]
+        onehot = (binsf[:, :, None] == iota_b[None, None, :]).astype(
+            payload.dtype)                                     # [C, F, B]
+        vals = jnp.stack([chunk[:, grad_col] * ok,
+                          chunk[:, hess_col] * ok,
+                          chunk[:, cnt_col] * ok], axis=1)     # [C, 3]
+        hist = hist + jnp.einsum("cfb,cd->fbd", onehot, vals,
+                                 preferred_element_type=jnp.float32)
+        return k + 1, hist
+
+    hist0 = jnp.zeros((F, B, 3), jnp.float32)
+    _, hist = lax.while_loop(lambda c: c[0] < nch, body,
+                             (jnp.int32(0), hist0))
+    return hist
