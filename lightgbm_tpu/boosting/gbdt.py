@@ -26,7 +26,9 @@ from ..models.tree import Tree
 from ..ops.split import FeatureMeta
 from ..utils.log import Log
 from ..utils.random import Random, partition_seed
+from ..ops import segment as seg
 from .grower import GrowerConfig, make_tree_grower
+from .grower2 import PayloadCols, make_partitioned_grower
 
 K_EPSILON = 1e-15
 
@@ -56,6 +58,120 @@ def _cached_grower(meta_dev: FeatureMeta, cfg, max_num_bin: int, ds: BinnedDatas
         grower = make_tree_grower(meta_dev, cfg, max_num_bin)
         _GROWER_CACHE[key] = grower
     return grower
+
+
+_PGROWER_CACHE: Dict = {}
+
+
+def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
+                    ds: BinnedDataset, cols: PayloadCols, payload_width: int):
+    key = (cfg, max_num_bin, ds.bins.shape, cols, payload_width,
+           tuple((m.num_bin, m.missing_type, m.default_bin, m.is_trivial, m.bin_type)
+                 for m in ds.bin_mappers),
+           ds.monotone_constraints.tobytes(), ds.feature_penalty.tobytes())
+    grower = _PGROWER_CACHE.get(key)
+    if grower is None:
+        grower = make_partitioned_grower(meta_dev, cfg, max_num_bin, cols,
+                                         ds.num_features)
+        _PGROWER_CACHE[key] = grower
+    return grower
+
+
+class _FastState:
+    """Partition-ordered training state for the serial fast path.
+
+    The whole of training state — bin columns, label/weight, per-class raw
+    scores, per-iteration grad/hess and the current tree's per-row output —
+    lives in ONE row-major payload matrix that the partitioned grower
+    reorders in place (rows of each leaf contiguous).  Everything downstream
+    of the grower becomes elementwise: gradients, score updates, bagging-free
+    count masks.  Original row order is recovered through the index column
+    only when a consumer needs it (metrics, sync back to the legacy path).
+    """
+
+    def __init__(self, gbdt: "GBDT"):
+        ds = gbdt.train_set
+        F = ds.num_features
+        K = gbdt.num_tree_per_iteration
+        n_pad = ds.num_data_padded
+        self.F, self.K, self.n_pad = F, K, n_pad
+        self.label_col = F
+        self.weight_col = F + 1
+        self.cnt_col = F + 2
+        self.idx_col = F + 3
+        self.score0 = F + 4
+        # multiclass trains K trees per iteration, all from the SAME
+        # pre-iteration scores (gbdt.cpp Boosting computes every class's
+        # gradients before any tree), but each tree reorders the rows — so
+        # the pre-iteration scores are snapshotted into columns that ride
+        # the partition, and each class's gradients are recomputed from the
+        # snapshot in whatever order the rows currently sit
+        self.snap0 = F + 4 + K if K > 1 else self.score0
+        self.grad_col = self.snap0 + (K if K > 1 else 1)
+        self.hess_col = self.grad_col + 1
+        self.value_col = self.grad_col + 2
+        self.P = self.value_col + 1
+        self.cols = PayloadCols(grad=self.grad_col, hess=self.hess_col,
+                                cnt=self.cnt_col, value=self.value_col)
+
+        P, score0, idx_col = self.P, self.score0, self.idx_col
+
+        @jax.jit
+        def build(bins, label, weight, vmask, score):
+            pay = jnp.zeros((n_pad + seg.CHUNK, P), jnp.float32)
+            pay = pay.at[:n_pad, :F].set(bins.T.astype(jnp.float32))
+            pay = pay.at[:n_pad, F].set(label)
+            pay = pay.at[:n_pad, F + 1].set(weight)
+            pay = pay.at[:n_pad, self.cnt_col].set(vmask)
+            pay = pay.at[:n_pad, idx_col].set(
+                jnp.arange(n_pad, dtype=jnp.float32))
+            pay = pay.at[:n_pad, score0:score0 + K].set(score.T)
+            return pay
+
+        self.payload = build(gbdt.bins_dev, gbdt.label_dev, gbdt.weight_dev,
+                             gbdt.valid_mask, gbdt.score)
+        self.aux = jnp.zeros_like(self.payload)
+        self.grower = _cached_pgrower(gbdt.meta_dev, gbdt.grower_cfg,
+                                      ds.max_num_bin, ds, self.cols, self.P)
+
+        obj = gbdt.objective
+        snap0, cnt_col = self.snap0, self.cnt_col
+        grad_col, hess_col = self.grad_col, self.hess_col
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def snap_scores(payload):
+            return payload.at[:n_pad, snap0:snap0 + K].set(
+                payload[:n_pad, score0:score0 + K])
+
+        @functools.partial(jax.jit, donate_argnums=(0,),
+                           static_argnames=("k",))
+        def fill_class(payload, k):
+            snap = payload[:n_pad, snap0:snap0 + K].T
+            g, h = obj.get_gradients_multi(snap, payload[:n_pad, F],
+                                           payload[:n_pad, F + 1])
+            valid = payload[:n_pad, cnt_col]
+            payload = payload.at[:n_pad, grad_col].set(g[k] * valid)
+            return payload.at[:n_pad, hess_col].set(h[k] * valid)
+
+        @functools.partial(jax.jit, donate_argnums=(0,),
+                           static_argnames=("k",))
+        def apply_score(payload, lr, k):
+            upd = payload[:n_pad, self.value_col] * lr
+            return payload.at[:n_pad, score0 + k].add(upd)
+
+        self._snap_scores = snap_scores
+        self._fill_class = fill_class
+        self._apply_score = apply_score
+
+    def raw_scores(self) -> np.ndarray:
+        """[K, n_pad] scores in ORIGINAL row order (host)."""
+        h = np.asarray(jax.device_get(
+            self.payload[:self.n_pad,
+                         self.idx_col:self.score0 + self.K]))
+        idx = h[:, 0].astype(np.int64)
+        out = np.zeros((self.K, self.n_pad), np.float32)
+        out[:, idx] = h[:, 1:1 + self.K].T
+        return out
 
 
 def _feature_meta_device(ds: BinnedDataset) -> FeatureMeta:
@@ -168,9 +284,13 @@ class GBDT:
             cat_l2=float(config.cat_l2),
             cat_smooth=float(config.cat_smooth),
             max_cat_to_onehot=int(config.max_cat_to_onehot),
-            min_data_per_group=int(config.min_data_per_group))
+            min_data_per_group=int(config.min_data_per_group),
+            hist_impl=str(getattr(config, "tpu_histogram_impl", "auto")
+                          or "auto"))
         self.grower = _cached_grower(self.meta_dev, self.grower_cfg,
                                      train_set.max_num_bin, train_set)
+        # partition-ordered fast path (built lazily on first eligible iter)
+        self._fast: Optional[_FastState] = None
 
         # scores: [K, N_pad] on device
         K = self.num_tree_per_iteration
@@ -234,8 +354,60 @@ class GBDT:
         self.valid_sets.append([name, valid, bins_v, score_v, metrics])
 
     # -- one boosting iteration (gbdt.cpp:387-482) ---------------------------
+    def _fast_eligible(self) -> bool:
+        """The partition-ordered fast path covers the plain serial GBDT:
+        row-wise objective (gradients independent of row order), no
+        leaf-output renewal, no bagging subsample, index column exact in
+        f32.  Everything else keeps the legacy masked grower."""
+        cfg = self.config
+        return (type(self) is GBDT
+                and self.objective is not None
+                and getattr(self.objective, "is_rowwise", True)
+                and not self.objective.renew_tree_output_required()
+                and not (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0)
+                and self.train_set.num_data_padded < (1 << 24))
+
+    def _fast_sync_back(self) -> None:
+        """Leave the fast path: restore original-order scores into the
+        legacy score matrix and drop the partitioned state."""
+        if self._fast is None:
+            return
+        self.score = jnp.asarray(self._fast.raw_scores())
+        self._fast = None
+
+    def _train_one_iter_fast(self) -> bool:
+        init_score = self._boost_from_average()
+        if self._fast is None:
+            self._fast = _FastState(self)
+        fs = self._fast
+        fmask = self._feature_sample()
+        if fs.K > 1:
+            fs.payload = fs._snap_scores(fs.payload)
+
+        lr = self.shrinkage_rate
+        should_continue = False
+        for k in range(self.num_tree_per_iteration):
+            fs.payload = fs._fill_class(fs.payload, k=k)
+            out, fs.payload, fs.aux = fs.grower(fs.payload, fs.aux, fmask)
+            tree, tree_dev, leaf_out = self._finish_tree(out, init_score)
+            if tree.num_leaves > 1:
+                should_continue = True
+                fs.payload = fs._apply_score(fs.payload, jnp.float32(lr), k=k)
+                depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
+                for vs in self.valid_sets:
+                    vs[3] = _traverse_update(vs[2], vs[3], leaf_out, tree_dev,
+                                             self.meta_dev, depth_iters, k)
+            self.model.trees.append(tree)
+        self.iter += 1
+        if not should_continue:
+            Log.warning("Stopped training because there are no more leaves that meet the split requirements")
+        return not should_continue
+
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
+        if grad is None and hess is None and self._fast_eligible():
+            return self._train_one_iter_fast()
+        self._fast_sync_back()
         init_score = 0.0
         if grad is None or hess is None:
             init_score = self._boost_from_average()
@@ -277,6 +449,7 @@ class GBDT:
         the bin-level traversal with negated leaf outputs."""
         if self.iter <= 0:
             return
+        self._fast_sync_back()
         K = self.num_tree_per_iteration
         for k in reversed(range(K)):
             tree = self.model.trees.pop()
@@ -532,6 +705,8 @@ class GBDT:
 
     # -- evaluation ----------------------------------------------------------
     def raw_train_score(self) -> np.ndarray:
+        if self._fast is not None:
+            return self._fast.raw_scores()[:, : self.train_set.num_data]
         return jax.device_get(self.score)[:, : self.train_set.num_data]
 
     def raw_valid_score(self, i: int) -> np.ndarray:

@@ -49,6 +49,9 @@ class GrowerConfig(NamedTuple):
     cat_smooth: float = 10.0
     max_cat_to_onehot: int = 4
     min_data_per_group: int = 100
+    # segment-engine implementation for the partitioned grower
+    # (Config.tpu_histogram_impl): "auto" | "pallas" | "lax"
+    hist_impl: str = "auto"
 
 
 def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,

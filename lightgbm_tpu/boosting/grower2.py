@@ -74,13 +74,26 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
 
     hist_kwargs = dict(num_features=F, num_bins=B, grad_col=cols.grad,
                        hess_col=cols.hess, cnt_col=cols.cnt)
+    impl = seg.resolve_impl(cfg.hist_impl, F, B)
+    if impl == "pallas":
+        from ..ops import pallas_segment as pseg
+        hist_fn = functools.partial(pseg.segment_histogram, **hist_kwargs)
+
+        def part_fn(payload, aux, start, count, pred, lv, rv):
+            return pseg.partition_segment(payload, aux, start, count, pred,
+                                          lv, rv, cols.value, B)
+    else:
+        hist_fn = functools.partial(seg.segment_histogram, **hist_kwargs)
+
+        def part_fn(payload, aux, start, count, pred, lv, rv):
+            return seg.partition_segment(payload, aux, start, count, pred,
+                                         lv, rv, cols.value)
 
     def grow(payload: jax.Array, aux: jax.Array,
              feature_mask: jax.Array):
         n_rows = jnp.int32(payload.shape[0] - seg.CHUNK)
 
-        hist_root = seg.segment_histogram(payload, jnp.int32(0), n_rows,
-                                          **hist_kwargs)
+        hist_root = hist_fn(payload, jnp.int32(0), n_rows)
         # every row lands in exactly one bin of feature 0, so the root totals
         # fall out of the histogram — no separate full-data pass
         totals = jnp.sum(hist_root[0], axis=0)
@@ -150,9 +163,9 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
 
             start = st["seg_start"][best_leaf]
             count = st["seg_cnt"][best_leaf]
-            payload, aux, nl_raw = seg.partition_segment(
+            payload, aux, nl_raw = part_fn(
                 st["payload"], st["aux"], start, count, pred,
-                st["blo"][best_leaf], st["bro"][best_leaf], cols.value)
+                st["blo"][best_leaf], st["bro"][best_leaf])
             nr_raw = count - nl_raw
 
             # child aggregates: left from the stored split, right by diff
@@ -169,8 +182,7 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             left_smaller = lcnt <= rcnt
             h_start = jnp.where(left_smaller, start, start + nl_raw)
             h_count = jnp.where(left_smaller, nl_raw, nr_raw)
-            hist_small = seg.segment_histogram(payload, h_start, h_count,
-                                               **hist_kwargs)
+            hist_small = hist_fn(payload, h_start, h_count)
             hist_parent = st["hist"][best_leaf]
             hist_big = hist_parent - hist_small
             new_left = jnp.where(left_smaller, hist_small, hist_big)
@@ -288,4 +300,7 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         }
         return tree, st["payload"], st["aux"]
 
-    return jax.jit(grow) if jit else grow
+    # payload/aux are donated: the training state is updated in place across
+    # trees, never copied (HistogramPool-style buffer discipline without the
+    # pointer juggling of feature_histogram.hpp:655-826)
+    return jax.jit(grow, donate_argnums=(0, 1)) if jit else grow

@@ -18,6 +18,10 @@ from ..utils.log import Log
 class ObjectiveFunction:
     name = "custom"
     is_constant_hessian = False
+    # gradients depend only on each row's own (score, label, weight) — lets
+    # the trainer compute them in any row order (partitioned fast path);
+    # query-grouped objectives (ranking) set this False
+    is_rowwise = True
 
     def __init__(self, config):
         self.config = config

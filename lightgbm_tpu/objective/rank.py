@@ -54,6 +54,7 @@ def check_rank_label(label: np.ndarray, num_levels: int) -> None:
 
 
 class LambdarankNDCG(ObjectiveFunction):
+    is_rowwise = False  # pairwise within query groups
     name = "lambdarank"
     is_constant_hessian = False
 

@@ -1,0 +1,251 @@
+"""Pallas TPU kernels for the segment engine's two hot paths.
+
+The portable lax implementations in `ops.segment` materialize the joint
+(feature, bin) one-hot and the permutation matrices through HBM — the very
+traffic that made the round-1 histogram 30-50x slower than a CPU.  These
+kernels keep every one-hot in VMEM:
+
+- `segment_histogram`: walks a leaf's contiguous chunks with manual
+  HBM->VMEM DMA at dynamic offsets (the trip count is a runtime scalar, so
+  one compilation serves every segment), builds the [C, F*B] one-hot in
+  VMEM and contracts it with the (grad, hess, count) columns on the MXU.
+  Mirrors the role of the reference OpenCL kernels
+  (src/treelearner/ocl/histogram256.cl:73-121 and the 16/64 variants) —
+  the B<=256/64/16 specialization falls out of the static num_bins arg.
+- `partition_segment`: the three compact passes of
+  `ops.segment.partition_segment` fused into one kernel; each chunk's
+  stable compaction is a one-hot permutation matmul in VMEM, appended to
+  the scratch buffer by a dynamic-offset DMA, then blended back.
+
+Both kernels alias payload/aux in/out so no copy of the [N, P] training
+state is ever made.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .split import MISSING_NAN, MISSING_ZERO
+
+# must match ops.segment.CHUNK (payload guard sizing)
+CHUNK = 256
+
+# VMEM budget gate: the joint one-hot is [CHUNK, F*B] f32.  Beyond this the
+# caller keeps the portable path (EFB keeps real workloads far below it).
+MAX_FB_COLS = 8192
+
+
+def fits_vmem(num_features: int, num_bins: int) -> bool:
+    return num_features * num_bins <= MAX_FB_COLS
+
+
+def _row_iota():
+    return lax.broadcasted_iota(jnp.int32, (CHUNK, 1), 0)[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# histogram
+# ---------------------------------------------------------------------------
+
+def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
+                 F, B, grad_col, hess_col, cnt_col):
+    start = scalars[0]
+    count = scalars[1]
+    nch = (count + CHUNK - 1) // CHUNK
+    out_ref[:] = jnp.zeros(out_ref.shape, out_ref.dtype)
+    iota_rows = _row_iota()
+
+    def body(k, _):
+        dma = pltpu.make_async_copy(
+            payload_hbm.at[pl.ds(start + k * CHUNK, CHUNK), :], chunk, sem)
+        dma.start()
+        dma.wait()
+        data = chunk[:]
+        ok = (iota_rows < (count - k * CHUNK)).astype(jnp.float32)
+        binsf = data[:, :F].astype(jnp.int32)                    # [C, F]
+        jidx = binsf + lax.broadcasted_iota(jnp.int32, (CHUNK, F), 1) * B
+        iota_fb = lax.broadcasted_iota(jnp.int32, (CHUNK, F * B), 1)
+        onehot = (jidx[:, :, None] == iota_fb.reshape(CHUNK, F, B)
+                  ).astype(jnp.float32).reshape(CHUNK, F * B)
+        zero = jnp.zeros_like(ok)
+        vals = jnp.stack(
+            [data[:, grad_col] * ok, data[:, hess_col] * ok,
+             data[:, cnt_col] * ok, zero, zero, zero, zero, zero],
+            axis=0)                                              # [8, C]
+        out_ref[:] += lax.dot_general(
+            vals, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # [8, F*B]
+        return 0
+
+    lax.fori_loop(0, nch, body, 0, unroll=False)
+
+
+@functools.partial(jax.jit, static_argnames=("num_features", "num_bins",
+                                             "grad_col", "hess_col",
+                                             "cnt_col", "interpret"))
+def segment_histogram(payload, start, count, *, num_features, num_bins,
+                      grad_col, hess_col, cnt_col, interpret=False):
+    """hist[F, B, 3] over payload rows [start, start+count) — TPU kernel."""
+    F, B, P = num_features, num_bins, payload.shape[1]
+    scalars = jnp.stack([start, count]).astype(jnp.int32)
+    kern = functools.partial(_hist_kernel, F=F, B=B, grad_col=grad_col,
+                             hess_col=hess_col, cnt_col=cnt_col)
+    out = pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((CHUNK, P), jnp.float32),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((8, F * B), jnp.float32),
+        interpret=interpret,
+    )(scalars, payload)
+    return out[:3].reshape(3, F, B).transpose(1, 2, 0)
+
+
+# ---------------------------------------------------------------------------
+# partition
+# ---------------------------------------------------------------------------
+
+def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
+                      payload_out, aux_out, nl_out,
+                      chunk, compact, sem_in, sem_out, *, P, B, value_col):
+    """payload_hbm/aux_hbm are aliased with payload_out/aux_out — the kernel
+    reads and writes the same HBM buffers through the `_out` refs."""
+    start = scalars[0]
+    count = scalars[1]
+    feature = scalars[2]
+    threshold = scalars[3]
+    default_left = scalars[4]
+    is_cat = scalars[5]
+    missing_type = scalars[6]
+    num_bin = scalars[7]
+    default_bin = scalars[8]
+    left_value = fvals[0]
+    right_value = fvals[1]
+    nch = (count + CHUNK - 1) // CHUNK
+    iota_rows = _row_iota()
+    iota_p = lax.broadcasted_iota(jnp.int32, (1, P), 1)
+
+    def read_chunk(src_ref, k, buf):
+        dma = pltpu.make_async_copy(
+            src_ref.at[pl.ds(start + k * CHUNK, CHUNK), :], buf, sem_in)
+        dma.start()
+        dma.wait()
+        return buf[:]
+
+    def go_left(data, k):
+        # select the split feature's bin column by lane reduction (dynamic
+        # lane indexing is not a Mosaic primitive; the masked sum is)
+        fbin = jnp.sum(jnp.where(iota_p == feature, data, 0.0),
+                       axis=1).astype(jnp.int32)                 # [C]
+        miss = ((missing_type == MISSING_NAN) & (fbin == num_bin - 1)) | \
+               ((missing_type == MISSING_ZERO) & (fbin == default_bin))
+        gl_num = jnp.where(miss, default_left > 0, fbin <= threshold)
+        iota_b = lax.broadcasted_iota(jnp.int32, (CHUNK, B), 1)
+        hits = (fbin[:, None] == iota_b) & (bitset_ref[:] > 0)
+        gl_cat = jnp.sum(hits.astype(jnp.int32), axis=1) > 0
+        gl = jnp.where(is_cat > 0, gl_cat, gl_num)
+        return gl & (iota_rows < (count - k * CHUNK))
+
+    def compact_append(k, keep, base, running):
+        keep_i = keep.astype(jnp.int32)
+        dest = jnp.cumsum(keep_i) - keep_i
+        iota_c = lax.broadcasted_iota(jnp.int32, (CHUNK, CHUNK), 0)
+        perm = ((dest[None, :] == iota_c) & keep[None, :]).astype(jnp.float32)
+        compact[:] = jnp.dot(perm, chunk[:],
+                             preferred_element_type=jnp.float32)
+        dma = pltpu.make_async_copy(
+            compact, aux_out.at[pl.ds(start + base + running, CHUNK), :],
+            sem_out)
+        dma.start()
+        dma.wait()
+        return running + jnp.sum(keep_i)
+
+    # pass A: lefts -> aux[start ..)
+    def body_a(k, nl):
+        data = read_chunk(payload_out, k, chunk)
+        return compact_append(k, go_left(data, k), 0, nl)
+
+    num_left = lax.fori_loop(0, nch, body_a, jnp.int32(0), unroll=False)
+    nl_out[0] = num_left
+
+    # pass B: rights -> aux[start + num_left ..)
+    def body_b(k, nr):
+        data = read_chunk(payload_out, k, chunk)
+        keep = (~go_left(data, k)) & (iota_rows < (count - k * CHUNK))
+        return compact_append(k, keep, num_left, nr)
+
+    lax.fori_loop(0, nch, body_b, jnp.int32(0), unroll=False)
+
+    # pass C: blended copy-back aux -> payload with value-column rewrite
+    def body_c(k, _):
+        src = read_chunk(aux_out, k, chunk)
+        orig = read_chunk(payload_out, k, compact)
+        pos = start + k * CHUNK + iota_rows
+        val = jnp.where(pos < start + num_left, left_value, right_value)
+        src = jnp.where(iota_p == value_col, val[:, None], src)
+        ok = (iota_rows < (count - k * CHUNK))[:, None]
+        compact[:] = jnp.where(ok, src, orig)
+        dma = pltpu.make_async_copy(
+            compact, payload_out.at[pl.ds(start + k * CHUNK, CHUNK), :],
+            sem_out)
+        dma.start()
+        dma.wait()
+        return 0
+
+    lax.fori_loop(0, nch, body_c, 0, unroll=False)
+
+
+@functools.partial(jax.jit, static_argnames=("value_col", "num_bins",
+                                             "interpret"))
+def partition_segment(payload, aux, start, count, pred, left_value,
+                      right_value, value_col, num_bins, interpret=False):
+    """Same contract as ops.segment.partition_segment, fused on-chip."""
+    P = payload.shape[1]
+    B = num_bins
+    scalars = jnp.stack([
+        start, count, pred.feature, pred.threshold,
+        pred.default_left.astype(jnp.int32), pred.is_cat.astype(jnp.int32),
+        pred.missing_type, pred.num_bin, pred.default_bin,
+    ]).astype(jnp.int32)
+    fvals = jnp.stack([left_value, right_value]).astype(jnp.float32)
+    bitset = pred.bitset.astype(jnp.int32).reshape(1, B)
+    kern = functools.partial(_partition_kernel, P=P, B=B,
+                             value_col=value_col)
+    payload_new, aux_new, nl = pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pltpu.SMEM)),
+            scratch_shapes=[
+                pltpu.VMEM((CHUNK, P), jnp.float32),
+                pltpu.VMEM((CHUNK, P), jnp.float32),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+        ),
+        out_shape=(jax.ShapeDtypeStruct(payload.shape, payload.dtype),
+                   jax.ShapeDtypeStruct(aux.shape, aux.dtype),
+                   jax.ShapeDtypeStruct((1,), jnp.int32)),
+        input_output_aliases={3: 0, 4: 1},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(scalars, fvals, bitset, payload, aux)
+    return payload_new, aux_new, nl[0]

@@ -41,6 +41,21 @@ from .split import MISSING_NAN, MISSING_ZERO
 CHUNK = 256
 
 
+def resolve_impl(impl: str, num_features: int, num_bins: int) -> str:
+    """Pick the segment-engine implementation at trace time.
+
+    "auto" (Config.tpu_histogram_impl default) chooses the Pallas kernels on
+    a TPU backend when the joint one-hot fits VMEM, otherwise the portable
+    lax path.  "pallas" / "lax" force a choice (tests, debugging)."""
+    if impl == "auto":
+        from . import pallas_segment
+        if (jax.default_backend() == "tpu"
+                and pallas_segment.fits_vmem(num_features, num_bins)):
+            return "pallas"
+        return "lax"
+    return impl
+
+
 class SplitPredicate(NamedTuple):
     """Scalars describing one split's routing decision
     (Bin::Split semantics, src/io/dense_bin.hpp:190-283)."""
