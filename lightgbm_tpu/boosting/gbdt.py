@@ -128,9 +128,8 @@ class _FastState:
             pay = pay.at[:n_pad, score0:score0 + K].set(score.T)
             return pay
 
-        self.payload = build(gbdt.bins_dev, gbdt.label_dev, gbdt.weight_dev,
-                             gbdt.valid_mask, gbdt.score)
-        self.aux = jnp.zeros_like(self.payload)
+        self._build = build
+        self.reset(gbdt)
         self.grower = _cached_pgrower(gbdt.meta_dev, gbdt.grower_cfg,
                                       ds.max_num_bin, ds, self.cols, self.P)
 
@@ -162,6 +161,15 @@ class _FastState:
         self._snap_scores = snap_scores
         self._fill_class = fill_class
         self._apply_score = apply_score
+
+    def reset(self, gbdt: "GBDT") -> None:
+        """(Re)build the payload from the legacy-order state — used on first
+        entry and when re-entering the fast path after a sync back (the
+        jitted closures and the grower survive, so no retracing)."""
+        self.payload = self._build(gbdt.bins_dev, gbdt.label_dev,
+                                   gbdt.weight_dev, gbdt.valid_mask,
+                                   gbdt.score)
+        self.aux = jnp.zeros_like(self.payload)
 
     def raw_scores(self) -> np.ndarray:
         """[K, n_pad] scores in ORIGINAL row order (host)."""
@@ -289,8 +297,10 @@ class GBDT:
                           or "auto"))
         self.grower = _cached_grower(self.meta_dev, self.grower_cfg,
                                      train_set.max_num_bin, train_set)
-        # partition-ordered fast path (built lazily on first eligible iter)
+        # partition-ordered fast path (built lazily on first eligible iter;
+        # the state object survives sync-backs so re-entry never retraces)
         self._fast: Optional[_FastState] = None
+        self._fast_active = False
 
         # scores: [K, N_pad] on device
         K = self.num_tree_per_iteration
@@ -369,16 +379,20 @@ class GBDT:
 
     def _fast_sync_back(self) -> None:
         """Leave the fast path: restore original-order scores into the
-        legacy score matrix and drop the partitioned state."""
-        if self._fast is None:
+        legacy score matrix.  The state object is kept for cheap re-entry."""
+        if not self._fast_active:
             return
         self.score = jnp.asarray(self._fast.raw_scores())
-        self._fast = None
+        self._fast_active = False
 
     def _train_one_iter_fast(self) -> bool:
         init_score = self._boost_from_average()
         if self._fast is None:
             self._fast = _FastState(self)
+            self._fast_active = True
+        elif not self._fast_active:
+            self._fast.reset(self)
+            self._fast_active = True
         fs = self._fast
         fmask = self._feature_sample()
         if fs.K > 1:
@@ -705,7 +719,7 @@ class GBDT:
 
     # -- evaluation ----------------------------------------------------------
     def raw_train_score(self) -> np.ndarray:
-        if self._fast is not None:
+        if self._fast_active:
             return self._fast.raw_scores()[:, : self.train_set.num_data]
         return jax.device_get(self.score)[:, : self.train_set.num_data]
 

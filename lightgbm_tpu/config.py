@@ -88,8 +88,9 @@ class Config:
             if isinstance(default, tuple):
                 default = list(default)
             setattr(self, name, default)
-        # non-registry knobs the TPU build adds
-        self.tpu_histogram_impl = "auto"  # auto | einsum | pallas
+        # non-registry knobs the TPU build adds: segment-engine selection
+        # for the partitioned grower (validated in ops.segment.resolve_impl)
+        self.tpu_histogram_impl = "auto"  # auto | pallas | lax
         self.raw_params: Dict[str, Any] = {}
         if params:
             self.set(params)

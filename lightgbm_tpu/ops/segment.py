@@ -47,6 +47,9 @@ def resolve_impl(impl: str, num_features: int, num_bins: int) -> str:
     "auto" (Config.tpu_histogram_impl default) chooses the Pallas kernels on
     a TPU backend when the joint one-hot fits VMEM, otherwise the portable
     lax path.  "pallas" / "lax" force a choice (tests, debugging)."""
+    if impl not in ("auto", "pallas", "lax"):
+        raise ValueError(
+            "tpu_histogram_impl must be one of auto|pallas|lax, got %r" % impl)
     if impl == "auto":
         from . import pallas_segment
         if (jax.default_backend() == "tpu"
