@@ -233,8 +233,8 @@ class Booster:
         self._engine.rollback_one_iter()
         return self
 
-    @property
     def current_iteration(self) -> int:
+        """Number of completed iterations (reference Booster method)."""
         return self._model.current_iteration
 
     def num_trees(self) -> int:

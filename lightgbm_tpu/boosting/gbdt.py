@@ -264,8 +264,42 @@ class GBDT:
             self.model.objective_str = objective.to_string()
         self.num_init_iteration = self.model.current_iteration
 
+        # -- parallel learner selection (tree_learner factory parity,
+        #    src/treelearner/tree_learner.cpp:9-33: the requested mode times
+        #    the visible device count decides the learner) ------------------
+        self.parallel_mode: Optional[str] = None
+        self.mesh = None
+        self.mesh_axis = "workers"
+        self._fmask_pad = 0
+        tl = str(getattr(config, "tree_learner", "serial") or "serial")
+        if tl != "serial":
+            devices = jax.devices()
+            nm = int(getattr(config, "num_machines", 1) or 1)
+            ndev = len(devices) if nm <= 1 else min(nm, len(devices))
+            n_pad_ = train_set.num_data_padded
+            if ndev <= 1:
+                Log.warning(
+                    "tree_learner=%s requested but only one device is "
+                    "visible; training with the serial learner", tl)
+            elif tl in ("data", "voting") and n_pad_ % ndev != 0:
+                Log.warning(
+                    "tree_learner=%s: padded row count %d is not divisible "
+                    "by %d devices; training with the serial learner",
+                    tl, n_pad_, ndev)
+            else:
+                from jax.sharding import Mesh
+                self.parallel_mode = tl
+                self.mesh = Mesh(np.array(devices[:ndev]), (self.mesh_axis,))
+                Log.info("Using %s-parallel tree learner over %d devices",
+                         tl, ndev)
+
         # -- device state ----------------------------------------------------
-        self.bins_dev = jnp.asarray(train_set.bins)
+        if self.parallel_mode == "feature":
+            # uploaded padded + feature-sharded in _setup_parallel_learner;
+            # avoid a second full-matrix host->device transfer here
+            self.bins_dev = None
+        else:
+            self.bins_dev = jnp.asarray(train_set.bins)
         self.meta_dev = _feature_meta_device(train_set)
         self.valid_mask = jnp.asarray(train_set.valid_row_mask())
         md = train_set.metadata
@@ -330,6 +364,9 @@ class GBDT:
         # converted outputs (rf.hpp EvalOneMetric)
         self._metric_objective = objective
 
+        if self.parallel_mode is not None:
+            self._setup_parallel_learner()
+
         # continued training (input_model / init_model, gbdt.cpp:64-169 with
         # num_init_iteration_ > 0): map the loaded trees' double thresholds
         # back onto this dataset's bins, then replay them onto the score
@@ -339,6 +376,69 @@ class GBDT:
             for idx, tree in enumerate(self.model.trees):
                 tree.set_bin_thresholds(train_set.bin_mappers)
                 self._add_tree_to_train_score(tree, idx % K, 1.0)
+
+    def _setup_parallel_learner(self) -> None:
+        """Build the shard_map'd grower and place training state on the mesh.
+
+        data/voting: rows sharded (bins [F, N] over N, per-row vectors over
+        N, scores [K, N] over N); feature: features sharded (bins/fmask over
+        F, rows replicated).  The grower output tree is replicated except
+        the per-row leaf ids, which follow the row sharding."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.feature_parallel import pad_features, pad_feature_meta
+
+        mode = self.parallel_mode
+        ax = self.mesh_axis
+        n = self.mesh.shape[ax]
+        meta = self.meta_dev
+        if mode == "feature":
+            bins_h, _, f_padded = pad_features(
+                self.train_set.bins, np.ones(self.train_set.num_features,
+                                             bool), n)
+            self._fmask_pad = f_padded - self.train_set.num_features
+            meta = pad_feature_meta(meta, f_padded)
+            self.bins_dev = jax.device_put(
+                jnp.asarray(bins_h), NamedSharding(self.mesh, P(ax, None)))
+            row_spec, vals_spec, score_spec = P(), P(), P()
+            bins_spec, fmask_spec = P(ax, None), P(ax)
+            leaf_id_spec = P()
+        else:
+            self.bins_dev = jax.device_put(
+                self.bins_dev, NamedSharding(self.mesh, P(None, ax)))
+            row_spec, vals_spec, score_spec = P(ax), P(ax, None), P(None, ax)
+            bins_spec, fmask_spec = P(None, ax), P()
+            leaf_id_spec = P(ax)
+        self._row_sharding = NamedSharding(self.mesh, row_spec)
+
+        for attr in ("valid_mask", "label_dev", "weight_dev", "_bag_cmask"):
+            setattr(self, attr, jax.device_put(
+                getattr(self, attr), self._row_sharding))
+        self.score = jax.device_put(self.score,
+                                    NamedSharding(self.mesh, score_spec))
+
+        cfg = self.grower_cfg
+        if mode in ("data", "voting"):
+            # inside shard_map the histogram kernel sees only the local
+            # shard's rows; its chunking invariant must hold for N/n
+            local_n = self.train_set.num_data_padded // n
+            cfg = cfg._replace(
+                row_chunk=16384 if local_n % 16384 == 0 else local_n)
+        grow_core = make_tree_grower(
+            meta, cfg, self.train_set.max_num_bin,
+            axis_name=ax, jit=False, mode=mode, num_machines=n,
+            top_k=int(getattr(self.config, "top_k", 20)))
+        out_specs = dict.fromkeys((
+            "num_leaves", "leaf_value", "leaf_count", "leaf_sum_g",
+            "leaf_sum_h", "split_feature", "split_bin", "split_gain",
+            "default_left", "split_is_cat", "split_cat_bitset", "left_child",
+            "right_child", "internal_value", "internal_count"), P())
+        out_specs["leaf_id"] = leaf_id_spec
+        # check_vma off: every shard carries the replicated winner through
+        # the fori_loop, which the varying-axes tracker cannot prove
+        self.grower = jax.jit(jax.shard_map(
+            grow_core, mesh=self.mesh,
+            in_specs=(bins_spec, vals_spec, fmask_spec),
+            out_specs=out_specs, check_vma=False))
 
     # -- validation ----------------------------------------------------------
     def add_valid(self, name: str, valid: BinnedDataset, metrics: List) -> None:
@@ -371,6 +471,7 @@ class GBDT:
         f32.  Everything else keeps the legacy masked grower."""
         cfg = self.config
         return (type(self) is GBDT
+                and self.mesh is None
                 and self.objective is not None
                 and getattr(self.objective, "is_rowwise", True)
                 and not self.objective.renew_tree_output_required()
@@ -592,6 +693,8 @@ class GBDT:
                 mask = np.zeros(self.train_set.num_data_padded, dtype=np.float32)
                 mask[idx] = 1.0
                 self.bag_mask_host = mask
+        if self.mesh is not None:
+            return jax.device_put(self.bag_mask_host, self._row_sharding)
         return jnp.asarray(self.bag_mask_host)
 
     def _bagging_masks(self, grads, hesss):
@@ -610,6 +713,10 @@ class GBDT:
             mask[self.feature_rng.sample(f, used)] = True
         else:
             mask[:] = True
+        if self._fmask_pad:
+            # feature-parallel pads the feature axis to a shard multiple;
+            # padded columns never enter split search
+            mask = np.concatenate([mask, np.zeros(self._fmask_pad, bool)])
         return jnp.asarray(mask)
 
     def _renew_leaf_values(self, out: Dict, k: int) -> Optional[np.ndarray]:

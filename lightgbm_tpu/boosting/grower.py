@@ -28,7 +28,7 @@ from jax import lax
 from ..ops.histogram import build_histogram
 from ..ops.split import (FeatureMeta, K_MIN_SCORE, MISSING_NAN, MISSING_ZERO,
                          SplitResult, find_best_split, leaf_output,
-                         per_feature_best_gains)
+                         pad_feature_meta, per_feature_best_gains)
 
 
 class GrowerConfig(NamedTuple):
@@ -86,10 +86,7 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
     B = num_bins_max
     feature_mode = axis_name is not None and mode == "feature"
     voting_mode = axis_name is not None and mode == "voting"
-
-    def reduce_hist(h):
-        return lax.psum(h, axis_name) if (axis_name and not feature_mode
-                                          and not voting_mode) else h
+    data_mode = axis_name is not None and mode == "data"
 
     find_kwargs = dict(
         l1=cfg.lambda_l1, l2=cfg.lambda_l2, max_delta_step=cfg.max_delta_step,
@@ -105,8 +102,51 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
     out_fn = functools.partial(leaf_output, l1=cfg.lambda_l1, l2=cfg.lambda_l2,
                                max_delta_step=cfg.max_delta_step)
 
+    def _winner_sync(my, f_offset):
+        """SyncUpGlobalBestSplit (parallel_tree_learner.h:183-206):
+        gain pmax + lowest-shard tie-break, then the whole SplitResult
+        packed into ONE f32 buffer for a single one-hot psum (the
+        reference likewise ships a fixed-size SplitInfo blob).
+        Integer fields (feature, bin) are exact in f32 below 2^24."""
+
+        def bcast_from_winner(res):
+            gain_max = lax.pmax(res.gain, axis_name)
+            big = jnp.int32(1 << 30)
+            winner = lax.pmin(jnp.where(res.gain == gain_max, my, big),
+                              axis_name)
+            is_w = my == winner
+            payload = jnp.concatenate([
+                jnp.stack([
+                    res.gain,
+                    (res.feature + f_offset).astype(jnp.float32),
+                    res.threshold_bin.astype(jnp.float32),
+                    res.default_left.astype(jnp.float32),
+                    res.left_sum_g, res.left_sum_h, res.left_count,
+                    res.is_cat.astype(jnp.float32),
+                    res.left_output, res.right_output,
+                ]),
+                res.cat_bitset.astype(jnp.float32)])
+            payload = lax.psum(jnp.where(is_w, payload,
+                                         jnp.zeros_like(payload)), axis_name)
+            return SplitResult(
+                gain=payload[0],
+                feature=payload[1].astype(jnp.int32),
+                threshold_bin=payload[2].astype(jnp.int32),
+                default_left=payload[3] > 0,
+                left_sum_g=payload[4],
+                left_sum_h=payload[5],
+                left_count=payload[6],
+                is_cat=payload[7] > 0,
+                cat_bitset=payload[10:] > 0,
+                left_output=payload[8],
+                right_output=payload[9])
+
+        return bcast_from_winner
+
     def grow(bins: jax.Array, vals: jax.Array, feature_mask: jax.Array) -> Dict[str, jax.Array]:
         F, N = bins.shape
+
+        reduce_hist = lambda h: h  # serial / feature / voting: local
 
         if feature_mode:
             my = lax.axis_index(axis_name)
@@ -115,46 +155,46 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
                                        for a in meta])
             find_local = functools.partial(find_best_split, meta=meta_local,
                                            **find_kwargs)
-
-            def bcast_from_winner(res):
-                """SyncUpGlobalBestSplit (parallel_tree_learner.h:183-206):
-                gain pmax + lowest-shard tie-break, then the whole SplitResult
-                packed into ONE f32 buffer for a single one-hot psum (the
-                reference likewise ships a fixed-size SplitInfo blob).
-                Integer fields (feature, bin) are exact in f32 below 2^24."""
-                gain_max = lax.pmax(res.gain, axis_name)
-                big = jnp.int32(1 << 30)
-                winner = lax.pmin(jnp.where(res.gain == gain_max, my, big),
-                                  axis_name)
-                is_w = my == winner
-                payload = jnp.concatenate([
-                    jnp.stack([
-                        res.gain,
-                        (res.feature + f_offset).astype(jnp.float32),
-                        res.threshold_bin.astype(jnp.float32),
-                        res.default_left.astype(jnp.float32),
-                        res.left_sum_g, res.left_sum_h, res.left_count,
-                        res.is_cat.astype(jnp.float32),
-                        res.left_output, res.right_output,
-                    ]),
-                    res.cat_bitset.astype(jnp.float32)])
-                payload = lax.psum(jnp.where(is_w, payload,
-                                             jnp.zeros_like(payload)), axis_name)
-                return SplitResult(
-                    gain=payload[0],
-                    feature=payload[1].astype(jnp.int32),
-                    threshold_bin=payload[2].astype(jnp.int32),
-                    default_left=payload[3] > 0,
-                    left_sum_g=payload[4],
-                    left_sum_h=payload[5],
-                    left_count=payload[6],
-                    is_cat=payload[7] > 0,
-                    cat_bitset=payload[10:] > 0,
-                    left_output=payload[8],
-                    right_output=payload[9])
+            bcast_from_winner = _winner_sync(my, f_offset)
 
             def find_split(hist, sg, sh, cnt, fmask):
                 return bcast_from_winner(find_local(hist, sg, sh, cnt, fmask))
+
+        elif data_mode:
+            # DataParallelTreeLearner with the reference's actual wire
+            # pattern (data_parallel_tree_learner.cpp:159-246): histograms
+            # ReduceScatter over the feature axis so each shard owns F/n
+            # features, split search runs only on owned features, and the
+            # global winner is an allreduce-max of one SplitInfo blob —
+            # psum_scatter + the shared winner sync, NOT a full psum with
+            # replicated search.
+            n = max(num_machines, 1)
+            Fp = ((F + n - 1) // n) * n
+            padf = Fp - F
+            Floc = Fp // n
+            if padf:
+                bins_h = jnp.pad(bins, ((0, padf), (0, 0)))
+                fmask_p = jnp.pad(feature_mask, (0, padf))
+                meta_p = pad_feature_meta(meta, Fp)
+            else:
+                bins_h, fmask_p, meta_p = bins, feature_mask, meta
+            my = lax.axis_index(axis_name)
+            f_offset = my * Floc
+            meta_local = FeatureMeta(
+                *[lax.dynamic_slice_in_dim(a, f_offset, Floc)
+                  for a in meta_p])
+            find_local = functools.partial(find_best_split, meta=meta_local,
+                                           **find_kwargs)
+            bcast_from_winner = _winner_sync(my, f_offset)
+
+            def reduce_hist(h):
+                return lax.psum_scatter(h, axis_name, scatter_dimension=0,
+                                        tiled=True)
+
+            def find_split(hist_loc, sg, sh, cnt, fmask):
+                fmask_loc = lax.dynamic_slice_in_dim(fmask_p, f_offset, Floc)
+                return bcast_from_winner(
+                    find_local(hist_loc, sg, sh, cnt, fmask_loc))
 
         elif voting_mode:
             k_vote = min(top_k, F)
@@ -195,8 +235,11 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
         if axis_name and not feature_mode:
             totals = lax.psum(totals, axis_name)
         root_g, root_h, root_c = totals[0], totals[1], totals[2]
+        hist_bins = bins_h if data_mode else bins   # padded F in data mode
+        Fh = (bins_h.shape[0] // max(num_machines, 1)) if data_mode else F
         hist_root = reduce_hist(
-            build_histogram(bins, vals, num_bins=B, row_chunk=cfg.row_chunk))
+            build_histogram(hist_bins, vals, num_bins=B,
+                            row_chunk=cfg.row_chunk))
         res0 = find_split(hist_root, root_g, root_h, root_c, feature_mask)
 
         ni = max(L - 1, 1)
@@ -207,7 +250,7 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
             # sharded; in feature mode rows are replicated instead)
             leaf_id0 = lax.pvary(leaf_id0, axis_name)
         state = {
-            "hist": jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(hist_root),
+            "hist": jnp.zeros((L, Fh, B, 3), jnp.float32).at[0].set(hist_root),
             "leaf_id": leaf_id0,
             "sum_g": jnp.zeros(L, jnp.float32).at[0].set(root_g),
             "sum_h": jnp.zeros(L, jnp.float32).at[0].set(root_h),
@@ -288,8 +331,9 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
             left_smaller = lcnt <= rcnt
             small_slot = jnp.where(left_smaller, best_leaf, s)
             mask = ((leaf_id == small_slot) & do).astype(jnp.float32)
-            hist_small = reduce_hist(build_histogram(bins, vals * mask[:, None],
-                                                     num_bins=B, row_chunk=cfg.row_chunk))
+            hist_small = reduce_hist(
+                build_histogram(hist_bins, vals * mask[:, None],
+                                num_bins=B, row_chunk=cfg.row_chunk))
             hist_parent = st["hist"][best_leaf]
             hist_big = hist_parent - hist_small
             new_left = jnp.where(left_smaller, hist_small, hist_big)

@@ -63,6 +63,28 @@ class SplitResult(NamedTuple):
     right_output: jax.Array  # regularization (cat_l2 for sorted-subset splits)
 
 
+
+def pad_feature_meta(meta: "FeatureMeta", f_padded: int) -> "FeatureMeta":
+    """Extend per-feature metadata with trivial (inert) entries for padded
+    feature columns — shared by the feature- and data-parallel learners."""
+    F = int(meta.num_bin.shape[0])
+    pad = f_padded - F
+    if pad <= 0:
+        return meta
+
+    def ext(a, fill):
+        return jnp.concatenate([a, jnp.full((pad,), fill, a.dtype)])
+
+    return FeatureMeta(
+        num_bin=ext(meta.num_bin, 1),
+        missing_type=ext(meta.missing_type, 0),
+        default_bin=ext(meta.default_bin, 0),
+        is_trivial=ext(meta.is_trivial, True),
+        is_categorical=ext(meta.is_categorical, False),
+        penalty=ext(meta.penalty, 1.0),
+        monotone=ext(meta.monotone, 0),
+    )
+
 def threshold_l1(s, l1):
     return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
 

@@ -3,14 +3,13 @@
 TPU-native equivalent of the reference DataParallelTreeLearner
 (src/treelearner/data_parallel_tree_learner.cpp) + Network collectives
 (src/network/network.cpp): rows are sharded over the mesh 'data' axis, local
-histograms are summed with `lax.psum` over ICI inside `shard_map`, split
-finding runs replicated on the reduced histograms, and the winning split is
-applied identically on every shard (indices local, counts global).
-
-The reference's ReduceScatter + per-rank feature ownership + Allreduce-max of
-SplitInfo (network boundary at data_parallel_tree_learner.cpp:159-246)
-collapses into a single psum because XLA owns algorithm selection and
-topology; the feature-sharded variant lives in feature_parallel.py.
+histograms are ReduceScattered over the feature dimension with
+`lax.psum_scatter` so each shard owns F/n features' reduced histograms,
+split search runs only on owned features, and the global winner is one
+SyncUpGlobalBestSplit allreduce (gain pmax + packed SplitInfo psum) — the
+same wire pattern as the reference's network boundary at
+data_parallel_tree_learner.cpp:159-246, with XLA collectives over ICI in
+place of src/network/ sockets.
 """
 from __future__ import annotations
 
@@ -44,13 +43,19 @@ def make_data_parallel_train_step(meta: FeatureMeta, cfg: GrowerConfig,
     """
     objective = resolve_objective(objective)
     grow = make_tree_grower(meta, cfg, num_bins_max, axis_name=DATA_AXIS,
-                            jit=False)
+                            jit=False, mode="data",
+                            num_machines=mesh.shape[DATA_AXIS])
     step = make_step(grow, objective, learning_rate)
+    # check_vma off: the owned-feature winner is broadcast to every shard by
+    # the SyncUpGlobalBestSplit psum, so the carried split state is
+    # replicated in value, but the varying-axes tracker cannot prove it
+    # through the fori_loop carry
     sharded = jax.shard_map(
         step, mesh=mesh,
         in_specs=(P(None, DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                   P(DATA_AXIS), P(DATA_AXIS), P(None)),
-        out_specs=(P(DATA_AXIS), P()))
+        out_specs=(P(DATA_AXIS), P()),
+        check_vma=False)
     return jax.jit(sharded)
 
 

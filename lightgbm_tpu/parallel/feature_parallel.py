@@ -17,7 +17,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..boosting.grower import GrowerConfig, make_tree_grower
-from ..ops.split import FeatureMeta
+from ..ops.split import FeatureMeta, pad_feature_meta  # noqa: F401  (re-export)
 from ._common import make_step, resolve_objective
 
 FEATURE_AXIS = "feature"
@@ -32,27 +32,6 @@ def pad_features(bins: np.ndarray, feature_mask: np.ndarray, num_shards: int):
         bins = np.concatenate([bins, np.zeros((pad, bins.shape[1]), bins.dtype)])
         feature_mask = np.concatenate([feature_mask, np.zeros(pad, bool)])
     return bins, feature_mask, F + pad
-
-
-def pad_feature_meta(meta: FeatureMeta, f_padded: int) -> FeatureMeta:
-    """Extend per-feature metadata with trivial entries for padded columns."""
-    F = int(meta.num_bin.shape[0])
-    pad = f_padded - F
-    if pad <= 0:
-        return meta
-
-    def ext(a, fill):
-        return jnp.concatenate([a, jnp.full((pad,), fill, a.dtype)])
-
-    return FeatureMeta(
-        num_bin=ext(meta.num_bin, 1),
-        missing_type=ext(meta.missing_type, 0),
-        default_bin=ext(meta.default_bin, 0),
-        is_trivial=ext(meta.is_trivial, True),
-        is_categorical=ext(meta.is_categorical, False),
-        penalty=ext(meta.penalty, 1.0),
-        monotone=ext(meta.monotone, 0),
-    )
 
 
 def make_feature_parallel_train_step(meta: FeatureMeta, cfg: GrowerConfig,

@@ -1,0 +1,104 @@
+"""tree_learner=serial|data|feature|voting through the public API.
+
+The reference factory (src/treelearner/tree_learner.cpp:9-33) picks the
+learner from the config; here lgb.train must do the same over the visible
+device mesh (8 virtual CPU devices in tests), with the FULL boosting loop —
+objective, bagging, feature sampling, validation, early stopping — not a
+standalone step function.  data/feature must reproduce the serial learner's
+model exactly on the reference example data; voting is a different
+algorithm (bounded communication) and only needs comparable quality."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _train(params, X, y, Xv=None, yv=None, rounds=12, callbacks=None):
+    ds = lgb.Dataset(X, label=y)
+    kwargs = {}
+    if Xv is not None:
+        kwargs["valid_sets"] = [lgb.Dataset(Xv, label=yv, reference=ds)]
+        kwargs["valid_names"] = ["test"]
+    return lgb.train(dict(params), ds, num_boost_round=rounds,
+                     callbacks=callbacks or [], **kwargs)
+
+
+BASE = {"objective": "binary", "metric": "auc", "num_leaves": 15,
+        "learning_rate": 0.1, "min_data_in_leaf": 20, "verbose": -1,
+        "seed": 7}
+
+# model-file fields that must match EXACTLY (tree structure + routing);
+# float statistics may differ in the last ulps because distributed psum
+# accumulates shard partials in a different order than the serial scan
+_EXACT = ("split_feature=", "threshold=", "decision_type=", "left_child=",
+          "right_child=", "leaf_count=", "internal_count=", "num_leaves=",
+          "num_cat=", "cat_threshold=", "cat_boundaries=", "shrinkage=")
+_CLOSE = ("leaf_value=", "internal_value=", "split_gain=", "leaf_weight=",
+          "internal_weight=")
+
+
+def assert_models_equivalent(a: str, b: str, rtol=1e-4, atol=1e-6):
+    la, lb = a.splitlines(), b.splitlines()
+    assert len(la) == len(lb)
+    for xa, xb in zip(la, lb):
+        if xa == xb:
+            continue
+        key = xa.split("=")[0] + "="
+        if key == "tree_sizes=":   # byte lengths shift with value digits
+            continue
+        assert key == xb.split("=")[0] + "=", (xa, xb)
+        assert key not in _EXACT, "structural mismatch: %s vs %s" % (xa, xb)
+        assert key in _CLOSE, "unexpected diff line: %s vs %s" % (xa, xb)
+        va = np.asarray([float(v) for v in xa.split("=")[1].split()])
+        vb = np.asarray([float(v) for v in xb.split("=")[1].split()])
+        np.testing.assert_allclose(va, vb, rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("mode", ["data", "feature"])
+def test_parallel_learner_matches_serial(binary_data, mode):
+    X, y, Xt, yt = binary_data
+    serial = _train(BASE, X, y)
+    par = _train({**BASE, "tree_learner": mode}, X, y)
+    assert_models_equivalent(par.model_to_string(), serial.model_to_string())
+
+
+def test_voting_learner_trains_comparably(binary_data):
+    X, y, Xt, yt = binary_data
+    serial = _train(BASE, X, y)
+    par = _train({**BASE, "tree_learner": "voting", "top_k": 10}, X, y)
+
+    # quality check: held-out logloss comparable to serial
+    ps = serial.predict(Xt)
+    pv = par.predict(Xt)
+    def logloss(p):
+        p = np.clip(p, 1e-7, 1 - 1e-7)
+        return -np.mean(yt * np.log(p) + (1 - yt) * np.log(1 - p))
+    assert logloss(pv) < logloss(ps) + 0.02
+
+
+def test_parallel_with_bagging_and_early_stopping(binary_data):
+    """The full loop must run in parallel mode: bagging masks, validation
+    scoring and early stopping all active."""
+    X, y, Xt, yt = binary_data
+    params = {**BASE, "tree_learner": "data", "bagging_fraction": 0.8,
+              "bagging_freq": 1, "feature_fraction": 0.9}
+    evals = {}
+    bst = _train(params, X, y, Xt, yt, rounds=40,
+                 callbacks=[lgb.early_stopping(5, verbose=False),
+                            lgb.record_evaluation(evals)])
+    assert bst.best_iteration >= 1
+    assert len(evals["test"]["auc"]) >= bst.best_iteration
+    # and the bagged parallel model must match the bagged serial model
+    serial = _train(params | {"tree_learner": "serial"}, X, y, Xt, yt,
+                    rounds=40,
+                    callbacks=[lgb.early_stopping(5, verbose=False)])
+    assert_models_equivalent(bst.model_to_string(), serial.model_to_string())
+
+
+def test_single_device_falls_back_to_serial(binary_data, monkeypatch):
+    import jax
+    X, y, _, _ = binary_data
+    dev0 = [jax.devices()[0]]
+    monkeypatch.setattr(jax, "devices", lambda *a: dev0)
+    bst = _train({**BASE, "tree_learner": "data"}, X, y, rounds=3)
+    assert bst.current_iteration() == 3
