@@ -100,15 +100,26 @@ class Application:
         early_stopping = int(params.pop("early_stopping_round",
                              params.pop("early_stopping_rounds", 0)))
 
-        X, y, weight, query = self._load(data_path)
-        group = None
-        if query is not None:
-            group = query.astype(np.int64)
-        train_set = Dataset(X, label=y, weight=weight, group=group, params=params)
+        from .io.dataset import BinnedDataset
+        resolved = {Config.resolve_alias(k): v for k, v in params.items()}
+        if BinnedDataset.is_binary_file(data_path):
+            train_set = Dataset(data_path, params=params)
+            train_set.construct(Config(params))
+        else:
+            X, y, weight, query = self._load(data_path)
+            group = None
+            if query is not None:
+                group = query.astype(np.int64)
+            train_set = Dataset(X, label=y, weight=weight, group=group,
+                                params=params)
+            if str(resolved.get("save_binary", "")).lower() in ("true", "1"):
+                train_set.construct(Config(params))
+                train_set.save_binary(data_path + ".bin")
         valid_sets = []
         valid_names = []
+        num_features = train_set.binned.num_features
         for i, vp in enumerate(valid_paths):
-            vX, vy, vweight, vquery = self._load(vp, num_features=X.shape[1])
+            vX, vy, vweight, vquery = self._load(vp, num_features=num_features)
             vgroup = vquery.astype(np.int64) if vquery is not None else None
             valid_sets.append(train_set.create_valid(vX, label=vy, weight=vweight,
                                                      group=vgroup))

@@ -58,18 +58,54 @@ class Dataset:
             return self
         if config is None:
             config = Config(self.params)
+        if isinstance(self.data, str):
+            # a path: binary dataset cache (save_binary) or a text data file.
+            # A validation set given as a path still aligns to the training
+            # mappers/bundles through self.reference (Dataset::CreateValid).
+            ref_mappers = ref_bundle = None
+            if self.reference is not None:
+                self.reference.construct(config)
+                ref_mappers = self.reference._binned.bin_mappers
+                ref_bundle = self.reference._binned.bundle_info
+            if BinnedDataset.is_binary_file(self.data):
+                if ref_mappers is not None:
+                    Log.fatal("A binary dataset cache carries its own bin "
+                              "mappers and cannot be re-aligned to a "
+                              "reference dataset; rebuild the cache from "
+                              "the validation data instead")
+                self._binned = BinnedDataset.load_binary(self.data)
+            else:
+                from .io.parser import parse_file
+                X, label = parse_file(self.data)
+                self._binned = BinnedDataset.from_matrix(
+                    X, config, bin_mappers=ref_mappers,
+                    reference_bundle=ref_bundle)
+                if label is not None and self.label is None:
+                    self.label = label
+            md = self._binned.metadata
+            if self.label is not None:
+                md.set_label(np.asarray(self.label))
+            if self.weight is not None:
+                md.set_weight(self.weight)
+            if self.init_score is not None:
+                md.set_init_score(self.init_score)
+            if self.group is not None:
+                md.set_query(self.group)
+            return self
         X = _to_2d_float(self.data)
         fn = None if self.feature_name == "auto" else list(self.feature_name)
         cats: Sequence[int] = ()
         if self.categorical_feature != "auto" and self.categorical_feature:
             cats = [int(c) for c in self.categorical_feature]
         ref_mappers = None
+        ref_bundle = None
         if self.reference is not None:
             self.reference.construct(config)
             ref_mappers = self.reference._binned.bin_mappers
+            ref_bundle = self.reference._binned.bundle_info
         self._binned = BinnedDataset.from_matrix(
             X, config, bin_mappers=ref_mappers, feature_names=fn,
-            categorical_feature=cats)
+            categorical_feature=cats, reference_bundle=ref_bundle)
         md = self._binned.metadata
         if self.label is not None:
             md.set_label(np.asarray(self.label))
@@ -83,6 +119,12 @@ class Dataset:
         if self._binned is None:
             self.construct()
         return self._binned
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """Write the constructed dataset to a binary cache file that later
+        Dataset(filename) calls load directly (reference save_binary)."""
+        self.binned.save_binary(filename)
+        return self
 
     def create_valid(self, data, label=None, weight=None, group=None,
                      init_score=None, params=None) -> "Dataset":
@@ -154,6 +196,7 @@ class Booster:
 
         if train_set is not None:
             self.config = Config(params)
+            self.config.warn_unimplemented()
             train_set.construct(self.config)
             obj = self.config.objective
             self._objective = create_objective(obj, self.config) \

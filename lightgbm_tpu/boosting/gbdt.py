@@ -27,6 +27,8 @@ from ..ops.split import FeatureMeta
 from ..utils.log import Log
 from ..utils.random import Random, partition_seed
 from ..ops import segment as seg
+from ..ops.bundle import (BundleMap, bundle_map_from_info, decode_bin,
+                          identity_bundle_map)
 from .grower import GrowerConfig, make_tree_grower
 from .grower2 import PayloadCols, make_partitioned_grower
 
@@ -48,14 +50,24 @@ def _construct_bitset(vals) -> list:
 _GROWER_CACHE: Dict = {}
 
 
-def _cached_grower(meta_dev: FeatureMeta, cfg, max_num_bin: int, ds: BinnedDataset):
-    key = (cfg, max_num_bin, ds.bins.shape,
+def _bundle_key(ds: BinnedDataset):
+    info = ds.bundle_info
+    if info is None:
+        return None
+    return (info.f_group.tobytes(), info.f_offset.tobytes(),
+            info.f_identity.tobytes())
+
+
+def _cached_grower(meta_dev: FeatureMeta, cfg, max_num_bin: int, ds: BinnedDataset,
+                   bundle_map=None):
+    key = (cfg, max_num_bin, ds.bins.shape, _bundle_key(ds),
            tuple((m.num_bin, m.missing_type, m.default_bin, m.is_trivial, m.bin_type)
                  for m in ds.bin_mappers),
            ds.monotone_constraints.tobytes(), ds.feature_penalty.tobytes())
     grower = _GROWER_CACHE.get(key)
     if grower is None:
-        grower = make_tree_grower(meta_dev, cfg, max_num_bin)
+        grower = make_tree_grower(meta_dev, cfg, max_num_bin,
+                                  bundle_map=bundle_map)
         _GROWER_CACHE[key] = grower
     return grower
 
@@ -64,15 +76,18 @@ _PGROWER_CACHE: Dict = {}
 
 
 def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
-                    ds: BinnedDataset, cols: PayloadCols, payload_width: int):
+                    ds: BinnedDataset, cols: PayloadCols, payload_width: int,
+                    bundle_map=None):
     key = (cfg, max_num_bin, ds.bins.shape, cols, payload_width,
+           _bundle_key(ds),
            tuple((m.num_bin, m.missing_type, m.default_bin, m.is_trivial, m.bin_type)
                  for m in ds.bin_mappers),
            ds.monotone_constraints.tobytes(), ds.feature_penalty.tobytes())
     grower = _PGROWER_CACHE.get(key)
     if grower is None:
-        grower = make_partitioned_grower(meta_dev, cfg, max_num_bin, cols,
-                                         ds.num_features)
+        grower = make_partitioned_grower(
+            meta_dev, cfg, max_num_bin, cols, ds.num_features,
+            bundle_map=bundle_map, num_columns=ds.bins.shape[0])
         _PGROWER_CACHE[key] = grower
     return grower
 
@@ -91,22 +106,22 @@ class _FastState:
 
     def __init__(self, gbdt: "GBDT"):
         ds = gbdt.train_set
-        F = ds.num_features
+        G = ds.bins.shape[0]   # storage columns (EFB bundles, G <= F)
         K = gbdt.num_tree_per_iteration
         n_pad = ds.num_data_padded
-        self.F, self.K, self.n_pad = F, K, n_pad
-        self.label_col = F
-        self.weight_col = F + 1
-        self.cnt_col = F + 2
-        self.idx_col = F + 3
-        self.score0 = F + 4
+        self.G, self.K, self.n_pad = G, K, n_pad
+        self.label_col = G
+        self.weight_col = G + 1
+        self.cnt_col = G + 2
+        self.idx_col = G + 3
+        self.score0 = G + 4
         # multiclass trains K trees per iteration, all from the SAME
         # pre-iteration scores (gbdt.cpp Boosting computes every class's
         # gradients before any tree), but each tree reorders the rows — so
         # the pre-iteration scores are snapshotted into columns that ride
         # the partition, and each class's gradients are recomputed from the
         # snapshot in whatever order the rows currently sit
-        self.snap0 = F + 4 + K if K > 1 else self.score0
+        self.snap0 = G + 4 + K if K > 1 else self.score0
         self.grad_col = self.snap0 + (K if K > 1 else 1)
         self.hess_col = self.grad_col + 1
         self.value_col = self.grad_col + 2
@@ -119,9 +134,9 @@ class _FastState:
         @jax.jit
         def build(bins, label, weight, vmask, score):
             pay = jnp.zeros((n_pad + seg.CHUNK, P), jnp.float32)
-            pay = pay.at[:n_pad, :F].set(bins.T.astype(jnp.float32))
-            pay = pay.at[:n_pad, F].set(label)
-            pay = pay.at[:n_pad, F + 1].set(weight)
+            pay = pay.at[:n_pad, :G].set(bins.T.astype(jnp.float32))
+            pay = pay.at[:n_pad, G].set(label)
+            pay = pay.at[:n_pad, G + 1].set(weight)
             pay = pay.at[:n_pad, self.cnt_col].set(vmask)
             pay = pay.at[:n_pad, idx_col].set(
                 jnp.arange(n_pad, dtype=jnp.float32))
@@ -131,7 +146,9 @@ class _FastState:
         self._build = build
         self.reset(gbdt)
         self.grower = _cached_pgrower(gbdt.meta_dev, gbdt.grower_cfg,
-                                      ds.max_num_bin, ds, self.cols, self.P)
+                                      ds.max_num_bin, ds, self.cols, self.P,
+                                      bundle_map=gbdt.bundle_map
+                                      if ds.bundle_info is not None else None)
 
         obj = gbdt.objective
         snap0, cnt_col = self.snap0, self.cnt_col
@@ -146,8 +163,8 @@ class _FastState:
                            static_argnames=("k",))
         def fill_class(payload, k):
             snap = payload[:n_pad, snap0:snap0 + K].T
-            g, h = obj.get_gradients_multi(snap, payload[:n_pad, F],
-                                           payload[:n_pad, F + 1])
+            g, h = obj.get_gradients_multi(snap, payload[:n_pad, G],
+                                           payload[:n_pad, G + 1])
             valid = payload[:n_pad, cnt_col]
             payload = payload.at[:n_pad, grad_col].set(g[k] * valid)
             return payload.at[:n_pad, hess_col].set(h[k] * valid)
@@ -210,7 +227,7 @@ def _update_score_k(score, leaf_id, leaf_out, k):
 
 @functools.partial(jax.jit, static_argnames=("depth_iters", "k"))
 def _traverse_update(bins_v, score_kv, leaf_out, tree_dev, meta: FeatureMeta,
-                     depth_iters: int, k: int):
+                     bmap: BundleMap, depth_iters: int, k: int):
     """Add one tree's (shrunk) outputs to row k of a [K, M] score matrix by
     vectorized bin-level traversal (Tree::DecisionInner semantics,
     tree.h:234-249 / 288-295)."""
@@ -226,7 +243,9 @@ def _traverse_update(bins_v, score_kv, leaf_out, tree_dev, meta: FeatureMeta,
         is_leaf = nd < 0
         ndc = jnp.maximum(nd, 0)
         f = sf[ndc]
-        fbin = bins_v[f, rows].astype(jnp.int32)
+        raw = bins_v[bmap.f_group[f], rows].astype(jnp.int32)
+        fbin = decode_bin(raw, bmap.f_identity[f], bmap.f_offset[f],
+                          meta.num_bin[f], meta.default_bin[f])
         mt = meta.missing_type[f]
         is_missing = ((mt == 2) & (fbin == meta.num_bin[f] - 1)) | \
                      ((mt == 1) & (fbin == meta.default_bin[f]))
@@ -293,6 +312,18 @@ class GBDT:
                 Log.info("Using %s-parallel tree learner over %d devices",
                          tl, ndev)
 
+        # EFB bundle decode map (identity when the dataset is unbundled)
+        if train_set.bundle_info is not None:
+            self.bundle_map = bundle_map_from_info(train_set.bundle_info)
+            if self.parallel_mode is not None:
+                Log.warning("EFB-bundled dataset: parallel tree learners "
+                            "are not supported with bundling; training "
+                            "with the serial learner")
+                self.parallel_mode = None
+                self.mesh = None
+        else:
+            self.bundle_map = identity_bundle_map(train_set.num_features)
+
         # -- device state ----------------------------------------------------
         if self.parallel_mode == "feature":
             # uploaded padded + feature-sharded in _setup_parallel_learner;
@@ -328,9 +359,13 @@ class GBDT:
             max_cat_to_onehot=int(config.max_cat_to_onehot),
             min_data_per_group=int(config.min_data_per_group),
             hist_impl=str(getattr(config, "tpu_histogram_impl", "auto")
-                          or "auto"))
+                          or "auto"),
+            hist_pool_slots=self._hist_pool_slots(config, train_set))
         self.grower = _cached_grower(self.meta_dev, self.grower_cfg,
-                                     train_set.max_num_bin, train_set)
+                                     train_set.max_num_bin, train_set,
+                                     bundle_map=self.bundle_map
+                                     if train_set.bundle_info is not None
+                                     else None)
         # partition-ordered fast path (built lazily on first eligible iter;
         # the state object survives sync-backs so re-entry never retraces)
         self._fast: Optional[_FastState] = None
@@ -376,6 +411,28 @@ class GBDT:
             for idx, tree in enumerate(self.model.trees):
                 tree.set_bin_thresholds(train_set.bin_mappers)
                 self._add_tree_to_train_score(tree, idx % K, 1.0)
+
+    @staticmethod
+    def _hist_pool_slots(config, train_set: BinnedDataset) -> int:
+        """histogram_pool_size (MB, reference HistogramPool semantics) ->
+        pool slot count for the partitioned grower.  -1 keeps one slot per
+        leaf unless that alone would exceed a 4 GB HBM budget, in which
+        case the pool auto-caps with a warning."""
+        L = int(config.num_leaves)
+        slot_bytes = (train_set.bins.shape[0] * train_set.max_num_bin
+                      * 3 * 4)
+        pool_mb = float(getattr(config, "histogram_pool_size", -1.0) or -1.0)
+        if pool_mb > 0:
+            return max(2, min(L, int(pool_mb * 1024 * 1024 // max(slot_bytes, 1))))
+        budget = 4 << 30
+        if L * slot_bytes > budget:
+            slots = max(2, int(budget // max(slot_bytes, 1)))
+            Log.warning(
+                "histogram memory for %d leaves would be %.1f GB; capping "
+                "the histogram pool at %d slots (set histogram_pool_size "
+                "to control this)", L, L * slot_bytes / 2**30, slots)
+            return slots
+        return 0
 
     def _setup_parallel_learner(self) -> None:
         """Build the shard_map'd grower and place training state on the mesh.
@@ -456,7 +513,7 @@ class GBDT:
                 continue
             tree_dev, leaf_out = self._tree_to_device(tree)
             score_v = _traverse_update(bins_v, score_v, leaf_out, tree_dev,
-                                       self.meta_dev, self._depth_iters(tree),
+                                       self.meta_dev, self.bundle_map, self._depth_iters(tree),
                                        idx % K)
         for m in metrics:
             m.init(valid.metadata.label, valid.metadata.weight,
@@ -511,7 +568,7 @@ class GBDT:
                 depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
                 for vs in self.valid_sets:
                     vs[3] = _traverse_update(vs[2], vs[3], leaf_out, tree_dev,
-                                             self.meta_dev, depth_iters, k)
+                                             self.meta_dev, self.bundle_map, depth_iters, k)
             self.model.trees.append(tree)
         self.iter += 1
         if not should_continue:
@@ -551,7 +608,7 @@ class GBDT:
                 depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
                 for vs in self.valid_sets:
                     vs[3] = _traverse_update(vs[2], vs[3], leaf_out, tree_dev,
-                                             self.meta_dev, depth_iters, k)
+                                             self.meta_dev, self.bundle_map, depth_iters, k)
             self.model.trees.append(tree)
         self.iter += 1
         if not should_continue:
@@ -573,10 +630,10 @@ class GBDT:
             tree_dev, neg_out = self._tree_to_device(tree, negate=True)
             depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
             self.score = _traverse_update(self.bins_dev, self.score, neg_out,
-                                          tree_dev, self.meta_dev, depth_iters, k)
+                                          tree_dev, self.meta_dev, self.bundle_map, depth_iters, k)
             for vs in self.valid_sets:
                 vs[3] = _traverse_update(vs[2], vs[3], neg_out, tree_dev,
-                                         self.meta_dev, depth_iters, k)
+                                         self.meta_dev, self.bundle_map, depth_iters, k)
         self.iter -= 1
 
     def _depth_iters(self, tree: Tree) -> int:
@@ -593,7 +650,7 @@ class GBDT:
         tree_dev, leaf_out = self._tree_to_device(tree)
         self.score = _traverse_update(self.bins_dev, self.score,
                                       leaf_out * jnp.float32(scale), tree_dev,
-                                      self.meta_dev, self._depth_iters(tree), k)
+                                      self.meta_dev, self.bundle_map, self._depth_iters(tree), k)
 
     def _add_tree_to_valid_scores(self, tree: Tree, k: int, scale: float) -> None:
         if tree.num_leaves <= 1:
@@ -605,7 +662,7 @@ class GBDT:
         leaf_out = leaf_out * jnp.float32(scale)
         for vs in self.valid_sets:
             vs[3] = _traverse_update(vs[2], vs[3], leaf_out, tree_dev,
-                                     self.meta_dev, depth_iters, k)
+                                     self.meta_dev, self.bundle_map, depth_iters, k)
 
     def _multiply_scores(self, k: int, factor: float) -> None:
         """ScoreUpdater::MultiplyScore on plane k, train + valid (rf.hpp)."""

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.bundle import decode_bin, expand_histogram
 from ..ops.histogram import build_histogram
 from ..ops.split import (FeatureMeta, K_MIN_SCORE, MISSING_NAN, MISSING_ZERO,
                          SplitResult, find_best_split, leaf_output,
@@ -52,12 +53,17 @@ class GrowerConfig(NamedTuple):
     # segment-engine implementation for the partitioned grower
     # (Config.tpu_histogram_impl): "auto" | "pallas" | "lax"
     hist_impl: str = "auto"
+    # histogram pool slots for the partitioned grower (reference
+    # HistogramPool, feature_histogram.hpp:655-826, histogram_pool_size
+    # param): 0 = one slot per leaf (unbounded); otherwise LRU-evicted
+    # cache with recompute-on-miss over the leaf's row segment
+    hist_pool_slots: int = 0
 
 
 def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
                      axis_name: str = None, jit: bool = True,
                      mode: str = "data", num_machines: int = 1,
-                     top_k: int = 20):
+                     top_k: int = 20, bundle_map=None):
     """Returns grow(bins[F,N], vals[N,3], feature_mask[F]) -> tree arrays dict,
     jit-compiled once per (shape, config).
 
@@ -87,6 +93,16 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
     feature_mode = axis_name is not None and mode == "feature"
     voting_mode = axis_name is not None and mode == "voting"
     data_mode = axis_name is not None and mode == "data"
+    bundled = bundle_map is not None
+    assert not (bundled and axis_name is not None), \
+        "EFB-bundled datasets train with the serial learner"
+
+    def hist_view(h):
+        """[G, B, 3] bundle histogram -> [F, B, 3] split view (EFB)."""
+        if not bundled:
+            return h
+        return expand_histogram(h, bundle_map, meta.num_bin,
+                                meta.default_bin, B)
 
     find_kwargs = dict(
         l1=cfg.lambda_l1, l2=cfg.lambda_l2, max_delta_step=cfg.max_delta_step,
@@ -229,7 +245,7 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
 
         else:
             def find_split(hist, sg, sh, cnt, fmask):
-                return find(hist, sg, sh, cnt, fmask)
+                return find(hist_view(hist), sg, sh, cnt, fmask)
 
         totals = jnp.sum(vals, axis=0)
         if axis_name and not feature_mode:
@@ -309,6 +325,11 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
                 owner = (f // F) == my
                 f_loc = jnp.clip(f - f_offset, 0, F - 1)
                 fbin = bins[f_loc].astype(jnp.int32)
+            elif bundled:
+                raw = bins[bundle_map.f_group[f]]
+                fbin = decode_bin(raw, bundle_map.f_identity[f],
+                                  bundle_map.f_offset[f], meta.num_bin[f],
+                                  meta.default_bin[f])
             else:
                 fbin = bins[f].astype(jnp.int32)
             mt = meta.missing_type[f]

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.bundle import BundleMap, expand_histogram, identity_bundle_map
 from ..ops.split import (FeatureMeta, K_MIN_SCORE, SplitResult,
                          find_best_split, leaf_output)
 from ..ops import segment as seg
@@ -47,17 +48,27 @@ class PayloadCols(NamedTuple):
 
 def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                             num_bins_max: int, cols: PayloadCols,
-                            num_features: int, jit: bool = True):
+                            num_features: int, jit: bool = True,
+                            bundle_map: BundleMap = None,
+                            num_columns: int = None):
     """Returns grow(payload, aux, feature_mask) ->
     (tree arrays dict, payload, aux).
 
     payload/aux: [N_pad + CHUNK, P] f32 with a CHUNK-row guard tail whose
     count-mask is 0.  Valid rows are [0, N_pad); the root segment covers all
     of them regardless of the ordering left behind by previous trees.
+
+    With EFB (bundle_map set), the payload holds num_columns < num_features
+    bundled bin columns; histograms are built bundled (state stays [L, G,
+    B, 3] — the memory win) and expanded to per-feature views only for
+    split finding.
     """
     L = cfg.num_leaves
     B = num_bins_max
     F = num_features
+    G = num_columns if num_columns is not None else F
+    bundled = bundle_map is not None
+    bmap = bundle_map if bundled else identity_bundle_map(F)
 
     find_kwargs = dict(
         l1=cfg.lambda_l1, l2=cfg.lambda_l2, max_delta_step=cfg.max_delta_step,
@@ -72,9 +83,9 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
     out_fn = functools.partial(leaf_output, l1=cfg.lambda_l1, l2=cfg.lambda_l2,
                                max_delta_step=cfg.max_delta_step)
 
-    hist_kwargs = dict(num_features=F, num_bins=B, grad_col=cols.grad,
+    hist_kwargs = dict(num_features=G, num_bins=B, grad_col=cols.grad,
                        hess_col=cols.hess, cnt_col=cols.cnt)
-    impl = seg.resolve_impl(cfg.hist_impl, F, B)
+    impl = seg.resolve_impl(cfg.hist_impl, G, B)
     if impl == "pallas":
         from ..ops import pallas_segment as pseg
         hist_fn = functools.partial(pseg.segment_histogram, **hist_kwargs)
@@ -89,16 +100,32 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             return seg.partition_segment(payload, aux, start, count, pred,
                                          lv, rv, cols.value)
 
+    def hist_view(hist_g):
+        """[G, B, 3] bundle histogram -> [F, B, 3] per-feature split view."""
+        if not bundled:
+            return hist_g
+        return expand_histogram(hist_g, bmap, meta.num_bin, meta.default_bin,
+                                B)
+
+    # histogram pool (reference HistogramPool, feature_histogram.hpp:655-826):
+    # POOL < L caches per-leaf histograms with LRU eviction; a split whose
+    # parent was evicted recomputes it by walking the (still contiguous)
+    # parent segment — cheap under the O(rows-touched) engine
+    POOL = cfg.hist_pool_slots if 0 < cfg.hist_pool_slots < L else L
+    pooled = POOL < L
+    assert POOL >= 2, "histogram pool needs at least 2 slots"
+
     def grow(payload: jax.Array, aux: jax.Array,
              feature_mask: jax.Array):
         n_rows = jnp.int32(payload.shape[0] - seg.CHUNK)
 
         hist_root = hist_fn(payload, jnp.int32(0), n_rows)
-        # every row lands in exactly one bin of feature 0, so the root totals
-        # fall out of the histogram — no separate full-data pass
+        # every row lands in exactly one bin of storage column 0, so the
+        # root totals fall out of the histogram — no separate full-data pass
         totals = jnp.sum(hist_root[0], axis=0)
         root_g, root_h, root_c = totals[0], totals[1], totals[2]
-        res0 = find(hist_root, root_g, root_h, root_c, feature_mask)
+        res0 = find(hist_view(hist_root), root_g, root_h, root_c,
+                    feature_mask)
 
         # rows start as one root segment with the root Newton step as the
         # per-row output (covers the unsplittable-stump case)
@@ -109,7 +136,8 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         state = {
             "payload": payload,
             "aux": aux,
-            "hist": jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(hist_root),
+            "hist": jnp.zeros((POOL, G, B, 3),
+                              jnp.float32).at[0].set(hist_root),
             "seg_start": jnp.zeros(L, jnp.int32),
             "seg_cnt": jnp.zeros(L, jnp.int32).at[0].set(n_rows),
             "sum_g": jnp.zeros(L, jnp.float32).at[0].set(root_g),
@@ -145,6 +173,10 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             "num_leaves": jnp.int32(1),
             "done": jnp.bool_(False),
         }
+        if pooled:
+            state["slot_of_leaf"] = jnp.full(L, -1, jnp.int32).at[0].set(0)
+            state["leaf_of_slot"] = jnp.full(POOL, -1, jnp.int32).at[0].set(0)
+            state["slot_use"] = jnp.zeros(POOL, jnp.int32)
 
         def do_split(s, st, best_leaf):
             """Partition the split leaf and evaluate its children; runs only
@@ -152,17 +184,31 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             node = s - 1
             f = st["bfeat"][best_leaf]
             pred = SplitPredicate(
-                feature=f,
+                col=bmap.f_group[f],
                 threshold=st["bbin"][best_leaf],
                 default_left=st["bdleft"][best_leaf],
                 is_cat=st["bcat"][best_leaf],
                 bitset=st["bbitset"][best_leaf],
                 missing_type=meta.missing_type[f],
                 num_bin=meta.num_bin[f],
-                default_bin=meta.default_bin[f])
+                default_bin=meta.default_bin[f],
+                offset=bmap.f_offset[f],
+                identity=bmap.f_identity[f])
 
             start = st["seg_start"][best_leaf]
             count = st["seg_cnt"][best_leaf]
+
+            # parent histogram: read the pool slot, or rebuild it from the
+            # (still contiguous) parent segment if it was evicted
+            if pooled:
+                pslot = st["slot_of_leaf"][best_leaf]
+                hist_parent = lax.cond(
+                    pslot >= 0,
+                    lambda: st["hist"][jnp.maximum(pslot, 0)],
+                    lambda: hist_fn(st["payload"], start, count))
+            else:
+                hist_parent = st["hist"][best_leaf]
+
             payload, aux, nl_raw = part_fn(
                 st["payload"], st["aux"], start, count, pred,
                 st["blo"][best_leaf], st["bro"][best_leaf])
@@ -183,17 +229,47 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             h_start = jnp.where(left_smaller, start, start + nl_raw)
             h_count = jnp.where(left_smaller, nl_raw, nr_raw)
             hist_small = hist_fn(payload, h_start, h_count)
-            hist_parent = st["hist"][best_leaf]
             hist_big = hist_parent - hist_small
             new_left = jnp.where(left_smaller, hist_small, hist_big)
             new_right = jnp.where(left_smaller, hist_big, hist_small)
-            hist = st["hist"]
-            hist = hist.at[best_leaf].set(new_left)
-            hist = hist.at[s].set(new_right)
+            if pooled:
+                slot_of_leaf = st["slot_of_leaf"]
+                leaf_of_slot = st["leaf_of_slot"]
+                use = st["slot_use"]
+                iota_pool = jnp.arange(POOL, dtype=jnp.int32)
+
+                def evict(slot_of_leaf, leaf_of_slot, victim):
+                    old = leaf_of_slot[victim]
+                    oldc = jnp.maximum(old, 0)
+                    slot_of_leaf = slot_of_leaf.at[oldc].set(
+                        jnp.where(old >= 0, -1, slot_of_leaf[oldc]))
+                    return slot_of_leaf
+
+                # left child: reuse the parent's slot, else evict the LRU
+                victim_l = jnp.argmin(use).astype(jnp.int32)
+                lslot = jnp.where(pslot >= 0, pslot, victim_l)
+                slot_of_leaf = jnp.where(
+                    pslot >= 0, slot_of_leaf,
+                    evict(slot_of_leaf, leaf_of_slot, victim_l))
+                leaf_of_slot = leaf_of_slot.at[lslot].set(best_leaf)
+                use = use.at[lslot].set(s)
+                # right child: evict the LRU among the remaining slots
+                prio = jnp.where(iota_pool == lslot, jnp.int32(1 << 30), use)
+                rslot = jnp.argmin(prio).astype(jnp.int32)
+                slot_of_leaf = evict(slot_of_leaf, leaf_of_slot, rslot)
+                leaf_of_slot = leaf_of_slot.at[rslot].set(s)
+                use = use.at[rslot].set(s)
+                slot_of_leaf = slot_of_leaf.at[best_leaf].set(lslot)
+                slot_of_leaf = slot_of_leaf.at[s].set(rslot)
+                hist = st["hist"].at[lslot].set(new_left)
+                hist = hist.at[rslot].set(new_right)
+            else:
+                hist = st["hist"].at[best_leaf].set(new_left)
+                hist = hist.at[s].set(new_right)
 
             child_depth = st["leaf_depth"][best_leaf] + 1
-            res_l = find(new_left, lg, lh, lcnt, feature_mask)
-            res_r = find(new_right, rg, rh, rcnt, feature_mask)
+            res_l = find(hist_view(new_left), lg, lh, lcnt, feature_mask)
+            res_r = find(hist_view(new_right), rg, rh, rcnt, feature_mask)
             if cfg.max_depth > 0:
                 depth_ok = child_depth < cfg.max_depth
             else:
@@ -208,6 +284,10 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             st_new["payload"] = payload
             st_new["aux"] = aux
             st_new["hist"] = hist
+            if pooled:
+                st_new["slot_of_leaf"] = slot_of_leaf
+                st_new["leaf_of_slot"] = leaf_of_slot
+                st_new["slot_use"] = use
             st_new["seg_start"] = set2(st["seg_start"], start, start + nl_raw)
             st_new["seg_cnt"] = set2(st["seg_cnt"], nl_raw, nr_raw)
             st_new["sum_g"] = set2(st["sum_g"], lg, rg)

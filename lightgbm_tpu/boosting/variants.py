@@ -239,7 +239,8 @@ class RF(GBDT):
                 depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
                 for vs in self.valid_sets:
                     vs[3] = _traverse_update(vs[2], vs[3], leaf_out, tree_dev,
-                                             self.meta_dev, depth_iters, k)
+                                             self.meta_dev, self.bundle_map,
+                                             depth_iters, k)
                 self._multiply_scores(k, 1.0 / (m + 1.0))
             else:
                 # reference appends a fresh zero stump when no split is found

@@ -20,6 +20,7 @@ import numpy as np
 from ..utils.log import Log
 from ..utils.random import Random
 from .binning import (BIN_TYPE_CATEGORICAL, BIN_TYPE_NUMERICAL, BinMapper)
+from .bundling import BundleInfo, bundle_features
 
 
 def _round_up(n: int, m: int) -> int:
@@ -74,7 +75,8 @@ class BinnedDataset:
         self.num_data = 0
         self.num_total_features = 0
         self.bin_mappers: List[BinMapper] = []
-        self.bins: Optional[np.ndarray] = None  # [F, N_pad] uint8/uint16
+        self.bins: Optional[np.ndarray] = None  # [G, N_pad] uint8/uint16
+        self.bundle_info: Optional[BundleInfo] = None  # EFB grouping (G<F)
         self.num_data_padded = 0
         self.max_num_bin = 0
         self.metadata: Optional[Metadata] = None
@@ -87,9 +89,11 @@ class BinnedDataset:
     def from_matrix(cls, X: np.ndarray, config, *, bin_mappers: Optional[List[BinMapper]] = None,
                     feature_names: Optional[Sequence[str]] = None,
                     categorical_feature: Sequence[int] = (),
-                    row_chunk: int = 16384) -> "BinnedDataset":
+                    row_chunk: int = 16384,
+                    reference_bundle: Optional[BundleInfo] = None) -> "BinnedDataset":
         """Bin a raw [N, F] float matrix.  When bin_mappers is given (validation
-        sets), reuse the training mappers (reference Dataset::CreateValid)."""
+        sets), reuse the training mappers (reference Dataset::CreateValid) and
+        the training bundling (reference_bundle)."""
         X = np.asarray(X)
         if X.ndim != 2:
             Log.fatal("Data should be 2 dimensional")
@@ -112,6 +116,42 @@ class BinnedDataset:
             if mapper.is_trivial:
                 continue
             bins[j, :n] = mapper.values_to_bins(X[:, j].astype(np.float64))
+
+        # Exclusive Feature Bundling (reference dataset.cpp:66-210): pack
+        # mutually-exclusive sparse features into shared storage columns.
+        # Validation sets reuse the training layout; parallel tree learners
+        # keep unbundled storage (their feature sharding predates bundles).
+        num_bins_arr = [m.num_bin for m in bin_mappers]
+        default_bins_arr = [m.default_bin for m in bin_mappers]
+        if reference_bundle is not None:
+            from .bundling import apply_bundles
+            ds.bundle_info = reference_bundle
+            bins = apply_bundles(bins, reference_bundle, num_bins_arr,
+                                 default_bins_arr)
+        elif (bool(getattr(config, "enable_bundle", True))
+              and str(getattr(config, "tree_learner", "serial")) == "serial"
+              and f >= 2):
+            # features mostly at their zero bin are bundling candidates;
+            # denser ones isolate themselves anyway via the conflict budget
+            # but would make conflict counting quadratic-expensive
+            bundleable = [
+                (not m.is_trivial) and m.sparse_rate >= 0.5
+                and m.num_bin >= 2 for m in bin_mappers]
+            if sum(bundleable) >= 2:
+                out = bundle_features(
+                    bins, num_bins_arr, default_bins_arr, bundleable, n,
+                    max_conflict_rate=float(
+                        getattr(config, "max_conflict_rate", 0.0) or 0.0),
+                    max_bundle_bins=max(ds.max_num_bin, 255),
+                    sample_cnt=int(getattr(config,
+                                           "bin_construct_sample_cnt",
+                                           200000)),
+                    seed=int(getattr(config, "data_random_seed", 1)))
+                if out is not None:
+                    bins, ds.bundle_info = out
+        if ds.bundle_info is not None:
+            ds.max_num_bin = max(ds.max_num_bin,
+                                 ds.bundle_info.max_group_bin)
         ds.bins = bins
         ds.num_data_padded = n_pad
         ds.metadata = Metadata(n)
@@ -122,6 +162,103 @@ class BinnedDataset:
         pen = getattr(config, "feature_contri", None) or []
         ds.feature_penalty = np.ones(f, dtype=np.float32)
         ds.feature_penalty[: len(pen)] = np.asarray(pen, dtype=np.float32)[:f]
+        return ds
+
+
+    # -- binary dataset cache (reference save_binary / DatasetLoader::
+    #    LoadFromBinFile, src/io/dataset_loader.cpp:267+) -------------------
+    BINARY_MAGIC = "lightgbm_tpu.dataset.v1"
+
+    def save_binary(self, path: str) -> None:
+        """Serialize the fully-constructed dataset (bins, mappers, bundles,
+        metadata) so later runs skip parsing + find-bin + bundling."""
+        import io as _io
+        import json as _json
+        header = {
+            "magic": self.BINARY_MAGIC,
+            "num_data": self.num_data,
+            "num_total_features": self.num_total_features,
+            "num_data_padded": self.num_data_padded,
+            "max_num_bin": self.max_num_bin,
+            "feature_names": self.feature_names,
+        }
+        arrays = {"bins": self.bins,
+                  "monotone": self.monotone_constraints,
+                  "penalty": self.feature_penalty}
+        for i, m in enumerate(self.bin_mappers):
+            ma = m.to_arrays()
+            header.setdefault("mappers", []).append(
+                {k: v for k, v in ma.items()
+                 if not isinstance(v, np.ndarray)})
+            arrays["mapper%d_upper" % i] = ma["bin_upper_bound"]
+            arrays["mapper%d_cats" % i] = ma["bin_2_categorical"]
+        if self.bundle_info is not None:
+            bi = self.bundle_info
+            header["bundle_groups"] = [list(map(int, g)) for g in bi.groups]
+            arrays["bundle_f_group"] = bi.f_group
+            arrays["bundle_f_offset"] = bi.f_offset
+            arrays["bundle_f_identity"] = bi.f_identity
+            arrays["bundle_group_num_bin"] = bi.group_num_bin
+        md = self.metadata
+        if md is not None:
+            for name in ("label", "weight", "init_score", "query_boundaries"):
+                v = getattr(md, name)
+                if v is not None:
+                    arrays["md_" + name] = v
+        arrays["header"] = np.frombuffer(
+            _json.dumps(header).encode(), dtype=np.uint8)
+        with open(path, "wb") as fh:
+            np.savez_compressed(fh, **arrays)
+        Log.info("Saved binary dataset cache to %s", path)
+
+    @staticmethod
+    def is_binary_file(path: str) -> bool:
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                if "header" not in z.files:
+                    return False
+                import json as _json
+                header = _json.loads(bytes(z["header"].tobytes()).decode())
+                return header.get("magic") == BinnedDataset.BINARY_MAGIC
+        except Exception:
+            return False
+
+    @classmethod
+    def load_binary(cls, path: str) -> "BinnedDataset":
+        import json as _json
+        from .bundling import BundleInfo
+        with np.load(path, allow_pickle=False) as z:
+            header = _json.loads(bytes(z["header"].tobytes()).decode())
+            if header.get("magic") != cls.BINARY_MAGIC:
+                Log.fatal("%s is not a lightgbm_tpu binary dataset", path)
+            ds = cls()
+            ds.num_data = int(header["num_data"])
+            ds.num_total_features = int(header["num_total_features"])
+            ds.num_data_padded = int(header["num_data_padded"])
+            ds.max_num_bin = int(header["max_num_bin"])
+            ds.feature_names = list(header["feature_names"])
+            ds.bins = z["bins"]
+            ds.monotone_constraints = z["monotone"]
+            ds.feature_penalty = z["penalty"]
+            for i, mh in enumerate(header["mappers"]):
+                d = dict(mh)
+                d["bin_upper_bound"] = z["mapper%d_upper" % i]
+                d["bin_2_categorical"] = z["mapper%d_cats" % i]
+                ds.bin_mappers.append(BinMapper.from_arrays(d))
+            if "bundle_groups" in header:
+                ds.bundle_info = BundleInfo(
+                    groups=[list(g) for g in header["bundle_groups"]],
+                    f_group=z["bundle_f_group"],
+                    f_offset=z["bundle_f_offset"],
+                    f_identity=z["bundle_f_identity"],
+                    group_num_bin=z["bundle_group_num_bin"],
+                    max_group_bin=int(z["bundle_group_num_bin"].max()))
+            ds.metadata = Metadata(ds.num_data)
+            for name in ("label", "weight", "init_score", "query_boundaries"):
+                if "md_" + name in z.files:
+                    setattr(ds.metadata, name, z["md_" + name])
+        Log.info("Loaded binary dataset cache from %s (%d rows, %d features)",
+                 path, ds.num_data, ds.num_total_features)
         return ds
 
     @staticmethod
@@ -137,14 +274,28 @@ class BinnedDataset:
         min_data_in_bin = int(getattr(config, "min_data_in_bin", 3))
         use_missing = bool(getattr(config, "use_missing", True))
         zero_as_missing = bool(getattr(config, "zero_as_missing", False))
-        for j in range(f):
+        def find_one(j: int) -> BinMapper:
             m = BinMapper()
             values = X[sample_idx, j].astype(np.float64)
             bin_type = BIN_TYPE_CATEGORICAL if j in cat else BIN_TYPE_NUMERICAL
             m.find_bin(values, len(sample_idx), max_bin,
                        min_data_in_bin=min_data_in_bin, bin_type=bin_type,
                        use_missing=use_missing, zero_as_missing=zero_as_missing)
-            mappers.append(m)
+            return m
+
+        # feature-sharded find-bin (reference ParallelFindBin /
+        # is_parallel_find_bin, src/io/dataset_loader.cpp:842-924: each rank
+        # bins a feature slice and the mappers are allgathered; here the
+        # shards are host worker threads, and the "allgather" is the shared
+        # result list — one process owns all device shards)
+        if bool(getattr(config, "is_parallel_find_bin", True)) and f > 8:
+            import concurrent.futures as cf
+            import os as _os
+            workers = min(16, _os.cpu_count() or 1)
+            with cf.ThreadPoolExecutor(workers) as pool:
+                mappers = list(pool.map(find_one, range(f)))
+        else:
+            mappers = [find_one(j) for j in range(f)]
         num_trivial = sum(1 for m in mappers if m.is_trivial)
         if num_trivial:
             Log.info("%d features are ignored (constant value)", num_trivial)

@@ -1,0 +1,78 @@
+"""Device-side Exclusive Feature Bundling support.
+
+The storage/bin matrix holds G bundled columns; the split layer sees F
+original features.  Two primitives bridge them (reference counterpart:
+FeatureGroup bin offsets + FeatureHistogram views into the group
+histogram, include/LightGBM/feature_group.h:18):
+
+- `expand_histogram`: [G, Bg, 3] bundle histogram -> [F, B, 3] per-feature
+  views by static gathers; a feature's default (zero) bin takes the bundle
+  remainder (rows where any OTHER member was non-default are rows where
+  this member sat at its default).
+- `decode_bin`: bundled storage value -> the original feature's bin, used
+  by every routing site (partition predicates, traversal).
+
+A dataset without bundling uses the identity map (f_group=arange,
+identity=True) so every consumer runs one uniform code path.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BundleMap(NamedTuple):
+    f_group: jax.Array     # [F] i32 storage column of each feature
+    f_offset: jax.Array    # [F] i32 bin offset inside the bundle
+    f_identity: jax.Array  # [F] bool raw-bin passthrough
+
+
+def identity_bundle_map(num_features: int) -> BundleMap:
+    return BundleMap(
+        f_group=jnp.arange(num_features, dtype=jnp.int32),
+        f_offset=jnp.zeros(num_features, jnp.int32),
+        f_identity=jnp.ones(num_features, bool))
+
+
+def bundle_map_from_info(info) -> BundleMap:
+    return BundleMap(f_group=jnp.asarray(info.f_group, jnp.int32),
+                     f_offset=jnp.asarray(info.f_offset, jnp.int32),
+                     f_identity=jnp.asarray(info.f_identity))
+
+
+def decode_bin(value, identity, offset, num_bin, default_bin):
+    """Original bin of one feature given its bundle's storage value.
+
+    enc = offset + b - (b > d) for b != d; anything outside the feature's
+    range means "this member at its default bin"."""
+    v = value.astype(jnp.int32)
+    e = v - offset
+    in_range = (e >= 0) & (e < num_bin - 1)
+    b = e + (e >= default_bin)
+    return jnp.where(identity, v, jnp.where(in_range, b, default_bin))
+
+
+def expand_histogram(hist_g: jax.Array, bmap: BundleMap, num_bin,
+                     default_bin, num_bins_feature: int) -> jax.Array:
+    """[G, Bg, 3] -> [F, B, 3] per-feature histogram views.
+
+    num_bin/default_bin: [F] i32 (FeatureMeta columns)."""
+    Bg = hist_g.shape[1]
+    B = num_bins_feature
+    b = jnp.arange(B, dtype=jnp.int32)[None, :]              # [1, B]
+    d = default_bin[:, None]
+    ident = bmap.f_identity[:, None]
+    src = jnp.where(ident, b, bmap.f_offset[:, None] + b - (b > d))
+    src = jnp.clip(src, 0, Bg - 1)
+    out = hist_g[bmap.f_group[:, None], src]                 # [F, B, 3]
+    valid = (b < num_bin[:, None])[:, :, None]
+    out = jnp.where(valid, out, 0.0)
+    # non-identity default bin = bundle total minus this member's own mass
+    totals = jnp.sum(hist_g, axis=1)[bmap.f_group]           # [F, 3]
+    own = jnp.sum(jnp.where((b == d)[:, :, None], 0.0, out), axis=1)
+    fixed = (totals - own)[:, None, :]
+    at_default = (b == d)[:, :, None] & ~ident[:, :, None]
+    return jnp.where(at_default, fixed, out)

@@ -11,8 +11,10 @@ on the MXU, chunked with `lax.scan` to bound the transient one-hot.  TPU has no
 fast random scatter-add; the one-hot contraction is the idiomatic mapping (the
 compare-and-broadcast producer fuses into the dot on TPU).
 
-A Pallas kernel specialization lives in pallas_histogram.py (selected via
-Config.tpu_histogram_impl) for the largest shapes.
+This masked full-data formulation backs the legacy grower and the parallel
+tree learners; the partitioned grower (boosting/grower2.py) replaces it with
+O(rows-touched) segment kernels (ops/segment.py, ops/pallas_segment.py,
+selected via Config.tpu_histogram_impl).
 """
 from __future__ import annotations
 

@@ -124,13 +124,15 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
     reads and writes the same HBM buffers through the `_out` refs."""
     start = scalars[0]
     count = scalars[1]
-    feature = scalars[2]
+    col = scalars[2]
     threshold = scalars[3]
     default_left = scalars[4]
     is_cat = scalars[5]
     missing_type = scalars[6]
     num_bin = scalars[7]
     default_bin = scalars[8]
+    offset = scalars[9]
+    identity = scalars[10]
     left_value = fvals[0]
     right_value = fvals[1]
     nch = (count + CHUNK - 1) // CHUNK
@@ -145,10 +147,15 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
         return buf[:]
 
     def go_left(data, k):
-        # select the split feature's bin column by lane reduction (dynamic
-        # lane indexing is not a Mosaic primitive; the masked sum is)
-        fbin = jnp.sum(jnp.where(iota_p == feature, data, 0.0),
-                       axis=1).astype(jnp.int32)                 # [C]
+        # select the split feature's storage column by lane reduction
+        # (dynamic lane indexing is not a Mosaic primitive; the masked sum
+        # is), then decode the EFB bundle value to the feature's own bin
+        raw = jnp.sum(jnp.where(iota_p == col, data, 0.0),
+                      axis=1).astype(jnp.int32)                  # [C]
+        e = raw - offset
+        in_range = (e >= 0) & (e < num_bin - 1)
+        decoded = jnp.where(in_range, e + (e >= default_bin), default_bin)
+        fbin = jnp.where(identity > 0, raw, decoded)
         miss = ((missing_type == MISSING_NAN) & (fbin == num_bin - 1)) | \
                ((missing_type == MISSING_ZERO) & (fbin == default_bin))
         gl_num = jnp.where(miss, default_left > 0, fbin <= threshold)
@@ -215,9 +222,10 @@ def partition_segment(payload, aux, start, count, pred, left_value,
     P = payload.shape[1]
     B = num_bins
     scalars = jnp.stack([
-        start, count, pred.feature, pred.threshold,
+        start, count, pred.col, pred.threshold,
         pred.default_left.astype(jnp.int32), pred.is_cat.astype(jnp.int32),
         pred.missing_type, pred.num_bin, pred.default_bin,
+        pred.offset, pred.identity.astype(jnp.int32),
     ]).astype(jnp.int32)
     fvals = jnp.stack([left_value, right_value]).astype(jnp.float32)
     bitset = pred.bitset.astype(jnp.int32).reshape(1, B)

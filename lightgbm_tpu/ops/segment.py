@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .bundle import decode_bin
 from .split import MISSING_NAN, MISSING_ZERO
 
 # rows per chunk: small enough that the joint one-hot [C, F*B] and the
@@ -61,8 +62,10 @@ def resolve_impl(impl: str, num_features: int, num_bins: int) -> str:
 
 class SplitPredicate(NamedTuple):
     """Scalars describing one split's routing decision
-    (Bin::Split semantics, src/io/dense_bin.hpp:190-283)."""
-    feature: jax.Array       # i32 column index into the bin columns
+    (Bin::Split semantics, src/io/dense_bin.hpp:190-283).  `col` is the
+    STORAGE column (the feature's EFB bundle); offset/identity decode the
+    stored value back to the feature's own bin."""
+    col: jax.Array           # i32 storage-column index into the bin columns
     threshold: jax.Array     # i32 bin threshold (numerical)
     default_left: jax.Array  # bool — where missing rows go
     is_cat: jax.Array        # bool — categorical bitset split
@@ -70,13 +73,16 @@ class SplitPredicate(NamedTuple):
     missing_type: jax.Array  # i32 (of the split feature)
     num_bin: jax.Array       # i32
     default_bin: jax.Array   # i32
+    offset: jax.Array        # i32 bin offset inside the bundle
+    identity: jax.Array      # bool — raw-bin passthrough (no bundle)
 
 
 def go_left_chunk(chunk: jax.Array, pred: SplitPredicate) -> jax.Array:
-    """[C] bool routing for one payload chunk (bin cols at [:, :F])."""
+    """[C] bool routing for one payload chunk (bin cols at [:, :G])."""
     C = chunk.shape[0]
-    fcol = lax.dynamic_slice(chunk, (0, pred.feature), (C, 1))[:, 0]
-    fbin = fcol.astype(jnp.int32)
+    fcol = lax.dynamic_slice(chunk, (0, pred.col), (C, 1))[:, 0]
+    fbin = decode_bin(fcol, pred.identity, pred.offset, pred.num_bin,
+                      pred.default_bin)
     miss = ((pred.missing_type == MISSING_NAN) & (fbin == pred.num_bin - 1)) | \
            ((pred.missing_type == MISSING_ZERO) & (fbin == pred.default_bin))
     gl_num = jnp.where(miss, pred.default_left, fbin <= pred.threshold)
@@ -178,6 +184,9 @@ def segment_histogram(payload: jax.Array, start: jax.Array, count: jax.Array,
     P = payload.shape[1]
     nch = (count + C - 1) // C
     iota_b = jnp.arange(B, dtype=jnp.int32)
+    # CPU test meshes scatter quickly but choke on one-hot contractions;
+    # TPU is the inverse (and normally runs the Pallas kernels anyway)
+    use_scatter = jax.default_backend() != "tpu"
 
     def body(carry):
         k, hist = carry
@@ -185,13 +194,20 @@ def segment_histogram(payload: jax.Array, start: jax.Array, count: jax.Array,
         ok = (jnp.arange(C, dtype=jnp.int32) < (count - k * C)).astype(
             payload.dtype)
         binsf = chunk[:, :F].astype(jnp.int32)                 # [C, F]
-        onehot = (binsf[:, :, None] == iota_b[None, None, :]).astype(
-            payload.dtype)                                     # [C, F, B]
         vals = jnp.stack([chunk[:, grad_col] * ok,
                           chunk[:, hess_col] * ok,
                           chunk[:, cnt_col] * ok], axis=1)     # [C, 3]
-        hist = hist + jnp.einsum("cfb,cd->fbd", onehot, vals,
-                                 preferred_element_type=jnp.float32)
+        if use_scatter:
+            jidx = (binsf + iota_b[0] +
+                    jnp.arange(F, dtype=jnp.int32)[None, :] * B)  # [C, F]
+            upd = jnp.broadcast_to(vals[:, None, :], (C, F, 3)).reshape(-1, 3)
+            hist = hist.reshape(F * B, 3).at[jidx.reshape(-1)].add(
+                upd).reshape(F, B, 3)
+        else:
+            onehot = (binsf[:, :, None] == iota_b[None, None, :]).astype(
+                payload.dtype)                                 # [C, F, B]
+            hist = hist + jnp.einsum("cfb,cd->fbd", onehot, vals,
+                                     preferred_element_type=jnp.float32)
         return k + 1, hist
 
     hist0 = jnp.zeros((F, B, 3), jnp.float32)

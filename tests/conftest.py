@@ -121,3 +121,36 @@ def multiclass_data():
     X_test, y_test = load_svmlight_style(
         os.path.join(REFERENCE_DIR, "examples/multiclass_classification/multiclass.test"))
     return X_train, y_train, X_test, y_test
+
+
+# model-file fields that must match EXACTLY (tree structure + routing);
+# float statistics may differ in the last ulps because distributed psum
+# accumulates shard partials in a different order than the serial scan
+_EXACT = ("split_feature=", "threshold=", "decision_type=", "left_child=",
+          "right_child=", "leaf_count=", "internal_count=", "num_leaves=",
+          "num_cat=", "cat_threshold=", "cat_boundaries=", "shrinkage=")
+_CLOSE = ("leaf_value=", "internal_value=", "split_gain=", "leaf_weight=",
+          "internal_weight=")
+
+def assert_models_equivalent(a: str, b: str, rtol=1e-4, atol=1e-6):
+    la, lb = a.splitlines(), b.splitlines()
+    assert len(la) == len(lb)
+    for xa, xb in zip(la, lb):
+        if xa == xb:
+            continue
+        key = xa.split("=")[0] + "="
+        if key == "tree_sizes=":   # byte lengths shift with value digits
+            continue
+        assert key == xb.split("=")[0] + "=", (xa, xb)
+        assert key not in _EXACT, "structural mismatch: %s vs %s" % (xa, xb)
+        assert key in _CLOSE, "unexpected diff line: %s vs %s" % (xa, xb)
+        va = np.asarray([float(v) for v in xa.split("=")[1].split()])
+        vb = np.asarray([float(v) for v in xb.split("=")[1].split()])
+        if key == "split_gain=":
+            # gains are differences of large sums: f32 cancellation makes
+            # them the noisiest field when accumulation order differs
+            np.testing.assert_allclose(va, vb, rtol=max(rtol, 5e-3),
+                                       atol=max(atol, 1e-3))
+        else:
+            np.testing.assert_allclose(va, vb, rtol=rtol, atol=atol)
+

@@ -1,0 +1,135 @@
+"""Binary dataset cache (reference save_binary / LoadFromBinFile,
+src/io/dataset_loader.cpp:267+) and feature-sharded find-bin."""
+import os
+import numpy as np
+
+import lightgbm_tpu as lgb
+from conftest import assert_models_equivalent
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+PARAMS = {"objective": "binary", "metric": "auc", "num_leaves": 15,
+          "max_bin": 63, "min_data_in_leaf": 20, "verbose": -1}
+
+
+def _data(n=2000, f=12, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+def test_binary_roundtrip_trains_identically(tmp_path):
+    X, y = _data()
+    direct = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
+                       num_boost_round=6)
+
+    path = str(tmp_path / "train.bin")
+    lgb.Dataset(X, label=y).construct(Config(dict(PARAMS))).save_binary(path)
+    assert BinnedDataset.is_binary_file(path)
+    cached = lgb.train(dict(PARAMS), lgb.Dataset(path), num_boost_round=6)
+    assert cached.model_to_string() == direct.model_to_string()
+
+
+def test_binary_preserves_bundles(tmp_path):
+    rng = np.random.default_rng(1)
+    n = 3000
+    X = np.zeros((n, 12))
+    which = rng.integers(0, 6, size=n)
+    X[np.arange(n), which] = rng.integers(1, 6, size=n)
+    X[:, 6:] = rng.standard_normal((n, 6)) * (rng.random((n, 6)) < 0.2)
+    y = (which % 2 == 0).astype(np.float32)
+
+    ds = BinnedDataset.from_matrix(X, Config(dict(PARAMS)))
+    assert ds.bundle_info is not None
+    path = str(tmp_path / "b.bin")
+    ds.save_binary(path)
+    ds2 = BinnedDataset.load_binary(path)
+    assert ds2.bundle_info is not None
+    assert ds2.bundle_info.groups == ds.bundle_info.groups
+    np.testing.assert_array_equal(ds2.bins, ds.bins)
+    assert ds2.max_num_bin == ds.max_num_bin
+
+
+def test_is_binary_file_rejects_text(tmp_path):
+    p = str(tmp_path / "t.txt")
+    with open(p, "w") as fh:
+        fh.write("1 2 3\n")
+    assert not BinnedDataset.is_binary_file(p)
+
+
+def test_cli_save_binary_and_reload(tmp_path):
+    """CLI task=train with save_binary=true writes <data>.bin; a second train
+    pointed at the .bin file reproduces the model."""
+    from lightgbm_tpu.application import Application
+    X, y = _data(seed=2)
+    data = str(tmp_path / "train.tsv")
+    np.savetxt(data, np.column_stack([y, X]), delimiter="\t")
+
+    common = ["task=train", "objective=binary", "num_leaves=7",
+              "num_trees=4", "min_data_in_leaf=20", "verbose=-1"]
+    m1 = str(tmp_path / "m1.txt")
+    Application(common + ["data=" + data, "save_binary=true",
+                          "output_model=" + m1]).run()
+    assert os.path.exists(data + ".bin")
+    m2 = str(tmp_path / "m2.txt")
+    Application(common + ["data=" + data + ".bin",
+                          "output_model=" + m2]).run()
+    def model_body(path):  # strip the echoed-parameters section (CLI args differ)
+        text = open(path).read()
+        return text.split("\nparameters:")[0]
+    assert model_body(m1) == model_body(m2)
+
+
+def test_parallel_find_bin_deterministic():
+    """Thread-sharded find-bin must produce the same mappers as serial."""
+    X, y = _data(n=1500, f=24, seed=3)
+    a = BinnedDataset.from_matrix(X, Config(dict(PARAMS)))
+    b = BinnedDataset.from_matrix(
+        X, Config({**PARAMS, "is_parallel_find_bin": False}))
+    for ma, mb in zip(a.bin_mappers, b.bin_mappers):
+        assert ma.num_bin == mb.num_bin
+        np.testing.assert_array_equal(ma.bin_upper_bound, mb.bin_upper_bound)
+
+
+def test_cli_binary_train_with_valid_files(tmp_path):
+    """Regression: task=train data=<bin> valid=<text> must work (the
+    valid loader takes the feature count from the constructed train set)."""
+    from lightgbm_tpu.application import Application
+    X, y = _data(seed=5)
+    data = str(tmp_path / "t.tsv")
+    np.savetxt(data, np.column_stack([y, X]), delimiter="\t")
+    vX, vy = _data(n=500, seed=6)
+    vdata = str(tmp_path / "v.tsv")
+    np.savetxt(vdata, np.column_stack([vy, vX]), delimiter="\t")
+
+    common = ["task=train", "objective=binary", "num_leaves=7",
+              "num_trees=3", "min_data_in_leaf=20", "verbose=-1"]
+    Application(common + ["data=" + data, "is_save_binary=true",
+                          "output_model=" + str(tmp_path / "m0.txt")]).run()
+    assert os.path.exists(data + ".bin")  # alias form must be honored
+    Application(common + ["data=" + data + ".bin", "valid=" + vdata,
+                          "output_model=" + str(tmp_path / "m1.txt")]).run()
+    assert os.path.exists(str(tmp_path / "m1.txt"))
+
+
+def test_path_valid_set_aligns_to_reference(tmp_path):
+    """Regression: a validation Dataset given as a file path must reuse the
+    training mappers (Dataset::CreateValid), not re-bin independently."""
+    X, y = _data(seed=7)
+    vX, vy = _data(n=600, seed=8)
+    vpath = str(tmp_path / "v.tsv")
+    np.savetxt(vpath, np.column_stack([vy, vX]), delimiter="\t")
+
+    ds = lgb.Dataset(X, label=y)
+    evals = {}
+    bst = lgb.train(dict(PARAMS), ds, num_boost_round=5,
+                    valid_sets=[lgb.Dataset(vpath, reference=ds)],
+                    valid_names=["v"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    ref = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y), num_boost_round=5,
+                    valid_sets=[lgb.Dataset(vX, label=vy,
+                                            reference=lgb.Dataset(X, label=y))])
+    # same mappers -> same predictions on the valid rows
+    np.testing.assert_allclose(bst.predict(vX), ref.predict(vX), rtol=1e-6)
+    assert "auc" in evals["v"]

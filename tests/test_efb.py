@@ -1,0 +1,99 @@
+"""Exclusive Feature Bundling (reference src/io/dataset.cpp:66-210).
+
+Mutually-exclusive sparse features share storage columns; the split layer
+still sees original features.  With zero conflicts the transformation is
+lossless, so bundled training must reproduce unbundled training."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from conftest import assert_models_equivalent
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+
+def _sparse_problem(n=4000, blocks=6, per_block=6, seed=0):
+    """Features come in blocks; within a block exactly one feature is
+    non-zero per row — perfectly exclusive (zero conflicts)."""
+    rng = np.random.default_rng(seed)
+    F = blocks * per_block
+    X = np.zeros((n, F))
+    logit = np.zeros(n)
+    for b in range(blocks):
+        which = rng.integers(0, per_block, size=n)
+        # low-cardinality values — the shape EFB targets (one-hot-ish)
+        vals = rng.integers(1, 8, size=n).astype(np.float64)
+        X[np.arange(n), b * per_block + which] = vals
+        logit += 0.3 * (which - per_block / 2) + 0.2 * vals * (which == 0)
+    y = (logit + rng.standard_normal(n) * 0.5 > 0).astype(np.float32)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "metric": "binary_logloss",
+          "num_leaves": 15, "learning_rate": 0.1, "min_data_in_leaf": 20,
+          "max_bin": 63, "verbose": -1}
+
+
+def test_bundles_shrink_storage():
+    X, y = _sparse_problem()
+    ds = BinnedDataset.from_matrix(X, Config(dict(PARAMS)))
+    assert ds.bundle_info is not None
+    G = ds.bins.shape[0]
+    assert G < ds.num_features / 2, (G, ds.num_features)
+    # every feature appears in exactly one bundle
+    members = sorted(f for g in ds.bundle_info.groups for f in g)
+    assert members == list(range(ds.num_features))
+
+
+def test_bundled_training_matches_unbundled():
+    X, y = _sparse_problem()
+    bundled = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
+                        num_boost_round=10)
+    plain = lgb.train({**PARAMS, "enable_bundle": False},
+                      lgb.Dataset(X, label=y), num_boost_round=10)
+    assert_models_equivalent(bundled.model_to_string(),
+                             plain.model_to_string())
+    np.testing.assert_allclose(bundled.predict(X), plain.predict(X),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_bundled_valid_and_early_stopping():
+    X, y = _sparse_problem(seed=3)
+    Xv, yv = _sparse_problem(n=1500, seed=4)
+    ds = lgb.Dataset(X, label=y)
+    evals = {}
+    bst = lgb.train(dict(PARAMS), ds, num_boost_round=25,
+                    valid_sets=[lgb.Dataset(Xv, label=yv, reference=ds)],
+                    valid_names=["v"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    ll = evals["v"]["binary_logloss"]
+    assert ll[-1] < ll[0]
+    assert np.isfinite(bst.predict(Xv)).all()
+
+
+def test_conflicting_features_stay_separate():
+    """With max_conflict_rate=0 co-occurring features must not bundle."""
+    rng = np.random.default_rng(0)
+    n =2000
+    a = np.where(rng.random(n) < 0.1, rng.standard_normal(n), 0.0)
+    b = np.where(a != 0, rng.standard_normal(n), 0.0)  # fires WITH a
+    X = np.stack([a, b], axis=1)
+    ds = BinnedDataset.from_matrix(X, Config(dict(PARAMS)))
+    if ds.bundle_info is not None:
+        assert all(len(g) == 1 for g in ds.bundle_info.groups)
+
+
+def test_dense_data_not_bundled(binary_data):
+    X, y, _, _ = binary_data
+    ds = BinnedDataset.from_matrix(X, Config(dict(PARAMS)))
+    assert ds.bundle_info is None
+
+
+def test_bundled_with_bagging_variants():
+    """The legacy grower path (bagging) must decode bundles too."""
+    X, y = _sparse_problem(seed=9)
+    p = {**PARAMS, "bagging_fraction": 0.7, "bagging_freq": 1, "seed": 5}
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=8)
+    plain = lgb.train({**p, "enable_bundle": False},
+                      lgb.Dataset(X, label=y), num_boost_round=8)
+    assert_models_equivalent(bst.model_to_string(), plain.model_to_string())

@@ -182,3 +182,21 @@ def test_masked_counts_match_bagging():
                         fmask)
     out2 = jax.device_get(tree2)
     _assert_same_tree(out1, out2)
+
+
+def test_histogram_pool_recompute_matches():
+    """A small LRU histogram pool (histogram_pool_size) must reproduce the
+    unbounded grower: evicted parents are rebuilt from their still-contiguous
+    row segments (reference HistogramPool recompute-on-miss)."""
+    import lightgbm_tpu as lgb
+    from conftest import assert_models_equivalent
+    X, y = _make_problem(n=4000, f=8, seed=13)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "num_leaves": 31, "max_bin": 63, "min_data_in_leaf": 20,
+              "verbose": -1}
+    full = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=8)
+    # ~4 slots: 63 bins * 8 features * 3 * 4B per slot
+    tiny = lgb.train({**params, "histogram_pool_size": 0.025},
+                     lgb.Dataset(X, label=y), num_boost_round=8)
+    assert_models_equivalent(tiny.model_to_string(), full.model_to_string())

@@ -41,14 +41,16 @@ def test_histogram_matches(start, count):
 
 
 def _pred(feature=1, threshold=B // 2, default_left=False, is_cat=False,
-          bitset=None, missing_type=0, num_bin=B, default_bin=0):
+          bitset=None, missing_type=0, num_bin=B, default_bin=0,
+          offset=0, identity=True):
     return SplitPredicate(
-        feature=jnp.int32(feature), threshold=jnp.int32(threshold),
+        col=jnp.int32(feature), threshold=jnp.int32(threshold),
         default_left=jnp.bool_(default_left), is_cat=jnp.bool_(is_cat),
         bitset=jnp.asarray(bitset if bitset is not None else
                            np.zeros(B, bool)),
         missing_type=jnp.int32(missing_type), num_bin=jnp.int32(num_bin),
-        default_bin=jnp.int32(default_bin))
+        default_bin=jnp.int32(default_bin), offset=jnp.int32(offset),
+        identity=jnp.bool_(identity))
 
 
 @pytest.mark.parametrize("start,count,predkw", [
@@ -58,6 +60,9 @@ def _pred(feature=1, threshold=B // 2, default_left=False, is_cat=False,
     (0, 600, dict(is_cat=True,
                   bitset=(np.arange(B) % 3 == 0))),
     (513, 256, dict(feature=0, threshold=0)),
+    # EFB bundle decode: storage col 2 holds an offset-encoded member
+    (64, 500, dict(feature=2, threshold=3, offset=5, identity=False,
+                   num_bin=9, default_bin=0)),
 ])
 def test_partition_matches(start, count, predkw):
     pay = _payload(1024, seed=start + count)

@@ -11,6 +11,7 @@ import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
+from conftest import assert_models_equivalent
 
 
 def _train(params, X, y, Xv=None, yv=None, rounds=12, callbacks=None):
@@ -26,33 +27,6 @@ def _train(params, X, y, Xv=None, yv=None, rounds=12, callbacks=None):
 BASE = {"objective": "binary", "metric": "auc", "num_leaves": 15,
         "learning_rate": 0.1, "min_data_in_leaf": 20, "verbose": -1,
         "seed": 7}
-
-# model-file fields that must match EXACTLY (tree structure + routing);
-# float statistics may differ in the last ulps because distributed psum
-# accumulates shard partials in a different order than the serial scan
-_EXACT = ("split_feature=", "threshold=", "decision_type=", "left_child=",
-          "right_child=", "leaf_count=", "internal_count=", "num_leaves=",
-          "num_cat=", "cat_threshold=", "cat_boundaries=", "shrinkage=")
-_CLOSE = ("leaf_value=", "internal_value=", "split_gain=", "leaf_weight=",
-          "internal_weight=")
-
-
-def assert_models_equivalent(a: str, b: str, rtol=1e-4, atol=1e-6):
-    la, lb = a.splitlines(), b.splitlines()
-    assert len(la) == len(lb)
-    for xa, xb in zip(la, lb):
-        if xa == xb:
-            continue
-        key = xa.split("=")[0] + "="
-        if key == "tree_sizes=":   # byte lengths shift with value digits
-            continue
-        assert key == xb.split("=")[0] + "=", (xa, xb)
-        assert key not in _EXACT, "structural mismatch: %s vs %s" % (xa, xb)
-        assert key in _CLOSE, "unexpected diff line: %s vs %s" % (xa, xb)
-        va = np.asarray([float(v) for v in xa.split("=")[1].split()])
-        vb = np.asarray([float(v) for v in xb.split("=")[1].split()])
-        np.testing.assert_allclose(va, vb, rtol=rtol, atol=atol)
-
 
 @pytest.mark.parametrize("mode", ["data", "feature"])
 def test_parallel_learner_matches_serial(binary_data, mode):
