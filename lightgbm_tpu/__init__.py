@@ -7,6 +7,8 @@ from .callback import (early_stopping, log_evaluation, print_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
 from .engine import cv, train
+from .plotting import (create_tree_digraph, plot_importance, plot_metric,
+                       plot_tree)
 from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 from .utils.log import LightGBMError
 
@@ -15,4 +17,6 @@ __version__ = "0.1.0"
 __all__ = ["Dataset", "Booster", "Config", "train", "cv", "LightGBMError",
            "early_stopping", "log_evaluation", "print_evaluation",
            "record_evaluation", "reset_parameter",
-           "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"]
+           "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+           "plot_importance", "plot_metric", "plot_tree",
+           "create_tree_digraph"]

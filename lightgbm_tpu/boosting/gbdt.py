@@ -126,6 +126,11 @@ class _FastState:
         self.hess_col = self.grad_col + 1
         self.value_col = self.grad_col + 2
         self.P = self.value_col + 1
+        if jax.default_backend() == "tpu":
+            # Mosaic DMA slices must span whole 128-lane tiles; a [N, P]
+            # f32 array is physically padded to 128 lanes on TPU anyway,
+            # so declaring the pad costs no extra HBM
+            self.P = -(-self.P // 128) * 128
         self.cols = PayloadCols(grad=self.grad_col, hess=self.hess_col,
                                 cnt=self.cnt_col, value=self.value_col)
 

@@ -60,6 +60,21 @@ def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
     out_ref[:] = jnp.zeros(out_ref.shape, out_ref.dtype)
     iota_rows = _row_iota()
 
+    # one-hot machinery, built once before the chunk loop.  E[f, j] = 1 iff
+    # column j lies in feature f's B-wide window; expanding the [C, F] bin
+    # values through E on the MXU broadcasts each feature's bin across its
+    # window, and a single [C, F*B] compare against the within-window offset
+    # finishes the one-hot — Mosaic supports neither 3D reshape/broadcast
+    # nor cheap per-feature lane writes, and this keeps VPU work at O(F*B)
+    # per row instead of the O(F^2*B) of per-feature full-width compares.
+    iota_fr = lax.broadcasted_iota(jnp.int32, (F, F * B), 0)
+    iota_fc = lax.broadcasted_iota(jnp.int32, (F, F * B), 1)
+    d = iota_fc - iota_fr * B
+    in_win = (d >= 0) & (d < B)
+    E = in_win.astype(jnp.float32)                               # [F, F*B]
+    jmod = jnp.sum(jnp.where(in_win, d, 0), axis=0)              # [F*B] i32
+    jmod_f = jmod.astype(jnp.float32)
+
     def body(k, _):
         dma = pltpu.make_async_copy(
             payload_hbm.at[pl.ds(start + k * CHUNK, CHUNK), :], chunk, sem)
@@ -67,16 +82,23 @@ def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
         dma.wait()
         data = chunk[:]
         ok = (iota_rows < (count - k * CHUNK)).astype(jnp.float32)
-        binsf = data[:, :F].astype(jnp.int32)                    # [C, F]
-        jidx = binsf + lax.broadcasted_iota(jnp.int32, (CHUNK, F), 1) * B
-        iota_fb = lax.broadcasted_iota(jnp.int32, (CHUNK, F * B), 1)
-        onehot = (jidx[:, :, None] == iota_fb.reshape(CHUNK, F, B)
-                  ).astype(jnp.float32).reshape(CHUNK, F * B)
-        zero = jnp.zeros_like(ok)
-        vals = jnp.stack(
-            [data[:, grad_col] * ok, data[:, hess_col] * ok,
-             data[:, cnt_col] * ok, zero, zero, zero, zero, zero],
-            axis=0)                                              # [8, C]
+        binsf = data[:, :F]                                      # [C, F] f32
+        expand = lax.dot_general(
+            binsf, E, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # [C, F*B]
+        onehot = (expand == jmod_f[None, :]).astype(jnp.float32)
+        # rows 0..2 of vals = (grad, hess, cnt) columns of data, selected by
+        # a static 0/1 matrix — Mosaic can't stack 1-D slices into [8, C]
+        P = data.shape[1]
+        iota_r8 = lax.broadcasted_iota(jnp.int32, (8, P), 0)
+        iota_pc = lax.broadcasted_iota(jnp.int32, (8, P), 1)
+        sel = (((iota_r8 == 0) & (iota_pc == grad_col)) |
+               ((iota_r8 == 1) & (iota_pc == hess_col)) |
+               ((iota_r8 == 2) & (iota_pc == cnt_col))).astype(jnp.float32)
+        vals = lax.dot_general(
+            sel, data, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # [8, C]
+        vals = vals * ok[None, :]
         out_ref[:] += lax.dot_general(
             vals, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                  # [8, F*B]
@@ -146,30 +168,46 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
         dma.wait()
         return buf[:]
 
+    def valid_mask(k):
+        return (iota_rows < (count - k * CHUNK)).astype(jnp.int32)
+
     def go_left(data, k):
         # select the split feature's storage column by lane reduction
         # (dynamic lane indexing is not a Mosaic primitive; the masked sum
-        # is), then decode the EFB bundle value to the feature's own bin
+        # is), then decode the EFB bundle value to the feature's own bin.
+        # All predicate logic is i32 arithmetic — Mosaic cannot re-truncate
+        # materialized bool vectors back to i1 for select_n.
         raw = jnp.sum(jnp.where(iota_p == col, data, 0.0),
                       axis=1).astype(jnp.int32)                  # [C]
         e = raw - offset
-        in_range = (e >= 0) & (e < num_bin - 1)
-        decoded = jnp.where(in_range, e + (e >= default_bin), default_bin)
-        fbin = jnp.where(identity > 0, raw, decoded)
-        miss = ((missing_type == MISSING_NAN) & (fbin == num_bin - 1)) | \
-               ((missing_type == MISSING_ZERO) & (fbin == default_bin))
-        gl_num = jnp.where(miss, default_left > 0, fbin <= threshold)
+        in_range = ((e >= 0) & (e < num_bin - 1)).astype(jnp.int32)
+        bump = (e >= default_bin).astype(jnp.int32)
+        decoded = in_range * (e + bump) + (1 - in_range) * default_bin
+        fbin = identity * raw + (1 - identity) * decoded
+        miss = (((missing_type == MISSING_NAN) &
+                 (fbin == num_bin - 1)).astype(jnp.int32) |
+                ((missing_type == MISSING_ZERO) &
+                 (fbin == default_bin)).astype(jnp.int32))
+        gl_num = (miss * default_left +
+                  (1 - miss) * (fbin <= threshold).astype(jnp.int32))
         iota_b = lax.broadcasted_iota(jnp.int32, (CHUNK, B), 1)
-        hits = (fbin[:, None] == iota_b) & (bitset_ref[:] > 0)
-        gl_cat = jnp.sum(hits.astype(jnp.int32), axis=1) > 0
-        gl = jnp.where(is_cat > 0, gl_cat, gl_num)
-        return gl & (iota_rows < (count - k * CHUNK))
+        hits = ((fbin[:, None] == iota_b) &
+                (bitset_ref[:] > 0)).astype(jnp.int32)
+        gl_cat = (jnp.sum(hits, axis=1) > 0).astype(jnp.int32)
+        gl = is_cat * gl_cat + (1 - is_cat) * gl_num
+        return gl * valid_mask(k)                                # [C] i32 0/1
 
-    def compact_append(k, keep, base, running):
-        keep_i = keep.astype(jnp.int32)
-        dest = jnp.cumsum(keep_i) - keep_i
+    def compact_append(k, keep_i, base, running):
+        # exclusive prefix sum as a strict-lower-triangular matvec (Mosaic
+        # has no cumsum primitive; counts <= CHUNK are exact in f32)
+        iota_i = lax.broadcasted_iota(jnp.int32, (CHUNK, CHUNK), 0)
+        iota_j = lax.broadcasted_iota(jnp.int32, (CHUNK, CHUNK), 1)
+        tri = (iota_j < iota_i).astype(jnp.float32)
+        dest = jnp.dot(tri, keep_i.astype(jnp.float32)[:, None],
+                       preferred_element_type=jnp.float32)[:, 0].astype(jnp.int32)
         iota_c = lax.broadcasted_iota(jnp.int32, (CHUNK, CHUNK), 0)
-        perm = ((dest[None, :] == iota_c) & keep[None, :]).astype(jnp.float32)
+        perm = ((dest[None, :] == iota_c) &
+                (keep_i[None, :] > 0)).astype(jnp.float32)
         compact[:] = jnp.dot(perm, chunk[:],
                              preferred_element_type=jnp.float32)
         dma = pltpu.make_async_copy(
@@ -190,8 +228,8 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
     # pass B: rights -> aux[start + num_left ..)
     def body_b(k, nr):
         data = read_chunk(payload_out, k, chunk)
-        keep = (~go_left(data, k)) & (iota_rows < (count - k * CHUNK))
-        return compact_append(k, keep, num_left, nr)
+        keep_i = valid_mask(k) - go_left(data, k)
+        return compact_append(k, keep_i, num_left, nr)
 
     lax.fori_loop(0, nch, body_b, jnp.int32(0), unroll=False)
 
@@ -200,10 +238,11 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
         src = read_chunk(aux_out, k, chunk)
         orig = read_chunk(payload_out, k, compact)
         pos = start + k * CHUNK + iota_rows
-        val = jnp.where(pos < start + num_left, left_value, right_value)
+        lf = (pos < start + num_left).astype(jnp.float32)        # [C]
+        val = lf * left_value + (1.0 - lf) * right_value
         src = jnp.where(iota_p == value_col, val[:, None], src)
-        ok = (iota_rows < (count - k * CHUNK))[:, None]
-        compact[:] = jnp.where(ok, src, orig)
+        okf = valid_mask(k).astype(jnp.float32)[:, None]
+        compact[:] = okf * src + (1.0 - okf) * orig
         dma = pltpu.make_async_copy(
             compact, payload_out.at[pl.ds(start + k * CHUNK, CHUNK), :],
             sem_out)
