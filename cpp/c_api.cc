@@ -1,0 +1,413 @@
+// Native model runtime behind lightgbm_tpu_c_api.h.
+//
+// Reimplements, in dependency-free C++17, the prediction side of the
+// reference stack: the text-model parser (gbdt_model_text.cpp
+// LoadModelFromString / Tree(const char*)), tree traversal with the
+// decision_type bit layout (tree.h:14-15 — bit0 categorical, bit1
+// default_left, bits 2-3 missing type), and the objective output
+// transforms (ConvertOutput of binary/multiclass/regression families).
+// Numerics follow the same rules as the Python predictor
+// (lightgbm_tpu/models/tree.py) so all three agree bit-for-bit.
+
+#include "lightgbm_tpu_c_api.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <fstream>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+constexpr double kZeroThreshold = 1e-35;  // reference meta.h
+
+constexpr int kCategoricalMask = 1;
+constexpr int kDefaultLeftMask = 2;
+constexpr int kMissingNone = 0;
+constexpr int kMissingZero = 1;
+constexpr int kMissingNan = 2;
+
+struct Tree {
+  int num_leaves = 1;
+  int num_cat = 0;
+  double shrinkage = 1.0;
+  std::vector<int> split_feature;
+  std::vector<double> threshold;
+  std::vector<int> decision_type;
+  std::vector<int> left_child;
+  std::vector<int> right_child;
+  std::vector<double> leaf_value;
+  std::vector<int64_t> cat_boundaries;
+  std::vector<uint32_t> cat_threshold;
+
+  bool CategoricalDecision(double fval, int node) const {
+    int mt = (decision_type[node] >> 2) & 3;
+    int cat;
+    if (std::isnan(fval)) {
+      if (mt == kMissingNan) return false;  // NaN goes right
+      cat = 0;
+    } else {
+      cat = static_cast<int>(fval);
+      if (cat < 0) return false;
+    }
+    int ci = static_cast<int>(threshold[node]);
+    int64_t lo = cat_boundaries[ci], hi = cat_boundaries[ci + 1];
+    int64_t i1 = lo + cat / 32;
+    if (i1 >= hi) return false;
+    return (cat_threshold[i1] >> (cat % 32)) & 1;
+  }
+
+  bool NumericalDecision(double fval, int node) const {
+    int dt = decision_type[node];
+    int mt = (dt >> 2) & 3;
+    bool is_nan = std::isnan(fval);
+    if (is_nan && mt != kMissingNan) fval = 0.0;
+    bool missing = (mt == kMissingZero && std::fabs(fval) <= kZeroThreshold) ||
+                   (mt == kMissingNan && is_nan);
+    if (missing) return (dt & kDefaultLeftMask) != 0;
+    return fval <= threshold[node];
+  }
+
+  // returns ~leaf_index reached by the row
+  int TraverseNode(const double* row) const {
+    if (num_leaves <= 1) return ~0;
+    int node = 0;
+    while (node >= 0) {
+      double fval = row[split_feature[node]];
+      bool left = (decision_type[node] & kCategoricalMask)
+                      ? CategoricalDecision(fval, node)
+                      : NumericalDecision(fval, node);
+      node = left ? left_child[node] : right_child[node];
+    }
+    return node;
+  }
+
+  double Predict(const double* row) const { return leaf_value[~TraverseNode(row)]; }
+  int PredictLeafIndex(const double* row) const { return ~TraverseNode(row); }
+};
+
+enum class Transform {
+  kNone,
+  kSigmoid,      // binary / multiclassova / xentropy: 1/(1+exp(-s*x))
+  kSoftmax,      // multiclass
+  kExp,          // poisson / gamma / tweedie
+  kSignSquare,   // regression with sqrt
+  kLog1pExp,     // xentlambda
+};
+
+struct Model {
+  int num_class = 1;
+  int num_tree_per_iteration = 1;
+  int max_feature_idx = 0;
+  bool average_output = false;
+  double sigmoid = 1.0;
+  Transform transform = Transform::kNone;
+  std::string objective;
+  std::vector<Tree> trees;
+  std::string text;  // original model text, for SaveModel
+
+  int NumIterations() const {
+    if (num_tree_per_iteration <= 0) return static_cast<int>(trees.size());
+    return static_cast<int>(trees.size()) / num_tree_per_iteration;
+  }
+};
+
+std::vector<std::string> SplitWs(const std::string& s) {
+  std::vector<std::string> out;
+  std::istringstream is(s);
+  std::string tok;
+  while (is >> tok) out.push_back(tok);
+  return out;
+}
+
+template <typename T>
+std::vector<T> ParseArray(const std::string& s) {
+  std::vector<T> out;
+  std::istringstream is(s);
+  double v;
+  while (is >> v) out.push_back(static_cast<T>(v));
+  return out;
+}
+
+void PickTransform(Model* m) {
+  auto toks = SplitWs(m->objective);
+  if (toks.empty()) return;
+  const std::string& kind = toks[0];
+  for (size_t i = 1; i < toks.size(); ++i) {
+    if (toks[i].rfind("sigmoid:", 0) == 0)
+      m->sigmoid = std::stod(toks[i].substr(8));
+  }
+  bool sqrt = std::find(toks.begin() + 1, toks.end(), "sqrt") != toks.end();
+  if (kind == "binary" || kind == "multiclassova" ||
+      kind == "cross_entropy" || kind == "xentropy") {
+    m->transform = Transform::kSigmoid;
+    if (kind == "cross_entropy" || kind == "xentropy") m->sigmoid = 1.0;
+  } else if (kind == "multiclass" || kind == "softmax") {
+    m->transform = Transform::kSoftmax;
+  } else if (kind == "poisson" || kind == "gamma" || kind == "tweedie") {
+    m->transform = Transform::kExp;
+  } else if (kind == "cross_entropy_lambda" || kind == "xentlambda") {
+    m->transform = Transform::kLog1pExp;
+  } else if (sqrt) {
+    m->transform = Transform::kSignSquare;
+  }
+}
+
+bool ParseModel(const std::string& text, Model* m, std::string* err) {
+  m->text = text;
+  std::istringstream is(text);
+  std::string line;
+  bool in_tree = false;
+  std::unordered_map<std::string, std::string> tree_kv;
+
+  auto finish_tree = [&]() -> bool {
+    if (!in_tree) return true;
+    Tree t;
+    auto get = [&](const char* k) -> const std::string& {
+      static const std::string empty;
+      auto it = tree_kv.find(k);
+      return it == tree_kv.end() ? empty : it->second;
+    };
+    t.num_leaves = std::max(1, atoi(get("num_leaves").c_str()));
+    t.num_cat = atoi(get("num_cat").c_str());
+    if (!get("shrinkage").empty()) t.shrinkage = std::stod(get("shrinkage"));
+    t.leaf_value = ParseArray<double>(get("leaf_value"));
+    if (t.num_leaves > 1) {
+      t.split_feature = ParseArray<int>(get("split_feature"));
+      t.threshold = ParseArray<double>(get("threshold"));
+      t.decision_type = ParseArray<int>(get("decision_type"));
+      t.left_child = ParseArray<int>(get("left_child"));
+      t.right_child = ParseArray<int>(get("right_child"));
+      size_t ni = static_cast<size_t>(t.num_leaves - 1);
+      if (t.split_feature.size() != ni || t.threshold.size() != ni ||
+          t.left_child.size() != ni || t.right_child.size() != ni ||
+          t.leaf_value.size() != static_cast<size_t>(t.num_leaves)) {
+        *err = "tree arrays disagree with num_leaves";
+        return false;
+      }
+      if (t.decision_type.empty()) t.decision_type.assign(ni, 0);
+      if (t.num_cat > 0) {
+        t.cat_boundaries = ParseArray<int64_t>(get("cat_boundaries"));
+        t.cat_threshold = ParseArray<uint32_t>(get("cat_threshold"));
+      }
+    }
+    m->trees.push_back(std::move(t));
+    in_tree = false;
+    tree_kv.clear();
+    return true;
+  };
+
+  while (std::getline(is, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    if (line == "end of trees") break;
+    if (line.rfind("Tree=", 0) == 0) {
+      if (!finish_tree()) return false;
+      in_tree = true;
+      tree_kv.clear();
+      continue;
+    }
+    auto eq = line.find('=');
+    if (eq == std::string::npos) continue;
+    std::string key = line.substr(0, eq), val = line.substr(eq + 1);
+    if (in_tree) {
+      tree_kv[key] = val;
+      continue;
+    }
+    if (key == "num_class") m->num_class = atoi(val.c_str());
+    else if (key == "num_tree_per_iteration")
+      m->num_tree_per_iteration = atoi(val.c_str());
+    else if (key == "max_feature_idx") m->max_feature_idx = atoi(val.c_str());
+    else if (key == "objective") m->objective = val;
+    else if (key == "average_output") m->average_output = true;
+  }
+  if (!finish_tree()) return false;
+  if (m->trees.empty()) {
+    *err = "no trees found in model";
+    return false;
+  }
+  if (m->num_tree_per_iteration <= 0) m->num_tree_per_iteration = 1;
+  PickTransform(m);
+  return true;
+}
+
+void ApplyTransform(const Model& m, double* row_out) {
+  int k = m.num_tree_per_iteration;
+  switch (m.transform) {
+    case Transform::kNone:
+      break;
+    case Transform::kSigmoid:
+      for (int j = 0; j < k; ++j)
+        row_out[j] = 1.0 / (1.0 + std::exp(-m.sigmoid * row_out[j]));
+      break;
+    case Transform::kSoftmax: {
+      double mx = row_out[0];
+      for (int j = 1; j < k; ++j) mx = std::max(mx, row_out[j]);
+      double sum = 0.0;
+      for (int j = 0; j < k; ++j) {
+        row_out[j] = std::exp(row_out[j] - mx);
+        sum += row_out[j];
+      }
+      for (int j = 0; j < k; ++j) row_out[j] /= sum;
+      break;
+    }
+    case Transform::kExp:
+      for (int j = 0; j < k; ++j) row_out[j] = std::exp(row_out[j]);
+      break;
+    case Transform::kSignSquare:
+      for (int j = 0; j < k; ++j) {
+        double v = row_out[j];
+        row_out[j] = (v >= 0 ? v * v : -v * v);
+      }
+      break;
+    case Transform::kLog1pExp:
+      for (int j = 0; j < k; ++j) row_out[j] = std::log1p(std::exp(row_out[j]));
+      break;
+  }
+}
+
+int Fail(const std::string& msg) {
+  g_last_error = msg;
+  return -1;
+}
+
+Model* AsModel(BoosterHandle h) { return static_cast<Model*>(h); }
+
+int LoadModel(const std::string& text, int* out_num_iterations,
+              BoosterHandle* out) {
+  auto m = std::make_unique<Model>();
+  std::string err;
+  if (!ParseModel(text, m.get(), &err)) return Fail("model parse error: " + err);
+  if (out_num_iterations) *out_num_iterations = m->NumIterations();
+  *out = m.release();
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  std::ifstream f(filename);
+  if (!f) return Fail(std::string("cannot open model file: ") + filename);
+  std::stringstream ss;
+  ss << f.rdbuf();
+  return LoadModel(ss.str(), out_num_iterations, out);
+}
+
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  if (model_str == nullptr) return Fail("model_str is null");
+  return LoadModel(model_str, out_num_iterations, out);
+}
+
+int LGBM_BoosterFree(BoosterHandle handle) {
+  delete AsModel(handle);
+  return 0;
+}
+
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len) {
+  *out_len = AsModel(handle)->num_class;
+  return 0;
+}
+
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len) {
+  *out_len = AsModel(handle)->max_feature_idx + 1;
+  return 0;
+}
+
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration) {
+  *out_iteration = AsModel(handle)->NumIterations();
+  return 0;
+}
+
+int LGBM_BoosterSaveModel(BoosterHandle handle, int num_iteration,
+                          const char* filename) {
+  int64_t len = 0;
+  Model* m = AsModel(handle);
+  (void)num_iteration;  // full stored text; truncation is a Python-side task
+  std::ofstream f(filename);
+  if (!f) return Fail(std::string("cannot open for write: ") + filename);
+  f << m->text;
+  len = static_cast<int64_t>(m->text.size());
+  return len >= 0 ? 0 : -1;
+}
+
+int LGBM_BoosterSaveModelToString(BoosterHandle handle, int num_iteration,
+                                  int64_t buffer_len, int64_t* out_len,
+                                  char* out_str) {
+  (void)num_iteration;
+  Model* m = AsModel(handle);
+  *out_len = static_cast<int64_t>(m->text.size()) + 1;
+  if (buffer_len >= *out_len && out_str != nullptr) {
+    std::memcpy(out_str, m->text.c_str(), m->text.size() + 1);
+  }
+  return 0;
+}
+
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result) {
+  (void)parameter;
+  Model* m = AsModel(handle);
+  int nfeat = m->max_feature_idx + 1;
+  if (ncol < nfeat)
+    return Fail("input has " + std::to_string(ncol) + " columns, model needs " +
+                std::to_string(nfeat));
+  int k = m->num_tree_per_iteration;
+  int iters = m->NumIterations();
+  if (num_iteration > 0 && num_iteration < iters) iters = num_iteration;
+  int used_trees = iters * k;
+
+  auto at = [&](int32_t r, int32_t c) -> double {
+    int64_t idx = is_row_major ? static_cast<int64_t>(r) * ncol + c
+                               : static_cast<int64_t>(c) * nrow + r;
+    if (data_type == C_API_DTYPE_FLOAT32)
+      return static_cast<const float*>(data)[idx];
+    return static_cast<const double*>(data)[idx];
+  };
+
+  std::vector<double> row(ncol);
+  if (predict_type == C_API_PREDICT_LEAF_INDEX) {
+    for (int32_t r = 0; r < nrow; ++r) {
+      for (int32_t c = 0; c < ncol; ++c) row[c] = at(r, c);
+      for (int t = 0; t < used_trees; ++t)
+        out_result[static_cast<int64_t>(r) * used_trees + t] =
+            m->trees[t].PredictLeafIndex(row.data());
+    }
+    *out_len = static_cast<int64_t>(nrow) * used_trees;
+    return 0;
+  }
+  if (predict_type != C_API_PREDICT_NORMAL &&
+      predict_type != C_API_PREDICT_RAW_SCORE)
+    return Fail("unsupported predict_type " + std::to_string(predict_type));
+
+  for (int32_t r = 0; r < nrow; ++r) {
+    for (int32_t c = 0; c < ncol; ++c) row[c] = at(r, c);
+    double* out_row = out_result + static_cast<int64_t>(r) * k;
+    for (int j = 0; j < k; ++j) out_row[j] = 0.0;
+    for (int t = 0; t < used_trees; ++t)
+      out_row[t % k] += m->trees[t].Predict(row.data());
+    if (m->average_output) {
+      for (int j = 0; j < k; ++j) out_row[j] /= iters;
+    } else if (predict_type == C_API_PREDICT_NORMAL) {
+      ApplyTransform(*m, out_row);
+    }
+  }
+  *out_len = static_cast<int64_t>(nrow) * k;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,75 @@
+/* C API for lightgbm-tpu's native model runtime.
+ *
+ * Deployment-side parity with the reference c_api.h (src/c_api.cpp): the
+ * functions a serving stack needs — load a text model, inspect it, predict
+ * dense matrices, save — implemented as a dependency-free C++17 shared
+ * library.  TRAINING entry points (LGBM_DatasetCreate*, LGBM_BoosterUpdate*)
+ * are deliberately absent: training in this framework is the JAX/TPU path
+ * (Python `lightgbm_tpu` package or the CLI), and a C shim around a Python
+ * interpreter would be slower and heavier than calling Python directly.
+ * Constants and signatures mirror the reference so existing C/C++ serving
+ * integrations recompile against this header unchanged.
+ */
+#ifndef LIGHTGBM_TPU_C_API_H_
+#define LIGHTGBM_TPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* BoosterHandle;
+
+#define C_API_DTYPE_FLOAT32 (0)
+#define C_API_DTYPE_FLOAT64 (1)
+
+#define C_API_PREDICT_NORMAL (0)
+#define C_API_PREDICT_RAW_SCORE (1)
+#define C_API_PREDICT_LEAF_INDEX (2)
+
+/* All functions return 0 on success, -1 on error (message via
+ * LGBM_GetLastError). */
+
+const char* LGBM_GetLastError();
+
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+
+int LGBM_BoosterFree(BoosterHandle handle);
+
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
+
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len);
+
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration);
+
+int LGBM_BoosterSaveModel(BoosterHandle handle, int num_iteration,
+                          const char* filename);
+
+int LGBM_BoosterSaveModelToString(BoosterHandle handle, int num_iteration,
+                                  int64_t buffer_len, int64_t* out_len,
+                                  char* out_str);
+
+/* Dense-matrix prediction.
+ * data: nrow*ncol values, row- or column-major; data_type selects
+ * float/double.  predict_type: normal (objective transform applied), raw
+ * score, or per-tree leaf indices.  num_iteration <= 0 means all.
+ * out_result must hold nrow*num_class doubles (nrow*num_trees for
+ * leaf_index); *out_len is set to the number written. */
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* LIGHTGBM_TPU_C_API_H_ */

@@ -1,0 +1,60 @@
+"""Microbench: per-call + per-row cost of the Pallas segment kernels on TPU."""
+import sys, os, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops import segment as seg
+from lightgbm_tpu.ops import pallas_segment as pseg
+
+print("backend:", jax.default_backend(), flush=True)
+rng = np.random.default_rng(0)
+N = 1 << 20            # 1M rows
+F, B = 28, 256
+P = 128
+GRAD, HESS, CNT, VAL = F, F + 1, F + 2, F + 3
+
+payload = np.zeros((N + seg.CHUNK, P), np.float32)
+payload[:N, :F] = rng.integers(0, B - 1, (N, F))
+payload[:N, GRAD] = rng.standard_normal(N)
+payload[:N, HESS] = rng.random(N) + 0.1
+payload[:N, CNT] = 1.0
+payload = jnp.asarray(payload)
+aux = jnp.zeros_like(payload)
+
+pred = seg.SplitPredicate(
+    col=jnp.int32(2), threshold=jnp.int32(100),
+    default_left=jnp.bool_(True), is_cat=jnp.bool_(False),
+    missing_type=jnp.int32(0), num_bin=jnp.int32(B),
+    default_bin=jnp.int32(0), offset=jnp.int32(0),
+    identity=jnp.bool_(True), bitset=jnp.zeros(B, jnp.int32))
+
+
+def timeit(fn, reps=20):
+    fn()  # warm
+    jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+for count in (1 << 12, 1 << 15, 1 << 18, 1 << 20):
+    c = jnp.int32(count)
+    t_h = timeit(lambda: pseg.segment_histogram(
+        payload, jnp.int32(0), c, num_features=F, num_bins=B,
+        grad_col=GRAD, hess_col=HESS, cnt_col=CNT))
+    t_p = timeit(lambda: pseg.partition_segment(
+        payload, aux, jnp.int32(0), c, pred, jnp.float32(1.0),
+        jnp.float32(-1.0), VAL, B)[2])
+    print("count=%8d  hist %7.3f ms (%5.2f ns/row)   part %7.3f ms (%5.2f ns/row)"
+          % (count, t_h * 1e3, t_h / count * 1e9, t_p * 1e3, t_p / count * 1e9),
+          flush=True)
+
+# dispatch floor: count=0
+t0 = timeit(lambda: pseg.segment_histogram(
+    payload, jnp.int32(0), jnp.int32(0), num_features=F, num_bins=B,
+    grad_col=GRAD, hess_col=HESS, cnt_col=CNT))
+print("hist count=0 floor: %.3f ms" % (t0 * 1e3), flush=True)

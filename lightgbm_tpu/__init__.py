@@ -6,7 +6,7 @@ from .basic import Booster, Dataset
 from .callback import (early_stopping, log_evaluation, print_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
-from .engine import cv, train
+from .engine import CVBooster, cv, train
 from .plotting import (create_tree_digraph, plot_importance, plot_metric,
                        plot_tree)
 from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
@@ -14,7 +14,8 @@ from .utils.log import LightGBMError
 
 __version__ = "0.1.0"
 
-__all__ = ["Dataset", "Booster", "Config", "train", "cv", "LightGBMError",
+__all__ = ["Dataset", "Booster", "Config", "train", "cv", "CVBooster",
+           "LightGBMError",
            "early_stopping", "log_evaluation", "print_evaluation",
            "record_evaluation", "reset_parameter",
            "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",

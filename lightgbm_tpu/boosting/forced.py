@@ -1,0 +1,77 @@
+"""Forced splits: forcedsplits_filename -> a static BFS schedule.
+
+Role of the reference's ForceSplits (serial_tree_learner.cpp:546-701): a
+JSON tree {"feature": int, "threshold": float, "left": {...}, "right":
+{...}} is imposed before gain-driven growth, breadth-first.  Redesigned for
+the jitted grower: the JSON is compiled host-side into per-rank arrays
+(feature, bin, BFS child links), and the grower carries a per-leaf pending
+rank.  Forced leaves get gain priorities far above any real gain, so the
+in-loop argmax applies them first in BFS order; an infeasible forced split
+(min_data / min_sum_hessian violated, or a categorical feature) falls back
+to the leaf's gain-driven best and — like the reference's aborted forcing
+queue — its forced descendants are dropped.
+"""
+from __future__ import annotations
+
+import json
+from typing import NamedTuple, Optional, Tuple
+
+# priority unit: forced rank j gets gain (n_forced - j) * UNIT, which
+# dominates any real gain and preserves BFS order under argmax
+PRIORITY_UNIT = 1e30
+
+
+class ForcedSchedule(NamedTuple):
+    """Hashable (all-tuple) forced-split plan, indexed by BFS rank."""
+    feat: Tuple[int, ...]    # [n] split feature per rank
+    bin: Tuple[int, ...]     # [n] threshold bin per rank
+    gain: Tuple[float, ...]  # [n] argmax priority per rank
+    lnext: Tuple[int, ...]   # [n] rank forced on the left child, -1 if none
+    rnext: Tuple[int, ...]   # [n] rank forced on the right child, -1 if none
+
+
+def load_forced_json(path: str):
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def build_forced_schedule(root_json, bin_mappers,
+                          num_leaves: int) -> Optional[ForcedSchedule]:
+    """Compile the forced-split JSON into a ForcedSchedule (BFS ranks).
+
+    Thresholds are real feature values, converted through each feature's
+    BinMapper (BinMapper::ValueToBin) exactly as the reference does when it
+    materializes a forced SplitInfo."""
+    if not root_json:
+        return None
+    feat, bins, lnext, rnext = [], [], [], []
+    queue = [(root_json, None, 0)]   # (node, parent_rank, side)
+    while queue and len(feat) < num_leaves - 1:
+        node, parent, side = queue.pop(0)
+        rank = len(feat)
+        f = int(node["feature"])
+        if not 0 <= f < len(bin_mappers):
+            raise ValueError("forced split names feature %d but the dataset "
+                             "has %d features" % (f, len(bin_mappers)))
+        mapper = bin_mappers[f]
+        b = int(mapper.value_to_bin(float(node["threshold"])))
+        # a forced threshold at/above the last bin can never send rows right
+        b = min(b, max(int(mapper.num_bin) - 2, 0))
+        feat.append(f)
+        bins.append(b)
+        lnext.append(-1)
+        rnext.append(-1)
+        if parent is not None:
+            (lnext if side == 0 else rnext)[parent] = rank
+        if node.get("left"):
+            queue.append((node["left"], rank, 0))
+        if node.get("right"):
+            queue.append((node["right"], rank, 1))
+
+    n = len(feat)
+    if n == 0:
+        return None
+    gain = [(n - j) * PRIORITY_UNIT for j in range(n)]
+    return ForcedSchedule(feat=tuple(feat), bin=tuple(bins),
+                          gain=tuple(gain), lnext=tuple(lnext),
+                          rnext=tuple(rnext))

@@ -77,9 +77,9 @@ _PGROWER_CACHE: Dict = {}
 
 def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
                     ds: BinnedDataset, cols: PayloadCols, payload_width: int,
-                    bundle_map=None):
+                    bundle_map=None, forced=None):
     key = (cfg, max_num_bin, ds.bins.shape, cols, payload_width,
-           _bundle_key(ds),
+           _bundle_key(ds), forced,
            tuple((m.num_bin, m.missing_type, m.default_bin, m.is_trivial, m.bin_type)
                  for m in ds.bin_mappers),
            ds.monotone_constraints.tobytes(), ds.feature_penalty.tobytes())
@@ -87,7 +87,8 @@ def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
     if grower is None:
         grower = make_partitioned_grower(
             meta_dev, cfg, max_num_bin, cols, ds.num_features,
-            bundle_map=bundle_map, num_columns=ds.bins.shape[0])
+            bundle_map=bundle_map, num_columns=ds.bins.shape[0],
+            forced=forced)
         _PGROWER_CACHE[key] = grower
     return grower
 
@@ -153,7 +154,8 @@ class _FastState:
         self.grower = _cached_pgrower(gbdt.meta_dev, gbdt.grower_cfg,
                                       ds.max_num_bin, ds, self.cols, self.P,
                                       bundle_map=gbdt.bundle_map
-                                      if ds.bundle_info is not None else None)
+                                      if ds.bundle_info is not None else None,
+                                      forced=gbdt.forced_schedule)
 
         obj = gbdt.objective
         snap0, cnt_col = self.snap0, self.cnt_col
@@ -316,6 +318,19 @@ class GBDT:
                 self.mesh = Mesh(np.array(devices[:ndev]), (self.mesh_axis,))
                 Log.info("Using %s-parallel tree learner over %d devices",
                          tl, ndev)
+
+        # forced splits: compile the JSON into a static BFS schedule for the
+        # partitioned grower (serial_tree_learner.cpp:546-701)
+        self.forced_schedule = None
+        fs_path = str(getattr(config, "forcedsplits_filename", "") or "")
+        if fs_path:
+            from .forced import build_forced_schedule, load_forced_json
+            self.forced_schedule = build_forced_schedule(
+                load_forced_json(fs_path), train_set.bin_mappers,
+                int(config.num_leaves))
+            if self.forced_schedule is not None:
+                Log.info("Loaded forced splits from %s (%d nodes)",
+                         fs_path, len(self.forced_schedule.feat))
 
         # EFB bundle decode map (identity when the dataset is unbundled)
         if train_set.bundle_info is not None:
@@ -585,6 +600,13 @@ class GBDT:
         if grad is None and hess is None and self._fast_eligible():
             return self._train_one_iter_fast()
         self._fast_sync_back()
+        if self.forced_schedule is not None and \
+                not getattr(self, "_warned_forced_legacy", False):
+            Log.warning("forcedsplits_filename is honored only by the "
+                        "serial fast path; this configuration (bagging / "
+                        "custom objective / parallel learner / renewal "
+                        "objective) trains WITHOUT forced splits")
+            self._warned_forced_legacy = True
         init_score = 0.0
         if grad is None or hess is None:
             init_score = self._boost_from_average()

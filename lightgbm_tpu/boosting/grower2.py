@@ -31,10 +31,18 @@ from jax import lax
 
 from ..ops.bundle import BundleMap, expand_histogram, identity_bundle_map
 from ..ops.split import (FeatureMeta, K_MIN_SCORE, SplitResult,
-                         find_best_split, leaf_output)
+                         evaluate_split_at, find_best_split, leaf_output)
 from ..ops import segment as seg
 from ..ops.segment import SplitPredicate
+from .forced import PRIORITY_UNIT, ForcedSchedule
 from .grower import GrowerConfig
+
+
+def _select_split(use, forced_res: SplitResult,
+                  normal_res: SplitResult) -> SplitResult:
+    """Field-wise where(use, forced, normal) over two SplitResults."""
+    return SplitResult(*[jnp.where(use, a, b)
+                         for a, b in zip(forced_res, normal_res)])
 
 
 class PayloadCols(NamedTuple):
@@ -50,7 +58,8 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                             num_bins_max: int, cols: PayloadCols,
                             num_features: int, jit: bool = True,
                             bundle_map: BundleMap = None,
-                            num_columns: int = None):
+                            num_columns: int = None,
+                            forced: ForcedSchedule = None):
     """Returns grow(payload, aux, feature_mask) ->
     (tree arrays dict, payload, aux).
 
@@ -115,6 +124,29 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
     pooled = POOL < L
     assert POOL >= 2, "histogram pool needs at least 2 slots"
 
+    if forced is not None:
+        fc_feat = jnp.asarray(forced.feat, jnp.int32)
+        fc_bin = jnp.asarray(forced.bin, jnp.int32)
+        fc_gain = jnp.asarray(forced.gain, jnp.float32)
+        fc_lnext = jnp.asarray(forced.lnext, jnp.int32)
+        fc_rnext = jnp.asarray(forced.rnext, jnp.int32)
+        eval_at = functools.partial(
+            evaluate_split_at, meta=meta, l1=cfg.lambda_l1, l2=cfg.lambda_l2,
+            max_delta_step=cfg.max_delta_step,
+            min_data_in_leaf=cfg.min_data_in_leaf,
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf)
+
+        def forced_override(rank, hist_fview, sg, sh, sc, normal_res):
+            """(result, real_gain, surviving_rank) for a leaf whose pending
+            forced rank is `rank` (-1 = none); infeasible -> fall back."""
+            r0 = jnp.maximum(rank, 0)
+            fres = eval_at(hist_fview, sg, sh, sc, fc_feat[r0], fc_bin[r0])
+            use = (rank >= 0) & jnp.isfinite(fres.gain)
+            real = jnp.where(use, fres.gain, normal_res.gain)
+            res = _select_split(use, fres._replace(gain=fc_gain[r0]),
+                                normal_res)
+            return res, real, jnp.where(use, rank, -1)
+
     def grow(payload: jax.Array, aux: jax.Array,
              feature_mask: jax.Array):
         n_rows = jnp.int32(payload.shape[0] - seg.CHUNK)
@@ -131,6 +163,13 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         # per-row output (covers the unsplittable-stump case)
         root_out = out_fn(root_g, root_h)
         payload = payload.at[:, cols.value].set(root_out)
+
+        real0 = res0.gain
+        root_rank = jnp.int32(-1)
+        if forced is not None:
+            res0, real0, root_rank = forced_override(
+                jnp.int32(0), hist_view(hist_root), root_g, root_h, root_c,
+                res0)
 
         ni = max(L - 1, 1)
         state = {
@@ -171,8 +210,13 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             "internal_value": jnp.zeros(ni, jnp.float32),
             "internal_count": jnp.zeros(ni, jnp.float32),
             "num_leaves": jnp.int32(1),
-            "done": jnp.bool_(False),
         }
+        if forced is not None:
+            # pending forced rank per leaf, and the REAL (not priority) gain
+            # of each leaf's stored best split, for honest split_gain records
+            state["fleaf"] = jnp.full(L, -1, jnp.int32).at[0].set(root_rank)
+            state["breal"] = jnp.full(L, K_MIN_SCORE,
+                                      jnp.float32).at[0].set(real0)
         if pooled:
             state["slot_of_leaf"] = jnp.full(L, -1, jnp.int32).at[0].set(0)
             state["leaf_of_slot"] = jnp.full(POOL, -1, jnp.int32).at[0].set(0)
@@ -270,6 +314,18 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             child_depth = st["leaf_depth"][best_leaf] + 1
             res_l = find(hist_view(new_left), lg, lh, lcnt, feature_mask)
             res_r = find(hist_view(new_right), rg, rh, rcnt, feature_mask)
+            real_l, real_r = res_l.gain, res_r.gain
+            if forced is not None:
+                jp = st["fleaf"][best_leaf]
+                applied = (jp >= 0) & \
+                    (st["bgain"][best_leaf] >= 0.5 * PRIORITY_UNIT)
+                jp0 = jnp.maximum(jp, 0)
+                jl = jnp.where(applied, fc_lnext[jp0], -1)
+                jr = jnp.where(applied, fc_rnext[jp0], -1)
+                res_l, real_l, jl = forced_override(
+                    jl, hist_view(new_left), lg, lh, lcnt, res_l)
+                res_r, real_r, jr = forced_override(
+                    jr, hist_view(new_right), rg, rh, rcnt, res_r)
             if cfg.max_depth > 0:
                 depth_ok = child_depth < cfg.max_depth
             else:
@@ -313,9 +369,13 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                                       st["bro"][best_leaf])
             st_new["leaf_depth"] = set2(st["leaf_depth"], child_depth,
                                         child_depth)
+            if forced is not None:
+                st_new["fleaf"] = set2(st["fleaf"], jl, jr)
+                st_new["breal"] = set2(st["breal"], real_l, real_r)
 
             # record the internal node (Tree::Split, tree.h:404-448)
-            gain = st["bgain"][best_leaf]
+            gain = (st["breal"] if forced is not None
+                    else st["bgain"])[best_leaf]
             st_new["split_feature"] = st["split_feature"].at[node].set(f)
             st_new["split_bin"] = st["split_bin"].at[node].set(
                 st["bbin"][best_leaf])
@@ -345,16 +405,19 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             st_new["num_leaves"] = st["num_leaves"] + 1
             return st_new
 
-        def body(s, st):
-            best_leaf = jnp.argmax(st["bgain"]).astype(jnp.int32)
-            gain = st["bgain"][best_leaf]
-            do = jnp.logical_and(~st["done"], gain > 0.0)
-            st_new = lax.cond(do, lambda: do_split(s, st, best_leaf),
-                              lambda: dict(st))
-            st_new["done"] = st["done"] | (gain <= 0.0)
-            return st_new
+        # while-loop, not fori+cond: a cond with an identity pass-through
+        # branch makes XLA copy the whole carried state — payload and aux
+        # included, ~1 GB per split at Higgs scale — every iteration.  The
+        # while body always splits; "no positive gain" simply ends the loop,
+        # which also gives early exit for free.
+        def loop_cond(st):
+            return (st["num_leaves"] < L) & (jnp.max(st["bgain"]) > 0.0)
 
-        st = lax.fori_loop(1, L, body, state) if L > 1 else state
+        def body(st):
+            best_leaf = jnp.argmax(st["bgain"]).astype(jnp.int32)
+            return do_split(st["num_leaves"], st, best_leaf)
+
+        st = lax.while_loop(loop_cond, body, state) if L > 1 else state
 
         leaf_value = jnp.where(
             (jnp.arange(L) == 0) & (st["num_leaves"] == 1),

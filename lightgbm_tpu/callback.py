@@ -59,7 +59,8 @@ def record_evaluation(eval_result: Dict) -> Callable:
     eval_result.clear()
 
     def _callback(env: CallbackEnv) -> None:
-        for name, metric, value, _ in env.evaluation_result_list or []:
+        for entry in env.evaluation_result_list or []:
+            name, metric, value = entry[0], entry[1], entry[2]
             eval_result.setdefault(name, collections.OrderedDict())
             eval_result[name].setdefault(metric, [])
             eval_result[name][metric].append(value)
@@ -105,7 +106,10 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
         for _ in env.evaluation_result_list:
             best_iter.append(0)
             best_score_list.append(None)
-        for (_, _, _, higher_better) in env.evaluation_result_list:
+        # entries are (name, metric, value, higher_better) from train(), or
+        # ('cv_agg', 'ds metric', mean, higher_better, std) from cv()
+        for entry in env.evaluation_result_list:
+            higher_better = entry[3]
             if higher_better:
                 best_score.append(float("-inf"))
                 cmp_op.append(lambda x, y: x > y)
@@ -118,12 +122,14 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
             _init(env)
         if not enabled[0]:
             return
-        for i, (name, metric, score, _) in enumerate(env.evaluation_result_list):
+        for i, entry in enumerate(env.evaluation_result_list):
+            name, metric, score = entry[0], entry[1], entry[2]
             if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
                 best_score[i] = score
                 best_iter[i] = env.iteration
                 best_score_list[i] = env.evaluation_result_list
-            if name == "training":
+            if name == "training" or \
+                    (name == "cv_agg" and metric.startswith("train ")):
                 continue  # train metric does not trigger stopping
             if env.iteration - best_iter[i] >= stopping_rounds:
                 if verbose:

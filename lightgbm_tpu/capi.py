@@ -1,0 +1,132 @@
+"""ctypes loader for the native C API (cpp/lightgbm_tpu_c_api.h).
+
+The shared library is the deployment-side runtime (model load + predict in
+pure C++, no Python/JAX needed); this module is the convenience bridge for
+Python callers and the test suite.  Build with `make -C cpp` (or
+`ensure_built()`), which needs only g++.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from .utils.log import LightGBMError
+
+_CPP_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "cpp")
+_LIB_PATH = os.path.join(_CPP_DIR, "lib_lightgbm_tpu.so")
+
+C_API_DTYPE_FLOAT32 = 0
+C_API_DTYPE_FLOAT64 = 1
+C_API_PREDICT_NORMAL = 0
+C_API_PREDICT_RAW_SCORE = 1
+C_API_PREDICT_LEAF_INDEX = 2
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def ensure_built() -> str:
+    """Build the shared library if missing; returns its path."""
+    if not os.path.exists(_LIB_PATH):
+        subprocess.run(["make", "-C", _CPP_DIR], check=True,
+                       capture_output=True)
+    return _LIB_PATH
+
+
+def load_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(ensure_built())
+        lib.LGBM_GetLastError.restype = ctypes.c_char_p
+        _lib = lib
+    return _lib
+
+
+def _check(rc: int) -> None:
+    if rc != 0:
+        raise LightGBMError(load_lib().LGBM_GetLastError().decode())
+
+
+class NativeBooster:
+    """Minimal handle over the C API, mirroring Booster's predict surface."""
+
+    def __init__(self, model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        lib = load_lib()
+        self._handle = ctypes.c_void_p()
+        out_iters = ctypes.c_int(0)
+        if model_file is not None:
+            _check(lib.LGBM_BoosterCreateFromModelfile(
+                model_file.encode(), ctypes.byref(out_iters),
+                ctypes.byref(self._handle)))
+        elif model_str is not None:
+            _check(lib.LGBM_BoosterLoadModelFromString(
+                model_str.encode(), ctypes.byref(out_iters),
+                ctypes.byref(self._handle)))
+        else:
+            raise ValueError("model_file or model_str required")
+        self.num_iterations = out_iters.value
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            load_lib().LGBM_BoosterFree(self._handle)
+            self._handle = None
+
+    @property
+    def num_class(self) -> int:
+        out = ctypes.c_int(0)
+        _check(load_lib().LGBM_BoosterGetNumClasses(self._handle,
+                                                    ctypes.byref(out)))
+        return out.value
+
+    @property
+    def num_feature(self) -> int:
+        out = ctypes.c_int(0)
+        _check(load_lib().LGBM_BoosterGetNumFeature(self._handle,
+                                                    ctypes.byref(out)))
+        return out.value
+
+    def save_model(self, filename: str) -> None:
+        _check(load_lib().LGBM_BoosterSaveModel(self._handle, -1,
+                                                filename.encode()))
+
+    def model_to_string(self) -> str:
+        lib = load_lib()
+        out_len = ctypes.c_int64(0)
+        _check(lib.LGBM_BoosterSaveModelToString(
+            self._handle, -1, 0, ctypes.byref(out_len), None))
+        buf = ctypes.create_string_buffer(out_len.value)
+        _check(lib.LGBM_BoosterSaveModelToString(
+            self._handle, -1, out_len.value, ctypes.byref(out_len), buf))
+        return buf.value.decode()
+
+    def predict(self, X: np.ndarray, raw_score: bool = False,
+                pred_leaf: bool = False,
+                num_iteration: int = -1) -> np.ndarray:
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        nrow, ncol = X.shape
+        k = self.num_class
+        iters = self.num_iterations if num_iteration <= 0 \
+            else min(num_iteration, self.num_iterations)
+        if pred_leaf:
+            ptype = C_API_PREDICT_LEAF_INDEX
+            # trees used = iters * num_tree_per_iteration (== num_class)
+            width = iters * max(1, k)
+        else:
+            ptype = C_API_PREDICT_RAW_SCORE if raw_score else C_API_PREDICT_NORMAL
+            width = k
+        out = np.zeros(nrow * max(width, k), dtype=np.float64)
+        out_len = ctypes.c_int64(0)
+        _check(load_lib().LGBM_BoosterPredictForMat(
+            self._handle, X.ctypes.data_as(ctypes.c_void_p),
+            C_API_DTYPE_FLOAT64, ctypes.c_int32(nrow), ctypes.c_int32(ncol),
+            1, ptype, ctypes.c_int(num_iteration), b"",
+            ctypes.byref(out_len), out.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_double))))
+        out = out[:out_len.value]
+        per_row = out_len.value // nrow
+        return out.reshape(nrow, per_row) if per_row > 1 else out

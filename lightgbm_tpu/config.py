@@ -145,7 +145,6 @@ class Config:
     _UNIMPLEMENTED = {
         "two_round": "single-pass host binning is always used",
         "pre_partition": "rows are sharded by the mesh automatically",
-        "forcedsplits_filename": "forced splits are not implemented",
     }
 
     def warn_unimplemented(self) -> None:

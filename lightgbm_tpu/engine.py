@@ -97,70 +97,221 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     return booster
 
 
-def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
-       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
-       metrics=None, fobj=None, feval=None, init_model=None,
-       early_stopping_rounds=None, seed: int = 0,
-       callbacks=None, eval_train_metric: bool = False) -> Dict[str, List[float]]:
-    """K-fold cross-validation (engine.py cv:317+)."""
+class CVBooster:
+    """Container of the per-fold Boosters (engine.py CVBooster:235-253).
+
+    Method calls are redirected to every fold's booster; the return value is
+    the list of per-fold results, in fold order."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):  # don't shadow protocol probes (deepcopy…)
+            raise AttributeError(name)
+
+        def handler(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler
+
+
+def _group_folds(group_sizes: np.ndarray, nfold: int):
+    """GroupKFold over ranking queries: whole queries are assigned to folds,
+    balancing fold sizes by rows (role of sklearn's GroupKFold in
+    engine.py:266-275) — queries largest-first onto the lightest fold."""
+    if len(group_sizes) < nfold:
+        raise ValueError(
+            "Cannot build %d group-aware folds from only %d queries; "
+            "reduce nfold" % (nfold, len(group_sizes)))
+    starts = np.concatenate([[0], np.cumsum(group_sizes)]).astype(np.int64)
+    order = np.argsort(group_sizes)[::-1]
+    fold_rows = np.zeros(nfold, np.int64)
+    fold_of_query = np.zeros(len(group_sizes), np.int32)
+    for q in order:
+        k = int(np.argmin(fold_rows))
+        fold_of_query[q] = k
+        fold_rows[k] += group_sizes[q]
+    for k in range(nfold):
+        test_q = np.where(fold_of_query == k)[0]
+        train_q = np.where(fold_of_query != k)[0]
+        test_idx = np.concatenate(
+            [np.arange(starts[q], starts[q + 1]) for q in test_q])
+        train_idx = np.concatenate(
+            [np.arange(starts[q], starts[q + 1]) for q in train_q])
+        yield (train_idx, test_idx,
+               group_sizes[train_q], group_sizes[test_q])
+
+
+def _make_n_folds(train_set: Dataset, folds, nfold: int, params: Dict,
+                  seed: int, fpreproc, stratified: bool, shuffle: bool,
+                  eval_train_metric: bool) -> CVBooster:
+    """Build the per-fold Boosters (engine.py _make_n_folds:256-301)."""
     train_set.construct()
     n = train_set.num_data()
     y = train_set.get_label()
     rng = np.random.default_rng(seed)
+    group = train_set.get_group()
 
-    if folds is None:
+    fold_group = None
+    if folds is not None:
+        if not hasattr(folds, "__iter__"):
+            raise AttributeError(
+                "folds should be an iterable of (train_idx, test_idx)")
+        folds = [(np.asarray(tr), np.asarray(te)) for tr, te in folds]
+    elif group is not None:
+        rich = list(_group_folds(np.asarray(group), nfold))
+        folds = [(tr, te) for tr, te, _, _ in rich]
+        fold_group = [(gtr, gte) for _, _, gtr, gte in rich]
+    elif stratified and y is not None and \
+            len(np.unique(y)) <= max(2, int(params.get("num_class", 2))):
         idx = np.arange(n)
-        if stratified and y is not None and len(np.unique(y)) <= max(2, int(params.get("num_class", 2))):
-            folds = []
-            pieces = [[] for _ in range(nfold)]
-            for cls in np.unique(y):
-                cls_idx = idx[y == cls]
-                if shuffle:
-                    rng.shuffle(cls_idx)
-                for k, part in enumerate(np.array_split(cls_idx, nfold)):
-                    pieces[k].append(part)
-            folds = [(np.setdiff1d(idx, np.concatenate(p)), np.concatenate(p))
-                     for p in pieces]
-        else:
+        pieces = [[] for _ in range(nfold)]
+        for cls in np.unique(y):
+            cls_idx = idx[y == cls]
             if shuffle:
-                rng.shuffle(idx)
-            parts = np.array_split(idx, nfold)
-            folds = [(np.setdiff1d(np.arange(n), p), p) for p in parts]
+                rng.shuffle(cls_idx)
+            for k, part in enumerate(np.array_split(cls_idx, nfold)):
+                pieces[k].append(part)
+        folds = [(np.setdiff1d(idx, np.concatenate(p)), np.concatenate(p))
+                 for p in pieces]
+    else:
+        idx = np.arange(n)
+        if shuffle:
+            rng.shuffle(idx)
+        parts = np.array_split(idx, nfold)
+        folds = [(np.setdiff1d(np.arange(n), p), p) for p in parts]
 
-    boosters = []
-    for train_idx, test_idx in folds:
-        tr = train_set.subset(np.sort(train_idx))
-        te = tr.create_valid(_subset_matrix(train_set, np.sort(test_idx)),
-                             label=np.asarray(y)[np.sort(test_idx)])
-        bst = Booster(params=dict(params), train_set=tr)
+    cvbooster = CVBooster()
+    for k, (train_idx, test_idx) in enumerate(folds):
+        train_idx = np.sort(np.asarray(train_idx))
+        test_idx = np.sort(np.asarray(test_idx))
+        tr = train_set.subset(train_idx)
+        te_label = None if y is None else np.asarray(y)[test_idx]
+        te = tr.create_valid(_subset_matrix(train_set, test_idx),
+                             label=te_label)
+        if fold_group is not None:
+            tr.set_group(fold_group[k][0])
+            te.set_group(fold_group[k][1])
+        w = train_set.get_weight()
+        if w is not None:  # subset() already sliced the train-fold weight
+            te.set_weight(np.asarray(w)[test_idx])
+        fold_params = dict(params)
+        if fpreproc is not None:
+            tr, te, fold_params = fpreproc(tr, te, fold_params)
+        bst = Booster(params=fold_params, train_set=tr)
+        if eval_train_metric:
+            bst.add_valid(tr, "train")
         bst.add_valid(te, "valid")
-        boosters.append(bst)
+        cvbooster.append(bst)
+    return cvbooster
 
+
+def _agg_cv_result(raw_results):
+    """[(dataset, metric, mean, is_higher_better, std)] across folds
+    (engine.py _agg_cv_result:304-314), keyed by (dataset, metric) so
+    eval_train_metric keeps train/valid series separate."""
+    cvmap = collections.OrderedDict()
+    metric_hib = {}
+    for one_result in raw_results:
+        for ds_name, metric, value, hib in one_result:
+            key = (ds_name, metric)
+            metric_hib[key] = hib
+            cvmap.setdefault(key, []).append(value)
+    return [(ds, m, float(np.mean(v)), metric_hib[(ds, m)], float(np.std(v)))
+            for (ds, m), v in cvmap.items()]
+
+
+def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       early_stopping_rounds=None, fpreproc=None, verbose_eval=None,
+       show_stdv: bool = True, seed: int = 0,
+       callbacks=None, eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, Any]:
+    """K-fold cross-validation (engine.py cv:317+).
+
+    Returns {metric-mean: [...], metric-stdv: [...]} (stdv omitted when
+    show_stdv=False); with return_cvbooster=True the dict also carries the
+    CVBooster under "cvbooster".  Folds are query-aware for ranking
+    datasets (whole queries per fold), stratified for classification."""
+    params = dict(params)
+    if metrics is not None:
+        params["metric"] = metrics
+    if fobj is not None:
+        params["objective"] = "none"
+    if init_model is not None:
+        raise NotImplementedError(
+            "cv(init_model=...) is not supported; continued training is "
+            "available through train()")
+
+    cvfolds = _make_n_folds(train_set, folds, nfold, params, seed, fpreproc,
+                            stratified, shuffle, eval_train_metric)
     results = collections.defaultdict(list)
+    best_iter, best_metric_val, best_hib = -1, None, True
+
+    callbacks = list(callbacks) if callbacks else []
+    if verbose_eval is True:
+        callbacks.append(log_evaluation(1, show_stdv))
+    elif isinstance(verbose_eval, int) and not isinstance(verbose_eval, bool) \
+            and verbose_eval > 0:
+        callbacks.append(log_evaluation(verbose_eval, show_stdv))
+    callbacks_before = [c for c in callbacks
+                        if getattr(c, "before_iteration", False)]
+    callbacks_after = [c for c in callbacks
+                       if not getattr(c, "before_iteration", False)]
+
     for i in range(num_boost_round):
-        all_evals = collections.defaultdict(list)
-        for bst in boosters:
-            bst.update(fobj=fobj)
-            for (name, metric, value, hib) in bst.eval_valid(feval):
-                all_evals[metric].append((value, hib))
-        stop = False
-        for metric, vals in all_evals.items():
-            mean = float(np.mean([v for v, _ in vals]))
-            std = float(np.std([v for v, _ in vals]))
-            results[metric + "-mean"].append(mean)
-            results[metric + "-stdv"].append(std)
-        if early_stopping_rounds and i >= early_stopping_rounds:
-            for metric, vals in all_evals.items():
-                hib = vals[0][1]
-                series = results[metric + "-mean"]
-                best_idx = int(np.argmax(series)) if hib else int(np.argmin(series))
-                if best_idx <= i - early_stopping_rounds:
-                    stop = True
-        if stop:
+        env = CallbackEnv(model=cvfolds, params=params, iteration=i,
+                          begin_iteration=0, end_iteration=num_boost_round,
+                          evaluation_result_list=None)
+        for cb in callbacks_before:
+            cb(env)
+        cvfolds.update(fobj=fobj)
+        # with eval_train_metric the fold boosters carry the training fold
+        # as an extra valid set named "train", so eval_valid covers both
+        raw = cvfolds.eval_valid(feval)
+        agg = _agg_cv_result(raw)
+        for ds_name, metric, mean, hib, std in agg:
+            key = metric if ds_name == "valid" else f"{ds_name} {metric}"
+            results[key + "-mean"].append(mean)
+            if show_stdv:
+                results[key + "-stdv"].append(std)
+
+        # early stopping on the first valid metric's mean
+        valid_agg = [a for a in agg if a[0] == "valid"]
+        if valid_agg:
+            _, _, mean, hib, _ = valid_agg[0]
+            if best_metric_val is None or (mean > best_metric_val if hib
+                                           else mean < best_metric_val):
+                best_metric_val, best_iter, best_hib = mean, i, hib
+        env = CallbackEnv(model=cvfolds, params=params, iteration=i,
+                          begin_iteration=0, end_iteration=num_boost_round,
+                          evaluation_result_list=[
+                              ("cv_agg", "%s %s" % (ds, m), mean, hib, std)
+                              for ds, m, mean, hib, std in agg])
+        try:
+            for cb in callbacks_after:
+                cb(env)
+        except EarlyStopException as e:
+            best_iter = e.best_iteration
             for key in results:
-                results[key] = results[key][: i + 1]
+                results[key] = results[key][: best_iter + 1]
             break
-    return dict(results)
+        if early_stopping_rounds and valid_agg and \
+                best_iter <= i - early_stopping_rounds:
+            for key in results:
+                results[key] = results[key][: best_iter + 1]
+            break
+
+    cvfolds.best_iteration = best_iter + 1
+    out: Dict[str, Any] = dict(results)
+    if return_cvbooster:
+        out["cvbooster"] = cvfolds
+    return out
 
 
 def _subset_matrix(ds: Dataset, idx: np.ndarray):

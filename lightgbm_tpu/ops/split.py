@@ -106,7 +106,8 @@ def _leaf_split_gain(sum_g, sum_h, l1, l2, max_delta_step):
 
 def _numerical_gain_tensor(g, h, c, sum_g, total_h, num_data, feature_mask, *,
                            meta, l1, l2, max_delta_step, min_data_in_leaf,
-                           min_sum_hessian_in_leaf, min_gain_to_split):
+                           min_sum_hessian_in_leaf, min_gain_to_split,
+                           apply_min_gain_filter: bool = True):
     """Shifted+penalized numerical split gains [F, 2, B] (dir -1 first) plus
     the stacked left-side aggregates [F, 2, B] and min_gain_shift.  Shared by
     the global argmax (find_best_split) and the per-feature reduction used by
@@ -169,9 +170,14 @@ def _numerical_gain_tensor(g, h, c, sum_g, total_h, num_data, feature_mask, *,
     gains = jnp.stack([gain2, gain1], axis=1)                              # [F, 2, B]; -1 first (tie-break)
     # shift by the no-split gain, then penalize (reference order:
     # FindBestThresholdNumerical subtracts, FindBestThreshold multiplies)
-    gains = jnp.where(gains > min_gain_shift,
-                      (gains - min_gain_shift) * meta.penalty[:, None, None],
-                      K_MIN_SCORE)
+    if apply_min_gain_filter:
+        gains = jnp.where(gains > min_gain_shift,
+                          (gains - min_gain_shift) * meta.penalty[:, None, None],
+                          K_MIN_SCORE)
+    else:
+        # forced-split path: constraint masks (already folded in as -inf)
+        # still apply, but a below-min-gain split is NOT rejected
+        gains = (gains - min_gain_shift) * meta.penalty[:, None, None]
     lgs = jnp.stack([lg2, lg1], axis=1)
     lhs = jnp.stack([lh2, lh1], axis=1)
     lcs = jnp.stack([lc2, lc1], axis=1)
@@ -423,4 +429,53 @@ def find_best_split(hist, sum_g, sum_h, num_data, feature_mask, *,
         default_left=default_left,
         left_sum_g=left_g, left_sum_h=left_h - eps, left_count=left_c,
         is_cat=is_cat, cat_bitset=cat_bitset,
+        left_output=lo, right_output=ro)
+
+
+def evaluate_split_at(hist, sum_g, sum_h, num_data, feature, threshold_bin, *,
+                      meta: FeatureMeta, l1, l2, max_delta_step,
+                      min_data_in_leaf, min_sum_hessian_in_leaf) -> SplitResult:
+    """SplitResult for a FORCED numerical split at (feature, threshold_bin).
+
+    Role of the forced-split evaluation inside the reference's ForceSplits
+    (serial_tree_learner.cpp:546-701): the threshold is imposed, but the
+    missing-value default direction is still chosen by gain, and the
+    min-data/min-hessian constraints still apply — an infeasible forced
+    split comes back with gain = -inf so the caller can fall back to the
+    leaf's gain-driven best.  feature/threshold_bin may be traced scalars.
+    """
+    f = jnp.asarray(feature, jnp.int32)
+    t = jnp.asarray(threshold_bin, jnp.int32)
+    B = hist.shape[1]
+    eps = K_EPSILON
+    total_h = sum_h + 2 * eps
+    # slice everything down to the one forced feature before the scan —
+    # this runs on every do_split when forcing is active, and the full
+    # [F, 2, B] tensor would double the leaf's split-finding work
+    hist_f = hist[f][None]                      # [1, B, 3]
+    meta1 = FeatureMeta(*[a[f][None] for a in meta])
+    gains, (lgs, lhs, lcs), _ = _numerical_gain_tensor(
+        hist_f[:, :, 0], hist_f[:, :, 1], hist_f[:, :, 2], sum_g, total_h,
+        num_data, jnp.ones(1, bool), meta=meta1,
+        l1=l1, l2=l2, max_delta_step=max_delta_step,
+        min_data_in_leaf=min_data_in_leaf,
+        min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
+        min_gain_to_split=0.0, apply_min_gain_filter=False)
+    pair = gains[0, :, t]                       # [2] directions, -1 first
+    d = jnp.argmax(pair)
+    gain = pair[d]
+    force_right = (meta1.num_bin[0] <= 2) & \
+        (meta1.missing_type[0] == MISSING_NAN)
+    default_left = (d == 0) & ~force_right
+    left_g = lgs[0, d, t]
+    left_h = lhs[0, d, t]
+    left_c = lcs[0, d, t]
+    right_g = sum_g - left_g
+    right_h = total_h - left_h
+    lo = leaf_output(left_g, left_h, l1, l2, max_delta_step)
+    ro = leaf_output(right_g, right_h, l1, l2, max_delta_step)
+    return SplitResult(
+        gain=gain, feature=f, threshold_bin=t, default_left=default_left,
+        left_sum_g=left_g, left_sum_h=left_h - eps, left_count=left_c,
+        is_cat=jnp.bool_(False), cat_bitset=jnp.zeros(B, bool),
         left_output=lo, right_output=ro)

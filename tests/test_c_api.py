@@ -1,0 +1,139 @@
+"""Native C API (cpp/c_api.cc): the C++ predictor must agree bit-for-bit
+with the Python predictor on every model family (c_api.cpp role parity)."""
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="module")
+def capi():
+    from lightgbm_tpu import capi as c
+    c.ensure_built()
+    return c
+
+
+def _train(params, X, y, rounds=8):
+    base = {"verbose": -1, "min_data_in_leaf": 5, "num_leaves": 15}
+    base.update(params)
+    return lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+def _roundtrip(capi, bst, X, tmp_path, name):
+    f = str(tmp_path / ("%s.txt" % name))
+    bst.save_model(f)
+    nb = capi.NativeBooster(model_file=f)
+    return nb, f
+
+
+def test_binary_agrees_with_python(capi, tmp_path):
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((400, 6)).astype(np.float64)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    bst = _train({"objective": "binary"}, X, y)
+    nb, _ = _roundtrip(capi, bst, X, tmp_path, "bin")
+    np.testing.assert_allclose(nb.predict(X), bst.predict(X), rtol=0, atol=1e-15)
+    np.testing.assert_allclose(nb.predict(X, raw_score=True),
+                               bst.predict(X, raw_score=True), atol=1e-15)
+    assert nb.num_class == 1
+    assert nb.num_feature == 6
+    assert nb.num_iterations == 8
+
+
+def test_binary_with_nans(capi, tmp_path):
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((400, 5))
+    X[rng.random(X.shape) < 0.15] = np.nan
+    y = (np.nan_to_num(X[:, 0]) > 0).astype(float)
+    bst = _train({"objective": "binary", "use_missing": True}, X, y)
+    nb, _ = _roundtrip(capi, bst, X, tmp_path, "nan")
+    np.testing.assert_allclose(nb.predict(X), bst.predict(X), atol=1e-15)
+
+
+def test_multiclass_softmax(capi, tmp_path):
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((500, 4))
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    bst = _train({"objective": "multiclass", "num_class": 3}, X, y.astype(float))
+    nb, _ = _roundtrip(capi, bst, X, tmp_path, "mc")
+    ours = nb.predict(X)
+    ref = bst.predict(X)
+    assert ours.shape == ref.shape == (500, 3)
+    np.testing.assert_allclose(ours, ref, atol=1e-15)
+
+
+def test_regression_and_poisson(capi, tmp_path):
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((300, 5))
+    y = X[:, 0] * 2 + 1.5 + rng.standard_normal(300) * 0.1
+    for obj in ("regression", "poisson"):
+        yy = np.abs(y) if obj == "poisson" else y
+        bst = _train({"objective": obj}, X, yy)
+        nb, _ = _roundtrip(capi, bst, X, tmp_path, obj)
+        np.testing.assert_allclose(nb.predict(X), bst.predict(X), atol=1e-12)
+
+
+def test_categorical_model(capi, tmp_path):
+    rng = np.random.default_rng(4)
+    n = 600
+    Xc = rng.integers(0, 8, n)
+    Xn = rng.standard_normal(n)
+    X = np.column_stack([Xc.astype(float), Xn])
+    y = ((Xc % 3 == 0) ^ (Xn > 0)).astype(float)
+    params = {"objective": "binary", "categorical_feature": "0",
+              "min_data_per_group": 5, "cat_smooth": 1.0}
+    bst = lgb.train({**params, "verbose": -1, "num_leaves": 15},
+                    lgb.Dataset(X, label=y,
+                                categorical_feature=[0]),
+                    num_boost_round=6)
+    nb, _ = _roundtrip(capi, bst, X, tmp_path, "cat")
+    np.testing.assert_allclose(nb.predict(X), bst.predict(X), atol=1e-15)
+
+
+def test_leaf_index_prediction(capi, tmp_path):
+    rng = np.random.default_rng(5)
+    X = rng.standard_normal((200, 4))
+    y = (X[:, 0] > 0).astype(float)
+    bst = _train({"objective": "binary"}, X, y, rounds=5)
+    nb, _ = _roundtrip(capi, bst, X, tmp_path, "leaf")
+    ours = nb.predict(X, pred_leaf=True)
+    ref = bst.predict(X, pred_leaf=True)
+    np.testing.assert_array_equal(ours.astype(int), ref.astype(int))
+
+
+def test_model_string_roundtrip_and_errors(capi, tmp_path):
+    rng = np.random.default_rng(6)
+    X = rng.standard_normal((200, 4))
+    y = (X[:, 0] > 0).astype(float)
+    bst = _train({"objective": "binary"}, X, y, rounds=3)
+    nb, f = _roundtrip(capi, bst, X, tmp_path, "rt")
+    s = nb.model_to_string()
+    nb2 = capi.NativeBooster(model_str=s)
+    np.testing.assert_allclose(nb2.predict(X), nb.predict(X), atol=0)
+    out = str(tmp_path / "resaved.txt")
+    nb.save_model(out)
+    assert os.path.getsize(out) > 100
+    with pytest.raises(Exception):
+        capi.NativeBooster(model_file=str(tmp_path / "missing.txt"))
+
+
+def test_golden_model_loads(capi):
+    golden = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".golden", "binary", "golden_model.txt")
+    if not os.path.exists(golden):
+        pytest.skip("golden fixtures not generated")
+    nb = capi.NativeBooster(model_file=golden)
+    assert nb.num_iterations == 20
+    assert nb.num_feature == 28
+    data = np.loadtxt("/root/reference/examples/binary_classification/binary.test",
+                      delimiter="\t")
+    pred = nb.predict(data[:, 1:])
+    ref = np.loadtxt(os.path.join(os.path.dirname(golden), "golden_pred.txt"))
+    np.testing.assert_allclose(pred, ref, atol=1e-10)

@@ -1,0 +1,97 @@
+"""cv() parity: CVBooster, eval_train_metric, group-aware folds
+(reference python-package/lightgbm/engine.py:235-466)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.engine import _group_folds
+
+
+def _binary_data(n=600, f=8, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.3 * X[:, 1] + rng.standard_normal(n) * 0.4 > 0)
+    return X, y.astype(np.float64)
+
+
+BASE = {"objective": "binary", "metric": "auc", "num_leaves": 7,
+        "min_data_in_leaf": 5, "verbose": -1}
+
+
+def test_cv_returns_mean_and_stdv_series():
+    X, y = _binary_data()
+    res = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=5, nfold=3)
+    assert set(res) == {"auc-mean", "auc-stdv"}
+    assert len(res["auc-mean"]) == 5
+    assert res["auc-mean"][-1] > 0.7
+
+
+def test_cv_show_stdv_false_and_metrics_override():
+    X, y = _binary_data()
+    res = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=3, nfold=3,
+                 metrics="binary_logloss", show_stdv=False)
+    assert set(res) == {"binary_logloss-mean"}
+
+
+def test_cv_return_cvbooster_and_best_iteration():
+    X, y = _binary_data()
+    res = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=6, nfold=4,
+                 return_cvbooster=True)
+    cvb = res["cvbooster"]
+    assert isinstance(cvb, lgb.CVBooster)
+    assert len(cvb.boosters) == 4
+    assert 1 <= cvb.best_iteration <= 6
+    # redirected method call hits every fold booster
+    assert cvb.num_trees() == [6] * 4
+
+
+def test_cv_eval_train_metric():
+    X, y = _binary_data()
+    res = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=4, nfold=3,
+                 eval_train_metric=True)
+    assert "train auc-mean" in res and "auc-mean" in res
+    # train metric should beat held-out on average by the last round
+    assert res["train auc-mean"][-1] >= res["auc-mean"][-1] - 1e-6
+
+
+def test_cv_early_stopping_truncates():
+    X, y = _binary_data(n=400)
+    res = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=50, nfold=3,
+                 early_stopping_rounds=3)
+    assert len(res["auc-mean"]) < 50
+
+
+def test_cv_custom_folds_iterable():
+    X, y = _binary_data(n=300)
+    idx = np.arange(300)
+    folds = [(idx[100:], idx[:100]), (idx[:200], idx[200:])]
+    res = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=3,
+                 folds=folds, return_cvbooster=True)
+    assert len(res["cvbooster"].boosters) == 2
+
+
+def test_group_folds_keep_queries_whole():
+    sizes = np.array([10, 20, 5, 8, 12, 30, 7, 9])
+    seen = []
+    for tr, te, gtr, gte in _group_folds(sizes, 3):
+        assert gtr.sum() == len(tr) and gte.sum() == len(te)
+        assert len(np.intersect1d(tr, te)) == 0
+        seen.append(te)
+    allte = np.sort(np.concatenate(seen))
+    assert np.array_equal(allte, np.arange(sizes.sum()))
+
+
+def test_cv_ranking_group_aware():
+    rng = np.random.default_rng(5)
+    n_q, per_q = 30, 8
+    n = n_q * per_q
+    X = rng.standard_normal((n, 6)).astype(np.float32)
+    rel = (X[:, 0] > 0.3).astype(np.float64) + (X[:, 1] > 0.8)
+    group = np.full(n_q, per_q)
+    params = {"objective": "lambdarank", "metric": "ndcg", "ndcg_at": "3",
+              "num_leaves": 7, "min_data_in_leaf": 2, "verbose": -1}
+    ds = lgb.Dataset(X, label=rel, group=group)
+    res = lgb.cv(params, ds, num_boost_round=3, nfold=3)
+    key = [k for k in res if k.endswith("-mean")][0]
+    assert len(res[key]) == 3
+    assert np.isfinite(res[key]).all()

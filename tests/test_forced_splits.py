@@ -1,0 +1,118 @@
+"""Forced splits (forcedsplits_filename; serial_tree_learner.cpp:546-701)."""
+import json
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.boosting.forced import build_forced_schedule
+
+REFBIN = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      ".refbuild", "lightgbm")
+
+
+def _data(n=800, f=6, seed=11):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, f)).astype(np.float32)
+    y = (0.5 * X[:, 0] - X[:, 2] + 0.3 * rng.standard_normal(n) > 0)
+    return X, y.astype(np.float64)
+
+
+def _train(tmp_path, forced_json, **extra):
+    X, y = _data()
+    fpath = tmp_path / "forced.json"
+    fpath.write_text(json.dumps(forced_json))
+    params = {"objective": "binary", "num_leaves": 16, "min_data_in_leaf": 5,
+              "verbose": -1, "forcedsplits_filename": str(fpath)}
+    params.update(extra)
+    return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=2), X, y
+
+
+def test_forced_root_split(tmp_path):
+    bst, X, y = _train(tmp_path, {"feature": 4, "threshold": 0.25})
+    for t in bst.dump_model()["tree_info"]:
+        root = t["tree_structure"]
+        assert root["split_feature"] == 4
+        # mapped threshold is a bin upper bound at/above the forced value
+        assert root["threshold"] >= 0.25 - 0.1
+        assert root["threshold"] < 1.0
+
+
+def test_forced_nested_splits(tmp_path):
+    forced = {"feature": 4, "threshold": 0.0,
+              "left": {"feature": 1, "threshold": -0.5},
+              "right": {"feature": 3, "threshold": 0.7}}
+    bst, X, y = _train(tmp_path, forced)
+    root = bst.dump_model()["tree_info"][0]["tree_structure"]
+    assert root["split_feature"] == 4
+    assert root["left_child"]["split_feature"] == 1
+    assert root["right_child"]["split_feature"] == 3
+    # split gains recorded are real gains, not argmax priorities
+    assert abs(root["split_gain"]) < 1e6
+
+
+def test_forced_split_model_predicts(tmp_path):
+    bst, X, y = _train(tmp_path, {"feature": 0, "threshold": 0.0})
+    pred = bst.predict(X)
+    acc = np.mean((pred > 0.5) == (y > 0.5))
+    assert acc > 0.7
+
+
+def test_infeasible_forced_split_falls_back(tmp_path):
+    # threshold far outside the data range -> empty child, infeasible;
+    # growth must fall back to gain-driven splits and still work
+    bst, X, y = _train(tmp_path, {"feature": 2, "threshold": 1e9})
+    root = bst.dump_model()["tree_info"][0]["tree_structure"]
+    assert "split_feature" in root
+    assert np.isfinite(bst.predict(X)).all()
+
+
+@pytest.mark.skipif(not os.path.exists(REFBIN), reason="reference CLI not built")
+def test_forced_splits_reference_cli_interop(tmp_path):
+    """Same forced-splits JSON, same data: our root/second-level structure
+    must match the reference CLI's."""
+    X, y = _data(n=600)
+    train_tsv = tmp_path / "train.tsv"
+    np.savetxt(train_tsv, np.column_stack([y, X]), delimiter="\t", fmt="%.7g")
+    forced = {"feature": 4, "threshold": 0.1,
+              "left": {"feature": 1, "threshold": -0.3}}
+    fpath = tmp_path / "forced.json"
+    fpath.write_text(json.dumps(forced))
+
+    ref_model = tmp_path / "ref_model.txt"
+    subprocess.run(
+        [REFBIN, "task=train", "data=%s" % train_tsv, "objective=binary",
+         "num_leaves=8", "min_data_in_leaf=5", "num_trees=1",
+         "forcedsplits_filename=%s" % fpath, "verbose=-1",
+         "output_model=%s" % ref_model], check=True, capture_output=True)
+    from lightgbm_tpu.models.gbdt_model import GBDTModel
+    ref = GBDTModel.load_model(str(ref_model)).dump_model()
+    ref_root = ref["tree_info"][0]["tree_structure"]
+
+    params = {"objective": "binary", "num_leaves": 8, "min_data_in_leaf": 5,
+              "verbose": -1, "forcedsplits_filename": str(fpath)}
+    ours = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=1)
+    our_root = ours.dump_model()["tree_info"][0]["tree_structure"]
+
+    assert our_root["split_feature"] == ref_root["split_feature"] == 4
+    assert our_root["left_child"].get("split_feature") == \
+        ref_root["left_child"].get("split_feature") == 1
+    assert abs(our_root["threshold"] - ref_root["threshold"]) < 1e-6
+
+
+def test_schedule_builder_bfs_ranks():
+    class FakeMapper:
+        num_bin = 10
+        def value_to_bin(self, v):
+            return int(min(max(v, 0), 8))
+    forced = {"feature": 0, "threshold": 3,
+              "left": {"feature": 1, "threshold": 2,
+                       "right": {"feature": 2, "threshold": 5}},
+              "right": {"feature": 1, "threshold": 7}}
+    sched = build_forced_schedule(forced, [FakeMapper()] * 3, 16)
+    assert sched.feat == (0, 1, 1, 2)          # BFS order
+    assert sched.lnext[0] == 1 and sched.rnext[0] == 2
+    assert sched.rnext[1] == 3 and sched.lnext[1] == -1
+    assert sched.gain[0] > sched.gain[1] > sched.gain[3] > 0
