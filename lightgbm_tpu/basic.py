@@ -280,6 +280,13 @@ class Booster:
         """Number of completed iterations (reference Booster method)."""
         return self._model.current_iteration
 
+    def phase_timings(self):
+        """Accumulated {phase: seconds} when tpu_profile_phases=true (the
+        reference's TIMETAG counters); empty dict otherwise."""
+        if self._engine is None:
+            return {}
+        return dict(self._engine.timer.seconds)
+
     def num_trees(self) -> int:
         return self._model.num_total_trees
 

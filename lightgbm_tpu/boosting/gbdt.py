@@ -26,6 +26,7 @@ from ..models.tree import Tree
 from ..ops.split import FeatureMeta
 from ..utils.log import Log
 from ..utils.random import Random, partition_seed
+from ..utils.timer import PhaseTimer
 from ..ops import segment as seg
 from ..ops.bundle import (BundleMap, bundle_map_from_info, decode_bin,
                           identity_bundle_map)
@@ -275,6 +276,8 @@ class GBDT:
         self.objective = objective
         self.train_metrics = metrics
         self.iter = 0
+        self.timer = PhaseTimer(bool(getattr(config, "tpu_profile_phases",
+                                             False)))
         self.shrinkage_rate = float(config.learning_rate)
         self.num_class = int(config.num_class)
         self.num_tree_per_iteration = objective.num_model_per_iteration \
@@ -350,7 +353,15 @@ class GBDT:
             # avoid a second full-matrix host->device transfer here
             self.bins_dev = None
         else:
-            self.bins_dev = jnp.asarray(train_set.bins)
+            from ..io.nbits import get_packed, should_pack, \
+                unpack_nibbles_device
+            if should_pack(train_set):
+                # dense_nbits_bin parity at the transfer boundary: ship the
+                # nibble-packed matrix (half the H2D bytes), unpack on chip
+                self.bins_dev = unpack_nibbles_device(
+                    get_packed(train_set), train_set.bins.shape[0])
+            else:
+                self.bins_dev = jnp.asarray(train_set.bins)
         self.meta_dev = _feature_meta_device(train_set)
         self.valid_mask = jnp.asarray(train_set.valid_row_mask())
         md = train_set.metadata
@@ -579,16 +590,30 @@ class GBDT:
         lr = self.shrinkage_rate
         should_continue = False
         for k in range(self.num_tree_per_iteration):
-            fs.payload = fs._fill_class(fs.payload, k=k)
-            out, fs.payload, fs.aux = fs.grower(fs.payload, fs.aux, fmask)
-            tree, tree_dev, leaf_out = self._finish_tree(out, init_score)
+            with self.timer.phase("boosting (gradients)"):
+                fs.payload = fs._fill_class(fs.payload, k=k)
+                self.timer.sync(fs.payload)
+            with self.timer.phase("tree (hist+split+partition)"):
+                out, fs.payload, fs.aux = fs.grower(fs.payload, fs.aux,
+                                                    fmask)
+                self.timer.sync(fs.payload)
+            with self.timer.phase("tree assemble (host)"):
+                tree, tree_dev, leaf_out = self._finish_tree(out, init_score)
             if tree.num_leaves > 1:
                 should_continue = True
-                fs.payload = fs._apply_score(fs.payload, jnp.float32(lr), k=k)
+                with self.timer.phase("train score update"):
+                    fs.payload = fs._apply_score(fs.payload,
+                                                 jnp.float32(lr), k=k)
+                    self.timer.sync(fs.payload)
                 depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
-                for vs in self.valid_sets:
-                    vs[3] = _traverse_update(vs[2], vs[3], leaf_out, tree_dev,
-                                             self.meta_dev, self.bundle_map, depth_iters, k)
+                with self.timer.phase("valid score update"):
+                    for vs in self.valid_sets:
+                        vs[3] = _traverse_update(vs[2], vs[3], leaf_out,
+                                                 tree_dev, self.meta_dev,
+                                                 self.bundle_map,
+                                                 depth_iters, k)
+                    if self.valid_sets:
+                        self.timer.sync(self.valid_sets[-1][3])
             self.model.trees.append(tree)
         self.iter += 1
         if not should_continue:
@@ -608,13 +633,17 @@ class GBDT:
                         "objective) trains WITHOUT forced splits")
             self._warned_forced_legacy = True
         init_score = 0.0
-        if grad is None or hess is None:
-            init_score = self._boost_from_average()
-            grads, hesss = self._gradients()
-        else:
-            grads, hesss = self._pad_custom_gradients(grad, hess)
+        with self.timer.phase("boosting (gradients)"):
+            if grad is None or hess is None:
+                init_score = self._boost_from_average()
+                grads, hesss = self._gradients()
+            else:
+                grads, hesss = self._pad_custom_gradients(grad, hess)
+            self.timer.sync(grads)
 
-        gmask, cmask = self._bagging_masks(grads, hesss)
+        with self.timer.phase("bagging"):
+            gmask, cmask = self._bagging_masks(grads, hesss)
+            self.timer.sync(gmask)
         self._bag_cmask = cmask
         fmask = self._feature_sample()
 
@@ -622,20 +651,32 @@ class GBDT:
         should_continue = False
         for k in range(self.num_tree_per_iteration):
             vals = _make_vals(grads, hesss, gmask, cmask, k)
-            out = self.grower(self.bins_dev, vals, fmask)
+            with self.timer.phase("tree (hist+split+partition)"):
+                out = self.grower(self.bins_dev, vals, fmask)
+                self.timer.sync(out)
             renewed = None
             if renew:
                 renewed = self._renew_leaf_values(out, k)
-            tree, tree_dev, leaf_out = self._finish_tree(out, init_score, renewed)
+            with self.timer.phase("tree assemble (host)"):
+                tree, tree_dev, leaf_out = self._finish_tree(out, init_score,
+                                                             renewed)
             if tree.num_leaves > 1:
                 should_continue = True
-                self.score = _update_score_k(self.score, out["leaf_id"], leaf_out, k)
+                with self.timer.phase("train score update"):
+                    self.score = _update_score_k(self.score, out["leaf_id"],
+                                                 leaf_out, k)
+                    self.timer.sync(self.score)
                 # fixed trip count (num_leaves-1 covers any depth) so the
                 # traversal compiles exactly once per config
                 depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
-                for vs in self.valid_sets:
-                    vs[3] = _traverse_update(vs[2], vs[3], leaf_out, tree_dev,
-                                             self.meta_dev, self.bundle_map, depth_iters, k)
+                with self.timer.phase("valid score update"):
+                    for vs in self.valid_sets:
+                        vs[3] = _traverse_update(vs[2], vs[3], leaf_out,
+                                                 tree_dev, self.meta_dev,
+                                                 self.bundle_map,
+                                                 depth_iters, k)
+                    if self.valid_sets:
+                        self.timer.sync(self.valid_sets[-1][3])
             self.model.trees.append(tree)
         self.iter += 1
         if not should_continue:

@@ -91,6 +91,9 @@ class Config:
         # non-registry knobs the TPU build adds: segment-engine selection
         # for the partitioned grower (validated in ops.segment.resolve_impl)
         self.tpu_histogram_impl = "auto"  # auto | pallas | lax
+        # per-phase wall timers (the reference's TIMETAG taxonomy,
+        # serial_tree_learner.cpp:14-41); adds a device sync per phase
+        self.tpu_profile_phases = False
         self._user_keys: set = set()
         self.raw_params: Dict[str, Any] = {}
         if params:
@@ -133,6 +136,12 @@ class Config:
                 continue
             if name in PARAMS:
                 setattr(self, name, _coerce(name, value, PARAMS[name]["type"]))
+            elif isinstance(getattr(self, name, None), bool):
+                # non-registry bool knob (tpu_profile_phases, future ones):
+                # CLI strings must not truthy-trap ("false" -> True)
+                setattr(self, name, str(value).lower() in
+                        ("1", "true", "yes", "on")
+                        if isinstance(value, str) else bool(value))
             else:
                 setattr(self, name, value)
         self._check_ranges()

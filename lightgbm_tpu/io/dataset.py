@@ -181,10 +181,18 @@ class BinnedDataset:
             "num_data_padded": self.num_data_padded,
             "max_num_bin": self.max_num_bin,
             "feature_names": self.feature_names,
+            "num_columns": int(self.bins.shape[0]),
         }
-        arrays = {"bins": self.bins,
-                  "monotone": self.monotone_constraints,
-                  "penalty": self.feature_penalty}
+        from .nbits import get_packed, should_pack
+        if should_pack(self):
+            # dense_nbits_bin parity at the storage boundary: <=16-bin
+            # columns cache at two per byte
+            header["nbits4"] = True
+            arrays = {"bins": get_packed(self)}
+        else:
+            arrays = {"bins": self.bins}
+        arrays.update({"monotone": self.monotone_constraints,
+                       "penalty": self.feature_penalty})
         for i, m in enumerate(self.bin_mappers):
             ma = m.to_arrays()
             header.setdefault("mappers", []).append(
@@ -237,7 +245,13 @@ class BinnedDataset:
             ds.num_data_padded = int(header["num_data_padded"])
             ds.max_num_bin = int(header["max_num_bin"])
             ds.feature_names = list(header["feature_names"])
-            ds.bins = z["bins"]
+            if header.get("nbits4"):
+                from .nbits import unpack_nibbles
+                packed = z["bins"]
+                ds.bins = unpack_nibbles(packed, int(header["num_columns"]))
+                ds._bins_packed = packed  # skip the re-pack at upload time
+            else:
+                ds.bins = z["bins"]
             ds.monotone_constraints = z["monotone"]
             ds.feature_penalty = z["penalty"]
             for i, mh in enumerate(header["mappers"]):
@@ -315,6 +329,13 @@ class BinnedDataset:
         """Bin threshold → double threshold for the model file
         (Dataset::RealThreshold)."""
         return self.bin_mappers[feature].bin_to_value(bin_idx)
+
+    def storage_num_bins(self) -> np.ndarray:
+        """[G] bin count of each STORAGE column (bundle width when EFB is
+        active, the feature's own bins otherwise)."""
+        if self.bundle_info is not None:
+            return np.asarray(self.bundle_info.group_num_bin)
+        return np.asarray([m.num_bin for m in self.bin_mappers])
 
     def valid_row_mask(self) -> np.ndarray:
         mask = np.zeros(self.num_data_padded, dtype=np.float32)

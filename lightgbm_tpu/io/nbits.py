@@ -1,0 +1,75 @@
+"""4-bit bin storage (dense_nbits_bin.hpp:37 role, redesigned for TPU).
+
+The reference keeps <=16-bin features nibble-packed in RAM because its
+histogram kernel reads the bin array directly, so 4-bit storage halves its
+working-set bandwidth.  This engine's training working set is the f32
+payload matrix (lane-padded to 128 on TPU — see docs/STORAGE.md for the
+measured argument), so packing pays off at the STORAGE/TRANSFER boundary
+instead: the binary dataset cache and the host->device upload are halved
+for <=16-bin datasets, with the nibbles unpacked on device where the
+unpack is free relative to the transfer.  Host RAM keeps the unpacked
+matrix (every host consumer reads it repeatedly; docs/STORAGE.md).
+
+Layout: storage column pairs (2k, 2k+1) share one uint8 row; column 2k in
+the high nibble.  An odd trailing column packs alone (low nibble zero-pad
+in the high slot semantics kept simple: stored as the high nibble).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def packable(group_num_bins) -> bool:
+    """True when every storage column fits in a nibble and packing saves."""
+    arr = np.asarray(group_num_bins)
+    return arr.size >= 2 and bool((arr <= 16).all())
+
+
+def should_pack(ds) -> bool:
+    """The one gate both boundaries (binary cache, H2D upload) share."""
+    return ds.bins.dtype == np.uint8 and packable(ds.storage_num_bins())
+
+
+def get_packed(ds) -> np.ndarray:
+    """The dataset's nibble-packed matrix, computed once and cached."""
+    packed = getattr(ds, "_bins_packed", None)
+    if packed is None:
+        packed = pack_nibbles(ds.bins)
+        ds._bins_packed = packed
+    return packed
+
+
+def pack_nibbles(bins: np.ndarray) -> np.ndarray:
+    """[G, N] uint8 (values < 16) -> [ceil(G/2), N] uint8."""
+    assert bins.dtype == np.uint8 and bins.max(initial=0) < 16
+    G, N = bins.shape
+    Gp = (G + 1) // 2
+    out = np.zeros((Gp, N), np.uint8)
+    out[: G // 2] = (bins[0::2][: G // 2] << 4) | bins[1::2]
+    if G % 2:
+        out[-1] = bins[-1] << 4
+    return out
+
+
+def unpack_nibbles(packed: np.ndarray, num_columns: int) -> np.ndarray:
+    """Inverse of pack_nibbles."""
+    Gp, N = packed.shape
+    out = np.empty((num_columns, N), np.uint8)
+    out[0::2] = packed[: (num_columns + 1) // 2] >> 4
+    out[1::2] = packed[: num_columns // 2] & 0x0F
+    return out
+
+
+def unpack_nibbles_device(packed_host: np.ndarray, num_columns: int):
+    """Upload the PACKED matrix (half the H2D bytes) and unpack on device."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def unpack(p):
+        hi = (p >> 4).astype(jnp.uint8)
+        lo = (p & 0x0F).astype(jnp.uint8)
+        inter = jnp.stack([hi, lo], axis=1).reshape(-1, p.shape[1])
+        return inter[:num_columns]
+
+    return unpack(jnp.asarray(packed_host))

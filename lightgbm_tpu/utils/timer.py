@@ -1,0 +1,64 @@
+"""Per-phase wall timers — the TIMETAG taxonomy, TPU-aware.
+
+Role of the reference's `#ifdef TIMETAG` counters
+(serial_tree_learner.cpp:14-41, gbdt.cpp init/boosting/train-score/
+out-of-bag-score/valid-score/metric/bagging/tree timers): accumulate
+seconds per named phase across training and report once at the end.
+
+On TPU the dispatch is asynchronous, so each timed phase must synchronize
+on its outputs to be meaningful; that costs pipeline overlap.  The timers
+are therefore OFF by default and enabled with `tpu_profile_phases=true`
+(the reference equivalently hides its timers behind a compile flag).
+"""
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from .log import Log
+
+
+class PhaseTimer:
+    def __init__(self, enabled: bool = False):
+        self.enabled = bool(enabled)
+        self.seconds: "OrderedDict[str, float]" = OrderedDict()
+        self.calls: Dict[str, int] = {}
+
+    @contextmanager
+    def phase(self, name: str):
+        """Time a phase.  Call `self.sync(outputs)` as the LAST statement of
+        the with-body — device work is async until observed, so an unsynced
+        phase bills its work to whichever later phase blocks first."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.seconds[name] = self.seconds.get(name, 0.0) + dt
+            self.calls[name] = self.calls.get(name, 0) + 1
+
+    def sync(self, outputs) -> None:
+        """Block on a phase's outputs (no-op when timing is off)."""
+        if self.enabled:
+            import jax
+            jax.block_until_ready(outputs)
+
+    def observe(self, name: str, seconds: float) -> None:
+        if self.enabled:
+            self.seconds[name] = self.seconds.get(name, 0.0) + seconds
+            self.calls[name] = self.calls.get(name, 0) + 1
+
+    def report(self) -> Optional[Dict[str, float]]:
+        """Log the accumulated table (reference prints at shutdown)."""
+        if not self.enabled or not self.seconds:
+            return None
+        Log.info("phase timings (tpu_profile_phases):")
+        for name, sec in self.seconds.items():
+            Log.info("  %-22s %9.3f s  (%d calls)", name, sec,
+                     self.calls.get(name, 0))
+        return dict(self.seconds)

@@ -1,0 +1,88 @@
+"""4-bit bin storage (dense_nbits_bin.hpp role; docs/STORAGE.md policy)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.nbits import (pack_nibbles, packable, unpack_nibbles,
+                                   unpack_nibbles_device)
+
+
+def test_pack_roundtrip_even_and_odd():
+    rng = np.random.default_rng(0)
+    for G in (2, 5, 8):
+        bins = rng.integers(0, 16, (G, 101)).astype(np.uint8)
+        packed = pack_nibbles(bins)
+        assert packed.shape == ((G + 1) // 2, 101)
+        np.testing.assert_array_equal(unpack_nibbles(packed, G), bins)
+
+
+def test_device_unpack_matches_host():
+    rng = np.random.default_rng(1)
+    bins = rng.integers(0, 16, (7, 64)).astype(np.uint8)
+    dev = np.asarray(unpack_nibbles_device(pack_nibbles(bins), 7))
+    np.testing.assert_array_equal(dev, bins)
+
+
+def test_packable_gate():
+    assert packable([16, 16, 2])
+    assert not packable([16, 17])
+    assert not packable([8])        # single column: nothing to pack
+
+
+def test_binary_cache_packs_low_bin_dataset(tmp_path):
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((500, 12)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 15, "verbose": -1})
+    ds.construct()
+    f_packed = tmp_path / "cache_packed.bin"
+    ds.binned.save_binary(str(f_packed))
+
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    loaded = BinnedDataset.load_binary(str(f_packed))
+    np.testing.assert_array_equal(loaded.bins, ds.binned.bins)
+    assert loaded.bins.shape[0] == 12
+
+    # high-bin dataset stays unpacked and still roundtrips
+    ds2 = lgb.Dataset(X, label=y, params={"max_bin": 255, "verbose": -1})
+    ds2.construct()
+    f2 = tmp_path / "cache_unpacked.bin"
+    ds2.binned.save_binary(str(f2))
+    loaded2 = BinnedDataset.load_binary(str(f2))
+    np.testing.assert_array_equal(loaded2.bins, ds2.binned.bins)
+
+
+def test_training_identical_through_packed_upload(monkeypatch):
+    """The packed-upload path must be bit-invisible to training: same data,
+    same params, pack gate on vs forced off -> identical models."""
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((600, 10)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    params = {"objective": "binary", "max_bin": 15, "num_leaves": 15,
+              "verbose": -1}
+
+    packed = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+
+    from lightgbm_tpu.io import nbits
+    monkeypatch.setattr(nbits, "packable", lambda nb: False)
+    unpacked = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+
+    assert packed.model_to_string() == unpacked.model_to_string()
+    np.testing.assert_array_equal(packed.predict(X), unpacked.predict(X))
+
+
+def test_phase_timers_accumulate():
+    rng = np.random.default_rng(4)
+    X = rng.standard_normal((300, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "tpu_profile_phases": True},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    t = bst.phase_timings()
+    assert "tree (hist+split+partition)" in t
+    assert "boosting (gradients)" in t
+    assert all(v >= 0 for v in t.values())
+    # off by default: no timings recorded
+    bst2 = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                     lgb.Dataset(X, label=y), num_boost_round=2)
+    assert bst2.phase_timings() == {}
