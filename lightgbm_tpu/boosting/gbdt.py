@@ -75,6 +75,43 @@ def _cached_grower(meta_dev: FeatureMeta, cfg, max_num_bin: int, ds: BinnedDatas
 
 _PGROWER_CACHE: Dict = {}
 
+_PACK_CACHE: Dict = {}
+
+
+def _fetch_packed(out: Dict) -> Dict[str, np.ndarray]:
+    """device_get of the grower's (small) outputs in ONE transfer.
+
+    A tunneled/remote TPU pays a full round trip per fetched array;
+    device_get of the ~17-entry tree dict cost ~90 ms/tree on the bench
+    chip against ~2 ms of actual host assembly.  All values are exact in
+    f32 (counts/ids < 2^24, flags 0/1), so flatten+concat on device, fetch
+    once, and split on host.  The big per-row leaf_id array (legacy grower)
+    is excluded and fetched only by the paths that need it."""
+    spec = tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                        for k, v in out.items() if k != "leaf_id"))
+    entry = _PACK_CACHE.get(spec)
+    if entry is None:
+        keys = [k for k, _, _ in spec]
+        shapes = {k: s for k, s, _ in spec}
+        dtypes = {k: d for k, _, d in spec}
+        sizes = [int(np.prod(shapes[k], dtype=np.int64)) for k in keys]
+        offs = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+
+        @jax.jit
+        def pack(o):
+            return jnp.concatenate(
+                [o[k].astype(jnp.float32).reshape(-1) for k in keys])
+
+        entry = (keys, shapes, dtypes, offs, pack)
+        _PACK_CACHE[spec] = entry
+    keys, shapes, dtypes, offs, pack = entry
+    flat = np.asarray(jax.device_get(pack(out)))
+    host = {}
+    for i, k in enumerate(keys):
+        a = flat[offs[i]:offs[i + 1]].reshape(shapes[k])
+        host[k] = a if dtypes[k] == "float32" else a.astype(dtypes[k])
+    return host
+
 
 def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
                     ds: BinnedDataset, cols: PayloadCols, payload_width: int,
@@ -167,15 +204,22 @@ class _FastState:
             return payload.at[:n_pad, snap0:snap0 + K].set(
                 payload[:n_pad, score0:score0 + K])
 
-        @functools.partial(jax.jit, donate_argnums=(0,),
-                           static_argnames=("k",))
-        def fill_class(payload, k):
+        def _fill_body(payload, k):
+            """Write class k's gradients into the grad/hess columns —
+            shared by the piecewise (profiled) and fused paths."""
             snap = payload[:n_pad, snap0:snap0 + K].T
             g, h = obj.get_gradients_multi(snap, payload[:n_pad, G],
                                            payload[:n_pad, G + 1])
             valid = payload[:n_pad, cnt_col]
-            payload = payload.at[:n_pad, grad_col].set(g[k] * valid)
-            return payload.at[:n_pad, hess_col].set(h[k] * valid)
+            payload = payload.at[:n_pad, grad_col].set(
+                jnp.take(g, k, axis=0) * valid)
+            return payload.at[:n_pad, hess_col].set(
+                jnp.take(h, k, axis=0) * valid)
+
+        @functools.partial(jax.jit, donate_argnums=(0,),
+                           static_argnames=("k",))
+        def fill_class(payload, k):
+            return _fill_body(payload, k)
 
         @functools.partial(jax.jit, donate_argnums=(0,),
                            static_argnames=("k",))
@@ -183,9 +227,30 @@ class _FastState:
             upd = payload[:n_pad, self.value_col] * lr
             return payload.at[:n_pad, score0 + k].add(upd)
 
+        grower = self.grower
+        value_col = self.value_col
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(payload, aux, fmask, lr, k):
+            """One fused tree: gradients -> grow -> conditional score add.
+            A tunneled TPU pays a round trip per dispatch; fusing the
+            per-tree chain into one program leaves a single launch plus
+            the packed result fetch.  k is traced (one compile serves
+            every class)."""
+            payload = _fill_body(payload, k)
+            out, payload, aux = grower.__wrapped__(payload, aux, fmask) \
+                if hasattr(grower, "__wrapped__") else grower(payload, aux,
+                                                             fmask)
+            # stumps must not move the scores (gbdt.cpp stops instead)
+            upd = jnp.where(out["num_leaves"] > 1,
+                            payload[:n_pad, value_col] * lr, 0.0)
+            payload = payload.at[:n_pad, score0 + k].add(upd)
+            return out, payload, aux
+
         self._snap_scores = snap_scores
         self._fill_class = fill_class
         self._apply_score = apply_score
+        self._step = step
 
     def reset(self, gbdt: "GBDT") -> None:
         """(Re)build the payload from the legacy-order state — used on first
@@ -590,21 +655,29 @@ class GBDT:
         lr = self.shrinkage_rate
         should_continue = False
         for k in range(self.num_tree_per_iteration):
-            with self.timer.phase("boosting (gradients)"):
-                fs.payload = fs._fill_class(fs.payload, k=k)
-                self.timer.sync(fs.payload)
-            with self.timer.phase("tree (hist+split+partition)"):
-                out, fs.payload, fs.aux = fs.grower(fs.payload, fs.aux,
-                                                    fmask)
-                self.timer.sync(fs.payload)
+            if not self.timer.enabled:
+                # one dispatch for the whole tree (gradients + growth +
+                # score add); profiling uses the piecewise path below
+                out, fs.payload, fs.aux = fs._step(
+                    fs.payload, fs.aux, fmask, jnp.float32(lr),
+                    jnp.int32(k))
+            else:
+                with self.timer.phase("boosting (gradients)"):
+                    fs.payload = fs._fill_class(fs.payload, k=k)
+                    self.timer.sync(fs.payload)
+                with self.timer.phase("tree (hist+split+partition)"):
+                    out, fs.payload, fs.aux = fs.grower(fs.payload, fs.aux,
+                                                        fmask)
+                    self.timer.sync(fs.payload)
             with self.timer.phase("tree assemble (host)"):
                 tree, tree_dev, leaf_out = self._finish_tree(out, init_score)
             if tree.num_leaves > 1:
                 should_continue = True
-                with self.timer.phase("train score update"):
-                    fs.payload = fs._apply_score(fs.payload,
-                                                 jnp.float32(lr), k=k)
-                    self.timer.sync(fs.payload)
+                if self.timer.enabled:
+                    with self.timer.phase("train score update"):
+                        fs.payload = fs._apply_score(fs.payload,
+                                                     jnp.float32(lr), k=k)
+                        self.timer.sync(fs.payload)
                 depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
                 with self.timer.phase("valid score update"):
                     for vs in self.valid_sets:
@@ -862,7 +935,7 @@ class GBDT:
                      renewed: Optional[np.ndarray] = None):
         """Fetch grower output, assemble the host Tree (reference numbering),
         apply shrinkage and first-tree bias (gbdt.cpp:450-456)."""
-        host = jax.device_get({k: v for k, v in out.items() if k != "leaf_id"})
+        host = _fetch_packed(out)
         nl = int(host["num_leaves"])
         L = self.grower_cfg.num_leaves
         tree = Tree(max(L, 2))

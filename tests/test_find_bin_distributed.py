@@ -1,0 +1,68 @@
+"""Mesh-distributed find-bin (dataset_loader.cpp:842-924 role) on the
+8-virtual-device CPU mesh."""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from lightgbm_tpu.parallel.find_bin import (DATA_AXIS,
+                                            make_distributed_find_bin,
+                                            shard_sample)
+
+NDEV = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices()
+    if len(devs) < NDEV:
+        pytest.skip("needs %d devices" % NDEV)
+    return Mesh(np.array(devs[:NDEV]), (DATA_AXIS,))
+
+
+def test_bounds_replicated_and_monotone(mesh):
+    rng = np.random.default_rng(0)
+    sample = rng.standard_normal((4096, 6)).astype(np.float32)
+    find = make_distributed_find_bin(mesh, max_bin=32)
+    bounds = np.asarray(find(shard_sample(mesh, sample)))
+    assert bounds.shape == (6, 32)
+    assert np.isposinf(bounds[:, -1]).all()
+    diffs = np.diff(bounds[:, :-1], axis=1)
+    assert (diffs >= 0).all()
+
+
+def test_bounds_approximate_true_quantiles(mesh):
+    rng = np.random.default_rng(1)
+    sample = rng.standard_normal((8192, 3)).astype(np.float32)
+    find = make_distributed_find_bin(mesh, max_bin=16)
+    bounds = np.asarray(find(shard_sample(mesh, sample)))
+    truth = np.quantile(sample, np.arange(1, 16) / 16, axis=0).T
+    err = np.abs(bounds[:, :-1] - truth)
+    assert err.max() < 0.1, err.max()
+
+
+def test_handles_nans_and_skewed_shards(mesh):
+    rng = np.random.default_rng(2)
+    sample = rng.standard_normal((4096, 2)).astype(np.float32)
+    sample[rng.random(sample.shape) < 0.2] = np.nan
+    # make shards statistically different: sort rows by feature 0 so each
+    # device sees a disjoint value range (the multi-host worst case)
+    sample = sample[np.argsort(np.nan_to_num(sample[:, 0]))]
+    find = make_distributed_find_bin(mesh, max_bin=16)
+    bounds = np.asarray(find(shard_sample(mesh, sample)))
+    finite = sample[np.isfinite(sample[:, 1]), 1]
+    truth = np.quantile(finite, np.arange(1, 16) / 16)
+    assert np.abs(bounds[1, :-1] - truth).max() < 0.15
+    assert np.isfinite(bounds[:, :-1]).all()
+
+
+def test_bounds_strictly_ascending_on_low_cardinality(mesh):
+    rng = np.random.default_rng(3)
+    # 90% zeros: many quantile targets land on the same value
+    sample = np.where(rng.random((4096, 2)) < 0.9, 0.0,
+                      rng.standard_normal((4096, 2))).astype(np.float32)
+    find = make_distributed_find_bin(mesh, max_bin=16)
+    bounds = np.asarray(find(shard_sample(mesh, sample)))
+    diffs = np.diff(bounds[:, :-1], axis=1)
+    assert (diffs > 0).all(), "bounds must be strictly ascending"

@@ -1,0 +1,66 @@
+"""plotting.py parity tests (reference python-package/lightgbm/plotting.py)."""
+import numpy as np
+import pytest
+
+mpl = pytest.importorskip("matplotlib")
+mpl.use("Agg")
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((400, 8)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    clf = lgb.LGBMClassifier(n_estimators=6, num_leaves=7, verbose=-1)
+    clf.fit(X, y, eval_set=[(X, y)])
+    return clf
+
+
+def test_plot_importance(trained):
+    ax = lgb.plot_importance(trained)
+    assert len(ax.patches) > 0
+    ax2 = lgb.plot_importance(trained.booster_, importance_type="gain",
+                              max_num_features=3, precision=2)
+    assert len(ax2.patches) <= 3
+
+
+def test_plot_metric(trained):
+    ax = lgb.plot_metric(trained)
+    assert ax.get_ylabel() == "binary_logloss"
+    rec = {}
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((200, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y)
+    lgb.train({"objective": "binary", "metric": "auc", "verbose": -1},
+              ds, num_boost_round=4, valid_sets=[ds], valid_names=["train"],
+              callbacks=[lgb.record_evaluation(rec)])
+    ax2 = lgb.plot_metric(rec, metric="auc")
+    assert ax2.get_ylabel() == "auc"
+
+
+def test_plot_metric_rejects_bare_booster(trained):
+    with pytest.raises(lgb.LightGBMError):
+        lgb.plot_metric(trained.booster_)
+
+
+def test_create_tree_digraph(trained):
+    g = lgb.create_tree_digraph(trained, tree_index=1,
+                                show_info=["internal_count", "leaf_count"])
+    src = g.source
+    assert "split1" in src or "split0" in src
+    assert "leaf" in src
+    with pytest.raises(IndexError):
+        lgb.create_tree_digraph(trained, tree_index=99)
+
+
+def test_plot_tree(trained):
+    try:
+        ax = lgb.plot_tree(trained, tree_index=0)
+    except Exception as e:  # graphviz binary may be absent
+        if "failed to execute" in str(e) or "ExecutableNotFound" in type(e).__name__:
+            pytest.skip("graphviz dot binary unavailable")
+        raise
+    assert not ax.axison
