@@ -94,11 +94,10 @@ class Application:
                        params.pop("valid", params.pop("valid_data", "")).split(",") if p]
         output_model = params.pop("output_model", "LightGBM_model.txt")
         input_model = params.pop("input_model", None)
-        num_rounds = int(params.pop("num_iterations",
-                         params.pop("num_trees", params.pop("num_boost_round", 100))))
+        from .engine import _rounds_from_params
+        num_rounds, early_stopping = _rounds_from_params(params, 100, 0)
+        num_rounds, early_stopping = int(num_rounds), int(early_stopping or 0)
         snapshot_freq = int(params.pop("snapshot_freq", -1))
-        early_stopping = int(params.pop("early_stopping_round",
-                             params.pop("early_stopping_rounds", 0)))
 
         from .io.dataset import BinnedDataset
         resolved = {Config.resolve_alias(k): v for k, v in params.items()}
@@ -157,8 +156,15 @@ class Application:
         raw_score = params.get("predict_raw_score", "").lower() in ("true", "1")
         pred_leaf = params.get("predict_leaf_index", "").lower() in ("true", "1")
         pred_contrib = params.get("predict_contrib", "").lower() in ("true", "1")
-        out = booster.predict(X, raw_score=raw_score, pred_leaf=pred_leaf,
-                              pred_contrib=pred_contrib)
+        num_iter = int(params.get("num_iteration_predict", -1))
+        early = params.get("pred_early_stop", "").lower() in ("true", "1")
+        out = booster.predict(
+            X, raw_score=raw_score, pred_leaf=pred_leaf,
+            pred_contrib=pred_contrib, num_iteration=num_iter,
+            pred_early_stop=early,
+            pred_early_stop_freq=int(params.get("pred_early_stop_freq", 10)),
+            pred_early_stop_margin=float(
+                params.get("pred_early_stop_margin", 10.0)))
         out = np.asarray(out)
         with open(output_result, "w") as fh:
             if out.ndim == 1:

@@ -154,11 +154,31 @@ class Config:
     _UNIMPLEMENTED = {
         "two_round": "single-pass host binning is always used",
         "pre_partition": "rows are sharded by the mesh automatically",
+        "device_type":
+            "this build always computes on the visible JAX/TPU devices",
+        "gpu_platform_id": "no OpenCL on TPU; the visible TPU chips are used",
+        "gpu_device_id": "no OpenCL on TPU; the visible TPU chips are used",
+        "gpu_use_dp": "histogram accumulation is always f32 on the MXU",
+        "machines": "XLA/ICI owns transport; launch with jax.distributed",
+        "machine_list_filename":
+            "XLA/ICI owns transport; launch with jax.distributed",
+        "local_listen_port":
+            "XLA/ICI owns transport; launch with jax.distributed",
+        "time_out": "XLA/ICI owns transport; launch with jax.distributed",
+        "is_enable_sparse":
+            "EFB-then-densify policy is always used (docs/STORAGE.md)",
+        "sparse_threshold":
+            "EFB-then-densify policy is always used (docs/STORAGE.md)",
     }
 
     def warn_unimplemented(self) -> None:
         for key, why in self._UNIMPLEMENTED.items():
-            if key in self._user_keys and bool(getattr(self, key, False)):
+            if key not in self._user_keys:
+                continue
+            default = PARAMS.get(key, {}).get("default")
+            if isinstance(default, tuple):
+                default = list(default)
+            if getattr(self, key, None) != default:
                 Log.warning("%s is accepted but not implemented (%s); "
                             "the setting has no effect", key, why)
 
@@ -192,6 +212,12 @@ class Config:
 
     def _derive(self) -> None:
         """Interdependent defaults (reference: config.cpp CheckParamConflict/:280+)."""
+        # verbosity -> global log level (application.cpp:54-65)
+        from .utils.log import LogLevel, reset_log_level
+        v = int(self.verbosity)
+        reset_log_level(LogLevel.FATAL if v < 0 else
+                        LogLevel.WARNING if v == 0 else
+                        LogLevel.INFO if v == 1 else LogLevel.DEBUG)
         obj = self.objective if isinstance(self.objective, str) else "none"
         if not self.metric and not getattr(self, "_metric_explicit", False):
             default_metric = _METRIC_ALIASES.get(obj, "")

@@ -26,6 +26,8 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
           early_stopping_rounds: Optional[int] = None,
           verbose_eval=True) -> Booster:
     params = dict(params)
+    num_boost_round, early_stopping_rounds = _rounds_from_params(
+        params, num_boost_round, early_stopping_rounds)
     if fobj is not None:
         params["objective"] = "none"
     init = None
@@ -97,6 +99,33 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     if booster._engine is not None:
         booster._engine.timer.report()
     return booster
+
+
+def _rounds_from_params(params: Dict, num_boost_round, early_stopping_rounds):
+    """Honor num_iterations / early_stopping_round given as PARAMS (the
+    reference engine pops the aliases and they override the kwarg).
+    Conflicting aliases: the canonical key wins deterministically, with a
+    warning (reference _choose_param_value behavior)."""
+    from ._params import ALIASES
+    found: Dict[str, Dict] = {"num_iterations": {},
+                              "early_stopping_round": {}}
+    for key in list(params):
+        canon = ALIASES.get(key, key)
+        if canon in found:
+            found[canon][key] = params.pop(key)
+    for canon, hits in found.items():
+        if not hits:
+            continue
+        if len({str(v) for v in hits.values()}) > 1:
+            Log.warning("conflicting aliases for %s (%s); using %s", canon,
+                        ", ".join("%s=%s" % kv for kv in hits.items()),
+                        canon if canon in hits else next(iter(hits)))
+        value = hits[canon] if canon in hits else next(iter(hits.values()))
+        if canon == "num_iterations":
+            num_boost_round = int(value)
+        else:
+            early_stopping_rounds = int(value)
+    return num_boost_round, early_stopping_rounds
 
 
 class CVBooster:
@@ -241,6 +270,8 @@ def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     CVBooster under "cvbooster".  Folds are query-aware for ranking
     datasets (whole queries per fold), stratified for classification."""
     params = dict(params)
+    num_boost_round, early_stopping_rounds = _rounds_from_params(
+        params, num_boost_round, early_stopping_rounds)
     if metrics is not None:
         params["metric"] = metrics
     if fobj is not None:

@@ -305,7 +305,8 @@ class BinnedDataset:
         if bool(getattr(config, "is_parallel_find_bin", True)) and f > 8:
             import concurrent.futures as cf
             import os as _os
-            workers = min(16, _os.cpu_count() or 1)
+            nt = int(getattr(config, "num_threads", 0) or 0)
+            workers = nt if nt > 0 else min(16, _os.cpu_count() or 1)
             with cf.ThreadPoolExecutor(workers) as pool:
                 mappers = list(pool.map(find_one, range(f)))
         else:

@@ -141,7 +141,8 @@ def segment_histogram(payload, start, count, *, num_features, num_bins,
 
 def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
                       payload_out, aux_out, nl_out,
-                      chunk, compact, sem_in, sem_out, *, P, B, value_col):
+                      chunk, compact, blend, sem_in, sem_out, *,
+                      P, B, value_col):
     """payload_hbm/aux_hbm are aliased with payload_out/aux_out — the kernel
     reads and writes the same HBM buffers through the `_out` refs."""
     start = scalars[0]
@@ -197,9 +198,13 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
         gl = is_cat * gl_cat + (1 - is_cat) * gl_num
         return gl * valid_mask(k)                                # [C] i32 0/1
 
-    def compact_append(k, keep_i, base, running):
-        # exclusive prefix sum as a strict-lower-triangular matvec (Mosaic
-        # has no cumsum primitive; counts <= CHUNK are exact in f32)
+    end = start + count
+
+    def compact_rows(keep_i, data, value):
+        """Stable forward compaction of data rows with keep_i=1 (exclusive
+        prefix sum as a strict-lower-triangular matvec — Mosaic has no
+        cumsum; counts <= CHUNK are exact in f32), with the per-row tree
+        output written into the value column on the way through."""
         iota_i = lax.broadcasted_iota(jnp.int32, (CHUNK, CHUNK), 0)
         iota_j = lax.broadcasted_iota(jnp.int32, (CHUNK, CHUNK), 1)
         tri = (iota_j < iota_i).astype(jnp.float32)
@@ -208,49 +213,74 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
         iota_c = lax.broadcasted_iota(jnp.int32, (CHUNK, CHUNK), 0)
         perm = ((dest[None, :] == iota_c) &
                 (keep_i[None, :] > 0)).astype(jnp.float32)
-        compact[:] = jnp.dot(perm, chunk[:],
-                             preferred_element_type=jnp.float32)
+        rows = jnp.dot(perm, data, preferred_element_type=jnp.float32)
+        return jnp.where(iota_p == value_col, value, rows)
+
+    def write_rows(dst_ref, d, rows, keep_cnt):
+        """Write rows[:keep_cnt] to dst_ref[d:d+keep_cnt).  The DMA is
+        always CHUNK rows; when the window stays inside the segment the
+        over-write tail only clobbers already-consumed rows (the write
+        cursor trails the read cursor), but a window crossing the segment
+        end would corrupt the NEXT leaf's rows — that boundary chunk is
+        blended read-modify-write instead."""
+        @pl.when(d + CHUNK <= end)
+        def _direct():
+            compact[:] = rows
+            dma = pltpu.make_async_copy(
+                compact, dst_ref.at[pl.ds(d, CHUNK), :], sem_out)
+            dma.start()
+            dma.wait()
+
+        @pl.when(d + CHUNK > end)
+        def _blended():
+            dma_r = pltpu.make_async_copy(
+                dst_ref.at[pl.ds(d, CHUNK), :], blend, sem_in)
+            dma_r.start()
+            dma_r.wait()
+            keepf = (iota_rows < keep_cnt).astype(jnp.float32)[:, None]
+            compact[:] = keepf * rows + (1.0 - keepf) * blend[:]
+            dma_w = pltpu.make_async_copy(
+                compact, dst_ref.at[pl.ds(d, CHUNK), :], sem_out)
+            dma_w.start()
+            dma_w.wait()
+
+    # pass A: ONE read of the segment; lefts forward-compact in place in
+    # payload (write cursor <= read cursor, so full-chunk writes only
+    # clobber consumed rows), rights staged compacted into aux scratch.
+    def body_a(k, carry):
+        nl, nr = carry
+        data = read_chunk(payload_out, k, chunk)
+        gl = go_left(data, k)
+        keep_r = valid_mask(k) - gl
+        lrows = compact_rows(gl, data, left_value)
+        write_rows(payload_out, start + nl, lrows, jnp.sum(gl))
+        rrows = compact_rows(keep_r, data, right_value)
+        # aux is scratch: over-write tails there are harmless, direct DMA
+        compact[:] = rrows
         dma = pltpu.make_async_copy(
-            compact, aux_out.at[pl.ds(start + base + running, CHUNK), :],
-            sem_out)
+            compact, aux_out.at[pl.ds(start + nr, CHUNK), :], sem_out)
         dma.start()
         dma.wait()
-        return running + jnp.sum(keep_i)
+        return (nl + jnp.sum(gl), nr + jnp.sum(keep_r))
 
-    # pass A: lefts -> aux[start ..)
-    def body_a(k, nl):
-        data = read_chunk(payload_out, k, chunk)
-        return compact_append(k, go_left(data, k), 0, nl)
-
-    num_left = lax.fori_loop(0, nch, body_a, jnp.int32(0), unroll=False)
+    num_left, num_right = lax.fori_loop(
+        0, nch, body_a, (jnp.int32(0), jnp.int32(0)), unroll=False)
     nl_out[0] = num_left
 
-    # pass B: rights -> aux[start + num_left ..)
-    def body_b(k, nr):
-        data = read_chunk(payload_out, k, chunk)
-        keep_i = valid_mask(k) - go_left(data, k)
-        return compact_append(k, keep_i, num_left, nr)
+    # pass B: copy the staged rights back behind the lefts (touches only
+    # the rights region, ~half the old blended full-segment pass C)
+    nrch = (num_right + CHUNK - 1) // CHUNK
 
-    lax.fori_loop(0, nch, body_b, jnp.int32(0), unroll=False)
-
-    # pass C: blended copy-back aux -> payload with value-column rewrite
-    def body_c(k, _):
-        src = read_chunk(aux_out, k, chunk)
-        orig = read_chunk(payload_out, k, compact)
-        pos = start + k * CHUNK + iota_rows
-        lf = (pos < start + num_left).astype(jnp.float32)        # [C]
-        val = lf * left_value + (1.0 - lf) * right_value
-        src = jnp.where(iota_p == value_col, val[:, None], src)
-        okf = valid_mask(k).astype(jnp.float32)[:, None]
-        compact[:] = okf * src + (1.0 - okf) * orig
+    def body_b(k, _):
         dma = pltpu.make_async_copy(
-            compact, payload_out.at[pl.ds(start + k * CHUNK, CHUNK), :],
-            sem_out)
+            aux_out.at[pl.ds(start + k * CHUNK, CHUNK), :], chunk, sem_in)
         dma.start()
         dma.wait()
+        keep = jnp.minimum(num_right - k * CHUNK, CHUNK)
+        write_rows(payload_out, start + num_left + k * CHUNK, chunk[:], keep)
         return 0
 
-    lax.fori_loop(0, nch, body_c, 0, unroll=False)
+    lax.fori_loop(0, nrch, body_b, 0, unroll=False)
 
 
 @functools.partial(jax.jit, static_argnames=("value_col", "num_bins",
@@ -282,6 +312,7 @@ def partition_segment(payload, aux, start, count, pred, left_value,
                        pl.BlockSpec(memory_space=pl.ANY),
                        pl.BlockSpec(memory_space=pltpu.SMEM)),
             scratch_shapes=[
+                pltpu.VMEM((CHUNK, P), jnp.float32),
                 pltpu.VMEM((CHUNK, P), jnp.float32),
                 pltpu.VMEM((CHUNK, P), jnp.float32),
                 pltpu.SemaphoreType.DMA(()),

@@ -1,0 +1,73 @@
+"""Every accepted parameter is honored or warned (round-2 verdict item 9)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils import log as lgb_log
+
+
+def _data(n=300, f=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, f)).astype(np.float32)
+    return X, (X[:, 0] > 0).astype(np.float64)
+
+
+def test_num_iterations_param_overrides_kwarg():
+    X, y = _data()
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_iterations": 4},
+                    lgb.Dataset(X, label=y), num_boost_round=100)
+    assert bst.num_trees() == 4
+    # alias form
+    bst2 = lgb.train({"objective": "binary", "verbose": -1, "n_estimators": 3},
+                     lgb.Dataset(X, label=y), num_boost_round=100)
+    assert bst2.num_trees() == 3
+
+
+def test_early_stopping_round_param():
+    rng = np.random.default_rng(9)
+    X, y = _data(n=500)
+    ds = lgb.Dataset(X, label=y)
+    # validation labels are pure noise: the metric plateaus immediately, so
+    # an ARMED early stopper must fire well before 60 rounds
+    vd = ds.create_valid(X[:200], label=rng.integers(0, 2, 200).astype(float))
+    bst = lgb.train({"objective": "binary", "metric": "auc", "verbose": -1,
+                     "early_stopping_round": 2},
+                    ds, num_boost_round=60, valid_sets=[vd])
+    assert bst.num_trees() < 60, "early_stopping_round param was ignored"
+    assert bst.best_iteration != -1
+
+
+def test_verbose_minus_one_silences_info(capsys):
+    X, y = _data()
+    lgb.train({"objective": "binary", "verbose": -1},
+              lgb.Dataset(X, label=y), num_boost_round=2)
+    err = capsys.readouterr()
+    assert "[Info]" not in err.out + err.err
+    # restore for other tests
+    lgb_log.reset_log_level(lgb_log.LogLevel.INFO)
+
+
+def test_unimplemented_params_warn(capsys):
+    lgb_log.reset_log_level(lgb_log.LogLevel.WARNING)
+    X, y = _data()
+    lgb.train({"objective": "binary", "verbose": 0,
+               "machines": "10.0.0.1:123,10.0.0.2:123",
+               "sparse_threshold": 0.5},
+              lgb.Dataset(X, label=y), num_boost_round=1)
+    err = capsys.readouterr()
+    text = err.out + err.err
+    assert "machines is accepted but not implemented" in text
+    assert "sparse_threshold is accepted but not implemented" in text
+    lgb_log.reset_log_level(lgb_log.LogLevel.INFO)
+
+
+def test_default_valued_unimplemented_params_stay_silent(capsys):
+    lgb_log.reset_log_level(lgb_log.LogLevel.WARNING)
+    X, y = _data()
+    lgb.train({"objective": "binary", "verbose": 0, "two_round": False,
+               "device_type": "cpu"},
+              lgb.Dataset(X, label=y), num_boost_round=1)
+    err = capsys.readouterr()
+    assert "accepted but not implemented" not in err.out + err.err
+    lgb_log.reset_log_level(lgb_log.LogLevel.INFO)
