@@ -115,7 +115,7 @@ def main():
     iters_per_sec = measure_iters / dt
 
     phases = phase_times(bst)
-    pred = bst.predict(Xte)
+    pred = bst.predict(Xte, device=True)
     test_auc = float(auc_score(yte, pred))
 
     eng = bst._engine

@@ -266,6 +266,7 @@ class Booster:
     def update(self, train_set=None, fobj=None) -> bool:
         if self._engine is None:
             raise LightGBMError("Cannot update a loaded Booster")
+        self._model_version = getattr(self, "_model_version", 0) + 1
         if fobj is not None:
             grad, hess = fobj(self._engine.raw_train_score().reshape(-1),
                               self.train_set)
@@ -273,6 +274,7 @@ class Booster:
         return self._engine.train_one_iter()
 
     def rollback_one_iter(self) -> "Booster":
+        self._model_version = getattr(self, "_model_version", 0) + 1
         self._engine.rollback_one_iter()
         return self
 
@@ -320,12 +322,34 @@ class Booster:
     def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
                 pred_leaf: bool = False, pred_contrib: bool = False,
                 pred_early_stop: bool = False, pred_early_stop_freq: int = 10,
-                pred_early_stop_margin: float = 10.0, **kwargs) -> np.ndarray:
+                pred_early_stop_margin: float = 10.0,
+                device: bool = False, **kwargs) -> np.ndarray:
+        """device=True runs the jitted accelerator predictor (f32
+        thresholds, numeric-split models only) instead of the exact f64
+        host traversal — the throughput path for large matrices."""
         X = _to_2d_float(data)
         if pred_leaf:
             return self._model.predict_leaf_index(X, num_iteration)
         if pred_contrib:
             return self._model.predict_contrib(X, num_iteration)
+        if device and pred_early_stop:
+            Log.warning("device prediction does not implement prediction "
+                        "early stop; using the host predictor")
+        elif device:
+            from .models.device_predictor import DevicePredictor, \
+                packable_model
+            if packable_model(self._model):
+                end = self._model.num_prediction_iterations(0, num_iteration)
+                key = (end, len(self._model.trees),
+                       getattr(self, "_model_version", 0))
+                if getattr(self, "_dev_pred_key", None) != key:
+                    self._dev_predictor = DevicePredictor(
+                        self._model, 0, num_iteration)
+                    self._dev_pred_key = key
+                raw = self._dev_predictor.predict_raw(X)
+                return self._finish_predict(raw, raw_score, num_iteration)
+            Log.warning("device prediction unavailable for models with "
+                        "categorical splits; using the host predictor")
         early = None
         # reference gates early stop on NeedAccuratePrediction: only binary /
         # multiclass / ranking objectives tolerate truncated sums
@@ -339,6 +363,10 @@ class Booster:
                                       early_stop=early,
                                       early_stop_freq=pred_early_stop_freq,
                                       early_stop_margin=pred_early_stop_margin)
+        return self._finish_predict(raw, raw_score, num_iteration)
+
+    def _finish_predict(self, raw: np.ndarray, raw_score: bool,
+                        num_iteration: int = -1) -> np.ndarray:
         if raw.shape[1] == 1:
             raw = raw[:, 0]
         if raw_score:

@@ -1,0 +1,128 @@
+"""Jitted batch prediction on the accelerator (gbdt_prediction.cpp role).
+
+The host predictor (`models/tree.py`) is the exactness reference (f64
+thresholds, byte-parity with the reference CLI).  This one trades f32
+thresholds for device throughput: all trees are packed into stacked SoA
+arrays once, and one jitted program traverses [N] rows x T trees with a
+fixed depth loop (num_leaves-1 bounds any path in a leaf-wise tree).
+
+Opt-in via `Booster.predict(..., device=True)`.  Models with categorical
+splits fall back to the host path (bitset membership over ragged
+category words does not vectorize cleanly; numeric models are the ones
+with million-row prediction workloads).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_K_ZERO_THRESHOLD = 1e-35
+MISSING_NONE, MISSING_ZERO, MISSING_NAN = 0, 1, 2
+
+
+def packable_model(model) -> bool:
+    return all(t.num_cat == 0 for t in model.trees)
+
+
+def pack_trees(trees, num_leaves_cap: int) -> Dict[str, np.ndarray]:
+    """Stack tree SoA arrays to [T, L-1] / [T, L] (inert padding)."""
+    T = len(trees)
+    L = max(num_leaves_cap, 2)
+    feat = np.zeros((T, L - 1), np.int32)
+    thr = np.zeros((T, L - 1), np.float32)
+    miss = np.zeros((T, L - 1), np.int32)
+    dleft = np.zeros((T, L - 1), bool)
+    left = np.full((T, L - 1), -1, np.int32)
+    right = np.full((T, L - 1), -1, np.int32)
+    leaf = np.zeros((T, L), np.float32)
+    for i, t in enumerate(trees):
+        ni = max(t.num_leaves - 1, 0)
+        if ni:
+            feat[i, :ni] = t.split_feature[:ni]
+            thr[i, :ni] = t.threshold[:ni]
+            dt = t.decision_type[:ni]
+            miss[i, :ni] = (dt >> 2) & 3
+            dleft[i, :ni] = (dt & 2) != 0
+            left[i, :ni] = t.left_child[:ni]
+            right[i, :ni] = t.right_child[:ni]
+        leaf[i, : t.num_leaves] = t.leaf_value[: t.num_leaves]
+    return {"feat": feat, "thr": thr, "miss": miss, "dleft": dleft,
+            "left": left, "right": right, "leaf": leaf}
+
+
+@functools.partial(jax.jit, static_argnames=("num_class", "depth_iters"))
+def _predict_packed(arrs, X, *, num_class: int, depth_iters: int):
+    N = X.shape[0]
+    K = num_class
+
+    def per_tree(carry, tree):
+        score, t_idx = carry
+
+        def body(_, node):
+            active = node >= 0
+            nd = jnp.maximum(node, 0)
+            f = tree["feat"][nd]                                  # [N]
+            fv = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
+            mt = tree["miss"][nd]
+            is_nan = jnp.isnan(fv)
+            fv2 = jnp.where(is_nan & (mt != MISSING_NAN), 0.0, fv)
+            missing = ((mt == MISSING_ZERO) &
+                       (jnp.abs(fv2) <= _K_ZERO_THRESHOLD)) | \
+                      ((mt == MISSING_NAN) & is_nan)
+            go_left = jnp.where(missing, tree["dleft"][nd],
+                                fv2 <= tree["thr"][nd])
+            child = jnp.where(go_left, tree["left"][nd], tree["right"][nd])
+            return jnp.where(active, child, node)
+
+        node0 = jnp.zeros(N, jnp.int32)
+        node = lax.fori_loop(0, depth_iters, body, node0) \
+            if depth_iters else node0
+        # children encode leaves as ~leaf, so stump/padded trees (whose
+        # children are all -1 = ~0) land on leaf 0 with no special case
+        leaf_idx = ~jnp.minimum(node, -1)
+        vals = tree["leaf"][leaf_idx]                             # [N]
+        k = jnp.mod(t_idx, K)
+        onehot = (jnp.arange(K) == k).astype(vals.dtype)          # [K]
+        return (score + vals[:, None] * onehot[None, :],
+                t_idx + 1), None
+
+    score0 = jnp.zeros((N, K), jnp.float32)
+    (score, _), _ = lax.scan(per_tree, (score0, jnp.int32(0)), arrs)
+    return score
+
+
+class DevicePredictor:
+    """Packs a model once; predicts [N, F] matrices on the accelerator."""
+
+    def __init__(self, model, start_iteration: int = 0,
+                 num_iteration: int = -1):
+        if not packable_model(model):
+            raise ValueError("model has categorical splits; "
+                             "use the host predictor")
+        k = model.num_tree_per_iteration
+        end = model.num_prediction_iterations(start_iteration, num_iteration)
+        trees = model.trees[start_iteration * k:
+                            (start_iteration + end) * k]
+        L = max((t.num_leaves for t in trees), default=2)
+        packed = pack_trees(trees, L)
+        self._arrs = {kk: jnp.asarray(v) for kk, v in packed.items()}
+        self.num_class = k
+        self.depth_iters = max(L - 1, 0)
+        self.num_features = model.max_feature_idx + 1
+
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, np.float32)
+        if X.shape[1] < self.num_features:
+            # jit gathers clamp out-of-bounds indices — a narrow matrix
+            # would yield silently wrong predictions, not an IndexError
+            raise ValueError("input has %d features, model needs %d"
+                             % (X.shape[1], self.num_features))
+        X = jnp.asarray(X)
+        out = _predict_packed(self._arrs, X, num_class=self.num_class,
+                              depth_iters=self.depth_iters)
+        return np.asarray(out, np.float64)
