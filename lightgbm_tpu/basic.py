@@ -23,8 +23,83 @@ from .objective import create_objective, create_objective_from_model_string
 from .utils.log import LightGBMError, Log
 
 
-def _to_2d_float(data) -> np.ndarray:
-    if hasattr(data, "values"):  # pandas
+def _is_dataframe(data) -> bool:
+    return hasattr(data, "dtypes") and hasattr(data, "columns")
+
+
+def _data_from_pandas(data, feature_name, categorical_feature,
+                      pandas_categorical):
+    """DataFrame -> (X f64, names, categorical indices, pandas_categorical).
+
+    Reference basic.py _data_from_pandas semantics: category-dtype columns
+    become their category CODES (-1/unseen -> NaN); the per-column category
+    lists are captured on the training set and re-applied positionally to
+    validation/prediction frames so codes stay consistent."""
+    cat_cols = [c for c in data.columns if str(data[c].dtype) == "category"]
+    if pandas_categorical is None:          # training frame defines them
+        pandas_categorical = [list(data[c].cat.categories) for c in cat_cols]
+    elif len(cat_cols) != len(pandas_categorical):
+        raise LightGBMError(
+            "train and valid dataset categorical_feature do not match")
+    if cat_cols:
+        data = data.copy()
+        for c, cats in zip(cat_cols, pandas_categorical):
+            col = data[c]
+            if list(col.cat.categories) != list(cats):
+                col = col.cat.set_categories(cats)
+            codes = np.asarray(col.cat.codes, dtype=np.float64)
+            codes = np.where(codes < 0, np.nan, codes)
+            data[c] = codes
+    if feature_name in ("auto", None):
+        names = [str(c) for c in data.columns]
+    else:
+        names = list(feature_name)
+    cols = [str(c) for c in data.columns]
+
+    def _pos(name):
+        # category columns are located by their DataFrame position, so a
+        # user-renaming feature_name list still works; user-named
+        # categorical_feature entries must exist in the names
+        if name in names:
+            return names.index(name)
+        if name in cols:
+            return cols.index(name)
+        raise LightGBMError("categorical column %r not found among the "
+                            "feature names %s" % (name, names))
+
+    cat_idx = []
+    if categorical_feature in ("auto", None):
+        cat_idx = [_pos(str(c)) for c in cat_cols]
+    else:
+        for cf in categorical_feature:
+            cat_idx.append(_pos(cf) if isinstance(cf, str) else int(cf))
+        for c in cat_cols:
+            i = _pos(str(c))
+            if i not in cat_idx:
+                cat_idx.append(i)
+    X = data.to_numpy(dtype=np.float64)
+    return X, names, sorted(set(cat_idx)), pandas_categorical
+
+
+def _load_pandas_categorical(model_text: str):
+    """Parse the python-binding's trailing pandas_categorical line
+    (reference basic.py _load_pandas_categorical)."""
+    import json as _json
+    idx = model_text.rfind("\npandas_categorical:")
+    if idx < 0:
+        return None
+    line = model_text[idx + len("\npandas_categorical:"):].split("\n")[0]
+    try:
+        return _json.loads(line)
+    except ValueError:
+        return None
+
+
+def _to_2d_float(data, pandas_categorical=None) -> np.ndarray:
+    if _is_dataframe(data):
+        data, _, _, _ = _data_from_pandas(data, "auto", "auto",
+                                          pandas_categorical)
+    elif hasattr(data, "values"):  # pandas Series
         data = data.values
     arr = np.asarray(data, dtype=np.float64)
     if arr.ndim == 1:
@@ -51,6 +126,7 @@ class Dataset:
         self.free_raw_data = free_raw_data
         self._binned: Optional[BinnedDataset] = None
         self.used_indices: Optional[np.ndarray] = None
+        self.pandas_categorical = None  # per-column category lists
 
     # -- construction --------------------------------------------------------
     def construct(self, config: Optional[Config] = None) -> "Dataset":
@@ -92,17 +168,27 @@ class Dataset:
             if self.group is not None:
                 md.set_query(self.group)
             return self
-        X = _to_2d_float(self.data)
-        fn = None if self.feature_name == "auto" else list(self.feature_name)
-        cats: Sequence[int] = ()
-        if self.categorical_feature != "auto" and self.categorical_feature:
-            cats = [int(c) for c in self.categorical_feature]
         ref_mappers = None
         ref_bundle = None
         if self.reference is not None:
             self.reference.construct(config)
             ref_mappers = self.reference._binned.bin_mappers
             ref_bundle = self.reference._binned.bundle_info
+        if _is_dataframe(self.data):
+            ref_pc = (self.reference.pandas_categorical
+                      if self.reference is not None else None)
+            X, names, cat_idx, self.pandas_categorical = _data_from_pandas(
+                self.data, self.feature_name, self.categorical_feature,
+                ref_pc)
+            fn = names
+            cats: Sequence[int] = cat_idx
+        else:
+            X = _to_2d_float(self.data)
+            fn = None if self.feature_name == "auto" \
+                else list(self.feature_name)
+            cats = ()
+            if self.categorical_feature != "auto" and self.categorical_feature:
+                cats = [int(c) for c in self.categorical_feature]
         self._binned = BinnedDataset.from_matrix(
             X, config, bin_mappers=ref_mappers, feature_names=fn,
             categorical_feature=cats, reference_bundle=ref_bundle)
@@ -214,9 +300,11 @@ class Booster:
                                            if init_model is not None else None)
             self._model = self._engine.model
             self.train_set = train_set
+            self.pandas_categorical = train_set.pandas_categorical
         elif model_file is not None or model_str is not None:
             text = model_str if model_str is not None else open(model_file).read()
             self._model = GBDTModel.load_model_from_string(text)
+            self.pandas_categorical = _load_pandas_categorical(text)
             self.config = Config(params)
             self._objective = create_objective_from_model_string(
                 self._model.objective_str, self.config)
@@ -231,6 +319,8 @@ class Booster:
         state.pop("train_set", None)
         state.pop("_valid_data", None)  # holds full datasets via .reference
         state.pop("_objective", None)
+        state.pop("_dev_predictor", None)   # holds device arrays
+        state.pop("_dev_pred_key", None)
         if self._model is not None:
             state["_model_str"] = self._model.save_model_to_string()
         state.pop("_model", None)
@@ -327,7 +417,7 @@ class Booster:
         """device=True runs the jitted accelerator predictor (f32
         thresholds, numeric-split models only) instead of the exact f64
         host traversal — the throughput path for large matrices."""
-        X = _to_2d_float(data)
+        X = _to_2d_float(data, getattr(self, "pandas_categorical", None))
         if pred_leaf:
             return self._model.predict_leaf_index(X, num_iteration)
         if pred_contrib:
@@ -391,7 +481,7 @@ class Booster:
 
         if self._objective is None:
             raise LightGBMError("Cannot refit with a custom objective")
-        X = _to_2d_float(data)
+        X = _to_2d_float(data, getattr(self, "pandas_categorical", None))
         label = np.asarray(label, dtype=np.float64).reshape(-1)
         n = X.shape[0]
         model = copy.deepcopy(self._model)
@@ -436,15 +526,39 @@ class Booster:
         return new_booster
 
     # -- model IO ------------------------------------------------------------
+    def _pandas_categorical_line(self) -> str:
+        """The python-binding's trailing category-lists record (reference
+        _save_pandas_categorical); empty when no category columns, so CLI
+        byte-parity is kept for non-pandas models.  numpy scalars serialize
+        as native numbers — stringified categories would never match an
+        int/float categorical column again at load time."""
+        if not getattr(self, "pandas_categorical", None):
+            return ""
+        import json as _json
+
+        def _default(o):
+            if hasattr(o, "item"):
+                return o.item()
+            return str(o)
+
+        return "\npandas_categorical:%s\n" % _json.dumps(
+            self.pandas_categorical, default=_default)
+
     def save_model(self, filename: str, num_iteration: int = -1,
                    start_iteration: int = 0) -> "Booster":
         params = self.config.to_string() if self.config else ""
         self._model.save_model(filename, start_iteration, num_iteration,
                                parameters=params)
+        line = self._pandas_categorical_line()
+        if line:
+            with open(filename, "a") as fh:
+                fh.write(line)
         return self
 
     def model_to_string(self, num_iteration: int = -1, start_iteration: int = 0) -> str:
-        return self._model.save_model_to_string(start_iteration, num_iteration)
+        return self._model.save_model_to_string(start_iteration,
+                                                num_iteration) + \
+            self._pandas_categorical_line()
 
     def dump_model(self, num_iteration: int = -1) -> Dict:
         return self._model.dump_model(num_iteration)

@@ -1,0 +1,144 @@
+"""pandas DataFrame input: category dtypes -> codes, auto categorical
+features, model round-trip (reference basic.py _data_from_pandas)."""
+import numpy as np
+import pytest
+
+pd = pytest.importorskip("pandas")
+
+import lightgbm_tpu as lgb
+
+
+def _frame(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    color = pd.Categorical(rng.choice(["red", "green", "blue"], n),
+                           categories=["red", "green", "blue"])
+    df = pd.DataFrame({
+        "num0": rng.standard_normal(n),
+        "color": color,
+        "num1": rng.standard_normal(n),
+    })
+    y = ((df["color"] == "red").to_numpy() ^
+         (df["num0"].to_numpy() > 0)).astype(float)
+    return df, y
+
+
+def test_dataframe_auto_categorical_trains():
+    df, y = _frame()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                     "min_data_per_group": 5},
+                    lgb.Dataset(df, label=y), num_boost_round=8)
+    pred = bst.predict(df)
+    acc = np.mean((pred > 0.5) == (y > 0.5))
+    assert acc > 0.9, acc
+    # the category column became a real categorical split
+    dump = bst.dump_model()
+    assert dump["feature_names"] == ["num0", "color", "num1"]
+
+
+def test_prediction_respects_training_category_order():
+    df, y = _frame()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                     "min_data_per_group": 5},
+                    lgb.Dataset(df, label=y), num_boost_round=5)
+    base = bst.predict(df)
+    # same values, shuffled category ORDER: codes differ, predictions must not
+    df2 = df.copy()
+    df2["color"] = df2["color"].cat.set_categories(["blue", "red", "green"])
+    got = bst.predict(df2)
+    np.testing.assert_allclose(got, base, atol=1e-12)
+
+
+def test_unseen_categories_become_missing():
+    df, y = _frame()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                     "min_data_per_group": 5},
+                    lgb.Dataset(df, label=y), num_boost_round=4)
+    df3 = df.copy()
+    vals = ["purple"] + list(df["color"].astype(str))[1:]
+    df3["color"] = pd.Categorical(vals)
+    out = bst.predict(df3)
+    assert np.isfinite(out).all()
+
+
+def test_model_file_round_trip_keeps_categories(tmp_path):
+    df, y = _frame()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                     "min_data_per_group": 5},
+                    lgb.Dataset(df, label=y), num_boost_round=5)
+    f = str(tmp_path / "m.txt")
+    bst.save_model(f)
+    text = open(f).read()
+    assert "pandas_categorical:" in text
+    loaded = lgb.Booster(model_file=f)
+    assert loaded.pandas_categorical == [["red", "green", "blue"]]
+    np.testing.assert_allclose(loaded.predict(df), bst.predict(df),
+                               atol=1e-12)
+
+
+def test_validation_frame_aligns_to_training_categories():
+    df, y = _frame()
+    ds = lgb.Dataset(df, label=y)
+    dfv, yv = _frame(seed=5)
+    vd = ds.create_valid(dfv, label=yv)
+    bst = lgb.Booster({"objective": "binary", "metric": "auc",
+                       "num_leaves": 15, "verbose": -1,
+                       "min_data_per_group": 5}, ds)
+    bst.add_valid(vd, "v")
+    bst.update()
+    (name, metric, value, _), = bst.eval_valid()
+    assert np.isfinite(value)
+
+
+def test_pickle_keeps_pandas_categorical(tmp_path):
+    import pickle
+    df, y = _frame()
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "min_data_per_group": 5},
+                    lgb.Dataset(df, label=y), num_boost_round=3)
+    clone = pickle.loads(pickle.dumps(bst))
+    assert clone.pandas_categorical == bst.pandas_categorical
+    np.testing.assert_allclose(clone.predict(df), bst.predict(df), atol=1e-12)
+
+
+def test_int_categories_survive_model_round_trip(tmp_path):
+    rng = np.random.default_rng(8)
+    n = 400
+    codes = rng.integers(10, 16, n)                 # int-valued categories
+    df = pd.DataFrame({
+        "num0": rng.standard_normal(n),
+        "bucket": pd.Categorical(codes),
+    })
+    y = ((codes % 2 == 0) ^ (df["num0"].to_numpy() > 0)).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                     "min_data_per_group": 5},
+                    lgb.Dataset(df, label=y), num_boost_round=5)
+    f = str(tmp_path / "m.txt")
+    bst.save_model(f)
+    loaded = lgb.Booster(model_file=f)
+    np.testing.assert_allclose(loaded.predict(df), bst.predict(df),
+                               atol=1e-12)
+    # and through model_to_string too
+    via_str = lgb.Booster(model_str=bst.model_to_string())
+    assert via_str.pandas_categorical == loaded.pandas_categorical
+    np.testing.assert_allclose(via_str.predict(df), bst.predict(df),
+                               atol=1e-12)
+
+
+def test_numeric_only_dataframe_writes_no_pandas_line(tmp_path):
+    rng = np.random.default_rng(9)
+    df = pd.DataFrame({"a": rng.standard_normal(200),
+                       "b": rng.standard_normal(200)})
+    y = (df["a"].to_numpy() > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                    lgb.Dataset(df, label=y), num_boost_round=2)
+    f = str(tmp_path / "m.txt")
+    bst.save_model(f)
+    assert "pandas_categorical" not in open(f).read()
+
+
+def test_feature_name_mismatch_message():
+    df, y = _frame()
+    ds = lgb.Dataset(df, label=y, feature_name=["f0", "f1", "f2"])
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "min_data_per_group": 5}, ds, num_boost_round=2)
+    assert bst.num_trees() == 2  # positional fallback located the column
