@@ -254,6 +254,61 @@ class Dataset:
         if self._binned is not None:
             self._binned.metadata.set_init_score(init_score)
 
+    def get_init_score(self):
+        return self.binned.metadata.init_score
+
+    def get_field(self, field_name: str):
+        """Generic field accessor (reference Dataset.get_field)."""
+        if field_name == "label":
+            return self.get_label()
+        if field_name == "weight":
+            return self.get_weight()
+        if field_name == "init_score":
+            return self.get_init_score()
+        if field_name in ("group", "query"):
+            return self.get_group()
+        raise LightGBMError("Unknown field name: %s" % field_name)
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        if field_name == "label":
+            self.set_label(data)
+        elif field_name == "weight":
+            self.set_weight(data)
+        elif field_name == "init_score":
+            self.set_init_score(data)
+        elif field_name in ("group", "query"):
+            self.set_group(data)
+        else:
+            raise LightGBMError("Unknown field name: %s" % field_name)
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        if self._binned is not None and \
+                categorical_feature != self.categorical_feature:
+            raise LightGBMError(
+                "Cannot change categorical_feature after the dataset is "
+                "constructed; create a new Dataset")
+        self.categorical_feature = categorical_feature
+        return self
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        self.feature_name = feature_name
+        if self._binned is not None and feature_name != "auto":
+            if len(feature_name) != self._binned.num_features:
+                raise LightGBMError(
+                    "Length of feature names does not equal the number "
+                    "of features")
+            self._binned.feature_names = list(feature_name)
+        return self
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        if self._binned is not None and self.reference is not reference:
+            raise LightGBMError(
+                "Cannot set reference after the dataset is constructed; "
+                "create a new Dataset")
+        self.reference = reference
+        return self
+
     def subset(self, used_indices, params=None) -> "Dataset":
         idx = np.asarray(used_indices)
         X = _to_2d_float(self.data)[idx]
@@ -303,11 +358,8 @@ class Booster:
             self.pandas_categorical = train_set.pandas_categorical
         elif model_file is not None or model_str is not None:
             text = model_str if model_str is not None else open(model_file).read()
-            self._model = GBDTModel.load_model_from_string(text)
-            self.pandas_categorical = _load_pandas_categorical(text)
             self.config = Config(params)
-            self._objective = create_objective_from_model_string(
-                self._model.objective_str, self.config)
+            self._load_from_string(text)
         else:
             raise LightGBMError("Booster needs train_set or model file")
 
@@ -332,11 +384,14 @@ class Booster:
         self._engine = None
         self.train_set = None
         self._valid_data = []
-        self._model = GBDTModel.load_model_from_string(model_str) \
-            if model_str is not None else None
-        cfg = self.config if self.config is not None else Config({})
-        self._objective = create_objective_from_model_string(
-            self._model.objective_str, cfg) if self._model is not None else None
+        if model_str is not None:
+            pc = getattr(self, "pandas_categorical", None)
+            self._load_from_string(model_str)
+            if pc is not None:  # pickled attr wins (string has no line)
+                self.pandas_categorical = pc
+        else:
+            self._model = None
+            self._objective = None
 
     # -- training ------------------------------------------------------------
     def add_valid(self, data: Dataset, name: str) -> "Booster":
@@ -379,10 +434,127 @@ class Booster:
             return {}
         return dict(self._engine.timer.seconds)
 
+    # -- reference Booster surface parity ------------------------------------
+    def num_model_per_iteration(self) -> int:
+        return self._model.num_tree_per_iteration
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        return float(self._model.trees[tree_id].leaf_value[leaf_id])
+
+    def attr(self, key: str):
+        return getattr(self, "_attr", {}).get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        store = getattr(self, "_attr", None)
+        if store is None:
+            store = self._attr = {}
+        for k, v in kwargs.items():
+            if v is None:
+                store.pop(k, None)
+            elif isinstance(v, str):
+                store[k] = v
+            else:
+                raise LightGBMError("Only string values are accepted")
+        return self
+
+    def _load_from_string(self, model_str: str) -> None:
+        """The one load-from-string sequence shared by __init__,
+        __setstate__ and model_from_string."""
+        self._model = GBDTModel.load_model_from_string(model_str)
+        self.pandas_categorical = _load_pandas_categorical(model_str)
+        cfg = self.config if self.config is not None else Config({})
+        self._objective = create_objective_from_model_string(
+            self._model.objective_str, cfg)
+        self._model_version = getattr(self, "_model_version", 0) + 1
+
+    def model_from_string(self, model_str: str,
+                          verbose: bool = True) -> "Booster":
+        """Re-initialize from a model string (drops any training engine)."""
+        self._engine = None
+        self.train_set = None
+        self._load_from_string(model_str)
+        if verbose:
+            Log.info("Finished loading model, total used %d iterations",
+                     self._model.current_iteration)
+        return self
+
+    def shuffle_models(self, start_iteration: int = 0,
+                       end_iteration: int = -1) -> "Booster":
+        """Randomly permute tree order in [start, end) iterations
+        (reference Booster.shuffle_models)."""
+        k = self._model.num_tree_per_iteration
+        total = self._model.current_iteration
+        end = total if end_iteration <= 0 else min(end_iteration, total)
+        if not 0 <= start_iteration <= end:
+            raise LightGBMError(
+                "shuffle_models range [%d, %d) is invalid for a %d-iteration "
+                "model" % (start_iteration, end, total))
+        idx = np.arange(start_iteration, end)
+        np.random.shuffle(idx)
+        trees = self._model.trees
+        blocks = [trees[i * k:(i + 1) * k] for i in range(total)]
+        reordered = blocks[:start_iteration] + \
+            [blocks[i] for i in idx] + blocks[end:]
+        self._model.trees = [t for b in reordered for t in b]
+        self._model_version = getattr(self, "_model_version", 0) + 1
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        self._train_data_name = name
+        return self
+
+    def free_dataset(self) -> "Booster":
+        self.train_set = None
+        return self
+
+    def free_network(self) -> "Booster":
+        return self  # XLA owns transport; nothing to tear down
+
+    def set_network(self, *args, **kwargs) -> "Booster":
+        Log.warning("set_network is a no-op: XLA/ICI owns transport; "
+                    "launch with jax.distributed for multi-host")
+        return self
+
+    def __copy__(self) -> "Booster":
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, _) -> "Booster":
+        return Booster(model_str=self.model_to_string())
+
     def num_trees(self) -> int:
         return self._model.num_total_trees
 
     # -- evaluation ----------------------------------------------------------
+    def eval(self, data: Dataset, name: str, feval=None) -> List:
+        """Evaluate the current model on an arbitrary Dataset
+        (reference Booster.eval)."""
+        data.construct(self.config)
+        label = data.get_label()
+        if isinstance(data.data, str):
+            # path-backed Dataset: re-parse the raw matrix (construct()
+            # keeps only the binned form)
+            from .io.parser import parse_file
+            X, _ = parse_file(data.data)
+        else:
+            X = _to_2d_float(data.data,
+                             getattr(self, "pandas_categorical", None))
+        raw = self._model.predict_raw(X).T                   # [K, N]
+        metrics = create_metrics(self.config.metric, self.config) \
+            if self.config else []
+        out = []
+        qb = data.binned.metadata.query_boundaries
+        for m in metrics:
+            m.init(label, data.get_weight(), qb)
+            score = raw if getattr(m, "multiclass", False) else \
+                (raw[0] if raw.shape[0] == 1 else raw.reshape(-1))
+            out.append((name, m.name, float(m.eval(score, self._objective)),
+                        m.is_higher_better))
+        if feval is not None:
+            preds = raw[0] if raw.shape[0] == 1 else raw.reshape(-1)
+            mname, val, hib = feval(preds, data)
+            out.append((name, mname, val, hib))
+        return out
+
     def eval_train(self, feval=None) -> List:
         return self._wrap_eval(self._engine.eval_train(), feval, "training")
 
