@@ -138,8 +138,9 @@ class _FastState:
     scores, per-iteration grad/hess and the current tree's per-row output —
     lives in ONE row-major payload matrix that the partitioned grower
     reorders in place (rows of each leaf contiguous).  Everything downstream
-    of the grower becomes elementwise: gradients, score updates, bagging-free
-    count masks.  Original row order is recovered through the index column
+    of the grower becomes elementwise: gradients, score updates, and the
+    count-mask column (which doubles as the bagging mask, refreshed through
+    the index column on resample).  Original row order is recovered through the index column
     only when a consumer needs it (metrics, sync back to the legacy path).
     """
 
@@ -204,6 +205,16 @@ class _FastState:
             return payload.at[:n_pad, snap0:snap0 + K].set(
                 payload[:n_pad, score0:score0 + K])
 
+        idx_col = self.idx_col
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def set_bag(payload, combined):
+            """Refresh the count-mask column from an ORIGINAL-order
+            valid*bag vector — rows sit in partition order, so the index
+            column routes the gather (Bagging, gbdt.cpp:213-295)."""
+            idx = payload[:n_pad, idx_col].astype(jnp.int32)
+            return payload.at[:n_pad, cnt_col].set(combined[idx])
+
         def _fill_body(payload, k):
             """Write class k's gradients into the grad/hess columns —
             shared by the piecewise (profiled) and fused paths."""
@@ -251,6 +262,7 @@ class _FastState:
         self._fill_class = fill_class
         self._apply_score = apply_score
         self._step = step
+        self._set_bag = set_bag
 
     def reset(self, gbdt: "GBDT") -> None:
         """(Re)build the payload from the legacy-order state — used on first
@@ -260,6 +272,7 @@ class _FastState:
                                    gbdt.weight_dev, gbdt.valid_mask,
                                    gbdt.score)
         self.aux = jnp.zeros_like(self.payload)
+        self._bag_dirty = True  # cnt col holds the plain valid mask
 
     def raw_scores(self) -> np.ndarray:
         """[K, n_pad] scores in ORIGINAL row order (host)."""
@@ -618,17 +631,16 @@ class GBDT:
 
     # -- one boosting iteration (gbdt.cpp:387-482) ---------------------------
     def _fast_eligible(self) -> bool:
-        """The partition-ordered fast path covers the plain serial GBDT:
-        row-wise objective (gradients independent of row order), no
-        leaf-output renewal, no bagging subsample, index column exact in
-        f32.  Everything else keeps the legacy masked grower."""
+        """The partition-ordered fast path covers the plain serial GBDT
+        (with or without bagging): row-wise objective (gradients
+        independent of row order), no leaf-output renewal, index column
+        exact in f32.  Everything else keeps the legacy masked grower."""
         cfg = self.config
         return (type(self) is GBDT
                 and self.mesh is None
                 and self.objective is not None
                 and getattr(self.objective, "is_rowwise", True)
                 and not self.objective.renew_tree_output_required()
-                and not (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0)
                 and self.train_set.num_data_padded < (1 << 24))
 
     def _fast_sync_back(self) -> None:
@@ -649,6 +661,21 @@ class GBDT:
             self._fast_active = True
         fs = self._fast
         fmask = self._feature_sample()
+        cfg = self.config
+        if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
+            # same RNG stream as the masked path, so both paths draw
+            # identical bags (equality-testable).  The cnt column rides
+            # the partition, so only an actual resample (or a rebuilt
+            # payload) needs the gather+scatter refresh.
+            resampled = self.iter % cfg.bagging_freq == 0
+            with self.timer.phase("bagging"):
+                bag = self._bagging()    # advances the RNG on resample
+                if resampled or fs._bag_dirty:
+                    # bag_mask_host is already zero on padded rows
+                    fs.payload = fs._set_bag(fs.payload,
+                                             bag.astype(jnp.float32))
+                    fs._bag_dirty = False
+                self.timer.sync(fs.payload)
         if fs.K > 1:
             fs.payload = fs._snap_scores(fs.payload)
 
@@ -701,9 +728,9 @@ class GBDT:
         if self.forced_schedule is not None and \
                 not getattr(self, "_warned_forced_legacy", False):
             Log.warning("forcedsplits_filename is honored only by the "
-                        "serial fast path; this configuration (bagging / "
-                        "custom objective / parallel learner / renewal "
-                        "objective) trains WITHOUT forced splits")
+                        "serial fast path; this configuration (custom "
+                        "objective / parallel learner / renewal objective / "
+                        "GOSS) trains WITHOUT forced splits")
             self._warned_forced_legacy = True
         init_score = 0.0
         with self.timer.phase("boosting (gradients)"):

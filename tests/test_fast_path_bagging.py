@@ -1,0 +1,87 @@
+"""Bagging on the partition-ordered fast path must match the masked
+grower bit-for-bit (same RNG stream -> same bags)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.boosting.gbdt import GBDT
+
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+          "bagging_freq": 2, "bagging_fraction": 0.7, "seed": 7,
+          "min_data_in_leaf": 5}
+
+
+def _data(n=700, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, 6)).astype(np.float32)
+    return X, (X[:, 0] + 0.4 * X[:, 1] > 0).astype(np.float64)
+
+
+def test_fast_path_active_with_bagging():
+    X, y = _data()
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=6)
+    assert bst._engine._fast_active
+    assert bst.num_trees() == 6
+    acc = np.mean((bst.predict(X) > 0.5) == (y > 0.5))
+    assert acc > 0.85
+
+
+def _assert_models_match(fast, slow, X):
+    """Identical structure (same bags -> same splits); values may differ in
+    the last f32 ulps because the fast path accumulates gradient sums in
+    partition order rather than original row order."""
+    df, ds = fast.dump_model(), slow.dump_model()
+    assert len(df["tree_info"]) == len(ds["tree_info"])
+
+    def walk(a, b):
+        assert ("split_feature" in a) == ("split_feature" in b)
+        if "split_feature" in a:
+            assert a["split_feature"] == b["split_feature"]
+            assert a["threshold"] == pytest.approx(b["threshold"], rel=1e-6)
+            assert a["internal_count"] == b["internal_count"]
+            walk(a["left_child"], b["left_child"])
+            walk(a["right_child"], b["right_child"])
+        else:
+            assert a["leaf_count"] == b["leaf_count"]
+            assert a["leaf_value"] == pytest.approx(b["leaf_value"],
+                                                    rel=1e-4, abs=1e-7)
+
+    for tf, ts in zip(df["tree_info"], ds["tree_info"]):
+        walk(tf["tree_structure"], ts["tree_structure"])
+    np.testing.assert_allclose(fast.predict(X), slow.predict(X),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_bagging_fast_equals_masked(monkeypatch):
+    X, y = _data()
+    fast = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
+                     num_boost_round=6)
+    assert fast._engine._fast_active
+    monkeypatch.setattr(GBDT, "_fast_eligible", lambda self: False)
+    slow = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
+                     num_boost_round=6)
+    assert not slow._engine._fast_active
+    _assert_models_match(fast, slow, X)
+
+
+def test_bagging_multiclass_fast_equals_masked(monkeypatch):
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((600, 5)).astype(np.float32)
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0.6)).astype(float)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "verbose": -1, "bagging_freq": 1, "bagging_fraction": 0.6,
+              "seed": 3, "min_data_in_leaf": 5}
+    fast = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=4)
+    assert fast._engine._fast_active
+    monkeypatch.setattr(GBDT, "_fast_eligible", lambda self: False)
+    slow = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=4)
+    # multiclass gain ties can break differently across engines under f32
+    # summation-order noise, so compare QUALITY, not per-node structure
+    assert fast.num_trees() == slow.num_trees()
+    acc_f = np.mean(np.argmax(fast.predict(X), 1) == y)
+    acc_s = np.mean(np.argmax(slow.predict(X), 1) == y)
+    assert acc_f >= acc_s - 0.02
+    assert acc_f > 0.8
