@@ -276,6 +276,25 @@ int Fail(const std::string& msg) {
   return -1;
 }
 
+// one row's scores/leaf-indices — shared by the dense and CSR entry points
+void PredictRow(const Model& m, const double* row, int predict_type,
+                int iters, int used_trees, double* out_row) {
+  int k = m.num_tree_per_iteration;
+  if (predict_type == C_API_PREDICT_LEAF_INDEX) {
+    for (int t = 0; t < used_trees; ++t)
+      out_row[t] = m.trees[t].PredictLeafIndex(row);
+    return;
+  }
+  for (int j = 0; j < k; ++j) out_row[j] = 0.0;
+  for (int t = 0; t < used_trees; ++t)
+    out_row[t % k] += m.trees[t].Predict(row);
+  if (m.average_output) {
+    for (int j = 0; j < k; ++j) out_row[j] /= iters;
+  } else if (predict_type == C_API_PREDICT_NORMAL) {
+    ApplyTransform(m, out_row);
+  }
+}
+
 Model* AsModel(BoosterHandle h) { return static_cast<Model*>(h); }
 
 int LoadModel(const std::string& text, int* out_num_iterations,
@@ -379,34 +398,78 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
     return static_cast<const double*>(data)[idx];
   };
 
+  bool leaf = predict_type == C_API_PREDICT_LEAF_INDEX;
+  if (!leaf && predict_type != C_API_PREDICT_NORMAL &&
+      predict_type != C_API_PREDICT_RAW_SCORE)
+    return Fail("unsupported predict_type " + std::to_string(predict_type));
+  int64_t width = leaf ? used_trees : k;
   std::vector<double> row(ncol);
-  if (predict_type == C_API_PREDICT_LEAF_INDEX) {
-    for (int32_t r = 0; r < nrow; ++r) {
-      for (int32_t c = 0; c < ncol; ++c) row[c] = at(r, c);
-      for (int t = 0; t < used_trees; ++t)
-        out_result[static_cast<int64_t>(r) * used_trees + t] =
-            m->trees[t].PredictLeafIndex(row.data());
-    }
-    *out_len = static_cast<int64_t>(nrow) * used_trees;
-    return 0;
+  for (int32_t r = 0; r < nrow; ++r) {
+    for (int32_t c = 0; c < ncol; ++c) row[c] = at(r, c);
+    PredictRow(*m, row.data(), predict_type, iters, used_trees,
+               out_result + r * width);
   }
-  if (predict_type != C_API_PREDICT_NORMAL &&
+  *out_len = static_cast<int64_t>(nrow) * width;
+  return 0;
+}
+
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem, int64_t num_col,
+                              int predict_type, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result) {
+  (void)parameter;
+  (void)nelem;
+  Model* m = AsModel(handle);
+  if (indptr_type != C_API_DTYPE_INT32 && indptr_type != C_API_DTYPE_INT64)
+    return Fail("indptr_type must be C_API_DTYPE_INT32/INT64, got " +
+                std::to_string(indptr_type));
+  if (data_type != C_API_DTYPE_FLOAT32 && data_type != C_API_DTYPE_FLOAT64)
+    return Fail("data_type must be C_API_DTYPE_FLOAT32/FLOAT64, got " +
+                std::to_string(data_type));
+  int nfeat = m->max_feature_idx + 1;
+  if (num_col < nfeat)
+    return Fail("CSR has " + std::to_string(num_col) +
+                " columns, model needs " + std::to_string(nfeat));
+  int k = m->num_tree_per_iteration;
+  int iters = m->NumIterations();
+  if (num_iteration > 0 && num_iteration < iters) iters = num_iteration;
+  int used_trees = iters * k;
+  int64_t nrow = nindptr - 1;
+
+  auto row_range = [&](int64_t r, int64_t* b, int64_t* e) {
+    if (indptr_type == C_API_DTYPE_INT32) {
+      *b = static_cast<const int32_t*>(indptr)[r];
+      *e = static_cast<const int32_t*>(indptr)[r + 1];
+    } else {
+      *b = static_cast<const int64_t*>(indptr)[r];
+      *e = static_cast<const int64_t*>(indptr)[r + 1];
+    }
+  };
+  auto val = [&](int64_t i) -> double {
+    if (data_type == C_API_DTYPE_FLOAT32)
+      return static_cast<const float*>(data)[i];
+    return static_cast<const double*>(data)[i];
+  };
+
+  std::vector<double> row(num_col, 0.0);
+  bool leaf = predict_type == C_API_PREDICT_LEAF_INDEX;
+  if (!leaf && predict_type != C_API_PREDICT_NORMAL &&
       predict_type != C_API_PREDICT_RAW_SCORE)
     return Fail("unsupported predict_type " + std::to_string(predict_type));
 
-  for (int32_t r = 0; r < nrow; ++r) {
-    for (int32_t c = 0; c < ncol; ++c) row[c] = at(r, c);
-    double* out_row = out_result + static_cast<int64_t>(r) * k;
-    for (int j = 0; j < k; ++j) out_row[j] = 0.0;
-    for (int t = 0; t < used_trees; ++t)
-      out_row[t % k] += m->trees[t].Predict(row.data());
-    if (m->average_output) {
-      for (int j = 0; j < k; ++j) out_row[j] /= iters;
-    } else if (predict_type == C_API_PREDICT_NORMAL) {
-      ApplyTransform(*m, out_row);
-    }
+  int64_t width = leaf ? used_trees : k;
+  for (int64_t r = 0; r < nrow; ++r) {
+    int64_t b, e;
+    row_range(r, &b, &e);
+    for (int64_t i = b; i < e; ++i) row[indices[i]] = val(i);
+    PredictRow(*m, row.data(), predict_type, iters, used_trees,
+               out_result + r * width);
+    for (int64_t i = b; i < e; ++i) row[indices[i]] = 0.0;  // reset touched
   }
-  *out_len = static_cast<int64_t>(nrow) * k;
+  *out_len = nrow * width;
   return 0;
 }
 

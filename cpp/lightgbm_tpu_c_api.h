@@ -68,6 +68,21 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
                               int num_iteration, const char* parameter,
                               int64_t* out_len, double* out_result);
 
+/* Sparse (CSR) prediction: indptr[nindptr] row offsets (int32 or int64 by
+ * indptr_type using the C_API_DTYPE_* int codes below), indices[nelem]
+ * column ids, data[nelem] values.  Absent entries are 0.0 (missing-zero
+ * semantics apply).  num_col must cover the model's feature count. */
+#define C_API_DTYPE_INT32 (2)
+#define C_API_DTYPE_INT64 (3)
+
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem, int64_t num_col,
+                              int predict_type, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Run when the axon TPU tunnel comes back: validates everything that could
+# not be hardware-tested while it was down, then takes a bench reading.
+set -e
+cd "$(dirname "$0")/.."
+echo "=== 1. kernels exact vs portable (incl. the 2-pass partition) ==="
+timeout 400 python exp/smoke_tpu_kernels.py 2>&1 | grep -vE "WARN|INFO|libtpu|common_lib|Failed to find|Logging" | tail -8
+echo "=== 2. grower profile (fixed cost + scaling) ==="
+timeout 500 python exp/prof_grow_small.py 2>&1 | grep "grow:" || true
+echo "=== 3. bench at 2M rows ==="
+BENCH_ROWS=2000000 BENCH_TEST_ROWS=200000 BENCH_ITERS=10 timeout 550 python bench.py 2>&1 | grep '"metric"'

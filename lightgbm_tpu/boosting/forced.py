@@ -35,6 +35,40 @@ def load_forced_json(path: str):
         return json.load(fh)
 
 
+def make_forced_machinery(forced: "ForcedSchedule", meta, cfg):
+    """Device arrays + the override closure shared by both growers.
+
+    Returns (lnext, rnext, forced_override): the BFS child-link arrays the
+    growers thread through their state, and forced_override(rank,
+    hist_fview, sg, sh, sc, normal_res) -> (result, real_gain,
+    surviving_rank)."""
+    import jax.numpy as jnp
+
+    from ..ops.split import SplitResult, evaluate_split_at
+
+    fc_feat = jnp.asarray(forced.feat, jnp.int32)
+    fc_bin = jnp.asarray(forced.bin, jnp.int32)
+    fc_gain = jnp.asarray(forced.gain, jnp.float32)
+    fc_lnext = jnp.asarray(forced.lnext, jnp.int32)
+    fc_rnext = jnp.asarray(forced.rnext, jnp.int32)
+
+    def forced_override(rank, hist_fview, sg, sh, sc, normal_res):
+        r0 = jnp.maximum(rank, 0)
+        fres = evaluate_split_at(
+            hist_fview, sg, sh, sc, fc_feat[r0], fc_bin[r0], meta=meta,
+            l1=cfg.lambda_l1, l2=cfg.lambda_l2,
+            max_delta_step=cfg.max_delta_step,
+            min_data_in_leaf=cfg.min_data_in_leaf,
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf)
+        use = (rank >= 0) & jnp.isfinite(fres.gain)
+        real = jnp.where(use, fres.gain, normal_res.gain)
+        res = SplitResult(*[jnp.where(use, a, b) for a, b in
+                            zip(fres._replace(gain=fc_gain[r0]), normal_res)])
+        return res, real, jnp.where(use, rank, -1)
+
+    return fc_lnext, fc_rnext, forced_override
+
+
 def build_forced_schedule(root_json, bin_mappers,
                           num_leaves: int) -> Optional[ForcedSchedule]:
     """Compile the forced-split JSON into a ForcedSchedule (BFS ranks).

@@ -60,15 +60,15 @@ def _bundle_key(ds: BinnedDataset):
 
 
 def _cached_grower(meta_dev: FeatureMeta, cfg, max_num_bin: int, ds: BinnedDataset,
-                   bundle_map=None):
-    key = (cfg, max_num_bin, ds.bins.shape, _bundle_key(ds),
+                   bundle_map=None, forced=None):
+    key = (cfg, max_num_bin, ds.bins.shape, _bundle_key(ds), forced,
            tuple((m.num_bin, m.missing_type, m.default_bin, m.is_trivial, m.bin_type)
                  for m in ds.bin_mappers),
            ds.monotone_constraints.tobytes(), ds.feature_penalty.tobytes())
     grower = _GROWER_CACHE.get(key)
     if grower is None:
         grower = make_tree_grower(meta_dev, cfg, max_num_bin,
-                                  bundle_map=bundle_map)
+                                  bundle_map=bundle_map, forced=forced)
         _GROWER_CACHE[key] = grower
     return grower
 
@@ -474,7 +474,9 @@ class GBDT:
                                      train_set.max_num_bin, train_set,
                                      bundle_map=self.bundle_map
                                      if train_set.bundle_info is not None
-                                     else None)
+                                     else None,
+                                     forced=self.forced_schedule
+                                     if self.parallel_mode is None else None)
         # partition-ordered fast path (built lazily on first eligible iter;
         # the state object survives sync-backs so re-entry never retraces)
         self._fast: Optional[_FastState] = None
@@ -725,12 +727,11 @@ class GBDT:
         if grad is None and hess is None and self._fast_eligible():
             return self._train_one_iter_fast()
         self._fast_sync_back()
-        if self.forced_schedule is not None and \
-                not getattr(self, "_warned_forced_legacy", False):
-            Log.warning("forcedsplits_filename is honored only by the "
-                        "serial fast path; this configuration (custom "
-                        "objective / parallel learner / renewal objective / "
-                        "GOSS) trains WITHOUT forced splits")
+        if self.forced_schedule is not None and self.parallel_mode is not None \
+                and not getattr(self, "_warned_forced_legacy", False):
+            Log.warning("forcedsplits_filename is honored by the serial "
+                        "learners only; the parallel tree learners train "
+                        "WITHOUT forced splits")
             self._warned_forced_legacy = True
         init_score = 0.0
         with self.timer.phase("boosting (gradients)"):

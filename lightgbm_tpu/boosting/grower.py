@@ -63,7 +63,7 @@ class GrowerConfig(NamedTuple):
 def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
                      axis_name: str = None, jit: bool = True,
                      mode: str = "data", num_machines: int = 1,
-                     top_k: int = 20, bundle_map=None):
+                     top_k: int = 20, bundle_map=None, forced=None):
     """Returns grow(bins[F,N], vals[N,3], feature_mask[F]) -> tree arrays dict,
     jit-compiled once per (shape, config).
 
@@ -96,6 +96,12 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
     bundled = bundle_map is not None
     assert not (bundled and axis_name is not None), \
         "EFB-bundled datasets train with the serial learner"
+    assert not (forced is not None and axis_name is not None), \
+        "forced splits run on the serial learners only"
+    if forced is not None:
+        from .forced import PRIORITY_UNIT, make_forced_machinery
+        fc_lnext, fc_rnext, forced_override = \
+            make_forced_machinery(forced, meta, cfg)
 
     def hist_view(h):
         """[G, B, 3] bundle histogram -> [F, B, 3] split view (EFB)."""
@@ -258,6 +264,13 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
                             row_chunk=cfg.row_chunk))
         res0 = find_split(hist_root, root_g, root_h, root_c, feature_mask)
 
+        real0 = res0.gain
+        root_rank = jnp.int32(-1)
+        if forced is not None:
+            res0, real0, root_rank = forced_override(
+                jnp.int32(0), hist_view(hist_root), root_g, root_h, root_c,
+                res0)
+
         ni = max(L - 1, 1)
         leaf_id0 = jnp.zeros(N, jnp.int32)
         if axis_name and not feature_mode:
@@ -302,6 +315,10 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
             "num_leaves": jnp.int32(1),
             "done": jnp.bool_(False),
         }
+        if forced is not None:
+            state["fleaf"] = jnp.full(L, -1, jnp.int32).at[0].set(root_rank)
+            state["breal"] = jnp.full(L, K_MIN_SCORE,
+                                      jnp.float32).at[0].set(real0)
 
         def body(s, st):
             best_leaf = jnp.argmax(st["bgain"]).astype(jnp.int32)
@@ -367,6 +384,18 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
             child_depth = st["leaf_depth"][best_leaf] + 1
             res_l = find_split(new_left, lg, lh, lcnt, feature_mask)
             res_r = find_split(new_right, rg, rh, rcnt, feature_mask)
+            real_l, real_r = res_l.gain, res_r.gain
+            if forced is not None:
+                jp = st["fleaf"][best_leaf]
+                applied = (jp >= 0) & \
+                    (st["bgain"][best_leaf] >= 0.5 * PRIORITY_UNIT)
+                jp0 = jnp.maximum(jp, 0)
+                jl = jnp.where(applied, fc_lnext[jp0], -1)
+                jr = jnp.where(applied, fc_rnext[jp0], -1)
+                res_l, real_l, jl = forced_override(
+                    jl, hist_view(new_left), lg, lh, lcnt, res_l)
+                res_r, real_r, jr = forced_override(
+                    jr, hist_view(new_right), rg, rh, rcnt, res_r)
             if cfg.max_depth > 0:
                 depth_ok = child_depth < cfg.max_depth
             else:
@@ -401,14 +430,18 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
             st_new["leaf_val"] = set2(st["leaf_val"], st["blo"][best_leaf],
                                       st["bro"][best_leaf])
             st_new["leaf_depth"] = set2(st["leaf_depth"], child_depth, child_depth)
+            if forced is not None:
+                st_new["fleaf"] = set2(st["fleaf"], jl, jr)
+                st_new["breal"] = set2(st["breal"], real_l, real_r)
 
             # -- record the internal node (Tree::Split, tree.h:404-448) -------
             def setn(arr, v):
                 return arr.at[node].set(jnp.where(do, v, arr[node]))
 
+            gain_rec = st["breal"][best_leaf] if forced is not None else gain
             st_new["split_feature"] = setn(st["split_feature"], f)
             st_new["split_bin"] = setn(st["split_bin"], t)
-            st_new["split_gain"] = setn(st["split_gain"], gain)
+            st_new["split_gain"] = setn(st["split_gain"], gain_rec)
             st_new["default_left"] = setn(st["default_left"], dl)
             st_new["split_is_cat"] = setn(st["split_is_cat"], cat)
             st_new["split_cat_bitset"] = st["split_cat_bitset"].at[node].set(

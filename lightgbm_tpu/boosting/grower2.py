@@ -31,18 +31,11 @@ from jax import lax
 
 from ..ops.bundle import BundleMap, expand_histogram, identity_bundle_map
 from ..ops.split import (FeatureMeta, K_MIN_SCORE, SplitResult,
-                         evaluate_split_at, find_best_split, leaf_output)
+                         find_best_split, leaf_output)
 from ..ops import segment as seg
 from ..ops.segment import SplitPredicate
 from .forced import PRIORITY_UNIT, ForcedSchedule
 from .grower import GrowerConfig
-
-
-def _select_split(use, forced_res: SplitResult,
-                  normal_res: SplitResult) -> SplitResult:
-    """Field-wise where(use, forced, normal) over two SplitResults."""
-    return SplitResult(*[jnp.where(use, a, b)
-                         for a, b in zip(forced_res, normal_res)])
 
 
 class PayloadCols(NamedTuple):
@@ -125,27 +118,9 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
     assert POOL >= 2, "histogram pool needs at least 2 slots"
 
     if forced is not None:
-        fc_feat = jnp.asarray(forced.feat, jnp.int32)
-        fc_bin = jnp.asarray(forced.bin, jnp.int32)
-        fc_gain = jnp.asarray(forced.gain, jnp.float32)
-        fc_lnext = jnp.asarray(forced.lnext, jnp.int32)
-        fc_rnext = jnp.asarray(forced.rnext, jnp.int32)
-        eval_at = functools.partial(
-            evaluate_split_at, meta=meta, l1=cfg.lambda_l1, l2=cfg.lambda_l2,
-            max_delta_step=cfg.max_delta_step,
-            min_data_in_leaf=cfg.min_data_in_leaf,
-            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf)
-
-        def forced_override(rank, hist_fview, sg, sh, sc, normal_res):
-            """(result, real_gain, surviving_rank) for a leaf whose pending
-            forced rank is `rank` (-1 = none); infeasible -> fall back."""
-            r0 = jnp.maximum(rank, 0)
-            fres = eval_at(hist_fview, sg, sh, sc, fc_feat[r0], fc_bin[r0])
-            use = (rank >= 0) & jnp.isfinite(fres.gain)
-            real = jnp.where(use, fres.gain, normal_res.gain)
-            res = _select_split(use, fres._replace(gain=fc_gain[r0]),
-                                normal_res)
-            return res, real, jnp.where(use, rank, -1)
+        from .forced import make_forced_machinery
+        fc_lnext, fc_rnext, forced_override = \
+            make_forced_machinery(forced, meta, cfg)
 
     def grow(payload: jax.Array, aux: jax.Array,
              feature_mask: jax.Array):

@@ -137,3 +137,40 @@ def test_golden_model_loads(capi):
     pred = nb.predict(data[:, 1:])
     ref = np.loadtxt(os.path.join(os.path.dirname(golden), "golden_pred.txt"))
     np.testing.assert_allclose(pred, ref, atol=1e-10)
+
+
+def test_csr_prediction_matches_dense(capi, tmp_path):
+    import ctypes
+    rng = np.random.default_rng(7)
+    n, f = 300, 8
+    X = rng.standard_normal((n, f))
+    X[rng.random(X.shape) < 0.6] = 0.0          # sparse-ish
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    bst = _train({"objective": "binary"}, X, y, rounds=6)
+    nb, _ = _roundtrip(capi, bst, X, tmp_path, "csr")
+
+    # build CSR by hand
+    indptr = [0]
+    indices, vals = [], []
+    for r in range(n):
+        nz = np.nonzero(X[r])[0]
+        indices.extend(nz.tolist())
+        vals.extend(X[r, nz].tolist())
+        indptr.append(len(indices))
+    indptr = np.asarray(indptr, np.int32)
+    indices = np.asarray(indices, np.int32)
+    vals = np.asarray(vals, np.float64)
+
+    lib = capi.load_lib()
+    out = np.zeros(n, np.float64)
+    out_len = ctypes.c_int64(0)
+    rc = lib.LGBM_BoosterPredictForCSR(
+        nb._handle, indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(vals)),
+        ctypes.c_int64(f), 0, -1, b"", ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0, lib.LGBM_GetLastError()
+    assert out_len.value == n
+    np.testing.assert_allclose(out, nb.predict(X), atol=1e-15)

@@ -116,3 +116,34 @@ def test_schedule_builder_bfs_ranks():
     assert sched.lnext[0] == 1 and sched.rnext[0] == 2
     assert sched.rnext[1] == 3 and sched.lnext[1] == -1
     assert sched.gain[0] > sched.gain[1] > sched.gain[3] > 0
+
+
+def test_forced_splits_on_masked_grower_goss(tmp_path):
+    """GOSS runs on the legacy masked grower; forced splits must hold there
+    too (serial_tree_learner.cpp ForceSplits is learner-agnostic)."""
+    X, y = _data()
+    fpath = tmp_path / "forced.json"
+    fpath.write_text(json.dumps({"feature": 4, "threshold": 0.0,
+                                 "left": {"feature": 1, "threshold": -0.5}}))
+    params = {"objective": "binary", "num_leaves": 16, "min_data_in_leaf": 5,
+              "verbose": -1, "boosting": "goss",
+              "forcedsplits_filename": str(fpath)}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=2)
+    assert not bst._engine._fast_active
+    for t in bst.dump_model()["tree_info"]:
+        root = t["tree_structure"]
+        assert root["split_feature"] == 4
+        assert root["left_child"].get("split_feature") == 1
+
+
+def test_forced_splits_with_bagging_fast_path(tmp_path):
+    X, y = _data()
+    fpath = tmp_path / "forced.json"
+    fpath.write_text(json.dumps({"feature": 2, "threshold": 0.1}))
+    params = {"objective": "binary", "num_leaves": 16, "min_data_in_leaf": 5,
+              "verbose": -1, "bagging_freq": 1, "bagging_fraction": 0.7,
+              "forcedsplits_filename": str(fpath)}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=2)
+    assert bst._engine._fast_active
+    for t in bst.dump_model()["tree_info"]:
+        assert t["tree_structure"]["split_feature"] == 2
