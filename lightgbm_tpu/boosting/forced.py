@@ -52,14 +52,16 @@ def make_forced_machinery(forced: "ForcedSchedule", meta, cfg):
     fc_lnext = jnp.asarray(forced.lnext, jnp.int32)
     fc_rnext = jnp.asarray(forced.rnext, jnp.int32)
 
-    def forced_override(rank, hist_fview, sg, sh, sc, normal_res):
+    def forced_override(rank, hist_fview, sg, sh, sc, normal_res,
+                        min_constraint=None, max_constraint=None):
         r0 = jnp.maximum(rank, 0)
         fres = evaluate_split_at(
             hist_fview, sg, sh, sc, fc_feat[r0], fc_bin[r0], meta=meta,
             l1=cfg.lambda_l1, l2=cfg.lambda_l2,
             max_delta_step=cfg.max_delta_step,
             min_data_in_leaf=cfg.min_data_in_leaf,
-            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf)
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+            min_constraint=min_constraint, max_constraint=max_constraint)
         use = (rank >= 0) & jnp.isfinite(fres.gain)
         real = jnp.where(use, fres.gain, normal_res.gain)
         res = SplitResult(*[jnp.where(use, a, b) for a, b in

@@ -53,11 +53,29 @@ class GrowerConfig(NamedTuple):
     # segment-engine implementation for the partitioned grower
     # (Config.tpu_histogram_impl): "auto" | "pallas" | "lax"
     hist_impl: str = "auto"
+    # any feature carries a monotone constraint: per-leaf value bounds are
+    # tracked and propagated through monotone splits (LeafSplits
+    # min/max_constraint, serial_tree_learner.cpp:765-777)
+    with_monotone: bool = False
     # histogram pool slots for the partitioned grower (reference
     # HistogramPool, feature_histogram.hpp:655-826, histogram_pool_size
     # param): 0 = one slot per leaf (unbounded); otherwise LRU-evicted
     # cache with recompute-on-miss over the leaf's row segment
     hist_pool_slots: int = 0
+
+
+def propagate_monotone_bounds(blo, bro, is_num, mono_f, pmin, pmax):
+    """Children's value bounds after a split (serial_tree_learner.cpp:
+    765-777): inherit the parent's, and a numerical split on a monotone
+    feature pins the shared boundary at the midpoint of the split outputs.
+    Tightened (max/min), never replaced, so an out-of-bounds midpoint
+    (possible for forced splits) cannot loosen a child's bounds."""
+    mid = (blo + bro) * 0.5
+    lmin = jnp.where(is_num & (mono_f < 0), jnp.maximum(mid, pmin), pmin)
+    lmax = jnp.where(is_num & (mono_f > 0), jnp.minimum(mid, pmax), pmax)
+    rmin = jnp.where(is_num & (mono_f > 0), jnp.maximum(mid, pmin), pmin)
+    rmax = jnp.where(is_num & (mono_f < 0), jnp.minimum(mid, pmax), pmax)
+    return lmin, lmax, rmin, rmax
 
 
 def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
@@ -102,6 +120,10 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
         from .forced import PRIORITY_UNIT, make_forced_machinery
         fc_lnext, fc_rnext, forced_override = \
             make_forced_machinery(forced, meta, cfg)
+    # per-leaf value-bound propagation runs on the serial learners; the
+    # parallel learners keep the pairwise output-ordering check only (the
+    # packed SplitInfo allreduce does not carry bounds)
+    with_mono = cfg.with_monotone and axis_name is None
 
     def hist_view(h):
         """[G, B, 3] bundle histogram -> [F, B, 3] split view (EFB)."""
@@ -250,8 +272,9 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
                 return res._replace(feature=sel[res.feature])
 
         else:
-            def find_split(hist, sg, sh, cnt, fmask):
-                return find(hist_view(hist), sg, sh, cnt, fmask)
+            def find_split(hist, sg, sh, cnt, fmask, **constraints):
+                return find(hist_view(hist), sg, sh, cnt, fmask,
+                            **constraints)
 
         totals = jnp.sum(vals, axis=0)
         if axis_name and not feature_mode:
@@ -262,7 +285,13 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
         hist_root = reduce_hist(
             build_histogram(hist_bins, vals, num_bins=B,
                             row_chunk=cfg.row_chunk))
-        res0 = find_split(hist_root, root_g, root_h, root_c, feature_mask)
+        if with_mono:
+            res0 = find_split(hist_root, root_g, root_h, root_c,
+                              feature_mask,
+                              min_constraint=jnp.float32(-jnp.inf),
+                              max_constraint=jnp.float32(jnp.inf))
+        else:
+            res0 = find_split(hist_root, root_g, root_h, root_c, feature_mask)
 
         real0 = res0.gain
         root_rank = jnp.int32(-1)
@@ -319,6 +348,9 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
             state["fleaf"] = jnp.full(L, -1, jnp.int32).at[0].set(root_rank)
             state["breal"] = jnp.full(L, K_MIN_SCORE,
                                       jnp.float32).at[0].set(real0)
+        if with_mono:
+            state["mincon"] = jnp.full(L, -jnp.inf, jnp.float32)
+            state["maxcon"] = jnp.full(L, jnp.inf, jnp.float32)
 
         def body(s, st):
             best_leaf = jnp.argmax(st["bgain"]).astype(jnp.int32)
@@ -382,8 +414,19 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
 
             # -- best splits of the two children ------------------------------
             child_depth = st["leaf_depth"][best_leaf] + 1
-            res_l = find_split(new_left, lg, lh, lcnt, feature_mask)
-            res_r = find_split(new_right, rg, rh, rcnt, feature_mask)
+            if with_mono:
+                lmin, lmax, rmin, rmax = propagate_monotone_bounds(
+                    st["blo"][best_leaf], st["bro"][best_leaf], ~cat,
+                    meta.monotone[f], st["mincon"][best_leaf],
+                    st["maxcon"][best_leaf])
+                res_l = find_split(new_left, lg, lh, lcnt, feature_mask,
+                                   min_constraint=lmin, max_constraint=lmax)
+                res_r = find_split(new_right, rg, rh, rcnt, feature_mask,
+                                   min_constraint=rmin, max_constraint=rmax)
+            else:
+                lmin = lmax = rmin = rmax = None
+                res_l = find_split(new_left, lg, lh, lcnt, feature_mask)
+                res_r = find_split(new_right, rg, rh, rcnt, feature_mask)
             real_l, real_r = res_l.gain, res_r.gain
             if forced is not None:
                 jp = st["fleaf"][best_leaf]
@@ -393,9 +436,11 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
                 jl = jnp.where(applied, fc_lnext[jp0], -1)
                 jr = jnp.where(applied, fc_rnext[jp0], -1)
                 res_l, real_l, jl = forced_override(
-                    jl, hist_view(new_left), lg, lh, lcnt, res_l)
+                    jl, hist_view(new_left), lg, lh, lcnt, res_l,
+                    min_constraint=lmin, max_constraint=lmax)
                 res_r, real_r, jr = forced_override(
-                    jr, hist_view(new_right), rg, rh, rcnt, res_r)
+                    jr, hist_view(new_right), rg, rh, rcnt, res_r,
+                    min_constraint=rmin, max_constraint=rmax)
             if cfg.max_depth > 0:
                 depth_ok = child_depth < cfg.max_depth
             else:
@@ -433,6 +478,9 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
             if forced is not None:
                 st_new["fleaf"] = set2(st["fleaf"], jl, jr)
                 st_new["breal"] = set2(st["breal"], real_l, real_r)
+            if with_mono:
+                st_new["mincon"] = set2(st["mincon"], lmin, rmin)
+                st_new["maxcon"] = set2(st["maxcon"], lmax, rmax)
 
             # -- record the internal node (Tree::Split, tree.h:404-448) -------
             def setn(arr, v):

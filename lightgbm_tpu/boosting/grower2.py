@@ -131,8 +131,13 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         # root totals fall out of the histogram — no separate full-data pass
         totals = jnp.sum(hist_root[0], axis=0)
         root_g, root_h, root_c = totals[0], totals[1], totals[2]
-        res0 = find(hist_view(hist_root), root_g, root_h, root_c,
-                    feature_mask)
+        if cfg.with_monotone:
+            res0 = find(hist_view(hist_root), root_g, root_h, root_c,
+                        feature_mask, min_constraint=jnp.float32(-jnp.inf),
+                        max_constraint=jnp.float32(jnp.inf))
+        else:
+            res0 = find(hist_view(hist_root), root_g, root_h, root_c,
+                        feature_mask)
 
         # rows start as one root segment with the root Newton step as the
         # per-row output (covers the unsplittable-stump case)
@@ -192,6 +197,9 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             state["fleaf"] = jnp.full(L, -1, jnp.int32).at[0].set(root_rank)
             state["breal"] = jnp.full(L, K_MIN_SCORE,
                                       jnp.float32).at[0].set(real0)
+        if cfg.with_monotone:
+            state["mincon"] = jnp.full(L, -jnp.inf, jnp.float32)
+            state["maxcon"] = jnp.full(L, jnp.inf, jnp.float32)
         if pooled:
             state["slot_of_leaf"] = jnp.full(L, -1, jnp.int32).at[0].set(0)
             state["leaf_of_slot"] = jnp.full(POOL, -1, jnp.int32).at[0].set(0)
@@ -287,8 +295,22 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                 hist = hist.at[s].set(new_right)
 
             child_depth = st["leaf_depth"][best_leaf] + 1
-            res_l = find(hist_view(new_left), lg, lh, lcnt, feature_mask)
-            res_r = find(hist_view(new_right), rg, rh, rcnt, feature_mask)
+            if cfg.with_monotone:
+                from .grower import propagate_monotone_bounds
+                lmin, lmax, rmin, rmax = propagate_monotone_bounds(
+                    st["blo"][best_leaf], st["bro"][best_leaf],
+                    ~st["bcat"][best_leaf], meta.monotone[f],
+                    st["mincon"][best_leaf], st["maxcon"][best_leaf])
+                res_l = find(hist_view(new_left), lg, lh, lcnt, feature_mask,
+                             min_constraint=lmin, max_constraint=lmax)
+                res_r = find(hist_view(new_right), rg, rh, rcnt,
+                             feature_mask, min_constraint=rmin,
+                             max_constraint=rmax)
+            else:
+                lmin = lmax = rmin = rmax = None
+                res_l = find(hist_view(new_left), lg, lh, lcnt, feature_mask)
+                res_r = find(hist_view(new_right), rg, rh, rcnt,
+                             feature_mask)
             real_l, real_r = res_l.gain, res_r.gain
             if forced is not None:
                 jp = st["fleaf"][best_leaf]
@@ -298,9 +320,11 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                 jl = jnp.where(applied, fc_lnext[jp0], -1)
                 jr = jnp.where(applied, fc_rnext[jp0], -1)
                 res_l, real_l, jl = forced_override(
-                    jl, hist_view(new_left), lg, lh, lcnt, res_l)
+                    jl, hist_view(new_left), lg, lh, lcnt, res_l,
+                    min_constraint=lmin, max_constraint=lmax)
                 res_r, real_r, jr = forced_override(
-                    jr, hist_view(new_right), rg, rh, rcnt, res_r)
+                    jr, hist_view(new_right), rg, rh, rcnt, res_r,
+                    min_constraint=rmin, max_constraint=rmax)
             if cfg.max_depth > 0:
                 depth_ok = child_depth < cfg.max_depth
             else:
@@ -347,6 +371,9 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             if forced is not None:
                 st_new["fleaf"] = set2(st["fleaf"], jl, jr)
                 st_new["breal"] = set2(st["breal"], real_l, real_r)
+            if cfg.with_monotone:
+                st_new["mincon"] = set2(st["mincon"], lmin, rmin)
+                st_new["maxcon"] = set2(st["maxcon"], lmax, rmax)
 
             # record the internal node (Tree::Split, tree.h:404-448)
             gain = (st["breal"] if forced is not None

@@ -107,7 +107,8 @@ def _leaf_split_gain(sum_g, sum_h, l1, l2, max_delta_step):
 def _numerical_gain_tensor(g, h, c, sum_g, total_h, num_data, feature_mask, *,
                            meta, l1, l2, max_delta_step, min_data_in_leaf,
                            min_sum_hessian_in_leaf, min_gain_to_split,
-                           apply_min_gain_filter: bool = True):
+                           apply_min_gain_filter: bool = True,
+                           min_constraint=None, max_constraint=None):
     """Shifted+penalized numerical split gains [F, 2, B] (dir -1 first) plus
     the stacked left-side aggregates [F, 2, B] and min_gain_shift.  Shared by
     the global argmax (find_best_split) and the per-feature reduction used by
@@ -153,6 +154,13 @@ def _numerical_gain_tensor(g, h, c, sum_g, total_h, num_data, feature_mask, *,
               & (lh >= min_sum_hessian_in_leaf) & (rh >= min_sum_hessian_in_leaf))
         lo = leaf_output(lg, lh, l1, l2, max_delta_step)
         ro = leaf_output(rg, rh, l1, l2, max_delta_step)
+        if min_constraint is not None:
+            # per-leaf value bounds (LeafSplits monotone constraints,
+            # feature_histogram.hpp:478-489): candidate outputs are clamped
+            # and the gain is evaluated AT the clamped outputs, which is
+            # what makes monotonicity hold through whole subtrees
+            lo = jnp.clip(lo, min_constraint, max_constraint)
+            ro = jnp.clip(ro, min_constraint, max_constraint)
         mono = meta.monotone[:, None]
         mono_bad = ((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro))
         sgl = threshold_l1(lg, l1)
@@ -350,7 +358,8 @@ def find_best_split(hist, sum_g, sum_h, num_data, feature_mask, *,
                     min_sum_hessian_in_leaf, min_gain_to_split,
                     max_cat_threshold=32, cat_l2=10.0, cat_smooth=10.0,
                     max_cat_to_onehot=4, min_data_per_group=100,
-                    with_categorical: bool = False) -> SplitResult:
+                    with_categorical: bool = False,
+                    min_constraint=None, max_constraint=None) -> SplitResult:
     """Best split for one leaf given its histogram.
 
     hist: [F, B, 3] f32; sum_g/sum_h/num_data: leaf totals (scalars);
@@ -368,7 +377,8 @@ def find_best_split(hist, sum_g, sum_h, num_data, feature_mask, *,
         l1=l1, l2=l2, max_delta_step=max_delta_step,
         min_data_in_leaf=min_data_in_leaf,
         min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
-        min_gain_to_split=min_gain_to_split)
+        min_gain_to_split=min_gain_to_split,
+        min_constraint=min_constraint, max_constraint=max_constraint)
 
     flat = gains.reshape(-1)
     idx = jnp.argmax(flat)
@@ -421,6 +431,13 @@ def find_best_split(hist, sum_g, sum_h, num_data, feature_mask, *,
     right_h = total_h - left_h
     lo = leaf_output(left_g, left_h, l1, l2_eff, max_delta_step)
     ro = leaf_output(right_g, right_h, l1, l2_eff, max_delta_step)
+    if min_constraint is not None:
+        # numerical winners carry clamped outputs; categorical splits are
+        # unclamped like the reference (feature_histogram.hpp:345-351)
+        lo = jnp.where(is_cat, lo, jnp.clip(lo, min_constraint,
+                                            max_constraint))
+        ro = jnp.where(is_cat, ro, jnp.clip(ro, min_constraint,
+                                            max_constraint))
 
     return SplitResult(
         gain=best_gain,
@@ -434,7 +451,9 @@ def find_best_split(hist, sum_g, sum_h, num_data, feature_mask, *,
 
 def evaluate_split_at(hist, sum_g, sum_h, num_data, feature, threshold_bin, *,
                       meta: FeatureMeta, l1, l2, max_delta_step,
-                      min_data_in_leaf, min_sum_hessian_in_leaf) -> SplitResult:
+                      min_data_in_leaf, min_sum_hessian_in_leaf,
+                      min_constraint=None,
+                      max_constraint=None) -> SplitResult:
     """SplitResult for a FORCED numerical split at (feature, threshold_bin).
 
     Role of the forced-split evaluation inside the reference's ForceSplits
@@ -460,7 +479,8 @@ def evaluate_split_at(hist, sum_g, sum_h, num_data, feature, threshold_bin, *,
         l1=l1, l2=l2, max_delta_step=max_delta_step,
         min_data_in_leaf=min_data_in_leaf,
         min_sum_hessian_in_leaf=min_sum_hessian_in_leaf,
-        min_gain_to_split=0.0, apply_min_gain_filter=False)
+        min_gain_to_split=0.0, apply_min_gain_filter=False,
+        min_constraint=min_constraint, max_constraint=max_constraint)
     pair = gains[0, :, t]                       # [2] directions, -1 first
     d = jnp.argmax(pair)
     gain = pair[d]
@@ -474,6 +494,9 @@ def evaluate_split_at(hist, sum_g, sum_h, num_data, feature, threshold_bin, *,
     right_h = total_h - left_h
     lo = leaf_output(left_g, left_h, l1, l2, max_delta_step)
     ro = leaf_output(right_g, right_h, l1, l2, max_delta_step)
+    if min_constraint is not None:
+        lo = jnp.clip(lo, min_constraint, max_constraint)
+        ro = jnp.clip(ro, min_constraint, max_constraint)
     return SplitResult(
         gain=gain, feature=f, threshold_bin=t, default_left=default_left,
         left_sum_g=left_g, left_sum_h=left_h - eps, left_count=left_c,

@@ -1,0 +1,142 @@
+"""Monotone constraints (recursive dump walk, reference
+test_engine.py:597-636 style) and missing-value mode behavior."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _mono_data(n=1200, seed=0):
+    rng = np.random.default_rng(seed)
+    x0 = rng.random(n)                      # constrained +1
+    x1 = rng.random(n)                      # constrained -1
+    x2 = rng.standard_normal(n)             # free
+    y = (5 * x0 - 5 * x1 + 0.5 * np.sin(8 * x2)
+         + rng.standard_normal(n) * 0.05)
+    return np.column_stack([x0, x1, x2]).astype(np.float64), y
+
+
+def _walk_monotone(node, constraint, feature):
+    """Every split on `feature` must order its children's subtree means
+    per the constraint (reference walks leaf outputs recursively)."""
+    if "split_feature" not in node:
+        return node["leaf_value"], node["leaf_value"]
+
+    lmin, lmax = _walk_monotone(node["left_child"], constraint, feature)
+    rmin, rmax = _walk_monotone(node["right_child"], constraint, feature)
+    if node["split_feature"] == feature:
+        if constraint > 0:
+            assert lmax <= rmin + 1e-10, \
+                "increasing constraint violated: left %g > right %g" % (lmax, rmin)
+        elif constraint < 0:
+            assert lmin >= rmax - 1e-10, \
+                "decreasing constraint violated"
+    return min(lmin, rmin), max(lmax, rmax)
+
+
+def test_monotone_constraints_hold_in_dumped_trees():
+    X, y = _mono_data()
+    params = {"objective": "regression", "num_leaves": 31, "verbose": -1,
+              "monotone_constraints": [1, -1, 0], "min_data_in_leaf": 10}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=15)
+    dump = bst.dump_model()
+    assert len(dump["tree_info"]) == 15
+    for t in dump["tree_info"]:
+        root = t["tree_structure"]
+        if "split_feature" in root:
+            _walk_monotone(root, 1, 0)
+            _walk_monotone(root, -1, 1)
+
+
+def test_monotone_prediction_direction():
+    X, y = _mono_data()
+    params = {"objective": "regression", "num_leaves": 31, "verbose": -1,
+              "monotone_constraints": [1, -1, 0], "min_data_in_leaf": 10}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=15)
+    base = np.tile(np.array([[0.5, 0.5, 0.0]]), (50, 1))
+    sweep = np.linspace(0.0, 1.0, 50)
+    up = base.copy(); up[:, 0] = sweep
+    pred_up = bst.predict(up)
+    assert (np.diff(pred_up) >= -1e-10).all(), "f0 must be non-decreasing"
+    down = base.copy(); down[:, 1] = sweep
+    pred_down = bst.predict(down)
+    assert (np.diff(pred_down) <= 1e-10).all(), "f1 must be non-increasing"
+
+
+def _missing_data(n=800, seed=2):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, 3))
+    y = (X[:, 0] > 0).astype(np.float64)
+    X[rng.random(n) < 0.3, 0] = np.nan      # informative column gets NaNs
+    return X, y
+
+
+def test_nan_rows_learn_a_default_direction():
+    X, y = _missing_data()
+    # make NaN itself informative: NaN rows are all positive
+    y[np.isnan(X[:, 0])] = 1.0
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                     "use_missing": True}, lgb.Dataset(X, label=y),
+                    num_boost_round=10)
+    nan_row = np.array([[np.nan, 0.0, 0.0]])
+    assert bst.predict(nan_row)[0] > 0.8
+
+
+def test_use_missing_false_treats_nan_as_zero():
+    X, y = _missing_data()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                     "use_missing": False}, lgb.Dataset(X, label=y),
+                    num_boost_round=5)
+    nan_row = np.array([[np.nan, 0.3, -0.2]])
+    zero_row = np.array([[0.0, 0.3, -0.2]])
+    assert bst.predict(nan_row)[0] == pytest.approx(
+        bst.predict(zero_row)[0], abs=1e-12)
+
+
+def test_zero_as_missing_groups_zeros_with_nans():
+    rng = np.random.default_rng(4)
+    n = 600
+    X = rng.standard_normal((n, 2))
+    X[rng.random(n) < 0.4, 0] = 0.0
+    y = ((X[:, 0] == 0.0) | (X[:, 1] > 0.8)).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                     "zero_as_missing": True}, lgb.Dataset(X, label=y),
+                    num_boost_round=10)
+    zero_row = np.array([[0.0, 0.0]])
+    nan_row = np.array([[np.nan, 0.0]])
+    # zeros and NaNs share the missing bin -> identical routing
+    assert bst.predict(zero_row)[0] == pytest.approx(
+        bst.predict(nan_row)[0], abs=1e-12)
+    assert bst.predict(zero_row)[0] > 0.6
+
+
+def test_monotone_on_masked_grower_goss():
+    X, y = _mono_data()
+    params = {"objective": "regression", "num_leaves": 31, "verbose": -1,
+              "monotone_constraints": [1, -1, 0], "min_data_in_leaf": 10,
+              "boosting": "goss"}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
+    assert not bst._engine._fast_active
+    for t in bst.dump_model()["tree_info"]:
+        root = t["tree_structure"]
+        if "split_feature" in root:
+            _walk_monotone(root, 1, 0)
+            _walk_monotone(root, -1, 1)
+
+
+def test_monotone_with_forced_splits(tmp_path):
+    import json
+    X, y = _mono_data()
+    fpath = tmp_path / "forced.json"
+    # force a root split on the FREE feature; constrained growth follows
+    fpath.write_text(json.dumps({"feature": 2, "threshold": 0.0}))
+    params = {"objective": "regression", "num_leaves": 31, "verbose": -1,
+              "monotone_constraints": [1, -1, 0], "min_data_in_leaf": 10,
+              "forcedsplits_filename": str(fpath)}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
+    for t in bst.dump_model()["tree_info"]:
+        root = t["tree_structure"]
+        if "split_feature" in root:
+            assert root["split_feature"] == 2
+            _walk_monotone(root, 1, 0)
+            _walk_monotone(root, -1, 1)
