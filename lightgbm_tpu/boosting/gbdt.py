@@ -165,7 +165,13 @@ class _FastState:
         self.grad_col = self.snap0 + (K if K > 1 else 1)
         self.hess_col = self.grad_col + 1
         self.value_col = self.grad_col + 2
-        self.P = self.value_col + 1
+        # pristine valid mask: the cnt column is a WORKING mask (bagging /
+        # GOSS selection overwrite it per iteration).  The gradient-weight
+        # column carries the sampling amplification so multiclass can draw
+        # one selection per iteration that RIDES the per-tree partitions.
+        self.bvalid_col = self.value_col + 1
+        self.gweight_col = self.bvalid_col + 1
+        self.P = self.gweight_col + 1
         if jax.default_backend() == "tpu":
             # Mosaic DMA slices must span whole 128-lane tiles; a [N, P]
             # f32 array is physically padded to 128 lanes on TPU anyway,
@@ -183,6 +189,7 @@ class _FastState:
             pay = pay.at[:n_pad, G].set(label)
             pay = pay.at[:n_pad, G + 1].set(weight)
             pay = pay.at[:n_pad, self.cnt_col].set(vmask)
+            pay = pay.at[:n_pad, self.bvalid_col].set(vmask)
             pay = pay.at[:n_pad, idx_col].set(
                 jnp.arange(n_pad, dtype=jnp.float32))
             pay = pay.at[:n_pad, score0:score0 + K].set(score.T)
@@ -240,15 +247,10 @@ class _FastState:
 
         grower = self.grower
         value_col = self.value_col
+        bvalid_col = self.bvalid_col
+        sample_hook = getattr(gbdt, "_fast_sample_hook", None)
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def step(payload, aux, fmask, lr, k):
-            """One fused tree: gradients -> grow -> conditional score add.
-            A tunneled TPU pays a round trip per dispatch; fusing the
-            per-tree chain into one program leaves a single launch plus
-            the packed result fetch.  k is traced (one compile serves
-            every class)."""
-            payload = _fill_body(payload, k)
+        def _grow_and_score(payload, aux, fmask, lr, k):
             out, payload, aux = grower.__wrapped__(payload, aux, fmask) \
                 if hasattr(grower, "__wrapped__") else grower(payload, aux,
                                                              fmask)
@@ -258,10 +260,72 @@ class _FastState:
             payload = payload.at[:n_pad, score0 + k].add(upd)
             return out, payload, aux
 
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(payload, aux, fmask, lr, k):
+            """One fused tree: gradients -> grow -> conditional score add.
+            A tunneled TPU pays a round trip per dispatch; fusing the
+            per-tree chain into one program leaves a single launch plus
+            the packed result fetch.  k is traced (one compile serves
+            every class)."""
+            payload = _fill_body(payload, k)
+            return _grow_and_score(payload, aux, fmask, lr, k)
+
+        def _all_grads(payload):
+            snap = payload[:n_pad, snap0:snap0 + K].T
+            return obj.get_gradients_multi(snap, payload[:n_pad, G],
+                                           payload[:n_pad, G + 1])
+
+        def _write_sampled(payload, g, h, k, gw, cm):
+            payload = payload.at[:n_pad, grad_col].set(
+                jnp.take(g, k, axis=0) * gw)
+            payload = payload.at[:n_pad, hess_col].set(
+                jnp.take(h, k, axis=0) * gw)
+            return payload.at[:n_pad, cnt_col].set(cm)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step_sampled(payload, aux, fmask, lr, k, key, enabled):
+            """Fused tree with a per-iteration row-sampling hook (GOSS):
+            gradients for ALL classes come from the snapshot, the hook
+            derives (gradient-weight, count-mask) from them off the
+            pristine valid column, and class k's weighted gradients plus
+            the selection mask land in the working columns."""
+            g, h = _all_grads(payload)
+            valid = payload[:n_pad, bvalid_col]
+            gw, cm = sample_hook(g * valid, h * valid, valid, key, enabled)
+            payload = _write_sampled(payload, g, h, k, gw, cm)
+            return _grow_and_score(payload, aux, fmask, lr, k)
+
+        gweight_col = self.gweight_col
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def apply_sample_masks(payload, key, enabled):
+            """Multiclass prelude: the selection is identical for every
+            class tree of an iteration, so it is drawn ONCE and written
+            into payload COLUMNS (gweight + cnt) — each class tree
+            repartitions the rows, and columns ride the partition while
+            standalone mask arrays would go stale after the first tree."""
+            g, h = _all_grads(payload)
+            valid = payload[:n_pad, bvalid_col]
+            gw, cm = sample_hook(g * valid, h * valid, valid, key, enabled)
+            payload = payload.at[:n_pad, gweight_col].set(gw)
+            return payload.at[:n_pad, cnt_col].set(cm)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step_masked(payload, aux, fmask, lr, k):
+            g, h = _all_grads(payload)
+            payload = _write_sampled(payload, g, h, k,
+                                     payload[:n_pad, gweight_col],
+                                     payload[:n_pad, cnt_col])
+            return _grow_and_score(payload, aux, fmask, lr, k)
+
         self._snap_scores = snap_scores
         self._fill_class = fill_class
         self._apply_score = apply_score
         self._step = step
+        self._step_sampled = step_sampled if sample_hook is not None else None
+        self._apply_sample_masks = apply_sample_masks \
+            if sample_hook is not None else None
+        self._step_masked = step_masked if sample_hook is not None else None
         self._set_bag = set_bag
 
     def reset(self, gbdt: "GBDT") -> None:
@@ -645,7 +709,8 @@ class GBDT:
         independent of row order), no leaf-output renewal, index column
         exact in f32.  Everything else keeps the legacy masked grower."""
         cfg = self.config
-        return (type(self) is GBDT
+        return ((type(self) is GBDT
+                 or getattr(self, "_fast_sample_hook", None) is not None)
                 and self.mesh is None
                 and self.objective is not None
                 and getattr(self.objective, "is_rowwise", True)
@@ -691,7 +756,26 @@ class GBDT:
         lr = self.shrinkage_rate
         should_continue = False
         for k in range(self.num_tree_per_iteration):
-            if not self.timer.enabled:
+            if fs._step_sampled is not None:
+                # row-sampling boosting (GOSS): always the fused path —
+                # the hook needs all-class gradients in one program.
+                # Multiclass draws the (identical) selection once per
+                # iteration and reuses it for every class tree.
+                key, enabled = self._fast_sample_args()
+                with self.timer.phase("tree (hist+split+partition)"):
+                    if fs.K == 1:
+                        out, fs.payload, fs.aux = fs._step_sampled(
+                            fs.payload, fs.aux, fmask, jnp.float32(lr),
+                            jnp.int32(k), key, enabled)
+                    else:
+                        if k == 0:
+                            fs.payload = fs._apply_sample_masks(
+                                fs.payload, key, enabled)
+                        out, fs.payload, fs.aux = fs._step_masked(
+                            fs.payload, fs.aux, fmask, jnp.float32(lr),
+                            jnp.int32(k))
+                    self.timer.sync(fs.payload)
+            elif not self.timer.enabled:
                 # one dispatch for the whole tree (gradients + growth +
                 # score add); profiling uses the piecewise path below
                 out, fs.payload, fs.aux = fs._step(
@@ -709,7 +793,8 @@ class GBDT:
                 tree, tree_dev, leaf_out = self._finish_tree(out, init_score)
             if tree.num_leaves > 1:
                 should_continue = True
-                if self.timer.enabled:
+                # the fused steps already applied the score add on device
+                if self.timer.enabled and fs._step_sampled is None:
                     with self.timer.phase("train score update"):
                         fs.payload = fs._apply_score(fs.payload,
                                                      jnp.float32(lr), k=k)

@@ -40,22 +40,43 @@ class GOSS(GBDT):
         Log.info("Using GOSS")
         self._goss_key = jax.random.PRNGKey(
             partition_seed(int(config.seed or 0) + int(config.bagging_seed), 3))
+        # one copy of the selection parameters serves BOTH engines
+        # (goss.hpp:88-138): top/other counts, amplification, warmup length
+        n = train_set.num_data
+        self._goss_top_k = max(1, int(n * config.top_rate))
+        self._goss_other_k = max(1, int(n * config.other_rate))
+        self._goss_multiply = float(
+            (n - self._goss_top_k) / self._goss_other_k)
+        self._goss_warmup = int(1.0 / config.learning_rate)
+
+        def _hook(g, h, valid, key, enabled):
+            """Fast-path sampling hook: same selection math as the masked
+            path (selection is row-order-free; the uniform draw differs by
+            permutation only, so the two engines draw statistically
+            identical — not bitwise-identical — samples)."""
+            gw, cm = _goss_masks(g, h, valid > 0, key, self._goss_top_k,
+                                 self._goss_other_k, self._goss_multiply)
+            gw = jnp.where(enabled, gw, valid)
+            cm = jnp.where(enabled, cm, valid)
+            return gw, cm
+
+        self._fast_sample_hook = _hook
+
+    def _fast_sample_args(self):
+        """(per-iteration PRNG key, sampling-enabled flag) — no sampling
+        during the first 1/learning_rate iterations (goss.hpp:137)."""
+        key = jax.random.fold_in(self._goss_key, self.iter)
+        return key, jnp.bool_(self.iter >= self._goss_warmup)
 
     def _bagging_masks(self, grads, hesss):
-        cfg = self.config
-        n = self.train_set.num_data
         # no subsampling for the first 1/learning_rate iterations (goss.hpp:137)
-        if self.iter < int(1.0 / cfg.learning_rate):
+        if self.iter < self._goss_warmup:
             m = jnp.asarray(self.bag_mask_host)
             return m, m
-        top_k = max(1, int(n * cfg.top_rate))
-        other_k = max(1, int(n * cfg.other_rate))
-        multiply = (n - top_k) / other_k
         key = jax.random.fold_in(self._goss_key, self.iter)
         valid = jnp.asarray(self.bag_mask_host) > 0
-        gmask, cmask = _goss_masks(grads, hesss, valid, key, top_k, other_k,
-                                   float(multiply))
-        return gmask, cmask
+        return _goss_masks(grads, hesss, valid, key, self._goss_top_k,
+                           self._goss_other_k, self._goss_multiply)
 
 
 @functools.partial(jax.jit, static_argnames=("top_k", "other_k"))

@@ -35,7 +35,12 @@ def test_goss_kicks_in_after_warmup(binary_data):
                     train, num_boost_round=4, verbose_eval=0)
     eng = bst._engine
     import jax
-    cmask = np.asarray(jax.device_get(eng._bag_cmask))
+    if eng._fast_active:  # fast path keeps the selection in the cnt column
+        fs = eng._fast
+        cmask = np.asarray(jax.device_get(
+            fs.payload[:fs.n_pad, fs.cnt_col]))
+    else:
+        cmask = np.asarray(jax.device_get(eng._bag_cmask))
     n = train.num_data()
     kept = int(cmask.sum())
     expected = max(1, int(n * 0.2)) + max(1, int(n * 0.1))

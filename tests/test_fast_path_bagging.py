@@ -85,3 +85,53 @@ def test_bagging_multiclass_fast_equals_masked(monkeypatch):
     acc_s = np.mean(np.argmax(slow.predict(X), 1) == y)
     assert acc_f >= acc_s - 0.02
     assert acc_f > 0.8
+
+
+def test_goss_runs_on_fast_path(monkeypatch):
+    X, y = _data(n=900)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "boosting": "goss", "learning_rate": 0.3, "top_rate": 0.3,
+              "other_rate": 0.2, "seed": 5, "min_data_in_leaf": 5}
+    fast = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=12)
+    assert fast._engine._fast_active
+    acc_fast = np.mean((fast.predict(X) > 0.5) == (y > 0.5))
+    assert acc_fast > 0.85
+
+    monkeypatch.setattr(GBDT, "_fast_eligible", lambda self: False)
+    slow = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=12)
+    acc_slow = np.mean((slow.predict(X) > 0.5) == (y > 0.5))
+    # sampling draws differ by row permutation only; quality must agree
+    assert abs(acc_fast - acc_slow) < 0.05
+    # warmup iterations (iter < 1/lr) draw NO sample: identical trees
+    d_f = fast.dump_model()["tree_info"][0]["tree_structure"]
+    d_s = slow.dump_model()["tree_info"][0]["tree_structure"]
+    assert d_f["split_feature"] == d_s["split_feature"]
+    assert d_f["internal_count"] == d_s["internal_count"]
+
+
+def test_goss_multiclass_fast():
+    rng = np.random.default_rng(8)
+    X = rng.standard_normal((700, 5)).astype(np.float32)
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5)).astype(float)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "verbose": -1, "boosting": "goss", "learning_rate": 0.3,
+              "min_data_in_leaf": 5}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
+    assert bst._engine._fast_active
+    acc = np.mean(np.argmax(bst.predict(X), 1) == y)
+    assert acc > 0.8
+
+
+def test_goss_profiled_scores_match_unprofiled():
+    """Regression: the fused sampled step must not double-apply scores
+    when tpu_profile_phases is on."""
+    X, y = _data(n=500)
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "boosting": "goss", "learning_rate": 0.3, "seed": 2,
+              "min_data_in_leaf": 5}
+    a = lgb.train(dict(params), lgb.Dataset(X, label=y), num_boost_round=6)
+    b = lgb.train({**params, "tpu_profile_phases": True},
+                  lgb.Dataset(X, label=y), num_boost_round=6)
+    assert a.model_to_string() == b.model_to_string()

@@ -118,9 +118,11 @@ def test_schedule_builder_bfs_ranks():
     assert sched.gain[0] > sched.gain[1] > sched.gain[3] > 0
 
 
-def test_forced_splits_on_masked_grower_goss(tmp_path):
-    """GOSS runs on the legacy masked grower; forced splits must hold there
-    too (serial_tree_learner.cpp ForceSplits is learner-agnostic)."""
+def test_forced_splits_on_masked_grower_goss(tmp_path, monkeypatch):
+    """Forced splits must hold on the legacy masked grower too
+    (serial_tree_learner.cpp ForceSplits is learner-agnostic)."""
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    monkeypatch.setattr(GBDT, "_fast_eligible", lambda self: False)
     X, y = _data()
     fpath = tmp_path / "forced.json"
     fpath.write_text(json.dumps({"feature": 4, "threshold": 0.0,

@@ -110,7 +110,9 @@ def test_zero_as_missing_groups_zeros_with_nans():
     assert bst.predict(zero_row)[0] > 0.6
 
 
-def test_monotone_on_masked_grower_goss():
+def test_monotone_on_masked_grower_goss(monkeypatch):
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    monkeypatch.setattr(GBDT, "_fast_eligible", lambda self: False)
     X, y = _mono_data()
     params = {"objective": "regression", "num_leaves": 31, "verbose": -1,
               "monotone_constraints": [1, -1, 0], "min_data_in_leaf": 10,
