@@ -403,11 +403,17 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
       predict_type != C_API_PREDICT_RAW_SCORE)
     return Fail("unsupported predict_type " + std::to_string(predict_type));
   int64_t width = leaf ? used_trees : k;
-  std::vector<double> row(ncol);
-  for (int32_t r = 0; r < nrow; ++r) {
-    for (int32_t c = 0; c < ncol; ++c) row[c] = at(r, c);
-    PredictRow(*m, row.data(), predict_type, iters, used_trees,
-               out_result + r * width);
+  // rows are independent — the reference's Predictor parallelizes the same
+  // way (predictor.hpp OpenMP pipeline)
+#pragma omp parallel
+  {
+    std::vector<double> row(ncol);
+#pragma omp for schedule(static)
+    for (int32_t r = 0; r < nrow; ++r) {
+      for (int32_t c = 0; c < ncol; ++c) row[c] = at(r, c);
+      PredictRow(*m, row.data(), predict_type, iters, used_trees,
+                 out_result + r * width);
+    }
   }
   *out_len = static_cast<int64_t>(nrow) * width;
   return 0;
@@ -454,20 +460,24 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
     return static_cast<const double*>(data)[i];
   };
 
-  std::vector<double> row(num_col, 0.0);
   bool leaf = predict_type == C_API_PREDICT_LEAF_INDEX;
   if (!leaf && predict_type != C_API_PREDICT_NORMAL &&
       predict_type != C_API_PREDICT_RAW_SCORE)
     return Fail("unsupported predict_type " + std::to_string(predict_type));
 
   int64_t width = leaf ? used_trees : k;
-  for (int64_t r = 0; r < nrow; ++r) {
-    int64_t b, e;
-    row_range(r, &b, &e);
-    for (int64_t i = b; i < e; ++i) row[indices[i]] = val(i);
-    PredictRow(*m, row.data(), predict_type, iters, used_trees,
-               out_result + r * width);
-    for (int64_t i = b; i < e; ++i) row[indices[i]] = 0.0;  // reset touched
+#pragma omp parallel
+  {
+    std::vector<double> prow(num_col, 0.0);
+#pragma omp for schedule(static)
+    for (int64_t r = 0; r < nrow; ++r) {
+      int64_t b, e;
+      row_range(r, &b, &e);
+      for (int64_t i = b; i < e; ++i) prow[indices[i]] = val(i);
+      PredictRow(*m, prow.data(), predict_type, iters, used_trees,
+                 out_result + r * width);
+      for (int64_t i = b; i < e; ++i) prow[indices[i]] = 0.0;  // reset
+    }
   }
   *out_len = nrow * width;
   return 0;
