@@ -11,6 +11,10 @@
 
 #include "lightgbm_tpu_c_api.h"
 
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
 #include <algorithm>
 #include <cmath>
 #include <cstring>
@@ -466,7 +470,15 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
     return Fail("unsupported predict_type " + std::to_string(predict_type));
 
   int64_t width = leaf ? used_trees : k;
-#pragma omp parallel
+  // each thread scatters into its own dense row buffer; cap the team so
+  // the combined buffers stay within ~256 MB on very wide sparse inputs
+  int team = 1;
+#ifdef _OPENMP
+  team = static_cast<int>(std::max<int64_t>(
+      1, std::min<int64_t>(omp_get_max_threads(),
+                           (256LL << 20) / (num_col * 8 + 1))));
+#endif
+#pragma omp parallel num_threads(team)
   {
     std::vector<double> prow(num_col, 0.0);
 #pragma omp for schedule(static)

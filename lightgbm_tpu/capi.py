@@ -30,10 +30,11 @@ _lib: Optional[ctypes.CDLL] = None
 
 
 def ensure_built() -> str:
-    """Build the shared library if missing; returns its path."""
-    if not os.path.exists(_LIB_PATH):
-        subprocess.run(["make", "-C", _CPP_DIR], check=True,
-                       capture_output=True)
+    """Build (or freshen) the shared library; returns its path.  make is
+    a no-op when the .so is newer than the sources, so running it
+    unconditionally keeps stale pre-built libraries from being loaded."""
+    subprocess.run(["make", "-C", _CPP_DIR], check=True,
+                   capture_output=True)
     return _LIB_PATH
 
 

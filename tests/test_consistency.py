@@ -1,0 +1,132 @@
+"""Cross-engine consistency on the reference's own example configs
+(role of tests/python_package_test/test_consistency.py, upgraded from
+CLI-vs-binding to OUR-engine-vs-REFERENCE-engine): train each example
+with both CLIs using the example's train.conf, predict the example's test
+file with both, and require the held-out metrics to agree."""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.application import Application
+
+REFERENCE = "/root/reference/examples"
+REFBIN = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      ".refbuild", "lightgbm")
+
+pytestmark = pytest.mark.skipif(not os.path.exists(REFBIN),
+                                reason="reference CLI not built")
+
+ROUNDS = "30"
+
+
+def _run_ours(conf_dir, conf, tmp_path, extra=()):
+    model = str(tmp_path / "ours_model.txt")
+    pred = str(tmp_path / "ours_pred.txt")
+    cwd = os.getcwd()
+    os.chdir(conf_dir)
+    try:
+        Application(["config=%s" % conf, "num_trees=%s" % ROUNDS,
+                     "output_model=%s" % model, "verbose=-1",
+                     *extra]).run()
+        Application(["task=predict", "data=%s" % _test_file(conf_dir),
+                     "input_model=%s" % model,
+                     "output_result=%s" % pred]).run()
+    finally:
+        os.chdir(cwd)
+    return np.loadtxt(pred)
+
+
+def _run_ref(conf_dir, conf, tmp_path, extra=()):
+    model = str(tmp_path / "ref_model.txt")
+    pred = str(tmp_path / "ref_pred.txt")
+    subprocess.run([REFBIN, "config=%s" % conf, "num_trees=%s" % ROUNDS,
+                    "output_model=%s" % model, "verbosity=-1", *extra],
+                   cwd=conf_dir, check=True, capture_output=True)
+    subprocess.run([REFBIN, "task=predict", "data=%s" % _test_file(conf_dir),
+                    "input_model=%s" % model, "output_result=%s" % pred],
+                   cwd=conf_dir, check=True, capture_output=True)
+    return np.loadtxt(pred)
+
+
+def _test_file(conf_dir):
+    for f in os.listdir(conf_dir):
+        if f.endswith(".test"):
+            return os.path.join(conf_dir, f)
+    raise FileNotFoundError(conf_dir)
+
+
+def _labels(conf_dir):
+    path = _test_file(conf_dir)
+    with open(path) as fh:
+        first = fh.readline()
+    if any(":" in tok for tok in first.split()[1:3]):  # libsvm
+        labels = []
+        with open(path) as fh:
+            for line in fh:
+                if line.strip():
+                    labels.append(float(line.split()[0]))
+        return np.asarray(labels)
+    data = np.loadtxt(path)
+    return data[:, 0]
+
+
+def _auc(y, p):
+    order = np.argsort(p)
+    ranks = np.empty(len(p)); ranks[order] = np.arange(1, len(p) + 1)
+    npos = y.sum(); nneg = len(y) - npos
+    return (ranks[y > 0].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+
+
+def test_binary_example(tmp_path):
+    d = os.path.join(REFERENCE, "binary_classification")
+    ours = _run_ours(d, "train.conf", tmp_path)
+    ref = _run_ref(d, "train.conf", tmp_path)
+    y = _labels(d)
+    auc_ours, auc_ref = _auc(y, ours), _auc(y, ref)
+    assert abs(auc_ours - auc_ref) < 0.02, (auc_ours, auc_ref)
+    assert auc_ours > 0.75
+
+
+def test_regression_example(tmp_path):
+    d = os.path.join(REFERENCE, "regression")
+    ours = _run_ours(d, "train.conf", tmp_path)
+    ref = _run_ref(d, "train.conf", tmp_path)
+    y = _labels(d)
+    l2_ours = float(np.mean((ours - y) ** 2))
+    l2_ref = float(np.mean((ref - y) ** 2))
+    assert l2_ours < l2_ref * 1.1, (l2_ours, l2_ref)
+
+
+def test_multiclass_example(tmp_path):
+    d = os.path.join(REFERENCE, "multiclass_classification")
+    ours = _run_ours(d, "train.conf", tmp_path)
+    ref = _run_ref(d, "train.conf", tmp_path)
+    y = _labels(d).astype(int)
+    acc_ours = float(np.mean(np.argmax(ours, 1) == y))
+    acc_ref = float(np.mean(np.argmax(ref, 1) == y))
+    assert acc_ours > acc_ref - 0.03, (acc_ours, acc_ref)
+
+
+def test_lambdarank_example(tmp_path):
+    d = os.path.join(REFERENCE, "lambdarank")
+    ours = _run_ours(d, "train.conf", tmp_path)
+    ref = _run_ref(d, "train.conf", tmp_path)
+    y = _labels(d)
+    qs = np.loadtxt(os.path.join(d, "rank.test.query")).astype(int)
+
+    def ndcg_at5(pred):
+        out, lo = [], 0
+        for q in qs:
+            yy, pp = y[lo:lo + q], pred[lo:lo + q]
+            lo += q
+            order = np.argsort(-pp)[:5]
+            dcg = np.sum((2 ** yy[order] - 1) / np.log2(np.arange(2, 2 + len(order))))
+            best = np.argsort(-yy)[:5]
+            idcg = np.sum((2 ** yy[best] - 1) / np.log2(np.arange(2, 2 + len(best))))
+            out.append(dcg / idcg if idcg > 0 else 1.0)
+        return float(np.mean(out))
+
+    n_ours, n_ref = ndcg_at5(ours), ndcg_at5(ref)
+    assert n_ours > n_ref - 0.03, (n_ours, n_ref)
