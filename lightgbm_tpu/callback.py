@@ -78,9 +78,24 @@ def reset_parameter(**kwargs) -> Callable:
                 new_params[key] = value[env.iteration - env.begin_iteration]
             elif callable(value):
                 new_params[key] = value(env.iteration - env.begin_iteration)
+            else:
+                raise ValueError("Only list and callable values are "
+                                 "supported as a parameter schedule")
         if new_params:
-            if "learning_rate" in new_params and env.model._engine is not None:
-                env.model._engine.shrinkage_rate = float(new_params["learning_rate"])
+            # cv() passes the CVBooster; apply to every fold engine
+            boosters = getattr(env.model, "boosters", [env.model])
+            from .config import Config
+            has_lr = any(Config.resolve_alias(k) == "learning_rate"
+                         for k in new_params)
+            for bst in boosters:
+                eng = getattr(bst, "_engine", None)
+                if eng is None:
+                    continue
+                # live-apply into the engine config (Booster::ResetConfig
+                # role) so e.g. bagging_fraction changes take effect
+                eng.config.set(new_params)
+                if has_lr:  # the engine caches the shrinkage scalar
+                    eng.shrinkage_rate = float(eng.config.learning_rate)
             env.params.update(new_params)
     _callback.before_iteration = True
     _callback.order = 10

@@ -21,11 +21,17 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
           valid_sets: Optional[List[Dataset]] = None,
           valid_names: Optional[List[str]] = None,
           fobj=None, feval=None, init_model=None,
+          feature_name="auto", categorical_feature="auto",
+          learning_rates=None,
           keep_training_booster: bool = True,
           callbacks: Optional[List] = None,
           early_stopping_rounds: Optional[int] = None,
           verbose_eval=True) -> Booster:
     params = dict(params)
+    if feature_name != "auto":
+        train_set.set_feature_name(feature_name)
+    if categorical_feature != "auto":
+        train_set.set_categorical_feature(categorical_feature)
     num_boost_round, early_stopping_rounds = _rounds_from_params(
         params, num_boost_round, early_stopping_rounds)
     if fobj is not None:
@@ -44,6 +50,10 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     booster = Booster(params=params, train_set=train_set, init_model=init)
     is_valid_contain_train = False
     train_data_name = "training"
+    if isinstance(valid_sets, Dataset):   # reference accepts a bare Dataset
+        valid_sets = [valid_sets]
+    if isinstance(valid_names, str):
+        valid_names = [valid_names]
     if valid_sets is not None:
         for i, valid in enumerate(valid_sets):
             name = valid_names[i] if valid_names else "valid_%d" % i
@@ -54,6 +64,9 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
             booster.add_valid(valid, name)
 
     callbacks = list(callbacks) if callbacks else []
+    if learning_rates is not None:
+        from .callback import reset_parameter
+        callbacks.append(reset_parameter(learning_rate=learning_rates))
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
         from .callback import early_stopping
         callbacks.append(early_stopping(early_stopping_rounds, verbose=bool(verbose_eval)))

@@ -274,10 +274,30 @@ class LGBMRegressor(LGBMModel):
     def _default_objective(self) -> str:
         return "regression"
 
+    def score(self, X, y, sample_weight=None):
+        """R^2 (the sklearn RegressorMixin contract, which GridSearchCV
+        relies on when no scoring is given)."""
+        y = np.asarray(y, dtype=np.float64)
+        pred = np.asarray(self.predict(X), dtype=np.float64)
+        w = None if sample_weight is None else np.asarray(sample_weight)
+        avg = np.average(y, weights=w)
+        ss_res = np.average((y - pred) ** 2, weights=w)
+        ss_tot = np.average((y - avg) ** 2, weights=w)
+        if ss_tot > 0:
+            return 1.0 - ss_res / ss_tot
+        # constant target: sklearn's r2_score convention
+        return 1.0 if ss_res == 0 else 0.0
+
 
 class LGBMClassifier(LGBMModel):
     def _default_objective(self) -> str:
         return "multiclass" if self._n_classes > 2 else "binary"
+
+    def score(self, X, y, sample_weight=None):
+        """Accuracy (the sklearn ClassifierMixin contract)."""
+        pred = np.asarray(self.predict(X))
+        hits = (pred == np.asarray(y)).astype(np.float64)
+        return float(np.average(hits, weights=sample_weight))
 
     def fit(self, X, y, sample_weight=None, **kwargs):
         y = np.asarray(y).reshape(-1)

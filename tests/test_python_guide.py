@@ -1,0 +1,67 @@
+"""Drop-in-replacement check: the reference's OWN python-guide example
+scripts run unmodified against this package (``import lightgbm`` aliased
+to ``lightgbm_tpu``)."""
+import os
+import runpy
+import sys
+
+import numpy as np
+import pytest
+
+pytest.importorskip("pandas")
+pytest.importorskip("sklearn")
+
+GUIDE = "/root/reference/examples/python-guide"
+
+pytestmark = pytest.mark.skipif(not os.path.isdir(GUIDE),
+                                reason="reference examples not mounted")
+
+
+def _run_example(name, tmp_path, monkeypatch, capsys):
+    import lightgbm_tpu
+    monkeypatch.setitem(sys.modules, "lightgbm", lightgbm_tpu)
+    # scripts read ../regression/... relative to the guide dir and write
+    # model files to CWD; run them from a scratch dir at the same depth
+    workdir = tmp_path / "python-guide"
+    workdir.mkdir()
+    (tmp_path / "regression").symlink_to(
+        os.path.join(os.path.dirname(GUIDE), "regression"))
+    (tmp_path / "binary_classification").symlink_to(
+        os.path.join(os.path.dirname(GUIDE), "binary_classification"))
+    monkeypatch.chdir(workdir)
+    runpy.run_path(os.path.join(GUIDE, name), run_name="__main__")
+    return capsys.readouterr().out
+
+
+def test_simple_example(tmp_path, monkeypatch, capsys):
+    out = _run_example("simple_example.py", tmp_path, monkeypatch, capsys)
+    assert "The rmse of prediction is:" in out
+    rmse = float(out.split("The rmse of prediction is:")[1].split()[0])
+    assert rmse < 0.6, rmse
+    assert (tmp_path / "python-guide" / "model.txt").exists()
+
+
+def test_sklearn_example(tmp_path, monkeypatch, capsys):
+    out = _run_example("sklearn_example.py", tmp_path, monkeypatch, capsys)
+    assert "The rmse of prediction is:" in out
+    assert "Feature importances:" in out
+    assert "Best parameters found by grid search are:" in out
+
+
+def test_logistic_regression_example(tmp_path, monkeypatch, capsys):
+    pytest.importorskip("scipy")
+    out = _run_example("logistic_regression.py", tmp_path, monkeypatch,
+                       capsys)
+    assert "Performance of `binary` objective with binary labels:" in out
+    assert "Performance of `xentropy` objective with probability labels:" in out
+    assert "Best `xentropy` time:" in out
+
+
+def test_advanced_example(tmp_path, monkeypatch, capsys):
+    out = _run_example("advanced_example.py", tmp_path, monkeypatch, capsys)
+    for milestone in ("Finish 10 - 20 rounds with model file",
+                      "Finish 20 - 30 rounds with decay learning rates",
+                      "Finish 30 - 40 rounds with changing bagging_fraction",
+                      "Finish 40 - 50 rounds with self-defined objective",
+                      "Finish first 10 rounds with callback function"):
+        assert milestone in out, milestone
