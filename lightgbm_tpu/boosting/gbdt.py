@@ -535,12 +535,6 @@ class GBDT:
                           or "auto"),
             hist_pool_slots=self._hist_pool_slots(config, train_set),
             with_monotone=bool(np.any(train_set.monotone_constraints)))
-        if np.any(train_set.monotone_constraints) and \
-                self.parallel_mode is not None:
-            Log.warning(
-                "monotone_constraints with tree_learner=%s enforce only the "
-                "per-split output ordering; full per-leaf bound propagation "
-                "runs on the serial learners", self.parallel_mode)
         self.grower = _cached_grower(self.meta_dev, self.grower_cfg,
                                      train_set.max_num_bin, train_set,
                                      bundle_map=self.bundle_map

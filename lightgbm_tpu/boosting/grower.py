@@ -120,10 +120,10 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
         from .forced import PRIORITY_UNIT, make_forced_machinery
         fc_lnext, fc_rnext, forced_override = \
             make_forced_machinery(forced, meta, cfg)
-    # per-leaf value-bound propagation runs on the serial learners; the
-    # parallel learners keep the pairwise output-ordering check only (the
-    # packed SplitInfo allreduce does not carry bounds)
-    with_mono = cfg.with_monotone and axis_name is None
+    # per-leaf bounds are replicated scalars every shard tracks identically
+    # (all shards apply identical splits), so propagation runs on the
+    # parallel learners too — each shard clamps its local candidates
+    with_mono = cfg.with_monotone
 
     def hist_view(h):
         """[G, B, 3] bundle histogram -> [F, B, 3] split view (EFB)."""
@@ -201,8 +201,9 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
                                            **find_kwargs)
             bcast_from_winner = _winner_sync(my, f_offset)
 
-            def find_split(hist, sg, sh, cnt, fmask):
-                return bcast_from_winner(find_local(hist, sg, sh, cnt, fmask))
+            def find_split(hist, sg, sh, cnt, fmask, **constraints):
+                return bcast_from_winner(find_local(hist, sg, sh, cnt, fmask,
+                                                    **constraints))
 
         elif data_mode:
             # DataParallelTreeLearner with the reference's actual wire
@@ -235,10 +236,11 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
                 return lax.psum_scatter(h, axis_name, scatter_dimension=0,
                                         tiled=True)
 
-            def find_split(hist_loc, sg, sh, cnt, fmask):
+            def find_split(hist_loc, sg, sh, cnt, fmask, **constraints):
                 fmask_loc = lax.dynamic_slice_in_dim(fmask_p, f_offset, Floc)
                 return bcast_from_winner(
-                    find_local(hist_loc, sg, sh, cnt, fmask_loc))
+                    find_local(hist_loc, sg, sh, cnt, fmask_loc,
+                               **constraints))
 
         elif voting_mode:
             k_vote = min(top_k, F)
@@ -248,7 +250,7 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
             vote_kwargs["min_sum_hessian_in_leaf"] = \
                 cfg.min_sum_hessian_in_leaf / max(num_machines, 1)
 
-            def find_split(hist_local, sg, sh, cnt, fmask):
+            def find_split(hist_local, sg, sh, cnt, fmask, **constraints):
                 # phase 1: vote top_k features by LOCAL split gain with
                 # 1/num_machines-scaled constraints (:53-55, :322-342)
                 local_tot = jnp.sum(hist_local[0], axis=0)
@@ -268,7 +270,8 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
                 hsel = lax.psum(hist_local[sel], axis_name)
                 meta_sel = FeatureMeta(*[a[sel] for a in meta])
                 res = find_best_split(hsel, sg, sh, cnt, fmask[sel],
-                                      meta=meta_sel, **find_kwargs)
+                                      meta=meta_sel, **find_kwargs,
+                                      **constraints)
                 return res._replace(feature=sel[res.feature])
 
         else:

@@ -142,3 +142,19 @@ def test_monotone_with_forced_splits(tmp_path):
             assert root["split_feature"] == 2
             _walk_monotone(root, 1, 0)
             _walk_monotone(root, -1, 1)
+
+
+def test_monotone_on_data_parallel_learner():
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs a multi-device mesh")
+    X, y = _mono_data(n=1024)
+    params = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+              "monotone_constraints": [1, -1, 0], "min_data_in_leaf": 10,
+              "tree_learner": "data"}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=6)
+    for t in bst.dump_model()["tree_info"]:
+        root = t["tree_structure"]
+        if "split_feature" in root:
+            _walk_monotone(root, 1, 0)
+            _walk_monotone(root, -1, 1)
