@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 
 from ..io.binning import BIN_TYPE_CATEGORICAL
@@ -318,6 +319,32 @@ class _FastState:
                                      payload[:n_pad, cnt_col])
             return _grow_and_score(payload, aux, fmask, lr, k)
 
+        bmap_fs = gbdt.bundle_map
+        meta_fs = gbdt.meta_dev
+        depth_iters_fs = max(gbdt.grower_cfg.num_leaves - 1, 1)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def payload_tree_add(payload, tree_dev, leaf_scaled, k):
+            """score[:, k] += leaf_scaled[leaf(x)] routed by the payload's
+            OWN bin columns — rows sit in partition order and the bins ride
+            along, so DART's drop/normalize score edits (and any other
+            tree replay) never need the original row order."""
+            bins_cols = payload[:n_pad, :G]
+            body = _make_decision_body(
+                tree_dev, meta_fs, bmap_fs,
+                lambda f: jnp.take_along_axis(
+                    bins_cols, bmap_fs.f_group[f][:, None],
+                    axis=1)[:, 0].astype(jnp.int32))
+            nd = lax.fori_loop(0, depth_iters_fs, body,
+                               jnp.zeros(n_pad, jnp.int32))
+            return payload.at[:n_pad, score0 + k].add(leaf_scaled[~nd])
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def apply_const_score(payload, delta, k):
+            return payload.at[:n_pad, score0 + k].add(delta)
+
+        self._payload_tree_add = payload_tree_add
+        self._apply_const_score = apply_const_score
         self._snap_scores = snap_scores
         self._fill_class = fill_class
         self._apply_score = apply_score
@@ -375,14 +402,12 @@ def _update_score_k(score, leaf_id, leaf_out, k):
     return score.at[k].add(leaf_out[leaf_id])
 
 
-@functools.partial(jax.jit, static_argnames=("depth_iters", "k"))
-def _traverse_update(bins_v, score_kv, leaf_out, tree_dev, meta: FeatureMeta,
-                     bmap: BundleMap, depth_iters: int, k: int):
-    """Add one tree's (shrunk) outputs to row k of a [K, M] score matrix by
-    vectorized bin-level traversal (Tree::DecisionInner semantics,
-    tree.h:234-249 / 288-295)."""
-    M = bins_v.shape[1]
-    rows = jnp.arange(M)
+def _make_decision_body(tree_dev, meta: FeatureMeta, bmap: BundleMap,
+                        gather_raw):
+    """One traversal step over per-row node ids (Tree::DecisionInner
+    semantics, tree.h:234-249 / 288-295), shared by the column-major
+    score replay and the payload-order replay — only the raw-bin gather
+    differs between the two layouts."""
     sf, sb, dl, lc, rc = (tree_dev["split_feature"], tree_dev["split_bin"],
                           tree_dev["default_left"], tree_dev["left_child"],
                           tree_dev["right_child"])
@@ -393,7 +418,7 @@ def _traverse_update(bins_v, score_kv, leaf_out, tree_dev, meta: FeatureMeta,
         is_leaf = nd < 0
         ndc = jnp.maximum(nd, 0)
         f = sf[ndc]
-        raw = bins_v[bmap.f_group[f], rows].astype(jnp.int32)
+        raw = gather_raw(f)
         fbin = decode_bin(raw, bmap.f_identity[f], bmap.f_offset[f],
                           meta.num_bin[f], meta.default_bin[f])
         mt = meta.missing_type[f]
@@ -404,6 +429,19 @@ def _traverse_update(bins_v, score_kv, leaf_out, tree_dev, meta: FeatureMeta,
         child = jnp.where(go_left, lc[ndc], rc[ndc])
         return jnp.where(is_leaf, nd, child)
 
+    return body
+
+
+@functools.partial(jax.jit, static_argnames=("depth_iters", "k"))
+def _traverse_update(bins_v, score_kv, leaf_out, tree_dev, meta: FeatureMeta,
+                     bmap: BundleMap, depth_iters: int, k: int):
+    """Add one tree's (shrunk) outputs to row k of a [K, M] score matrix by
+    vectorized bin-level traversal."""
+    M = bins_v.shape[1]
+    rows = jnp.arange(M)
+    body = _make_decision_body(
+        tree_dev, meta, bmap,
+        lambda f: bins_v[bmap.f_group[f], rows].astype(jnp.int32))
     nd = jax.lax.fori_loop(0, depth_iters, body, jnp.zeros(M, jnp.int32))
     return score_kv.at[k].add(leaf_out[~nd])
 
@@ -704,7 +742,8 @@ class GBDT:
         exact in f32.  Everything else keeps the legacy masked grower."""
         cfg = self.config
         return ((type(self) is GBDT
-                 or getattr(self, "_fast_sample_hook", None) is not None)
+                 or getattr(self, "_fast_sample_hook", None) is not None
+                 or getattr(self, "_fast_variant_ok", False))
                 and self.mesh is None
                 and self.objective is not None
                 and getattr(self.objective, "is_rowwise", True)
@@ -898,7 +937,29 @@ class GBDT:
 
     def _add_tree_to_train_score(self, tree: Tree, k: int, scale: float) -> None:
         """score[k] += scale * tree(x) over the training bins (DART drop /
-        normalize, RF running average, continued-training replay)."""
+        normalize, RF running average, continued-training replay).  On the
+        fast path the edit lands in the partition-ordered payload score
+        column, routed by the payload's own bin columns."""
+        if self._fast_active:
+            fs = self._fast
+            if tree.num_leaves > self.grower_cfg.num_leaves:
+                # the payload traversal's trip count covers only trees this
+                # run's grower can produce; oversized loaded trees must be
+                # replayed through the legacy path
+                raise AssertionError(
+                    "payload tree replay got a %d-leaf tree but the grower "
+                    "config allows %d; sync back to the legacy path first"
+                    % (tree.num_leaves, self.grower_cfg.num_leaves))
+            if tree.num_leaves <= 1:
+                fs.payload = fs._apply_const_score(
+                    fs.payload, jnp.float32(scale * tree.leaf_value[0]),
+                    jnp.int32(k))
+                return
+            tree_dev, leaf_out = self._tree_to_device(tree)
+            fs.payload = fs._payload_tree_add(
+                fs.payload, tree_dev, leaf_out * jnp.float32(scale),
+                jnp.int32(k))
+            return
         if tree.num_leaves <= 1:
             self.score = self.score.at[k].add(jnp.float32(scale * tree.leaf_value[0]))
             return

@@ -109,6 +109,9 @@ class DART(GBDT):
         self.tree_weight: list = []
         self.sum_weight = 0.0
         self.drop_index: list = []
+        # drop/normalize score edits route through the payload's own bin
+        # columns on the fast path (GBDT._add_tree_to_train_score)
+        self._fast_variant_ok = True
         Log.info("Using DART")
 
     def _run_tree(self, i: int, k: int):

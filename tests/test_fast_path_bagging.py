@@ -135,3 +135,38 @@ def test_goss_profiled_scores_match_unprofiled():
     b = lgb.train({**params, "tpu_profile_phases": True},
                   lgb.Dataset(X, label=y), num_boost_round=6)
     assert a.model_to_string() == b.model_to_string()
+
+
+def test_dart_runs_on_fast_path(monkeypatch):
+    X, y = _data(n=800)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "boosting": "dart", "drop_rate": 0.5, "drop_seed": 4,
+              "learning_rate": 0.2, "min_data_in_leaf": 5}
+    fast = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=10)
+    assert fast._engine._fast_active
+    acc_fast = np.mean((fast.predict(X) > 0.5) == (y > 0.5))
+    assert acc_fast > 0.85
+
+    monkeypatch.setattr(GBDT, "_fast_eligible", lambda self: False)
+    slow = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=10)
+    acc_slow = np.mean((slow.predict(X) > 0.5) == (y > 0.5))
+    assert abs(acc_fast - acc_slow) < 0.05
+    # the host-side drop RNG is engine-independent: identical drop
+    # bookkeeping means identical shrinkage schedules
+    assert fast._engine.tree_weight == pytest.approx(
+        slow._engine.tree_weight)
+    np.testing.assert_allclose(fast.predict(X), slow.predict(X),
+                               rtol=0.1, atol=0.02)
+
+
+def test_dart_xgboost_mode_fast():
+    X, y = _data(n=600, seed=9)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "boosting": "dart", "drop_rate": 0.4, "xgboost_dart_mode": True,
+              "uniform_drop": True, "learning_rate": 0.2,
+              "min_data_in_leaf": 5}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
+    assert bst._engine._fast_active
+    assert np.mean((bst.predict(X) > 0.5) == (y > 0.5)) > 0.8
