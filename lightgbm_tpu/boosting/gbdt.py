@@ -343,8 +343,38 @@ class _FastState:
         def apply_const_score(payload, delta, k):
             return payload.at[:n_pad, score0 + k].add(delta)
 
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def scale_score(payload, factor, k):
+            return payload.at[:n_pad, score0 + k].multiply(factor)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step_rf(payload, aux, fmask):
+            """RF's fused tree (rf.hpp Boosting): gradients of the ZERO
+            score masked by the bagged count column, then growth — one
+            dispatch, like the base fast path's _step.  Scoring is the
+            caller's job (running average, not an additive update)."""
+            zeros = jnp.zeros((K, n_pad), jnp.float32)
+            g, h = obj.get_gradients_multi(zeros, payload[:n_pad, G],
+                                           payload[:n_pad, G + 1])
+            valid = payload[:n_pad, cnt_col]
+            payload = payload.at[:n_pad, grad_col].set(g[0] * valid)
+            payload = payload.at[:n_pad, hess_col].set(h[0] * valid)
+            return grower.__wrapped__(payload, aux, fmask) \
+                if hasattr(grower, "__wrapped__") else grower(payload, aux,
+                                                              fmask)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def rf_score_update(payload, tree_dev, leaf_scaled, m):
+            """score = (score*m + tree)/(m+1) in one dispatch."""
+            payload = payload.at[:n_pad, score0].multiply(m / (m + 1.0))
+            return payload_tree_add.__wrapped__(
+                payload, tree_dev, leaf_scaled / (m + 1.0), jnp.int32(0))
+
         self._payload_tree_add = payload_tree_add
         self._apply_const_score = apply_const_score
+        self._scale_score = scale_score
+        self._step_rf = step_rf
+        self._rf_score_update = rf_score_update
         self._snap_scores = snap_scores
         self._fill_class = fill_class
         self._apply_score = apply_score
@@ -758,31 +788,38 @@ class GBDT:
         self.score = jnp.asarray(self._fast.raw_scores())
         self._fast_active = False
 
-    def _train_one_iter_fast(self) -> bool:
-        init_score = self._boost_from_average()
+    def _fast_enter(self) -> "_FastState":
         if self._fast is None:
             self._fast = _FastState(self)
             self._fast_active = True
         elif not self._fast_active:
             self._fast.reset(self)
             self._fast_active = True
-        fs = self._fast
-        fmask = self._feature_sample()
+        return self._fast
+
+    def _fast_refresh_bag(self, fs) -> None:
         cfg = self.config
-        if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
-            # same RNG stream as the masked path, so both paths draw
-            # identical bags (equality-testable).  The cnt column rides
-            # the partition, so only an actual resample (or a rebuilt
-            # payload) needs the gather+scatter refresh.
-            resampled = self.iter % cfg.bagging_freq == 0
-            with self.timer.phase("bagging"):
-                bag = self._bagging()    # advances the RNG on resample
-                if resampled or fs._bag_dirty:
-                    # bag_mask_host is already zero on padded rows
-                    fs.payload = fs._set_bag(fs.payload,
-                                             bag.astype(jnp.float32))
-                    fs._bag_dirty = False
-                self.timer.sync(fs.payload)
+        if not (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0):
+            return
+        # same RNG stream as the masked path, so both paths draw
+        # identical bags (equality-testable).  The cnt column rides
+        # the partition, so only an actual resample (or a rebuilt
+        # payload) needs the gather+scatter refresh.
+        resampled = self.iter % cfg.bagging_freq == 0
+        with self.timer.phase("bagging"):
+            bag = self._bagging()    # advances the RNG on resample
+            if resampled or fs._bag_dirty:
+                # bag_mask_host is already zero on padded rows
+                fs.payload = fs._set_bag(fs.payload,
+                                         bag.astype(jnp.float32))
+                fs._bag_dirty = False
+            self.timer.sync(fs.payload)
+
+    def _train_one_iter_fast(self) -> bool:
+        init_score = self._boost_from_average()
+        fs = self._fast_enter()
+        fmask = self._feature_sample()
+        self._fast_refresh_bag(fs)
         if fs.K > 1:
             fs.payload = fs._snap_scores(fs.payload)
 
@@ -940,16 +977,13 @@ class GBDT:
         normalize, RF running average, continued-training replay).  On the
         fast path the edit lands in the partition-ordered payload score
         column, routed by the payload's own bin columns."""
+        if self._fast_active and tree.num_leaves > self.grower_cfg.num_leaves:
+            # the payload traversal's trip count covers only trees this
+            # run's grower can produce; replay oversized loaded trees
+            # through the legacy path (it sizes the traversal per tree)
+            self._fast_sync_back()
         if self._fast_active:
             fs = self._fast
-            if tree.num_leaves > self.grower_cfg.num_leaves:
-                # the payload traversal's trip count covers only trees this
-                # run's grower can produce; oversized loaded trees must be
-                # replayed through the legacy path
-                raise AssertionError(
-                    "payload tree replay got a %d-leaf tree but the grower "
-                    "config allows %d; sync back to the legacy path first"
-                    % (tree.num_leaves, self.grower_cfg.num_leaves))
             if tree.num_leaves <= 1:
                 fs.payload = fs._apply_const_score(
                     fs.payload, jnp.float32(scale * tree.leaf_value[0]),

@@ -226,6 +226,7 @@ class RF(GBDT):
         obj = self.objective
         self._leaf_transform = lambda lv: obj.convert_output(lv)
         self._metric_objective = None
+        self._fast_variant_ok = True  # custom fast iteration below
         Log.info("Using RF")
 
     def _boost_from_average(self) -> float:
@@ -242,8 +243,42 @@ class RF(GBDT):
             self._grad_fn = jax.jit(gradfn)
         return self._grad_fn(self.score, self.label_dev, self.weight_dev)
 
+    def _train_one_iter_fast_rf(self) -> bool:
+        """RF on the partition-ordered fast path: zero-score gradients,
+        bagged counts, and the running-average score folded into the
+        payload score column (score = (score*m + tree)/(m+1), rf.hpp:
+        118-122) via the payload-order tree replay."""
+        from .gbdt import _traverse_update
+        fs = self._fast_enter()
+        self._fast_refresh_bag(fs)
+        fmask = self._feature_sample()
+        fs.payload = fs._fill_zero_grads(fs.payload)
+        out, fs.payload, fs.aux = fs.grower(fs.payload, fs.aux, fmask)
+        tree, tree_dev, leaf_out = self._finish_tree(out, 0.0, None)
+        m = float(self.iter + self.num_init_iteration)
+        if tree.num_leaves > 1:
+            fs.payload = fs._scale_score(
+                fs.payload, jnp.float32(m / (m + 1.0)), jnp.int32(0))
+            fs.payload = fs._payload_tree_add(
+                fs.payload, tree_dev, leaf_out / jnp.float32(m + 1.0),
+                jnp.int32(0))
+            depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
+            for vs in self.valid_sets:
+                vs[3] = vs[3].at[0].multiply(jnp.float32(m / (m + 1.0)))
+                vs[3] = _traverse_update(
+                    vs[2], vs[3], leaf_out / jnp.float32(m + 1.0), tree_dev,
+                    self.meta_dev, self.bundle_map, depth_iters, 0)
+        else:
+            tree.leaf_value[0] = 0.0
+        self.model.trees.append(tree)
+        self.iter += 1
+        return False
+
     def train_one_iter(self, grad=None, hess=None) -> bool:
         from .gbdt import _make_vals, _update_score_k, _traverse_update
+        if grad is None and hess is None and self._fast_eligible():
+            return self._train_one_iter_fast_rf()
+        self._fast_sync_back()
         if grad is None or hess is None:
             grads, hesss = self._gradients()
         else:

@@ -170,3 +170,27 @@ def test_dart_xgboost_mode_fast():
     bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
     assert bst._engine._fast_active
     assert np.mean((bst.predict(X) > 0.5) == (y > 0.5)) > 0.8
+
+
+def test_rf_runs_on_fast_path(monkeypatch):
+    X, y = _data(n=900)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "boosting": "rf", "bagging_freq": 1, "bagging_fraction": 0.7,
+              "feature_fraction": 0.8, "seed": 11, "min_data_in_leaf": 5}
+    fast = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=10)
+    assert fast._engine._fast_active
+    pred_fast = fast.predict(X)
+    acc_fast = np.mean((pred_fast > 0.5) == (y > 0.5))
+    assert acc_fast > 0.8
+
+    monkeypatch.setattr(GBDT, "_fast_eligible", lambda self: False)
+    slow = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=10)
+    # same bag + feature RNG streams -> same trees modulo f32 ulp noise
+    np.testing.assert_allclose(pred_fast, slow.predict(X), rtol=1e-3,
+                               atol=1e-4)
+    d_f = fast.dump_model()["tree_info"][0]["tree_structure"]
+    d_s = slow.dump_model()["tree_info"][0]["tree_structure"]
+    assert d_f["split_feature"] == d_s["split_feature"]
+    assert d_f["internal_count"] == d_s["internal_count"]
