@@ -244,24 +244,21 @@ class RF(GBDT):
         return self._grad_fn(self.score, self.label_dev, self.weight_dev)
 
     def _train_one_iter_fast_rf(self) -> bool:
-        """RF on the partition-ordered fast path: zero-score gradients,
-        bagged counts, and the running-average score folded into the
-        payload score column (score = (score*m + tree)/(m+1), rf.hpp:
-        118-122) via the payload-order tree replay."""
+        """RF on the partition-ordered fast path: gradients of the ZERO
+        score masked by the bagged count column, growth, and the
+        running-average score fold (score = (score*m + tree)/(m+1),
+        rf.hpp:118-122) — the tree step and the score fold are each ONE
+        device dispatch (_FastState._step_rf / _rf_score_update)."""
         from .gbdt import _traverse_update
         fs = self._fast_enter()
         self._fast_refresh_bag(fs)
         fmask = self._feature_sample()
-        fs.payload = fs._fill_zero_grads(fs.payload)
-        out, fs.payload, fs.aux = fs.grower(fs.payload, fs.aux, fmask)
+        out, fs.payload, fs.aux = fs._step_rf(fs.payload, fs.aux, fmask)
         tree, tree_dev, leaf_out = self._finish_tree(out, 0.0, None)
         m = float(self.iter + self.num_init_iteration)
         if tree.num_leaves > 1:
-            fs.payload = fs._scale_score(
-                fs.payload, jnp.float32(m / (m + 1.0)), jnp.int32(0))
-            fs.payload = fs._payload_tree_add(
-                fs.payload, tree_dev, leaf_out / jnp.float32(m + 1.0),
-                jnp.int32(0))
+            fs.payload = fs._rf_score_update(fs.payload, tree_dev, leaf_out,
+                                             jnp.float32(m))
             depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
             for vs in self.valid_sets:
                 vs[3] = vs[3].at[0].multiply(jnp.float32(m / (m + 1.0)))

@@ -35,13 +35,37 @@ from .split import MISSING_NAN, MISSING_ZERO
 # must match ops.segment.CHUNK (payload guard sizing)
 CHUNK = 256
 
-# VMEM budget gate: the joint one-hot is [CHUNK, F*B] f32.  Beyond this the
-# caller keeps the portable path (EFB keeps real workloads far below it).
-MAX_FB_COLS = 8192
+# per-tile one-hot budget: the joint one-hot over one FEATURE TILE is
+# [CHUNK, ~TILE_FB] f32 (4 MB).  Features are tiled so any F streams
+# through the same VMEM window — the role of the workgroup grid in the
+# reference OpenCL kernels (ocl/histogram256.cl:73-121).
+TILE_FB = 4096
+
+#: VMEM the kernel may plan for (chip has ~16 MB/core; leave headroom for
+#: the compiler's own buffers)
+_VMEM_BUDGET = 13 * 2**20
+
+
+def _pad128(n: int) -> int:
+    return -(-n // 128) * 128
+
+
+def _tiling(num_features: int, num_bins: int):
+    """(features-per-tile, tile count, padded one-hot width)."""
+    ft = max(1, min(num_features, TILE_FB // num_bins))
+    n_tiles = -(-num_features // ft)
+    return ft, n_tiles, _pad128(ft * num_bins)
 
 
 def fits_vmem(num_features: int, num_bins: int) -> bool:
-    return num_features * num_bins <= MAX_FB_COLS
+    """True when the tiled kernel's VMEM plan fits the budget: the one-hot
+    tile + the [8 * n_tiles, W] accumulator + the payload chunk."""
+    ft, n_tiles, w = _tiling(num_features, num_bins)
+    est = (4 * CHUNK * w                       # one-hot tile
+           + 4 * 8 * n_tiles * w               # accumulator
+           + 2 * 4 * CHUNK * _pad128(num_features + 32)  # chunk scratch
+           + 4 * ft * w)                       # window expander
+    return est <= _VMEM_BUDGET
 
 
 def _row_iota():
@@ -53,26 +77,31 @@ def _row_iota():
 # ---------------------------------------------------------------------------
 
 def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
-                 F, B, grad_col, hess_col, cnt_col):
+                 F, B, Ft, W, grad_col, hess_col, cnt_col):
     start = scalars[0]
     count = scalars[1]
     nch = (count + CHUNK - 1) // CHUNK
+    n_tiles = -(-F // Ft)
     out_ref[:] = jnp.zeros(out_ref.shape, out_ref.dtype)
     iota_rows = _row_iota()
 
     # one-hot machinery, built once before the chunk loop.  E[f, j] = 1 iff
-    # column j lies in feature f's B-wide window; expanding the [C, F] bin
-    # values through E on the MXU broadcasts each feature's bin across its
-    # window, and a single [C, F*B] compare against the within-window offset
-    # finishes the one-hot — Mosaic supports neither 3D reshape/broadcast
-    # nor cheap per-feature lane writes, and this keeps VPU work at O(F*B)
-    # per row instead of the O(F^2*B) of per-feature full-width compares.
-    iota_fr = lax.broadcasted_iota(jnp.int32, (F, F * B), 0)
-    iota_fc = lax.broadcasted_iota(jnp.int32, (F, F * B), 1)
+    # column j lies in tile-local feature f's B-wide window; expanding a
+    # [C, Ft] tile of bin values through E on the MXU broadcasts each
+    # feature's bin across its window, and a single [C, W] compare against
+    # the within-window offset finishes the one-hot — Mosaic supports
+    # neither 3D reshape/broadcast nor cheap per-feature lane writes, and
+    # this keeps VPU work at O(F*B) per row total across tiles.  The
+    # window geometry is identical for every tile, so E/jmod are built once
+    # at full tile width; a ragged last tile just row-slices E (its junk
+    # window columns read expand == 0 and land past Ft*B or in windows of
+    # features >= F — both discarded by the host-side slice).
+    iota_fr = lax.broadcasted_iota(jnp.int32, (Ft, W), 0)
+    iota_fc = lax.broadcasted_iota(jnp.int32, (Ft, W), 1)
     d = iota_fc - iota_fr * B
     in_win = (d >= 0) & (d < B)
-    E = in_win.astype(jnp.float32)                               # [F, F*B]
-    jmod = jnp.sum(jnp.where(in_win, d, 0), axis=0)              # [F*B] i32
+    E = in_win.astype(jnp.float32)                               # [Ft, W]
+    jmod = jnp.sum(jnp.where(in_win, d, 0), axis=0)              # [W] i32
     jmod_f = jmod.astype(jnp.float32)
 
     def body(k, _):
@@ -82,11 +111,6 @@ def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
         dma.wait()
         data = chunk[:]
         ok = (iota_rows < (count - k * CHUNK)).astype(jnp.float32)
-        binsf = data[:, :F]                                      # [C, F] f32
-        expand = lax.dot_general(
-            binsf, E, dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)                  # [C, F*B]
-        onehot = (expand == jmod_f[None, :]).astype(jnp.float32)
         # rows 0..2 of vals = (grad, hess, cnt) columns of data, selected by
         # a static 0/1 matrix — Mosaic can't stack 1-D slices into [8, C]
         P = data.shape[1]
@@ -99,9 +123,19 @@ def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
             sel, data, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)                  # [8, C]
         vals = vals * ok[None, :]
-        out_ref[:] += lax.dot_general(
-            vals, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)                  # [8, F*B]
+        # feature tiles walk the SAME resident chunk — the payload is read
+        # from HBM once per histogram no matter how wide it is
+        for t in range(n_tiles):
+            f0 = t * Ft
+            fw = min(Ft, F - f0)
+            binsf = data[:, f0:f0 + fw]                          # [C, fw] f32
+            expand = lax.dot_general(
+                binsf, E[:fw, :], dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)              # [C, W]
+            onehot = (expand == jmod_f[None, :]).astype(jnp.float32)
+            out_ref[8 * t:8 * t + 8, :] += lax.dot_general(
+                vals, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)              # [8, W]
         return 0
 
     lax.fori_loop(0, nch, body, 0, unroll=False)
@@ -114,9 +148,11 @@ def segment_histogram(payload, start, count, *, num_features, num_bins,
                       grad_col, hess_col, cnt_col, interpret=False):
     """hist[F, B, 3] over payload rows [start, start+count) — TPU kernel."""
     F, B, P = num_features, num_bins, payload.shape[1]
+    Ft, n_tiles, W = _tiling(F, B)
     scalars = jnp.stack([start, count]).astype(jnp.int32)
-    kern = functools.partial(_hist_kernel, F=F, B=B, grad_col=grad_col,
-                             hess_col=hess_col, cnt_col=cnt_col)
+    kern = functools.partial(_hist_kernel, F=F, B=B, Ft=Ft, W=W,
+                             grad_col=grad_col, hess_col=hess_col,
+                             cnt_col=cnt_col)
     out = pl.pallas_call(
         kern,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -129,10 +165,14 @@ def segment_histogram(payload, start, count, *, num_features, num_bins,
                 pltpu.SemaphoreType.DMA(()),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((8, F * B), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((8 * n_tiles, W), jnp.float32),
         interpret=interpret,
     )(scalars, payload)
-    return out[:3].reshape(3, F, B).transpose(1, 2, 0)
+    # [8*T, W] -> [T, 8, W] -> grad/hess/cnt of the real window columns
+    # -> [3, T*Ft, B] -> drop tile padding features -> [F, B, 3]
+    return (out.reshape(n_tiles, 8, W)[:, :3, :Ft * B]
+            .reshape(n_tiles, 3, Ft, B).transpose(1, 0, 2, 3)
+            .reshape(3, n_tiles * Ft, B)[:, :F].transpose(1, 2, 0))
 
 
 # ---------------------------------------------------------------------------

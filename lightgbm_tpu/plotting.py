@@ -28,6 +28,22 @@ def _float2str(value: float, precision: Optional[int]) -> str:
     return str(value)
 
 
+def _decorate_axes(ax, xlim, ylim, title, xlabel, ylabel, grid: bool):
+    """Shared axes finishing: explicit limits are validated, None limits
+    keep whatever default the caller computed, labels apply when given."""
+    for lim, setter, name in ((xlim, ax.set_xlim, "xlim"),
+                              (ylim, ax.set_ylim, "ylim")):
+        if lim is not None:
+            _check_not_tuple_of_2_elements(lim, name)
+            setter(lim)
+    for text, setter in ((title, ax.set_title), (xlabel, ax.set_xlabel),
+                         (ylabel, ax.set_ylabel)):
+        if text is not None:
+            setter(text)
+    ax.grid(grid)
+    return ax
+
+
 def _to_booster(booster) -> Booster:
     """Accept Booster or a fitted sklearn estimator."""
     if isinstance(booster, Booster):
@@ -55,17 +71,23 @@ def plot_importance(booster, ax=None, height: float = 0.2,
         raise ImportError("You must install matplotlib to plot importance.") from e
 
     booster = _to_booster(booster)
-    importance = booster.feature_importance(importance_type=importance_type)
+    importance = np.asarray(
+        booster.feature_importance(importance_type=importance_type))
     feature_name = booster.feature_name()
     if not len(importance):
         raise ValueError("Booster's feature_importance is empty.")
 
-    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    # ascending by importance so the largest bar lands on top; stable sort
+    # keeps tied features in model order like the reference plot
+    order = np.argsort(importance, kind="stable")
     if ignore_zero:
-        tuples = [x for x in tuples if x[1] > 0]
+        order = order[importance[order] > 0]
     if max_num_features is not None and max_num_features > 0:
-        tuples = tuples[-max_num_features:]
-    labels, values = zip(*tuples)
+        order = order[-max_num_features:]
+    if not len(order):
+        raise ValueError("No features with non-zero importance to plot.")
+    values = importance[order]
+    labels = [feature_name[i] for i in order]
 
     if ax is None:
         if figsize is not None:
@@ -74,31 +96,18 @@ def plot_importance(booster, ax=None, height: float = 0.2,
 
     ylocs = np.arange(len(values))
     ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    fmt = ((lambda v: _float2str(v, precision))
+           if importance_type == "gain" else (lambda v: str(int(v))))
     for x, y in zip(values, ylocs):
-        ax.text(x + 1, y, _float2str(x, precision)
-                if importance_type == "gain" else str(int(x)),
-                va="center")
+        ax.text(x + 1, y, fmt(x), va="center")
     ax.set_yticks(ylocs)
     ax.set_yticklabels(labels)
 
-    if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
-    else:
-        xlim = (0, max(values) * 1.1)
-    ax.set_xlim(xlim)
-    if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
-    else:
-        ylim = (-1, len(values))
-    ax.set_ylim(ylim)
-    if title is not None:
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+    if xlim is None:
+        ax.set_xlim((0, float(values.max()) * 1.1))
+    if ylim is None:
+        ax.set_ylim((-1, len(values)))
+    return _decorate_axes(ax, xlim, ylim, title, xlabel, ylabel, grid)
 
 
 def plot_metric(booster: Union[Dict, Booster], metric: Optional[str] = None,
@@ -168,27 +177,14 @@ def plot_metric(booster: Union[Dict, Booster], metric: Optional[str] = None,
         ax.plot(x_, results, label=name)
 
     ax.legend(loc="best")
-    if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
-    else:
-        xlim = (0, num_iteration)
-    ax.set_xlim(xlim)
-    if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
-    else:
-        range_result = max_result - min_result
-        ylim = (min_result - range_result * 0.2, max_result + range_result * 0.2)
-    ax.set_ylim(ylim)
+    if xlim is None:
+        ax.set_xlim((0, num_iteration))
+    if ylim is None:
+        spread = max_result - min_result
+        ax.set_ylim((min_result - spread * 0.2, max_result + spread * 0.2))
     if ylabel == "auto":
         ylabel = metric
-    if title is not None:
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+    return _decorate_axes(ax, xlim, ylim, title, xlabel, ylabel, grid)
 
 
 def _to_graphviz(tree_info: Dict, show_info: List[str],

@@ -40,6 +40,44 @@ def test_histogram_matches(start, count):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("f,b,start,count", [
+    (137, 256, 0, 300),    # MS-LTR shape: 9 tiles of 16, ragged last (9)
+    (70, 64, 100, 351),    # 2 tiles of 64, ragged last (6)
+    (700, 256, 256, 260),  # Expo/Yahoo shape: 44 tiles, ragged last (12)
+])
+def test_histogram_matches_tiled(f, b, start, count):
+    """Feature-tiled kernel vs portable engine at wide-feature shapes the
+    old F*B <= 8192 gate excluded (reference handles these through the
+    OpenCL workgroup grid, ocl/histogram256.cl:73-121)."""
+    assert pseg.fits_vmem(f, b), "gate must admit this shape now"
+    cols = dict(grad_col=f, hess_col=f + 1, cnt_col=f + 2)
+    p = f + 4
+    rng = np.random.default_rng(f + b)
+    n_pad = 640
+    pay = np.zeros((n_pad + seg.CHUNK, p), np.float32)
+    pay[:n_pad, :f] = rng.integers(0, b, size=(n_pad, f))
+    pay[:n_pad, f] = rng.standard_normal(n_pad)
+    pay[:n_pad, f + 1] = rng.random(n_pad)
+    pay[:n_pad, f + 2] = 1.0
+    pay = jnp.asarray(pay)
+    ref = seg.segment_histogram(pay, jnp.int32(start), jnp.int32(count),
+                                num_features=f, num_bins=b, **cols)
+    got = pseg.segment_histogram(pay, jnp.int32(start), jnp.int32(count),
+                                 num_features=f, num_bins=b, interpret=True,
+                                 **cols)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_vmem_gate_admits_benchmark_shapes():
+    """Every BASELINE.md dense workload shape must ride the TPU kernel;
+    only the extreme wide-sparse shapes (pre-EFB Allstate) may fall back."""
+    assert pseg.fits_vmem(28, 255)    # Higgs
+    assert pseg.fits_vmem(137, 256)   # MS-LTR
+    assert pseg.fits_vmem(700, 256)   # Expo / Yahoo LTR
+    assert not pseg.fits_vmem(4228, 256)  # raw Allstate: portable path
+
+
 def _pred(feature=1, threshold=B // 2, default_left=False, is_cat=False,
           bitset=None, missing_type=0, num_bin=B, default_bin=0,
           offset=0, identity=True):

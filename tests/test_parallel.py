@@ -91,6 +91,31 @@ def test_dryrun_multichip_entry():
     g.dryrun_multichip(NDEV)
 
 
+def test_dryrun_env_is_hermetic_against_dead_tunnel(monkeypatch):
+    """The round-3 driver failure mode: an accelerator sitecustomize on
+    PYTHONPATH plus JAX_PLATFORMS pointing at a dead tunnel.  The dryrun's
+    scrubbed environment must bring a fresh interpreter up on the virtual
+    CPU platform regardless — proven by actually starting one."""
+    import os
+    import subprocess
+    import sys
+    import __graft_entry__ as g
+
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.255.255.1")  # unroutable
+    monkeypatch.setenv("PALLAS_AXON_REMOTE_COMPILE", "1")
+    monkeypatch.setenv("PYTHONPATH", "/root/.axon_site" + os.pathsep
+                       + os.environ.get("PYTHONPATH", ""))
+    env = g._hermetic_cpu_env(NDEV)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "PALLAS_AXON_POOL_IPS" not in env
+    assert "axon" not in env.get("PYTHONPATH", "")
+    check = ("import jax; assert jax.default_backend() == 'cpu', "
+             "jax.default_backend(); assert len(jax.devices()) >= %d" % NDEV)
+    proc = subprocess.run([sys.executable, "-c", check], env=env, timeout=120)
+    assert proc.returncode == 0
+
+
 def test_entry_compiles():
     import __graft_entry__ as g
     fn, args = g.entry()
