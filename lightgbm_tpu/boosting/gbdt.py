@@ -76,6 +76,14 @@ def _cached_grower(meta_dev: FeatureMeta, cfg, max_num_bin: int, ds: BinnedDatas
 
 _PGROWER_CACHE: Dict = {}
 
+#: row count past which the fast path's f32 index column splits into
+#: radix-4096 (hi, lo) halves (f32 integers are exact below 2^24; tests
+#: lower this to exercise the wide layout at small N)
+_IDX_WIDE_THRESHOLD = 1 << 24
+
+#: radix of the split index
+_IDX_RADIX = 4096.0
+
 _PACK_CACHE: Dict = {}
 
 
@@ -114,20 +122,54 @@ def _fetch_packed(out: Dict) -> Dict[str, np.ndarray]:
     return host
 
 
+#: grower2 tree-dict fields that are replicated in value across a mesh
+#: (everything except the per-device row-segment bookkeeping)
+_PTREE_REPLICATED = (
+    "num_leaves", "leaf_value", "leaf_count", "leaf_sum_g", "leaf_sum_h",
+    "split_feature", "split_bin", "split_gain", "default_left",
+    "split_is_cat", "split_cat_bitset", "left_child", "right_child",
+    "internal_value", "internal_count")
+
+
 def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
                     ds: BinnedDataset, cols: PayloadCols, payload_width: int,
-                    bundle_map=None, forced=None):
+                    bundle_map=None, forced=None, mesh=None, mesh_axis=None,
+                    mode="data", top_k=20):
     key = (cfg, max_num_bin, ds.bins.shape, cols, payload_width,
-           _bundle_key(ds), forced,
+           _bundle_key(ds), forced, mesh, mesh_axis, mode, top_k,
            tuple((m.num_bin, m.missing_type, m.default_bin, m.is_trivial, m.bin_type)
                  for m in ds.bin_mappers),
            ds.monotone_constraints.tobytes(), ds.feature_penalty.tobytes())
     grower = _PGROWER_CACHE.get(key)
     if grower is None:
-        grower = make_partitioned_grower(
-            meta_dev, cfg, max_num_bin, cols, ds.num_features,
-            bundle_map=bundle_map, num_columns=ds.bins.shape[0],
-            forced=forced)
+        if mesh is None:
+            grower = make_partitioned_grower(
+                meta_dev, cfg, max_num_bin, cols, ds.num_features,
+                bundle_map=bundle_map, num_columns=ds.bins.shape[0],
+                forced=forced)
+        else:
+            # the mesh fast path: the SAME partitioned engine per shard
+            # (local row blocks partition locally), collectives at the
+            # histogram boundary only — the reference's learner inheritance
+            # (data_parallel_tree_learner.cpp:147 IS SerialTreeLearner +
+            # network), kept structurally
+            from jax.sharding import PartitionSpec as P
+            ax = mesh_axis
+            grow = make_partitioned_grower(
+                meta_dev, cfg, max_num_bin, cols, ds.num_features,
+                jit=False, bundle_map=bundle_map,
+                num_columns=ds.bins.shape[0], forced=forced,
+                axis_name=ax, mode=mode,
+                num_machines=int(mesh.shape[ax]), top_k=top_k)
+            tree_specs = dict.fromkeys(_PTREE_REPLICATED, P())
+            # per-device row segments come back stacked [ndev * L]
+            tree_specs["seg_start"] = P(ax)
+            tree_specs["seg_cnt"] = P(ax)
+            grower = jax.jit(jax.shard_map(
+                grow, mesh=mesh,
+                in_specs=(P(ax, None), P(ax, None), P(None)),
+                out_specs=(tree_specs, P(ax, None), P(ax, None)),
+                check_vma=False), donate_argnums=(0, 1))
         _PGROWER_CACHE[key] = grower
     return grower
 
@@ -151,6 +193,20 @@ class _FastState:
         K = gbdt.num_tree_per_iteration
         n_pad = ds.num_data_padded
         self.G, self.K, self.n_pad = G, K, n_pad
+        # mesh fast path: rows live in ndev device blocks of n_loc real rows
+        # + a CHUNK guard tail EACH (the partition kernels overrun into the
+        # guard, so it must sit at the end of every LOCAL block, not just
+        # the global tail).  Guard rows carry idx == n_pad — a dead slot
+        # that every original-order consumer (bag refresh, score sync)
+        # filters or routes to a zero entry.  Serial is the ndev == 1 case.
+        mesh = gbdt.mesh if gbdt.parallel_mode in ("data", "voting") else None
+        self.mesh = mesh
+        ndev = int(mesh.shape[gbdt.mesh_axis]) if mesh is not None else 1
+        self.ndev = ndev
+        n_loc = n_pad // ndev
+        self.n_loc = n_loc
+        n_rows = (n_loc + seg.CHUNK) * ndev
+        self.n_rows = n_rows
         self.label_col = G
         self.weight_col = G + 1
         self.cnt_col = G + 2
@@ -172,7 +228,12 @@ class _FastState:
         # one selection per iteration that RIDES the per-tree partitions.
         self.bvalid_col = self.value_col + 1
         self.gweight_col = self.bvalid_col + 1
-        self.P = self.gweight_col + 1
+        # past ~2^24 rows an f32 index column loses exactness; split the
+        # index into radix-4096 (hi, lo) halves — both remain exact through
+        # the one-hot permutation matmuls (each output is a single-term sum)
+        self.wide_idx = (n_pad + 1) >= _IDX_WIDE_THRESHOLD
+        self.idxhi_col = self.gweight_col + 1 if self.wide_idx else None
+        self.P = (self.idxhi_col if self.wide_idx else self.gweight_col) + 1
         if jax.default_backend() == "tpu":
             # Mosaic DMA slices must span whole 128-lane tiles; a [N, P]
             # f32 array is physically padded to 128 lanes on TPU anyway,
@@ -182,19 +243,61 @@ class _FastState:
                                 cnt=self.cnt_col, value=self.value_col)
 
         P, score0, idx_col = self.P, self.score0, self.idx_col
+        cnt_col_, bvalid_col_ = self.cnt_col, self.bvalid_col
 
-        @jax.jit
-        def build(bins, label, weight, vmask, score):
-            pay = jnp.zeros((n_pad + seg.CHUNK, P), jnp.float32)
-            pay = pay.at[:n_pad, :G].set(bins.T.astype(jnp.float32))
-            pay = pay.at[:n_pad, G].set(label)
-            pay = pay.at[:n_pad, G + 1].set(weight)
-            pay = pay.at[:n_pad, self.cnt_col].set(vmask)
-            pay = pay.at[:n_pad, self.bvalid_col].set(vmask)
-            pay = pay.at[:n_pad, idx_col].set(
-                jnp.arange(n_pad, dtype=jnp.float32))
-            pay = pay.at[:n_pad, score0:score0 + K].set(score.T)
+        wide_idx, idxhi_col = self.wide_idx, self.idxhi_col
+
+        def write_idx(pay, rows, idx):
+            """Store integer row indices into the index column(s)."""
+            if wide_idx:
+                pay = pay.at[rows, idxhi_col].set(
+                    jnp.floor_divide(idx, jnp.int32(_IDX_RADIX))
+                    .astype(jnp.float32))
+                idx = jnp.remainder(idx, jnp.int32(_IDX_RADIX))
+            return pay.at[rows, idx_col].set(idx.astype(jnp.float32))
+
+        def read_idx(payload):
+            """Integer row indices from the index column(s)."""
+            idx = payload[:, idx_col].astype(jnp.int32)
+            if wide_idx:
+                idx = idx + payload[:, idxhi_col].astype(jnp.int32) \
+                    * jnp.int32(_IDX_RADIX)
+            return idx
+
+        def build_block(bins, label, weight, vmask, score, idx0):
+            """One device block: n_loc_b real rows + the CHUNK guard tail,
+            guard idx pinned to the dead slot."""
+            n_loc_b = label.shape[0]
+            pay = jnp.zeros((n_loc_b + seg.CHUNK, P), jnp.float32)
+            pay = pay.at[:n_loc_b, :G].set(bins.T.astype(jnp.float32))
+            pay = pay.at[:n_loc_b, G].set(label)
+            pay = pay.at[:n_loc_b, G + 1].set(weight)
+            pay = pay.at[:n_loc_b, cnt_col_].set(vmask)
+            pay = pay.at[:n_loc_b, bvalid_col_].set(vmask)
+            pay = write_idx(pay, slice(None),
+                            jnp.full(pay.shape[0], n_pad, jnp.int32))
+            pay = write_idx(pay, slice(None, n_loc_b),
+                            idx0 + jnp.arange(n_loc_b, dtype=jnp.int32))
+            pay = pay.at[:n_loc_b, score0:score0 + K].set(score.T)
             return pay
+
+        if mesh is None:
+            build = jax.jit(functools.partial(build_block,
+                                              idx0=jnp.int32(0)))
+        else:
+            from jax.sharding import PartitionSpec as PS
+            ax = gbdt.mesh_axis
+
+            def build_local(bins_l, label_l, weight_l, vmask_l, score_l):
+                my = lax.axis_index(ax)
+                return build_block(bins_l, label_l, weight_l, vmask_l,
+                                   score_l, my * n_loc)
+
+            build = jax.jit(jax.shard_map(
+                build_local, mesh=mesh,
+                in_specs=(PS(None, ax), PS(ax), PS(ax), PS(ax),
+                          PS(None, ax)),
+                out_specs=PS(ax, None), check_vma=False))
 
         self._build = build
         self.reset(gbdt)
@@ -202,7 +305,11 @@ class _FastState:
                                       ds.max_num_bin, ds, self.cols, self.P,
                                       bundle_map=gbdt.bundle_map
                                       if ds.bundle_info is not None else None,
-                                      forced=gbdt.forced_schedule)
+                                      forced=gbdt.forced_schedule,
+                                      mesh=mesh, mesh_axis=gbdt.mesh_axis,
+                                      mode=gbdt.parallel_mode or "data",
+                                      top_k=int(getattr(gbdt.config, "top_k",
+                                                        20) or 20))
 
         obj = gbdt.objective
         snap0, cnt_col = self.snap0, self.cnt_col
@@ -210,8 +317,8 @@ class _FastState:
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def snap_scores(payload):
-            return payload.at[:n_pad, snap0:snap0 + K].set(
-                payload[:n_pad, score0:score0 + K])
+            return payload.at[:, snap0:snap0 + K].set(
+                payload[:, score0:score0 + K])
 
         idx_col = self.idx_col
 
@@ -219,21 +326,48 @@ class _FastState:
         def set_bag(payload, combined):
             """Refresh the count-mask column from an ORIGINAL-order
             valid*bag vector — rows sit in partition order, so the index
-            column routes the gather (Bagging, gbdt.cpp:213-295)."""
-            idx = payload[:n_pad, idx_col].astype(jnp.int32)
-            return payload.at[:n_pad, cnt_col].set(combined[idx])
+            column routes the gather (Bagging, gbdt.cpp:213-295).  Guard
+            rows route to the appended dead slot and stay masked out."""
+            combined = jnp.concatenate([combined, jnp.zeros(1, jnp.float32)])
+            return payload.at[:, cnt_col].set(combined[read_idx(payload)])
 
-        def _fill_body(payload, k):
-            """Write class k's gradients into the grad/hess columns —
-            shared by the piecewise (profiled) and fused paths."""
-            snap = payload[:n_pad, snap0:snap0 + K].T
-            g, h = obj.get_gradients_multi(snap, payload[:n_pad, G],
-                                           payload[:n_pad, G + 1])
-            valid = payload[:n_pad, cnt_col]
-            payload = payload.at[:n_pad, grad_col].set(
-                jnp.take(g, k, axis=0) * valid)
-            return payload.at[:n_pad, hess_col].set(
-                jnp.take(h, k, axis=0) * valid)
+        rowwise = getattr(obj, "is_rowwise", True) if obj is not None else True
+        label_orig, weight_orig = gbdt.label_dev, gbdt.weight_dev
+
+        if rowwise:
+            def _fill_body(payload, k):
+                """Write class k's gradients into the grad/hess columns —
+                shared by the piecewise (profiled) and fused paths."""
+                snap = payload[:, snap0:snap0 + K].T
+                g, h = obj.get_gradients_multi(snap, payload[:, G],
+                                               payload[:, G + 1])
+                valid = payload[:, cnt_col]
+                payload = payload.at[:, grad_col].set(
+                    jnp.take(g, k, axis=0) * valid)
+                return payload.at[:, hess_col].set(
+                    jnp.take(h, k, axis=0) * valid)
+        else:
+            def _fill_body(payload, k):
+                """Non-rowwise objectives (lambdarank/xendcg: gradients
+                couple rows within a query): scatter the snapshot scores
+                back to ORIGINAL row order through the index column,
+                compute gradients against the original-order label/weight
+                (where the query boundaries live), and gather the results
+                into the current partition order.  Two permutations per
+                class tree — cheap next to the histogram work."""
+                idx = read_idx(payload)
+                snap = payload[:, snap0:snap0 + K]
+                score_orig = jnp.zeros((K, n_pad + 1), jnp.float32) \
+                    .at[:, idx].set(snap.T)[:, :n_pad]
+                g, h = obj.get_gradients_multi(score_orig, label_orig,
+                                               weight_orig)
+                gp = jnp.pad(g, ((0, 0), (0, 1)))
+                hp = jnp.pad(h, ((0, 0), (0, 1)))
+                valid = payload[:, cnt_col]
+                payload = payload.at[:, grad_col].set(
+                    jnp.take(gp, k, axis=0)[idx] * valid)
+                return payload.at[:, hess_col].set(
+                    jnp.take(hp, k, axis=0)[idx] * valid)
 
         @functools.partial(jax.jit, donate_argnums=(0,),
                            static_argnames=("k",))
@@ -243,8 +377,8 @@ class _FastState:
         @functools.partial(jax.jit, donate_argnums=(0,),
                            static_argnames=("k",))
         def apply_score(payload, lr, k):
-            upd = payload[:n_pad, self.value_col] * lr
-            return payload.at[:n_pad, score0 + k].add(upd)
+            upd = payload[:, self.value_col] * lr
+            return payload.at[:, score0 + k].add(upd)
 
         grower = self.grower
         value_col = self.value_col
@@ -257,8 +391,8 @@ class _FastState:
                                                              fmask)
             # stumps must not move the scores (gbdt.cpp stops instead)
             upd = jnp.where(out["num_leaves"] > 1,
-                            payload[:n_pad, value_col] * lr, 0.0)
-            payload = payload.at[:n_pad, score0 + k].add(upd)
+                            payload[:, value_col] * lr, 0.0)
+            payload = payload.at[:, score0 + k].add(upd)
             return out, payload, aux
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -272,16 +406,16 @@ class _FastState:
             return _grow_and_score(payload, aux, fmask, lr, k)
 
         def _all_grads(payload):
-            snap = payload[:n_pad, snap0:snap0 + K].T
-            return obj.get_gradients_multi(snap, payload[:n_pad, G],
-                                           payload[:n_pad, G + 1])
+            snap = payload[:, snap0:snap0 + K].T
+            return obj.get_gradients_multi(snap, payload[:, G],
+                                           payload[:, G + 1])
 
         def _write_sampled(payload, g, h, k, gw, cm):
-            payload = payload.at[:n_pad, grad_col].set(
+            payload = payload.at[:, grad_col].set(
                 jnp.take(g, k, axis=0) * gw)
-            payload = payload.at[:n_pad, hess_col].set(
+            payload = payload.at[:, hess_col].set(
                 jnp.take(h, k, axis=0) * gw)
-            return payload.at[:n_pad, cnt_col].set(cm)
+            return payload.at[:, cnt_col].set(cm)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step_sampled(payload, aux, fmask, lr, k, key, enabled):
@@ -291,7 +425,7 @@ class _FastState:
             pristine valid column, and class k's weighted gradients plus
             the selection mask land in the working columns."""
             g, h = _all_grads(payload)
-            valid = payload[:n_pad, bvalid_col]
+            valid = payload[:, bvalid_col]
             gw, cm = sample_hook(g * valid, h * valid, valid, key, enabled)
             payload = _write_sampled(payload, g, h, k, gw, cm)
             return _grow_and_score(payload, aux, fmask, lr, k)
@@ -306,17 +440,17 @@ class _FastState:
             repartitions the rows, and columns ride the partition while
             standalone mask arrays would go stale after the first tree."""
             g, h = _all_grads(payload)
-            valid = payload[:n_pad, bvalid_col]
+            valid = payload[:, bvalid_col]
             gw, cm = sample_hook(g * valid, h * valid, valid, key, enabled)
-            payload = payload.at[:n_pad, gweight_col].set(gw)
-            return payload.at[:n_pad, cnt_col].set(cm)
+            payload = payload.at[:, gweight_col].set(gw)
+            return payload.at[:, cnt_col].set(cm)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step_masked(payload, aux, fmask, lr, k):
             g, h = _all_grads(payload)
             payload = _write_sampled(payload, g, h, k,
-                                     payload[:n_pad, gweight_col],
-                                     payload[:n_pad, cnt_col])
+                                     payload[:, gweight_col],
+                                     payload[:, cnt_col])
             return _grow_and_score(payload, aux, fmask, lr, k)
 
         bmap_fs = gbdt.bundle_map
@@ -329,23 +463,23 @@ class _FastState:
             OWN bin columns — rows sit in partition order and the bins ride
             along, so DART's drop/normalize score edits (and any other
             tree replay) never need the original row order."""
-            bins_cols = payload[:n_pad, :G]
+            bins_cols = payload[:, :G]
             body = _make_decision_body(
                 tree_dev, meta_fs, bmap_fs,
                 lambda f: jnp.take_along_axis(
                     bins_cols, bmap_fs.f_group[f][:, None],
                     axis=1)[:, 0].astype(jnp.int32))
             nd = lax.fori_loop(0, depth_iters_fs, body,
-                               jnp.zeros(n_pad, jnp.int32))
-            return payload.at[:n_pad, score0 + k].add(leaf_scaled[~nd])
+                               jnp.zeros(n_rows, jnp.int32))
+            return payload.at[:, score0 + k].add(leaf_scaled[~nd])
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def apply_const_score(payload, delta, k):
-            return payload.at[:n_pad, score0 + k].add(delta)
+            return payload.at[:, score0 + k].add(delta)
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def scale_score(payload, factor, k):
-            return payload.at[:n_pad, score0 + k].multiply(factor)
+            return payload.at[:, score0 + k].multiply(factor)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step_rf(payload, aux, fmask):
@@ -353,12 +487,12 @@ class _FastState:
             score masked by the bagged count column, then growth — one
             dispatch, like the base fast path's _step.  Scoring is the
             caller's job (running average, not an additive update)."""
-            zeros = jnp.zeros((K, n_pad), jnp.float32)
-            g, h = obj.get_gradients_multi(zeros, payload[:n_pad, G],
-                                           payload[:n_pad, G + 1])
-            valid = payload[:n_pad, cnt_col]
-            payload = payload.at[:n_pad, grad_col].set(g[0] * valid)
-            payload = payload.at[:n_pad, hess_col].set(h[0] * valid)
+            zeros = jnp.zeros((K, n_rows), jnp.float32)
+            g, h = obj.get_gradients_multi(zeros, payload[:, G],
+                                           payload[:, G + 1])
+            valid = payload[:, cnt_col]
+            payload = payload.at[:, grad_col].set(g[0] * valid)
+            payload = payload.at[:, hess_col].set(h[0] * valid)
             return grower.__wrapped__(payload, aux, fmask) \
                 if hasattr(grower, "__wrapped__") else grower(payload, aux,
                                                               fmask)
@@ -366,7 +500,7 @@ class _FastState:
         @functools.partial(jax.jit, donate_argnums=(0,))
         def rf_score_update(payload, tree_dev, leaf_scaled, m):
             """score = (score*m + tree)/(m+1) in one dispatch."""
-            payload = payload.at[:n_pad, score0].multiply(m / (m + 1.0))
+            payload = payload.at[:, score0].multiply(m / (m + 1.0))
             return payload_tree_add.__wrapped__(
                 payload, tree_dev, leaf_scaled / (m + 1.0), jnp.int32(0))
 
@@ -395,14 +529,26 @@ class _FastState:
         self.aux = jnp.zeros_like(self.payload)
         self._bag_dirty = True  # cnt col holds the plain valid mask
 
+    def host_idx(self) -> np.ndarray:
+        """Integer original-row indices of every payload row (host)."""
+        idx = np.asarray(jax.device_get(
+            self.payload[:, self.idx_col])).astype(np.int64)
+        if self.wide_idx:
+            hi = np.asarray(jax.device_get(
+                self.payload[:, self.idxhi_col])).astype(np.int64)
+            idx = idx + hi * int(_IDX_RADIX)
+        return idx
+
     def raw_scores(self) -> np.ndarray:
-        """[K, n_pad] scores in ORIGINAL row order (host)."""
+        """[K, n_pad] scores in ORIGINAL row order (host).  Guard rows
+        carry the dead-slot index and are dropped."""
         h = np.asarray(jax.device_get(
-            self.payload[:self.n_pad,
-                         self.idx_col:self.score0 + self.K]))
-        idx = h[:, 0].astype(np.int64)
+            self.payload[:, self.idx_col:self.score0 + self.K]))
+        idx = (self.host_idx() if self.wide_idx
+               else h[:, 0].astype(np.int64))
+        keep = idx < self.n_pad
         out = np.zeros((self.K, self.n_pad), np.float32)
-        out[:, idx] = h[:, 1:1 + self.K].T
+        out[:, idx[keep]] = h[keep, 1:1 + self.K].T
         return out
 
 
@@ -545,15 +691,23 @@ class GBDT:
                 Log.info("Loaded forced splits from %s (%d nodes)",
                          fs_path, len(self.forced_schedule.feat))
 
-        # EFB bundle decode map (identity when the dataset is unbundled)
+        # EFB bundle decode map (identity when the dataset is unbundled).
+        # Bundled + data/voting parallel trains on the MESH FAST PATH
+        # (partitioned engine per shard, full-psum of the small bundled
+        # histogram, replicated search — grower2 mesh modes); the masked
+        # legacy mesh grower cannot decode bundles, so feature-parallel or
+        # a fast-ineligible config falls back to the serial learner.
+        self._mesh_fast_only = False
         if train_set.bundle_info is not None:
             self.bundle_map = bundle_map_from_info(train_set.bundle_info)
-            if self.parallel_mode is not None:
-                Log.warning("EFB-bundled dataset: parallel tree learners "
-                            "are not supported with bundling; training "
-                            "with the serial learner")
+            if self.parallel_mode == "feature":
+                Log.warning("EFB-bundled dataset: feature-parallel is not "
+                            "supported with bundling; training with the "
+                            "serial learner")
                 self.parallel_mode = None
                 self.mesh = None
+            elif self.parallel_mode is not None:
+                self._mesh_fast_only = True
         else:
             self.bundle_map = identity_bundle_map(train_set.num_features)
 
@@ -710,12 +864,18 @@ class GBDT:
             bins_spec, fmask_spec = P(None, ax), P()
             leaf_id_spec = P(ax)
         self._row_sharding = NamedSharding(self.mesh, row_spec)
+        self._score_sharding = NamedSharding(self.mesh, score_spec)
 
         for attr in ("valid_mask", "label_dev", "weight_dev", "_bag_cmask"):
             setattr(self, attr, jax.device_put(
                 getattr(self, attr), self._row_sharding))
-        self.score = jax.device_put(self.score,
-                                    NamedSharding(self.mesh, score_spec))
+        self.score = jax.device_put(self.score, self._score_sharding)
+
+        if self._mesh_fast_only:
+            # bundled dataset: only the partitioned mesh fast path can
+            # decode EFB columns — the masked mesh grower is not built, and
+            # a fast-ineligible config falls back to the serial learner
+            return
 
         cfg = self.grower_cfg
         if mode in ("data", "voting"):
@@ -766,19 +926,35 @@ class GBDT:
 
     # -- one boosting iteration (gbdt.cpp:387-482) ---------------------------
     def _fast_eligible(self) -> bool:
-        """The partition-ordered fast path covers the plain serial GBDT
-        (with or without bagging): row-wise objective (gradients
-        independent of row order), no leaf-output renewal, index column
-        exact in f32.  Everything else keeps the legacy masked grower."""
+        """The partition-ordered fast path covers the serial GBDT (with or
+        without bagging), the row-sharded mesh learners (tree_learner=
+        data|voting — the partitioned engine runs per shard with
+        collectives at the histogram boundary; feature-parallel keeps the
+        masked engine, its rows are replicated not partitioned), ranking
+        objectives (original-order gradient fill through the index
+        column), leaf-output renewal (except under GOSS), and row counts
+        up to 2^31 (radix-split index columns past 2^24)."""
         cfg = self.config
         return ((type(self) is GBDT
                  or getattr(self, "_fast_sample_hook", None) is not None
                  or getattr(self, "_fast_variant_ok", False))
-                and self.mesh is None
+                and (self.mesh is None
+                     or self.parallel_mode in ("data", "voting"))
                 and self.objective is not None
-                and getattr(self.objective, "is_rowwise", True)
-                and not self.objective.renew_tree_output_required()
-                and self.train_set.num_data_padded < (1 << 24))
+                # non-rowwise objectives (ranking) ride the fast path via
+                # the original-order gradient fill; GOSS's fused sampling
+                # step has no such fill, so rank+GOSS keeps the legacy path
+                and (getattr(self.objective, "is_rowwise", True)
+                     or getattr(self, "_fast_sample_hook", None) is None)
+                # leaf renewal runs on the fast path (per-segment leaf
+                # membership + idx-column original-order mapping) except
+                # under GOSS, whose fused sampling step is incompatible
+                # with the pre-update-score renewal ordering
+                and (not self.objective.renew_tree_output_required()
+                     or getattr(self, "_fast_sample_hook", None) is None)
+                # int32 row positions in the segment engine; past 2^24 the
+                # payload's index column switches to the radix-split layout
+                and self.train_set.num_data_padded < (1 << 31))
 
     def _fast_sync_back(self) -> None:
         """Leave the fast path: restore original-order scores into the
@@ -786,6 +962,8 @@ class GBDT:
         if not self._fast_active:
             return
         self.score = jnp.asarray(self._fast.raw_scores())
+        if getattr(self, "_score_sharding", None) is not None:
+            self.score = jax.device_put(self.score, self._score_sharding)
         self._fast_active = False
 
     def _fast_enter(self) -> "_FastState":
@@ -825,7 +1003,41 @@ class GBDT:
 
         lr = self.shrinkage_rate
         should_continue = False
+        renew = (self.objective is not None
+                 and self.objective.renew_tree_output_required())
         for k in range(self.num_tree_per_iteration):
+            if renew:
+                # leaf-output renewal (RenewTreeOutput, serial_tree_learner
+                # .cpp:780-818): grow WITHOUT the fused score add — the
+                # robust per-leaf statistic needs the pre-update scores —
+                # then renew on host and replay the renewed outputs through
+                # the payload's bin-traversal score add.
+                with self.timer.phase("boosting (gradients)"):
+                    fs.payload = fs._fill_class(fs.payload, k=k)
+                with self.timer.phase("tree (hist+split+partition)"):
+                    out, fs.payload, fs.aux = fs.grower(fs.payload, fs.aux,
+                                                        fmask)
+                    self.timer.sync(fs.payload)
+                with self.timer.phase("leaf renewal (host)"):
+                    renewed = self._renew_leaf_values_fast(fs, out, k)
+                with self.timer.phase("tree assemble (host)"):
+                    tree, tree_dev, leaf_out = self._finish_tree(
+                        out, init_score, renewed)
+                if tree.num_leaves > 1:
+                    should_continue = True
+                    with self.timer.phase("train score update"):
+                        fs.payload = fs._payload_tree_add(
+                            fs.payload, tree_dev, leaf_out, jnp.int32(k))
+                        self.timer.sync(fs.payload)
+                    depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
+                    with self.timer.phase("valid score update"):
+                        for vs in self.valid_sets:
+                            vs[3] = _traverse_update(
+                                vs[2], vs[3], leaf_out, tree_dev,
+                                self.meta_dev, self.bundle_map, depth_iters,
+                                k)
+                self.model.trees.append(tree)
+                continue
             if fs._step_sampled is not None:
                 # row-sampling boosting (GOSS): always the fused path —
                 # the hook needs all-class gradients in one program.
@@ -895,6 +1107,12 @@ class GBDT:
                         "learners only; the parallel tree learners train "
                         "WITHOUT forced splits")
             self._warned_forced_legacy = True
+        if self._mesh_fast_only and not getattr(self, "_warned_mesh_fast",
+                                                False):
+            Log.warning("EFB-bundled parallel training rides the fast path "
+                        "only; this configuration trains with the serial "
+                        "learner")
+            self._warned_mesh_fast = True
         init_score = 0.0
         with self.timer.phase("boosting (gradients)"):
             if grad is None or hess is None:
@@ -1125,6 +1343,43 @@ class GBDT:
             # padded columns never enter split search
             mask = np.concatenate([mask, np.zeros(self._fmask_pad, bool)])
         return jnp.asarray(mask)
+
+    def _renew_leaf_values_fast(self, fs: "_FastState", out: Dict,
+                                k: int) -> Optional[np.ndarray]:
+        """RenewTreeOutput on the partitioned fast path: leaf membership
+        falls out of the row segments (every leaf's rows are contiguous per
+        device block), and the payload's index column maps the
+        partition-ordered scores/bag back to original row order so the
+        objective's renewal code runs UNCHANGED — bit-identical to the
+        legacy path."""
+        nl = int(jax.device_get(out["num_leaves"]))
+        if nl <= 1:
+            return None
+        # one contiguous column fetch: cnt (bag), idx, per-class scores
+        h = np.asarray(jax.device_get(
+            fs.payload[:, fs.cnt_col:fs.score0 + fs.K]))
+        cnt = h[:, 0]
+        idx = fs.host_idx() if fs.wide_idx else h[:, 1].astype(np.int64)
+        score_k = h[:, 2 + k].astype(np.float64)
+        ss = np.asarray(jax.device_get(out["seg_start"])).astype(np.int64)
+        sc = np.asarray(jax.device_get(out["seg_cnt"])).astype(np.int64)
+        L = ss.size // fs.ndev
+        R = fs.n_rows // fs.ndev
+        lid_part = np.full(fs.n_rows, nl, np.int64)
+        for d in range(fs.ndev):
+            off = d * R
+            for leaf in range(nl):
+                s = off + ss[d * L + leaf]
+                lid_part[s:s + sc[d * L + leaf]] = leaf
+        keep = idx < fs.n_pad
+        lid = np.full(fs.n_pad, nl, np.int64)
+        lid[idx[keep]] = lid_part[keep]
+        pred = np.zeros(fs.n_pad, np.float64)
+        pred[idx[keep]] = score_k[keep]
+        in_bag = np.zeros(fs.n_pad, bool)
+        in_bag[idx[keep]] = cnt[keep] > 0
+        lv = np.asarray(jax.device_get(out["leaf_value"]), dtype=np.float64)
+        return self.objective.renew_leaf_values(lv[:nl], lid, pred, in_bag)
 
     def _renew_leaf_values(self, out: Dict, k: int) -> Optional[np.ndarray]:
         """RenewTreeOutput wiring (gbdt.cpp:441-448 →

@@ -78,6 +78,48 @@ def propagate_monotone_bounds(blo, bro, is_num, mono_f, pmin, pmax):
     return lmin, lmax, rmin, rmax
 
 
+def make_winner_sync(axis_name: str, my, f_offset):
+    """SyncUpGlobalBestSplit (parallel_tree_learner.h:183-206): gain pmax +
+    lowest-shard tie-break, then the whole SplitResult packed into ONE f32
+    buffer for a single one-hot psum (the reference likewise ships a
+    fixed-size SplitInfo blob).  Integer fields (feature, bin) are exact in
+    f32 below 2^24.  Shared by the masked and partitioned mesh growers."""
+
+    def bcast_from_winner(res):
+        gain_max = lax.pmax(res.gain, axis_name)
+        big = jnp.int32(1 << 30)
+        winner = lax.pmin(jnp.where(res.gain == gain_max, my, big),
+                          axis_name)
+        is_w = my == winner
+        payload = jnp.concatenate([
+            jnp.stack([
+                res.gain,
+                (res.feature + f_offset).astype(jnp.float32),
+                res.threshold_bin.astype(jnp.float32),
+                res.default_left.astype(jnp.float32),
+                res.left_sum_g, res.left_sum_h, res.left_count,
+                res.is_cat.astype(jnp.float32),
+                res.left_output, res.right_output,
+            ]),
+            res.cat_bitset.astype(jnp.float32)])
+        payload = lax.psum(jnp.where(is_w, payload,
+                                     jnp.zeros_like(payload)), axis_name)
+        return SplitResult(
+            gain=payload[0],
+            feature=payload[1].astype(jnp.int32),
+            threshold_bin=payload[2].astype(jnp.int32),
+            default_left=payload[3] > 0,
+            left_sum_g=payload[4],
+            left_sum_h=payload[5],
+            left_count=payload[6],
+            is_cat=payload[7] > 0,
+            cat_bitset=payload[10:] > 0,
+            left_output=payload[8],
+            right_output=payload[9])
+
+    return bcast_from_winner
+
+
 def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
                      axis_name: str = None, jit: bool = True,
                      mode: str = "data", num_machines: int = 1,
@@ -146,46 +188,7 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
     out_fn = functools.partial(leaf_output, l1=cfg.lambda_l1, l2=cfg.lambda_l2,
                                max_delta_step=cfg.max_delta_step)
 
-    def _winner_sync(my, f_offset):
-        """SyncUpGlobalBestSplit (parallel_tree_learner.h:183-206):
-        gain pmax + lowest-shard tie-break, then the whole SplitResult
-        packed into ONE f32 buffer for a single one-hot psum (the
-        reference likewise ships a fixed-size SplitInfo blob).
-        Integer fields (feature, bin) are exact in f32 below 2^24."""
-
-        def bcast_from_winner(res):
-            gain_max = lax.pmax(res.gain, axis_name)
-            big = jnp.int32(1 << 30)
-            winner = lax.pmin(jnp.where(res.gain == gain_max, my, big),
-                              axis_name)
-            is_w = my == winner
-            payload = jnp.concatenate([
-                jnp.stack([
-                    res.gain,
-                    (res.feature + f_offset).astype(jnp.float32),
-                    res.threshold_bin.astype(jnp.float32),
-                    res.default_left.astype(jnp.float32),
-                    res.left_sum_g, res.left_sum_h, res.left_count,
-                    res.is_cat.astype(jnp.float32),
-                    res.left_output, res.right_output,
-                ]),
-                res.cat_bitset.astype(jnp.float32)])
-            payload = lax.psum(jnp.where(is_w, payload,
-                                         jnp.zeros_like(payload)), axis_name)
-            return SplitResult(
-                gain=payload[0],
-                feature=payload[1].astype(jnp.int32),
-                threshold_bin=payload[2].astype(jnp.int32),
-                default_left=payload[3] > 0,
-                left_sum_g=payload[4],
-                left_sum_h=payload[5],
-                left_count=payload[6],
-                is_cat=payload[7] > 0,
-                cat_bitset=payload[10:] > 0,
-                left_output=payload[8],
-                right_output=payload[9])
-
-        return bcast_from_winner
+    _winner_sync = functools.partial(make_winner_sync, axis_name)
 
     def grow(bins: jax.Array, vals: jax.Array, feature_mask: jax.Array) -> Dict[str, jax.Array]:
         F, N = bins.shape

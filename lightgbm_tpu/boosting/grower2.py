@@ -31,11 +31,12 @@ from jax import lax
 
 from ..ops.bundle import BundleMap, expand_histogram, identity_bundle_map
 from ..ops.split import (FeatureMeta, K_MIN_SCORE, SplitResult,
-                         find_best_split, leaf_output)
+                         find_best_split, leaf_output, pad_feature_meta,
+                         per_feature_best_gains)
 from ..ops import segment as seg
 from ..ops.segment import SplitPredicate
 from .forced import PRIORITY_UNIT, ForcedSchedule
-from .grower import GrowerConfig
+from .grower import GrowerConfig, make_winner_sync
 
 
 class PayloadCols(NamedTuple):
@@ -52,7 +53,9 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                             num_features: int, jit: bool = True,
                             bundle_map: BundleMap = None,
                             num_columns: int = None,
-                            forced: ForcedSchedule = None):
+                            forced: ForcedSchedule = None,
+                            axis_name: str = None, mode: str = "data",
+                            num_machines: int = 1, top_k: int = 20):
     """Returns grow(payload, aux, feature_mask) ->
     (tree arrays dict, payload, aux).
 
@@ -64,6 +67,25 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
     bundled bin columns; histograms are built bundled (state stays [L, G,
     B, 3] — the memory win) and expanded to per-feature views only for
     split finding.
+
+    axis_name: when set, the grower is one shard of a row-sharded parallel
+    tree learner inside shard_map over that mesh axis — the reference's
+    DataParallel / VotingParallel learners ARE its serial learner plus a
+    network boundary (data_parallel_tree_learner.cpp:147-246 inherits
+    SerialTreeLearner), and this grower keeps the same shape: per-device
+    payload segments partition locally, and only histograms cross the wire:
+
+    - mode="data": local per-leaf histograms are ReduceScattered over the
+      storage-column axis (`psum_scatter`), split search runs on owned
+      columns only, and one SyncUpGlobalBestSplit allreduce broadcasts the
+      winner (data_parallel_tree_learner.cpp:159-246).  When the dataset is
+      EFB-bundled or forced splits are active, the learner switches to a
+      full `psum` with replicated search: bundling already shrank G (so the
+      full blob is small on the wire) and both features need the whole
+      histogram on every shard.
+    - mode="voting": histograms stay local; shards vote top_k features by
+      local gain, only the vote winners' histograms are `psum`ed (PV-Tree,
+      voting_parallel_tree_learner.cpp), constraints scaled 1/num_machines.
     """
     L = cfg.num_leaves
     B = num_bins_max
@@ -71,6 +93,24 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
     G = num_columns if num_columns is not None else F
     bundled = bundle_map is not None
     bmap = bundle_map if bundled else identity_bundle_map(F)
+    meshed = axis_name is not None
+    # full-psum + replicated search when scatter/vote can't see whole
+    # features (EFB) or need the whole histogram everywhere (forced splits)
+    replicated = meshed and (bundled or forced is not None)
+    scatter_mode = meshed and not replicated and mode == "data"
+    voting_mode = meshed and not replicated and mode == "voting"
+    if meshed:
+        assert mode in ("data", "voting"), \
+            "partitioned mesh grower supports data|voting (feature-parallel " \
+            "rides the masked engine)"
+    n_mach = max(num_machines, 1)
+    if scatter_mode:
+        Gp = -(-G // n_mach) * n_mach
+        padg = Gp - G
+        Gloc = Gp // n_mach
+    # width of a pooled histogram: the owned scatter slice in data mode,
+    # the full (local or replicated) blob otherwise
+    Gh = Gloc if scatter_mode else G
 
     find_kwargs = dict(
         l1=cfg.lambda_l1, l2=cfg.lambda_l2, max_delta_step=cfg.max_delta_step,
@@ -126,18 +166,89 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
              feature_mask: jax.Array):
         n_rows = jnp.int32(payload.shape[0] - seg.CHUNK)
 
-        hist_root = hist_fn(payload, jnp.int32(0), n_rows)
+        # mesh-mode machinery is built at trace time (axis_index exists only
+        # inside shard_map); find_split closes over the feature mask so the
+        # split loop below is mode-agnostic
+        if scatter_mode:
+            my = lax.axis_index(axis_name)
+            f_offset = my * Gloc
+            meta_p = pad_feature_meta(meta, Gp) if padg else meta
+            meta_local = FeatureMeta(
+                *[lax.dynamic_slice_in_dim(a, f_offset, Gloc)
+                  for a in meta_p])
+            find_local = functools.partial(find_best_split, meta=meta_local,
+                                           **find_kwargs)
+            bcast_from_winner = make_winner_sync(axis_name, my, f_offset)
+            fmask_p = (jnp.pad(feature_mask, (0, padg)) if padg
+                       else feature_mask)
+            fmask_loc = lax.dynamic_slice_in_dim(fmask_p, f_offset, Gloc)
+
+            def reduce_hist(h):
+                if padg:
+                    h = jnp.pad(h, ((0, padg), (0, 0), (0, 0)))
+                return lax.psum_scatter(h, axis_name, scatter_dimension=0,
+                                        tiled=True)
+
+            def find_split(hist_loc, sg, sh, cnt, **constraints):
+                return bcast_from_winner(
+                    find_local(hist_loc, sg, sh, cnt, fmask_loc,
+                               **constraints))
+
+        elif voting_mode:
+            k_vote = min(top_k, F)
+            S = min(2 * k_vote, F)
+            vote_kwargs = dict(find_kwargs)
+            vote_kwargs["min_data_in_leaf"] = cfg.min_data_in_leaf / n_mach
+            vote_kwargs["min_sum_hessian_in_leaf"] = \
+                cfg.min_sum_hessian_in_leaf / n_mach
+
+            def reduce_hist(h):
+                return h
+
+            def find_split(hist_local, sg, sh, cnt, **constraints):
+                # phase 1: vote top_k features by LOCAL split gain with
+                # 1/num_machines-scaled constraints; phase 2: reduce ONLY
+                # the vote winners' histograms and find on them (PV-Tree)
+                local_tot = jnp.sum(hist_local[0], axis=0)
+                local_gains = per_feature_best_gains(
+                    hist_local, local_tot[0], local_tot[1], local_tot[2],
+                    feature_mask, meta=meta, **vote_kwargs)
+                top_vals, top_idx = lax.top_k(local_gains, k_vote)
+                valid_vote = (top_vals > K_MIN_SCORE).astype(jnp.int32)
+                all_top = lax.all_gather(top_idx, axis_name)
+                all_valid = lax.all_gather(valid_vote, axis_name)
+                votes = jnp.zeros(F, jnp.int32).at[all_top.reshape(-1)].add(
+                    all_valid.reshape(-1))
+                _, sel = lax.top_k(votes, S)
+                hsel = lax.psum(hist_local[sel], axis_name)
+                meta_sel = FeatureMeta(*[a[sel] for a in meta])
+                res = find_best_split(hsel, sg, sh, cnt, feature_mask[sel],
+                                      meta=meta_sel, **find_kwargs,
+                                      **constraints)
+                return res._replace(feature=sel[res.feature])
+
+        else:
+            def reduce_hist(h):
+                return lax.psum(h, axis_name) if replicated else h
+
+            def find_split(h, sg, sh, cnt, **constraints):
+                return find(hist_view(h), sg, sh, cnt, feature_mask,
+                            **constraints)
+
+        hist_root_local = hist_fn(payload, jnp.int32(0), n_rows)
         # every row lands in exactly one bin of storage column 0, so the
         # root totals fall out of the histogram — no separate full-data pass
-        totals = jnp.sum(hist_root[0], axis=0)
+        totals = jnp.sum(hist_root_local[0], axis=0)
+        if meshed:
+            totals = lax.psum(totals, axis_name)
+        hist_root = reduce_hist(hist_root_local)
         root_g, root_h, root_c = totals[0], totals[1], totals[2]
         if cfg.with_monotone:
-            res0 = find(hist_view(hist_root), root_g, root_h, root_c,
-                        feature_mask, min_constraint=jnp.float32(-jnp.inf),
-                        max_constraint=jnp.float32(jnp.inf))
+            res0 = find_split(hist_root, root_g, root_h, root_c,
+                              min_constraint=jnp.float32(-jnp.inf),
+                              max_constraint=jnp.float32(jnp.inf))
         else:
-            res0 = find(hist_view(hist_root), root_g, root_h, root_c,
-                        feature_mask)
+            res0 = find_split(hist_root, root_g, root_h, root_c)
 
         # rows start as one root segment with the root Newton step as the
         # per-row output (covers the unsplittable-stump case)
@@ -155,7 +266,7 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         state = {
             "payload": payload,
             "aux": aux,
-            "hist": jnp.zeros((POOL, G, B, 3),
+            "hist": jnp.zeros((POOL, Gh, B, 3),
                               jnp.float32).at[0].set(hist_root),
             "seg_start": jnp.zeros(L, jnp.int32),
             "seg_cnt": jnp.zeros(L, jnp.int32).at[0].set(n_rows),
@@ -228,11 +339,15 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             # parent histogram: read the pool slot, or rebuild it from the
             # (still contiguous) parent segment if it was evicted
             if pooled:
+                # NOTE: the rebuild branch runs a collective in mesh modes;
+                # the pool bookkeeping is replicated-in-value, so every
+                # shard takes the same branch and the psum pairs up
                 pslot = st["slot_of_leaf"][best_leaf]
                 hist_parent = lax.cond(
                     pslot >= 0,
                     lambda: st["hist"][jnp.maximum(pslot, 0)],
-                    lambda: hist_fn(st["payload"], start, count))
+                    lambda: reduce_hist(hist_fn(st["payload"], start,
+                                                count)))
             else:
                 hist_parent = st["hist"][best_leaf]
 
@@ -255,7 +370,7 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             left_smaller = lcnt <= rcnt
             h_start = jnp.where(left_smaller, start, start + nl_raw)
             h_count = jnp.where(left_smaller, nl_raw, nr_raw)
-            hist_small = hist_fn(payload, h_start, h_count)
+            hist_small = reduce_hist(hist_fn(payload, h_start, h_count))
             hist_big = hist_parent - hist_small
             new_left = jnp.where(left_smaller, hist_small, hist_big)
             new_right = jnp.where(left_smaller, hist_big, hist_small)
@@ -301,16 +416,14 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                     st["blo"][best_leaf], st["bro"][best_leaf],
                     ~st["bcat"][best_leaf], meta.monotone[f],
                     st["mincon"][best_leaf], st["maxcon"][best_leaf])
-                res_l = find(hist_view(new_left), lg, lh, lcnt, feature_mask,
-                             min_constraint=lmin, max_constraint=lmax)
-                res_r = find(hist_view(new_right), rg, rh, rcnt,
-                             feature_mask, min_constraint=rmin,
-                             max_constraint=rmax)
+                res_l = find_split(new_left, lg, lh, lcnt,
+                                   min_constraint=lmin, max_constraint=lmax)
+                res_r = find_split(new_right, rg, rh, rcnt,
+                                   min_constraint=rmin, max_constraint=rmax)
             else:
                 lmin = lmax = rmin = rmax = None
-                res_l = find(hist_view(new_left), lg, lh, lcnt, feature_mask)
-                res_r = find(hist_view(new_right), rg, rh, rcnt,
-                             feature_mask)
+                res_l = find_split(new_left, lg, lh, lcnt)
+                res_r = find_split(new_right, rg, rh, rcnt)
             real_l, real_r = res_l.gain, res_r.gain
             if forced is not None:
                 jp = st["fleaf"][best_leaf]

@@ -273,7 +273,12 @@ class RF(GBDT):
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
         from .gbdt import _make_vals, _update_score_k, _traverse_update
-        if grad is None and hess is None and self._fast_eligible():
+        # the fused RF step computes zero-score gradients from the
+        # PARTITION-ORDERED label/weight columns, which is only valid for
+        # row-independent objectives (a query-coupled objective would pair
+        # permuted labels with original-order query boundaries)
+        if grad is None and hess is None and self._fast_eligible() \
+                and getattr(self.objective, "is_rowwise", True):
             return self._train_one_iter_fast_rf()
         self._fast_sync_back()
         if grad is None or hess is None:

@@ -119,8 +119,10 @@ class BinnedDataset:
 
         # Exclusive Feature Bundling (reference dataset.cpp:66-210): pack
         # mutually-exclusive sparse features into shared storage columns.
-        # Validation sets reuse the training layout; parallel tree learners
-        # keep unbundled storage (their feature sharding predates bundles).
+        # Validation sets reuse the training layout.  Row-sharded parallel
+        # learners (data/voting) train bundled on the mesh fast path;
+        # feature-parallel keeps unbundled storage (its feature sharding
+        # predates bundles).
         num_bins_arr = [m.num_bin for m in bin_mappers]
         default_bins_arr = [m.default_bin for m in bin_mappers]
         if reference_bundle is not None:
@@ -129,7 +131,8 @@ class BinnedDataset:
             bins = apply_bundles(bins, reference_bundle, num_bins_arr,
                                  default_bins_arr)
         elif (bool(getattr(config, "enable_bundle", True))
-              and str(getattr(config, "tree_learner", "serial")) == "serial"
+              and str(getattr(config, "tree_learner", "serial"))
+              in ("serial", "data", "voting")
               and f >= 2):
             # features mostly at their zero bin are bundling candidates;
             # denser ones isolate themselves anyway via the conflict budget

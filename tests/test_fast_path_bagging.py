@@ -194,3 +194,21 @@ def test_rf_runs_on_fast_path(monkeypatch):
     d_s = slow.dump_model()["tree_info"][0]["tree_structure"]
     assert d_f["split_feature"] == d_s["split_feature"]
     assert d_f["internal_count"] == d_s["internal_count"]
+
+
+def test_wide_index_layout_matches_narrow(binary_data, monkeypatch):
+    """Past 2^24 rows the payload index column splits into radix-4096
+    (hi, lo) halves.  Force that layout at small N and require the exact
+    model of the narrow layout — proves every idx consumer (bag refresh,
+    score sync, renewal, rank fill) decodes it correctly."""
+    from lightgbm_tpu.boosting import gbdt as gb
+    X, y, _, _ = binary_data
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "bagging_fraction": 0.7, "bagging_freq": 2, "seed": 11}
+    narrow = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=8)
+    monkeypatch.setattr(gb, "_IDX_WIDE_THRESHOLD", 1)
+    wide = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=8)
+    assert wide._engine._fast.wide_idx, "wide layout did not engage"
+    assert wide.model_to_string() == narrow.model_to_string()

@@ -137,3 +137,35 @@ def test_percentile_matches_numpy_median():
     # extremes: alpha near 1 -> max side, alpha near 0 -> min side
     assert percentile(data, 0.999) == a[-1]
     assert a[0] <= percentile(data, 0.001) <= a[1]
+
+
+def test_renewal_objectives_ride_fast_path():
+    """L1/quantile/huber/MAPE (RenewTreeOutput family,
+    serial_tree_learner.cpp:780-818) must train on the partitioned fast
+    path — the round-3 gap — and reproduce the legacy engine's models
+    (renewal itself is bit-identical: same objective code over the
+    idx-mapped original-order arrays)."""
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from conftest import assert_models_equivalent
+    rng = np.random.default_rng(5)
+    X = rng.standard_normal((3000, 8)).astype(np.float32)
+    y = (X[:, 0] * 2 + np.abs(X[:, 1])
+         + rng.standard_normal(3000) * 0.3 + 3).astype(np.float32)
+    w = rng.random(3000).astype(np.float32) + 0.5
+    for obj in ("regression_l1", "quantile", "huber", "mape"):
+        params = {"objective": obj, "num_leaves": 15, "verbose": -1,
+                  "min_data_in_leaf": 20, "seed": 3, "alpha": 0.7,
+                  "bagging_fraction": 0.8, "bagging_freq": 2}
+        fast = lgb.train(dict(params), lgb.Dataset(X, label=y, weight=w),
+                         num_boost_round=6)
+        assert fast._engine._fast_active, "%s fell off the fast path" % obj
+        orig = GBDT._fast_eligible
+        GBDT._fast_eligible = lambda self: False
+        try:
+            legacy = lgb.train(dict(params),
+                               lgb.Dataset(X, label=y, weight=w),
+                               num_boost_round=6)
+        finally:
+            GBDT._fast_eligible = orig
+        assert_models_equivalent(fast.model_to_string(),
+                                 legacy.model_to_string())

@@ -36,6 +36,53 @@ def test_parallel_learner_matches_serial(binary_data, mode):
     assert_models_equivalent(par.model_to_string(), serial.model_to_string())
 
 
+def _engine(bst):
+    return bst._engine if hasattr(bst, "_engine") else bst.booster._engine
+
+
+def test_data_parallel_rides_the_fast_path(binary_data):
+    """tree_learner=data must train on the partitioned mesh fast path (the
+    round-3 gap: parallel learners ran the legacy masked engine) and still
+    reproduce the serial model."""
+    X, y, _, _ = binary_data
+    serial = _train(BASE, X, y)
+    par = _train({**BASE, "tree_learner": "data"}, X, y)
+    eng = _engine(par)
+    assert eng.mesh is not None, "mesh learner not selected"
+    assert eng._fast_active, "data-parallel fell off the fast path"
+    # the scaling property: the payload is row-sharded, so each device's
+    # histogram/partition work covers exactly its N/n-row block (+ guard)
+    fs = eng._fast
+    ndev = eng.mesh.shape[eng.mesh_axis]
+    rows_per_dev = {s.data.shape[0] for s in fs.payload.addressable_shards}
+    assert rows_per_dev == {fs.n_rows // ndev}
+    assert_models_equivalent(par.model_to_string(), serial.model_to_string())
+
+
+def test_voting_parallel_rides_the_fast_path(binary_data):
+    X, y, _, _ = binary_data
+    par = _train({**BASE, "tree_learner": "voting", "top_k": 10}, X, y)
+    eng = _engine(par)
+    assert eng.mesh is not None and eng._fast_active
+
+
+def test_efb_bundled_data_parallel(binary_data):
+    """EFB x parallel (excluded in round 3, gbdt.py fell back to serial):
+    a bundled dataset must train tree_learner=data on the mesh fast path
+    and reproduce the serial bundled model."""
+    from test_efb import PARAMS, _sparse_problem
+    X, y = _sparse_problem()
+    serial = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
+                       num_boost_round=10)
+    par = lgb.train({**PARAMS, "tree_learner": "data"},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    eng = _engine(par)
+    assert eng.train_set.bundle_info is not None, "EFB did not engage"
+    assert eng.mesh is not None, "mesh learner not selected"
+    assert eng._fast_active, "bundled data-parallel fell off the fast path"
+    assert_models_equivalent(par.model_to_string(), serial.model_to_string())
+
+
 def test_voting_learner_trains_comparably(binary_data):
     X, y, Xt, yt = binary_data
     serial = _train(BASE, X, y)
@@ -76,3 +123,23 @@ def test_single_device_falls_back_to_serial(binary_data, monkeypatch):
     monkeypatch.setattr(jax, "devices", lambda *a: dev0)
     bst = _train({**BASE, "tree_learner": "data"}, X, y, rounds=3)
     assert bst.current_iteration() == 3
+
+
+def test_voting_restricted_vote_accuracy(binary_data):
+    """PV-Tree's value is the RESTRICTED vote (top_k far below F): quality
+    must stay near serial even when the vote actually bites — the round-3
+    gap was that only finiteness was smoke-tested.  binary_data has 28
+    features; top_k=3 makes phase 1 select 6 of 28 histograms per split."""
+    X, y, Xt, yt = binary_data
+    serial = _train(BASE, X, y, rounds=30)
+    par = _train({**BASE, "tree_learner": "voting", "top_k": 3}, X, y,
+                 rounds=30)
+    eng = _engine(par)
+    assert eng.mesh is not None and eng._fast_active
+
+    def logloss(bst):
+        p = np.clip(bst.predict(Xt), 1e-7, 1 - 1e-7)
+        return -np.mean(yt * np.log(p) + (1 - yt) * np.log(1 - p))
+
+    ls, lv = logloss(serial), logloss(par)
+    assert lv < ls + 0.02, (lv, ls)
