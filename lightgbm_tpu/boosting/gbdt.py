@@ -241,6 +241,11 @@ class _FastState:
             self.P = -(-self.P // 128) * 128
         self.cols = PayloadCols(grad=self.grad_col, hess=self.hess_col,
                                 cnt=self.cnt_col, value=self.value_col)
+        payload_gb = self.n_rows * self.P * 4 / 2**30
+        Log.info("fast path payload: %d rows x %d cols, %.2f GB "
+                 "(+%.2f GB partition scratch)%s", self.n_rows, self.P,
+                 payload_gb, payload_gb,
+                 " sharded over %d devices" % ndev if ndev > 1 else "")
 
         P, score0, idx_col = self.P, self.score0, self.idx_col
         cnt_col_, bvalid_col_ = self.cnt_col, self.bvalid_col

@@ -35,6 +35,10 @@ class BundleInfo(NamedTuple):
     f_identity: np.ndarray       # [F] bool raw-bin passthrough (singleton)
     group_num_bin: np.ndarray    # [G] i32 total bins of each bundle
     max_group_bin: int
+    #: [G] realized full-data conflict rate per bundle (0 for singletons);
+    #: None when the layout was built without counting (validation reuse,
+    #: caches saved before the field existed)
+    conflict_rates: Optional[np.ndarray] = None
 
 
 def find_bundles(nonzero: List[np.ndarray], num_rows: int,
@@ -83,26 +87,55 @@ def find_bundles(nonzero: List[np.ndarray], num_rows: int,
     return groups
 
 
+def realized_conflict_rates(bins: np.ndarray, info: BundleInfo,
+                            default_bins: Sequence[int]) -> np.ndarray:
+    """Per-bundle fraction of rows where two or more members are
+    non-default on the FULL data (the rows whose later-written member
+    overwrote another).  The reference bounds this on the bundling sample
+    (dataset.cpp:66-153, max_conflict_rate); reporting the realized rate
+    tells the user how lossy their bundling actually was."""
+    N = bins.shape[1]
+    rates = np.zeros(len(info.groups), np.float64)
+    for gi, feats in enumerate(info.groups):
+        if len(feats) <= 1:
+            continue
+        nd = np.zeros(N, np.int32)
+        for f in feats:
+            nd += bins[f] != default_bins[f]
+        rates[gi] = float(np.count_nonzero(nd > 1)) / max(N, 1)
+    return rates
+
+
 def apply_bundles(bins: np.ndarray, info: BundleInfo,
                   num_bins: Sequence[int],
-                  default_bins: Sequence[int]) -> np.ndarray:
+                  default_bins: Sequence[int],
+                  count_conflicts: bool = False):
     """Re-encode a binned matrix with an EXISTING bundle layout (validation
-    sets reuse the training dataset's bundling, Dataset::CreateValid)."""
+    sets reuse the training dataset's bundling, Dataset::CreateValid).
+    With count_conflicts, also returns the per-bundle realized conflict
+    rates (reusing the member non-default masks this pass computes
+    anyway)."""
     G = len(info.groups)
     N = bins.shape[1]
     dtype = np.uint8 if info.max_group_bin <= 256 else np.uint16
     bundled = np.zeros((G, N), dtype)
+    rates = np.zeros(G, np.float64) if count_conflicts else None
     for gi, feats in enumerate(info.groups):
         if len(feats) == 1 and info.f_identity[feats[0]]:
             bundled[gi] = bins[feats[0]].astype(dtype)
             continue
+        nd_count = np.zeros(N, np.int32) if count_conflicts else None
         for f in feats:
             b = bins[f].astype(np.int32)
             d = int(default_bins[f])
             nd = b != d
+            if count_conflicts:
+                nd_count += nd
             enc = info.f_offset[f] + b - (b > d)
             bundled[gi, nd] = enc[nd].astype(dtype)
-    return bundled
+        if count_conflicts:
+            rates[gi] = float(np.count_nonzero(nd_count > 1)) / max(N, 1)
+    return (bundled, rates) if count_conflicts else bundled
 
 
 def bundle_features(bins: np.ndarray, num_bins: Sequence[int],
@@ -161,10 +194,24 @@ def bundle_features(bins: np.ndarray, num_bins: Sequence[int],
     info = BundleInfo(groups=groups, f_group=f_group, f_offset=f_offset,
                       f_identity=f_identity, group_num_bin=group_num_bin,
                       max_group_bin=int(group_num_bin.max()))
-    bundled = apply_bundles(bins, info, num_bins, default_bins)
+    bundled, rates = apply_bundles(bins, info, num_bins, default_bins,
+                                   count_conflicts=True)
+    # the encode pass covers padded rows (all-default, conflict-free);
+    # report rates over the real rows
+    rates = rates * (N / max(num_data, 1))
 
     n_multi = sum(1 for g in groups if len(g) > 1)
+    info = info._replace(conflict_rates=rates)
     Log.info("EFB: bundled %d features into %d columns "
-             "(%d multi-feature bundles, max %d bins)",
-             F, G, n_multi, int(group_num_bin.max()))
+             "(%d multi-feature bundles, max %d bins); realized conflict "
+             "rate on full data: max %.4f, mean %.4f",
+             F, G, n_multi, int(group_num_bin.max()),
+             float(rates.max()) if len(rates) else 0.0,
+             float(rates.mean()) if len(rates) else 0.0)
+    if len(rates) and rates.max() > max(max_conflict_rate, 1e-12):
+        Log.warning("EFB: realized conflict rate %.4f exceeds the "
+                    "max_conflict_rate budget %.4f (the budget is enforced "
+                    "on the bundling sample); colliding rows keep the "
+                    "later-written member's bin", float(rates.max()),
+                    max_conflict_rate)
     return bundled, info

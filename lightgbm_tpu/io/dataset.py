@@ -210,6 +210,8 @@ class BinnedDataset:
             arrays["bundle_f_offset"] = bi.f_offset
             arrays["bundle_f_identity"] = bi.f_identity
             arrays["bundle_group_num_bin"] = bi.group_num_bin
+            if bi.conflict_rates is not None:
+                arrays["bundle_conflict_rates"] = bi.conflict_rates
         md = self.metadata
         if md is not None:
             for name in ("label", "weight", "init_score", "query_boundaries"):
@@ -269,7 +271,9 @@ class BinnedDataset:
                     f_offset=z["bundle_f_offset"],
                     f_identity=z["bundle_f_identity"],
                     group_num_bin=z["bundle_group_num_bin"],
-                    max_group_bin=int(z["bundle_group_num_bin"].max()))
+                    max_group_bin=int(z["bundle_group_num_bin"].max()),
+                    conflict_rates=z["bundle_conflict_rates"]
+                    if "bundle_conflict_rates" in z.files else None)
             ds.metadata = Metadata(ds.num_data)
             for name in ("label", "weight", "init_score", "query_boundaries"):
                 if "md_" + name in z.files:
