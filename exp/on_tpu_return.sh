@@ -9,3 +9,15 @@ echo "=== 2. grower profile (fixed cost + scaling) ==="
 timeout 500 python exp/prof_grow_small.py 2>&1 | grep "grow:" || true
 echo "=== 3. bench at 2M rows ==="
 BENCH_ROWS=2000000 BENCH_TEST_ROWS=200000 BENCH_ITERS=10 timeout 550 python bench.py 2>&1 | grep '"metric"'
+echo "=== 4. mesh fast path on the real chip count (single-chip smoke) ==="
+timeout 400 python - <<'PYEOF' 2>&1 | tail -3
+import numpy as np
+import lightgbm_tpu as lgb
+rng = np.random.default_rng(0)
+X = rng.standard_normal((200000, 28)).astype(np.float32)
+y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+bst = lgb.train({"objective": "binary", "num_leaves": 255, "verbose": -1},
+                lgb.Dataset(X, label=y), num_boost_round=5)
+print("single-chip 200k x 28 x 255 leaves: 5 iters ok, fast path:",
+      bst._engine._fast_active)
+PYEOF

@@ -60,3 +60,33 @@ perr = float(jnp.abs(p_pl - p_ref).max())
 print("partition payload max abs err:", perr, flush=True)
 assert perr < 1e-5, perr
 print("SMOKE OK", flush=True)
+
+
+# --- round-4 additions: feature-TILED histogram at wide-benchmark shapes
+# (MS-LTR 137x256, Expo 700x256) with the double-buffered chunk DMA ---
+for (Fw, Bw) in ((137, 256), (700, 256)):
+    assert pseg.fits_vmem(Fw, Bw), (Fw, Bw)
+    Pw = -(-(Fw + 12) // 128) * 128
+    gcol, hcol, ccol = Fw, Fw + 1, Fw + 2
+    pay_w = np.zeros((2048 + seg.CHUNK, Pw), np.float32)
+    pay_w[:2048, :Fw] = rng.integers(0, Bw - 1, (2048, Fw))
+    pay_w[:2048, gcol] = rng.standard_normal(2048)
+    pay_w[:2048, hcol] = rng.random(2048) + 0.1
+    pay_w[:2048, ccol] = 1.0
+    pay_w = jnp.asarray(pay_w)
+    s_w, c_w = jnp.int32(256), jnp.int32(1500)
+    t0 = time.time()
+    h_w = pseg.segment_histogram(pay_w, s_w, c_w, num_features=Fw,
+                                 num_bins=Bw, grad_col=gcol, hess_col=hcol,
+                                 cnt_col=ccol)
+    jax.block_until_ready(h_w)
+    print("tiled hist %dx%d compile+run %.1fs" % (Fw, Bw, time.time() - t0),
+          flush=True)
+    h_wref = seg.segment_histogram(pay_w, s_w, c_w, num_features=Fw,
+                                   num_bins=Bw, grad_col=gcol, hess_col=hcol,
+                                   cnt_col=ccol)
+    err = float(jnp.abs(h_w - h_wref).max())
+    print("tiled hist %dx%d max abs err: %s" % (Fw, Bw, err), flush=True)
+    assert err < 1e-2, err
+print("tiled + double-buffered histogram kernels OK on", jax.default_backend(),
+      flush=True)

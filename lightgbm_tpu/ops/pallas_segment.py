@@ -78,12 +78,25 @@ def _row_iota():
 
 def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
                  F, B, Ft, W, grad_col, hess_col, cnt_col):
+    """chunk is a DOUBLE buffer [2, CHUNK, P]: while slot k%2 feeds the
+    one-hot matmuls, the DMA for chunk k+1 streams into the other slot —
+    the HBM read of the payload hides behind the MXU work (the round-3
+    kernel serialized them)."""
     start = scalars[0]
     count = scalars[1]
     nch = (count + CHUNK - 1) // CHUNK
     n_tiles = -(-F // Ft)
     out_ref[:] = jnp.zeros(out_ref.shape, out_ref.dtype)
     iota_rows = _row_iota()
+
+    def dma_for(k, slot):
+        return pltpu.make_async_copy(
+            payload_hbm.at[pl.ds(start + k * CHUNK, CHUNK), :],
+            chunk.at[slot], sem.at[slot])
+
+    @pl.when(nch > 0)
+    def _prefetch_first():
+        dma_for(0, 0).start()
 
     # one-hot machinery, built once before the chunk loop.  E[f, j] = 1 iff
     # column j lies in tile-local feature f's B-wide window; expanding a
@@ -105,11 +118,14 @@ def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
     jmod_f = jmod.astype(jnp.float32)
 
     def body(k, _):
-        dma = pltpu.make_async_copy(
-            payload_hbm.at[pl.ds(start + k * CHUNK, CHUNK), :], chunk, sem)
-        dma.start()
-        dma.wait()
-        data = chunk[:]
+        slot = lax.rem(k, 2)
+
+        @pl.when(k + 1 < nch)
+        def _prefetch_next():
+            dma_for(k + 1, lax.rem(k + 1, 2)).start()
+
+        dma_for(k, slot).wait()
+        data = chunk[slot]
         ok = (iota_rows < (count - k * CHUNK)).astype(jnp.float32)
         # rows 0..2 of vals = (grad, hess, cnt) columns of data, selected by
         # a static 0/1 matrix — Mosaic can't stack 1-D slices into [8, C]
@@ -161,8 +177,8 @@ def segment_histogram(payload, start, count, *, num_features, num_bins,
             in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
             out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
             scratch_shapes=[
-                pltpu.VMEM((CHUNK, P), jnp.float32),
-                pltpu.SemaphoreType.DMA(()),
+                pltpu.VMEM((2, CHUNK, P), jnp.float32),
+                pltpu.SemaphoreType.DMA((2,)),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((8 * n_tiles, W), jnp.float32),
