@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Run when the axon TPU tunnel comes back: validates everything that could
 # not be hardware-tested while it was down, then takes a bench reading.
-set -e
+set -e -o pipefail
 cd "$(dirname "$0")/.."
 echo "=== 1. kernels exact vs portable (incl. the 2-pass partition) ==="
 timeout 400 python exp/smoke_tpu_kernels.py 2>&1 | grep -vE "WARN|INFO|libtpu|common_lib|Failed to find|Logging" | tail -8
@@ -18,6 +18,6 @@ X = rng.standard_normal((200000, 28)).astype(np.float32)
 y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
 bst = lgb.train({"objective": "binary", "num_leaves": 255, "verbose": -1},
                 lgb.Dataset(X, label=y), num_boost_round=5)
-print("single-chip 200k x 28 x 255 leaves: 5 iters ok, fast path:",
-      bst._engine._fast_active)
+assert bst._engine._fast_active, "fell off the fast path on TPU"
+print("single-chip 200k x 28 x 255 leaves: 5 iters ok, fast path active")
 PYEOF
