@@ -664,6 +664,12 @@ class GBDT:
         tl = str(getattr(config, "tree_learner", "serial") or "serial")
         if tl != "serial":
             devices = jax.devices()
+            # num_machines semantics DIFFER from the reference on purpose:
+            # there it counts socket/MPI HOSTS; here the parallel unit is a
+            # mesh DEVICE (jax.devices() already spans all hosts under
+            # jax.distributed), so num_machines caps the devices used.
+            # Reference configs that set num_machines=<hosts> get at least
+            # that much parallelism.  See docs/DISTRIBUTED.md.
             nm = int(getattr(config, "num_machines", 1) or 1)
             ndev = len(devices) if nm <= 1 else min(nm, len(devices))
             n_pad_ = train_set.num_data_padded

@@ -143,3 +143,24 @@ def test_voting_restricted_vote_accuracy(binary_data):
 
     ls, lv = logloss(serial), logloss(par)
     assert lv < ls + 0.02, (lv, ls)
+
+
+@pytest.mark.parametrize("boosting,extra", [
+    ("goss", {"top_rate": 0.3, "other_rate": 0.2}),
+    ("dart", {"drop_rate": 0.2, "drop_seed": 4}),
+    ("rf", {"bagging_fraction": 0.7, "bagging_freq": 1,
+            "feature_fraction": 0.7}),
+])
+def test_boosting_variants_on_data_parallel_mesh(binary_data, boosting,
+                                                 extra):
+    """GOSS/DART/RF must compose with tree_learner=data on the mesh fast
+    path and match the serial learner's model (identical RNG streams on
+    both paths make the draws equal)."""
+    X, y, _, _ = binary_data
+    params = {**BASE, "boosting": boosting, **extra}
+    serial = _train(params, X, y, rounds=8)
+    par = _train({**params, "tree_learner": "data"}, X, y, rounds=8)
+    eng = _engine(par)
+    assert eng.mesh is not None
+    assert eng._fast_active, "%s fell off the mesh fast path" % boosting
+    assert_models_equivalent(par.model_to_string(), serial.model_to_string())
