@@ -164,3 +164,46 @@ def test_boosting_variants_on_data_parallel_mesh(binary_data, boosting,
     assert eng.mesh is not None
     assert eng._fast_active, "%s fell off the mesh fast path" % boosting
     assert_models_equivalent(par.model_to_string(), serial.model_to_string())
+
+
+def test_criteo_shaped_wide_index_on_data_parallel(binary_data, monkeypatch):
+    """The Criteo configuration (BASELINE.md: 1.7B rows, tree_learner=data)
+    needs the radix-split index layout AND the mesh fast path TOGETHER;
+    force the wide layout at small N on the 8-device mesh and require the
+    narrow serial model."""
+    from lightgbm_tpu.boosting import gbdt as gb
+    X, y, _, _ = binary_data
+    params = {**BASE, "bagging_fraction": 0.8, "bagging_freq": 2}
+    narrow_serial = _train(params, X, y, rounds=8)
+    monkeypatch.setattr(gb, "_IDX_WIDE_THRESHOLD", 1)
+    wide_par = _train({**params, "tree_learner": "data"}, X, y, rounds=8)
+    eng = _engine(wide_par)
+    assert eng.mesh is not None and eng._fast_active
+    assert eng._fast.wide_idx, "wide layout did not engage"
+    assert_models_equivalent(wide_par.model_to_string(),
+                             narrow_serial.model_to_string())
+
+
+def test_multiclass_on_data_parallel_mesh():
+    """K trees per iteration on the mesh fast path (per-class gradient
+    fill from the snapshot columns).  Softmax gradients saturate in
+    pure-class leaves, so split candidates tie EXACTLY there and the
+    psum's accumulation order legitimately flips them even in tree 1
+    (gains agree to 7 digits) — parity is therefore judged by quality,
+    like the reference's own row/col-wise engine pairs."""
+    rng = np.random.default_rng(12)
+    X = rng.standard_normal((2000, 10)).astype(np.float32)
+    y = (np.abs(X[:, 0]) + X[:, 1] > 0.8).astype(int) + \
+        (X[:, 2] > 0.5).astype(int)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 15,
+              "verbose": -1, "min_data_in_leaf": 20, "seed": 5}
+    serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=6)
+    par = lgb.train({**params, "tree_learner": "data"},
+                    lgb.Dataset(X, label=y), num_boost_round=6)
+    eng = _engine(par)
+    assert eng.mesh is not None and eng._fast_active
+    acc_s = float(np.mean(np.argmax(serial.predict(X), 1) == y))
+    acc_p = float(np.mean(np.argmax(par.predict(X), 1) == y))
+    assert acc_p > acc_s - 0.01, (acc_p, acc_s)
+    assert acc_p > 0.9
