@@ -110,12 +110,14 @@ def main():
 
     if os.environ.get("BENCH_NO_PROBE") != "1" and not _device_probe():
         # accelerator unreachable: re-exec on CPU at reduced scale so the
-        # round still records an honest (clearly labeled) number
+        # round still records an honest (clearly labeled) number.  The env
+        # scrub is the dryrun's hermetic one — a dead tunnel's plugin must
+        # not initialize in the fallback either.
         sys.stderr.write("bench: accelerator platform unreachable; "
                          "falling back to CPU at reduced scale\n")
-        env = dict(os.environ)
-        env.update({"BENCH_NO_PROBE": "1", "JAX_PLATFORMS": "cpu",
-                    "PALLAS_AXON_POOL_IPS": "",
+        import __graft_entry__ as ge
+        env = ge._hermetic_cpu_env(1)
+        env.update({"BENCH_NO_PROBE": "1",
                     "BENCH_ROWS": str(min(n_rows, 200_000)),
                     "BENCH_TEST_ROWS": str(min(n_test, 50_000)),
                     "BENCH_ITERS": str(min(measure_iters, 5))})
