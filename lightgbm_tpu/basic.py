@@ -221,6 +221,23 @@ class Dataset:
     def num_data(self) -> int:
         return self.binned.num_data
 
+    def get_ref_chain(self, ref_limit: int = 100) -> set:
+        """Chain of Dataset references: this dataset, its reference, its
+        reference's reference, ... until ref_limit or a loop (basic.py
+        get_ref_chain)."""
+        head = self
+        ref_chain: set = set()
+        while len(ref_chain) < ref_limit:
+            if isinstance(head, Dataset):
+                ref_chain.add(head)
+                if head.reference is not None and head.reference not in ref_chain:
+                    head = head.reference
+                else:
+                    break
+            else:
+                break
+        return ref_chain
+
     def num_feature(self) -> int:
         return self.binned.num_features
 
@@ -437,6 +454,24 @@ class Booster:
     # -- reference Booster surface parity ------------------------------------
     def num_model_per_iteration(self) -> int:
         return self._model.num_tree_per_iteration
+
+    def num_feature(self) -> int:
+        """Number of features the model was trained on (basic.py
+        num_feature / LGBM_BoosterGetNumFeature)."""
+        return self._model.max_feature_idx + 1
+
+    def reset_parameter(self, params: Dict) -> "Booster":
+        """Reset Booster parameters mid-training (basic.py reset_parameter
+        -> Booster::ResetConfig): live-applied into the engine config so
+        e.g. learning_rate / bagging_fraction changes take effect on the
+        next iteration.  Engine-less (loaded) boosters update their
+        prediction-time config."""
+        if self._engine is not None:
+            self._engine.reset_config(params)
+        elif self.config is not None:
+            self.config.set(params)
+        self.params.update(params)
+        return self
 
     def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
         return float(self._model.trees[tree_id].leaf_value[leaf_id])

@@ -1175,6 +1175,16 @@ class GBDT:
             Log.warning("Stopped training because there are no more leaves that meet the split requirements")
         return not should_continue
 
+    def reset_config(self, new_params: Dict) -> None:
+        """Booster::ResetConfig: live-apply parameter changes into the
+        engine config so they take effect on the next iteration (shared by
+        Booster.reset_parameter and the reset_parameter callback)."""
+        from ..config import Config
+        self.config.set(new_params)
+        if any(Config.resolve_alias(k) == "learning_rate"
+               for k in new_params):
+            self.shrinkage_rate = float(self.config.learning_rate)
+
     def rollback_one_iter(self) -> None:
         """RollbackOneIter (gbdt.cpp:484-500): drop the last iteration's trees
         and subtract their contribution from every score vector by re-running

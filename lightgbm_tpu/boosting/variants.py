@@ -232,6 +232,12 @@ class RF(GBDT):
     def _boost_from_average(self) -> float:
         return 0.0
 
+    def reset_config(self, new_params) -> None:
+        # rf.hpp ResetConfig: RF scores are running averages — shrinkage
+        # stays pinned at 1.0 whatever learning_rate says
+        super().reset_config(new_params)
+        self.shrinkage_rate = 1.0
+
     def _gradients(self):
         # gradients of the zero score, every iteration (rf.hpp Boosting)
         if self._grad_fn is None:

@@ -82,20 +82,11 @@ def reset_parameter(**kwargs) -> Callable:
                 raise ValueError("Only list and callable values are "
                                  "supported as a parameter schedule")
         if new_params:
-            # cv() passes the CVBooster; apply to every fold engine
+            # cv() passes the CVBooster; apply to every fold booster
+            # through the one shared ResetConfig path
             boosters = getattr(env.model, "boosters", [env.model])
-            from .config import Config
-            has_lr = any(Config.resolve_alias(k) == "learning_rate"
-                         for k in new_params)
             for bst in boosters:
-                eng = getattr(bst, "_engine", None)
-                if eng is None:
-                    continue
-                # live-apply into the engine config (Booster::ResetConfig
-                # role) so e.g. bagging_fraction changes take effect
-                eng.config.set(new_params)
-                if has_lr:  # the engine caches the shrinkage scalar
-                    eng.shrinkage_rate = float(eng.config.learning_rate)
+                bst.reset_parameter(new_params)
             env.params.update(new_params)
     _callback.before_iteration = True
     _callback.order = 10

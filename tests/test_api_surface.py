@@ -124,3 +124,42 @@ def test_eval_on_path_dataset(trained, tmp_path):
     d2 = lgb.Dataset(str(f), reference=ds)
     res = bst.eval(d2, "file")
     assert res and np.isfinite(res[0][2])
+
+
+def test_num_feature_and_ref_chain(trained):
+    bst, ds, X, y = trained
+    assert bst.num_feature() == X.shape[1]
+    d2 = ds.create_valid(X[:50], label=y[:50])
+    d2.construct(bst.config)
+    chain = d2.get_ref_chain()
+    assert ds in chain and d2 in chain and len(chain) == 2
+
+
+def test_reset_parameter_method():
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((400, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(np.float64)
+    bst = lgb.Booster({"objective": "binary", "verbose": -1,
+                       "learning_rate": 0.1}, lgb.Dataset(X, label=y))
+    bst.update()
+    bst.reset_parameter({"learning_rate": 0.01})
+    assert bst._engine.shrinkage_rate == 0.01
+    bst.update()
+    assert bst.num_trees() == 2
+
+
+def test_reset_parameter_rf_keeps_unit_shrinkage():
+    """rf.hpp ResetConfig semantics: RF scores are running averages, so a
+    learning_rate reset must NOT unpin shrinkage from 1.0."""
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((500, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(np.float64)
+    bst = lgb.Booster({"objective": "binary", "boosting": "rf",
+                       "bagging_fraction": 0.7, "bagging_freq": 1,
+                       "feature_fraction": 0.7, "verbose": -1},
+                      lgb.Dataset(X, label=y))
+    bst.update()
+    bst.reset_parameter({"learning_rate": 0.05})
+    assert bst._engine.shrinkage_rate == 1.0
+    bst.update()
+    assert bst.num_trees() == 2
