@@ -101,6 +101,11 @@ def _to_2d_float(data, pandas_categorical=None) -> np.ndarray:
                                           pandas_categorical)
     elif hasattr(data, "values"):  # pandas Series
         data = data.values
+    elif data.__class__.__module__.startswith("scipy.sparse"):
+        # reference basic.py accepts csr/csc/coo; the binning layer is
+        # dense-columnar (EFB recovers the storage win for one-hot-style
+        # sparsity — docs/STORAGE.md), so densify at the boundary
+        data = data.toarray()
     arr = np.asarray(data, dtype=np.float64)
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
@@ -328,7 +333,14 @@ class Dataset:
 
     def subset(self, used_indices, params=None) -> "Dataset":
         idx = np.asarray(used_indices)
-        X = _to_2d_float(self.data)[idx]
+        data = self.data
+        if data.__class__.__module__.startswith("scipy.sparse"):
+            # slice rows while still sparse — densifying the full matrix
+            # per fold would blow memory on large sparse cv() inputs
+            data = data.tocsr()[idx]
+            X = _to_2d_float(data)
+        else:
+            X = _to_2d_float(data)[idx]
         y = None if self.label is None else np.asarray(self.label)[idx]
         w = None if self.weight is None else np.asarray(self.weight)[idx]
         return Dataset(X, label=y, weight=w, reference=self,

@@ -364,4 +364,7 @@ def _subset_matrix(ds: Dataset, idx: np.ndarray):
     data = ds.data
     if hasattr(data, "values"):
         data = data.values
+    if data.__class__.__module__.startswith("scipy.sparse"):
+        # row-slice while sparse; densify only the fold
+        return np.asarray(data.tocsr()[idx].toarray(), dtype=np.float64)
     return np.asarray(data, dtype=np.float64)[idx]

@@ -163,3 +163,29 @@ def test_reset_parameter_rf_keeps_unit_shrinkage():
     assert bst._engine.shrinkage_rate == 1.0
     bst.update()
     assert bst.num_trees() == 2
+
+
+def test_scipy_sparse_input_train_and_predict():
+    """Reference basic.py accepts scipy.sparse for Dataset AND predict;
+    the dense-columnar binning densifies at the boundary (EFB recovers
+    the storage win — docs/STORAGE.md)."""
+    import scipy.sparse as sp
+    X = sp.random(600, 30, density=0.1, format="csr", random_state=0,
+                  dtype=np.float64)
+    y = (np.asarray(X.sum(axis=1)).ravel() > 0.5).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    p_sparse = bst.predict(X.tocsc())
+    p_dense = bst.predict(X.toarray())
+    np.testing.assert_allclose(p_sparse, p_dense, atol=1e-12)
+    assert np.isfinite(p_sparse).all()
+
+
+def test_scipy_sparse_cv_subsets_stay_sparse():
+    import scipy.sparse as sp
+    X = sp.random(900, 25, density=0.1, format="csr", random_state=2,
+                  dtype=np.float64)
+    y = (np.asarray(X.sum(axis=1)).ravel() > 0.5).astype(np.float32)
+    res = lgb.cv({"objective": "binary", "verbose": -1},
+                 lgb.Dataset(X, label=y), num_boost_round=3, nfold=3)
+    assert any(res[k][-1] > 0 for k in res if k.endswith("-mean"))
