@@ -64,7 +64,7 @@ print("SMOKE OK", flush=True)
 
 # --- round-4 additions: feature-TILED histogram at wide-benchmark shapes
 # (MS-LTR 137x256, Expo 700x256) with the double-buffered chunk DMA ---
-for (Fw, Bw) in ((137, 256), (700, 256)):
+for (Fw, Bw) in ((137, 256), (700, 256), (968, 64), (2000, 64)):
     assert pseg.fits_vmem(Fw, Bw), (Fw, Bw)
     Pw = -(-(Fw + 12) // 128) * 128
     gcol, hcol, ccol = Fw, Fw + 1, Fw + 2

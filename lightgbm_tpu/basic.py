@@ -95,17 +95,30 @@ def _load_pandas_categorical(model_text: str):
         return None
 
 
+def _is_scipy_sparse(data) -> bool:
+    return data.__class__.__module__.startswith("scipy.sparse")
+
+
+def _sparse_rows(data, idx: np.ndarray) -> np.ndarray:
+    """Row-slice a scipy.sparse matrix while still sparse, densify only
+    the slice (cv folds / subsets of large sparse inputs must never
+    materialize the full dense matrix)."""
+    return np.asarray(data.tocsr()[idx].toarray(), dtype=np.float64)
+
+
 def _to_2d_float(data, pandas_categorical=None) -> np.ndarray:
     if _is_dataframe(data):
         data, _, _, _ = _data_from_pandas(data, "auto", "auto",
                                           pandas_categorical)
+    elif _is_scipy_sparse(data):
+        # reference basic.py accepts csr/csc/coo/...; the binning layer is
+        # dense-columnar (EFB recovers the storage win for one-hot-style
+        # sparsity — docs/STORAGE.md), so densify at the boundary.  Checked
+        # BEFORE the .values duck test: dok_matrix subclasses dict, whose
+        # .values method would shadow this branch.
+        data = data.toarray()
     elif hasattr(data, "values"):  # pandas Series
         data = data.values
-    elif data.__class__.__module__.startswith("scipy.sparse"):
-        # reference basic.py accepts csr/csc/coo; the binning layer is
-        # dense-columnar (EFB recovers the storage win for one-hot-style
-        # sparsity — docs/STORAGE.md), so densify at the boundary
-        data = data.toarray()
     arr = np.asarray(data, dtype=np.float64)
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
@@ -333,14 +346,10 @@ class Dataset:
 
     def subset(self, used_indices, params=None) -> "Dataset":
         idx = np.asarray(used_indices)
-        data = self.data
-        if data.__class__.__module__.startswith("scipy.sparse"):
-            # slice rows while still sparse — densifying the full matrix
-            # per fold would blow memory on large sparse cv() inputs
-            data = data.tocsr()[idx]
-            X = _to_2d_float(data)
+        if _is_scipy_sparse(self.data):
+            X = _sparse_rows(self.data, idx)
         else:
-            X = _to_2d_float(data)[idx]
+            X = _to_2d_float(self.data)[idx]
         y = None if self.label is None else np.asarray(self.label)[idx]
         w = None if self.weight is None else np.asarray(self.weight)[idx]
         return Dataset(X, label=y, weight=w, reference=self,

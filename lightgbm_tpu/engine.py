@@ -361,10 +361,10 @@ def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
 
 
 def _subset_matrix(ds: Dataset, idx: np.ndarray):
+    from .basic import _is_scipy_sparse, _sparse_rows
     data = ds.data
+    if _is_scipy_sparse(data):
+        return _sparse_rows(data, idx)
     if hasattr(data, "values"):
         data = data.values
-    if data.__class__.__module__.startswith("scipy.sparse"):
-        # row-slice while sparse; densify only the fold
-        return np.asarray(data.tocsr()[idx].toarray(), dtype=np.float64)
     return np.asarray(data, dtype=np.float64)[idx]

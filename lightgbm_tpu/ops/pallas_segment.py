@@ -35,11 +35,12 @@ from .split import MISSING_NAN, MISSING_ZERO
 # must match ops.segment.CHUNK (payload guard sizing)
 CHUNK = 256
 
-# per-tile one-hot budget: the joint one-hot over one FEATURE TILE is
-# [CHUNK, ~TILE_FB] f32 (4 MB).  Features are tiled so any F streams
-# through the same VMEM window — the role of the workgroup grid in the
-# reference OpenCL kernels (ocl/histogram256.cl:73-121).
-TILE_FB = 4096
+# per-tile one-hot budget: the expand and one-hot intermediates over one
+# FEATURE TILE are each [CHUNK, ~TILE_FB] f32 (2 MB).  Features are tiled
+# so any F streams through the same VMEM window — the role of the
+# workgroup grid in the reference OpenCL kernels
+# (ocl/histogram256.cl:73-121).
+TILE_FB = 2048
 
 #: VMEM the kernel may plan for (chip has ~16 MB/core; leave headroom for
 #: the compiler's own buffers)
@@ -58,12 +59,13 @@ def _tiling(num_features: int, num_bins: int):
 
 
 def fits_vmem(num_features: int, num_bins: int) -> bool:
-    """True when the tiled kernel's VMEM plan fits the budget: the one-hot
-    tile + the [8 * n_tiles, W] accumulator + the payload chunk."""
+    """True when the tiled kernel's VMEM plan fits the budget: the expand
+    + one-hot tile intermediates, the [8 * n_tiles, W] accumulator and the
+    double-buffered payload chunk."""
     ft, n_tiles, w = _tiling(num_features, num_bins)
-    est = (4 * CHUNK * w                       # one-hot tile
+    est = (2 * 4 * CHUNK * w                   # expand + one-hot tiles
            + 4 * 8 * n_tiles * w               # accumulator
-           + 2 * 4 * CHUNK * _pad128(num_features + 32)  # chunk scratch
+           + 2 * 4 * CHUNK * _pad128(num_features + 32)  # chunk x2 (DMA)
            + 4 * ft * w)                       # window expander
     return est <= _VMEM_BUDGET
 

@@ -181,11 +181,41 @@ def test_scipy_sparse_input_train_and_predict():
     assert np.isfinite(p_sparse).all()
 
 
-def test_scipy_sparse_cv_subsets_stay_sparse():
+def test_scipy_sparse_cv_subsets_stay_sparse(monkeypatch):
+    """cv folds of a sparse input must row-slice while still sparse —
+    toarray may only ever see fold-sized slices, never the full matrix."""
     import scipy.sparse as sp
     X = sp.random(900, 25, density=0.1, format="csr", random_state=2,
                   dtype=np.float64)
     y = (np.asarray(X.sum(axis=1)).ravel() > 0.5).astype(np.float32)
+    densified_rows = []
+    orig = sp.csr_matrix.toarray
+
+    def spy(self, *a, **k):
+        densified_rows.append(self.shape[0])
+        return orig(self, *a, **k)
+
+    monkeypatch.setattr(sp.csr_matrix, "toarray", spy)
     res = lgb.cv({"objective": "binary", "verbose": -1},
                  lgb.Dataset(X, label=y), num_boost_round=3, nfold=3)
     assert any(res[k][-1] > 0 for k in res if k.endswith("-mean"))
+    assert densified_rows, "sparse path never engaged"
+    # the parent Dataset's construction densifies the full matrix ONCE
+    # (binning needs the columns); every fold slice must be fold-sized
+    full = [n for n in densified_rows if n == 900]
+    assert len(full) <= 1, \
+        "folds re-densified the full matrix: %r" % densified_rows
+
+
+def test_scipy_sparse_dok_input():
+    """dok_matrix subclasses dict — its .values method must not shadow
+    the sparse branch (ordering bug found in review)."""
+    import scipy.sparse as sp
+    X = sp.dok_matrix((300, 10), dtype=np.float64)
+    rng = np.random.default_rng(3)
+    for _ in range(400):
+        X[rng.integers(0, 300), rng.integers(0, 10)] = rng.random()
+    y = (np.asarray(X.tocsr().sum(axis=1)).ravel() > 0.2).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    assert np.isfinite(bst.predict(X)).all()

@@ -41,9 +41,10 @@ def test_histogram_matches(start, count):
 
 
 @pytest.mark.parametrize("f,b,start,count", [
-    (137, 256, 0, 300),    # MS-LTR shape: 9 tiles of 16, ragged last (9)
-    (70, 64, 100, 351),    # 2 tiles of 64, ragged last (6)
-    (700, 256, 256, 260),  # Expo/Yahoo shape: 44 tiles, ragged last (12)
+    (137, 256, 0, 300),    # MS-LTR shape: tiles of 8, ragged last
+    (70, 64, 100, 351),    # tiles of 32, ragged last
+    (700, 256, 256, 260),  # Expo/Yahoo shape: 88 tiles, ragged last
+    (968, 64, 0, 300),     # Bosch shape at the GPU-recommended max_bin=63
 ])
 def test_histogram_matches_tiled(f, b, start, count):
     """Feature-tiled kernel vs portable engine at wide-feature shapes the
@@ -75,6 +76,8 @@ def test_vmem_gate_admits_benchmark_shapes():
     assert pseg.fits_vmem(28, 255)    # Higgs
     assert pseg.fits_vmem(137, 256)   # MS-LTR
     assert pseg.fits_vmem(700, 256)   # Expo / Yahoo LTR
+    assert pseg.fits_vmem(968, 64)    # Bosch at GPU max_bin=63
+    assert pseg.fits_vmem(2000, 64)   # Epsilon at GPU max_bin=63
     assert not pseg.fits_vmem(4228, 256)  # raw Allstate: portable path
 
 
