@@ -15,7 +15,7 @@ F, B = 28, 256
 P = 128
 GRAD, HESS, CNT, VAL = F, F + 1, F + 2, F + 3
 
-payload = np.zeros((N + seg.CHUNK, P), np.float32)
+payload = np.zeros((N + seg.GUARD, P), np.float32)
 payload[:N, :F] = rng.integers(0, B - 1, (N, F))
 payload[:N, GRAD] = rng.standard_normal(N)
 payload[:N, HESS] = rng.random(N) + 0.1

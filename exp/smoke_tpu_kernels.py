@@ -15,7 +15,7 @@ B = 64
 P = 128  # lane-aligned payload width, as the fast path provides on TPU
 GRAD, HESS, CNT, VAL = F, F + 1, F + 2, F + 3
 
-payload = np.zeros((N + seg.CHUNK, P), np.float32)
+payload = np.zeros((N + seg.GUARD, P), np.float32)
 payload[:N, :F] = rng.integers(0, B - 1, (N, F))
 payload[:N, GRAD] = rng.standard_normal(N)
 payload[:N, HESS] = rng.random(N) + 0.1
@@ -68,7 +68,7 @@ for (Fw, Bw) in ((137, 256), (700, 256), (968, 64), (2000, 64)):
     assert pseg.fits_vmem(Fw, Bw), (Fw, Bw)
     Pw = -(-(Fw + 12) // 128) * 128
     gcol, hcol, ccol = Fw, Fw + 1, Fw + 2
-    pay_w = np.zeros((2048 + seg.CHUNK, Pw), np.float32)
+    pay_w = np.zeros((2048 + seg.GUARD, Pw), np.float32)
     pay_w[:2048, :Fw] = rng.integers(0, Bw - 1, (2048, Fw))
     pay_w[:2048, gcol] = rng.standard_normal(2048)
     pay_w[:2048, hcol] = rng.random(2048) + 0.1

@@ -106,6 +106,15 @@ def _sparse_rows(data, idx: np.ndarray) -> np.ndarray:
     return np.asarray(data.tocsr()[idx].toarray(), dtype=np.float64)
 
 
+def _slice_rows(data, idx: np.ndarray) -> np.ndarray:
+    """Row-slice any supported input matrix (sparse checked before the
+    `.values` duck test — dok_matrix subclasses dict, whose .values method
+    would otherwise win)."""
+    if _is_scipy_sparse(data):
+        return _sparse_rows(data, idx)
+    return _to_2d_float(data)[idx]
+
+
 def _to_2d_float(data, pandas_categorical=None) -> np.ndarray:
     if _is_dataframe(data):
         data, _, _, _ = _data_from_pandas(data, "auto", "auto",
@@ -346,10 +355,7 @@ class Dataset:
 
     def subset(self, used_indices, params=None) -> "Dataset":
         idx = np.asarray(used_indices)
-        if _is_scipy_sparse(self.data):
-            X = _sparse_rows(self.data, idx)
-        else:
-            X = _to_2d_float(self.data)[idx]
+        X = _slice_rows(self.data, idx)
         y = None if self.label is None else np.asarray(self.label)[idx]
         w = None if self.weight is None else np.asarray(self.weight)[idx]
         return Dataset(X, label=y, weight=w, reference=self,

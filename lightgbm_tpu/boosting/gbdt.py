@@ -194,7 +194,7 @@ class _FastState:
         n_pad = ds.num_data_padded
         self.G, self.K, self.n_pad = G, K, n_pad
         # mesh fast path: rows live in ndev device blocks of n_loc real rows
-        # + a CHUNK guard tail EACH (the partition kernels overrun into the
+        # + a GUARD-row tail EACH (the partition kernels overrun into the
         # guard, so it must sit at the end of every LOCAL block, not just
         # the global tail).  Guard rows carry idx == n_pad — a dead slot
         # that every original-order consumer (bag refresh, score sync)
@@ -205,7 +205,7 @@ class _FastState:
         self.ndev = ndev
         n_loc = n_pad // ndev
         self.n_loc = n_loc
-        n_rows = (n_loc + seg.CHUNK) * ndev
+        n_rows = (n_loc + seg.GUARD) * ndev
         self.n_rows = n_rows
         self.label_col = G
         self.weight_col = G + 1
@@ -270,10 +270,10 @@ class _FastState:
             return idx
 
         def build_block(bins, label, weight, vmask, score, idx0):
-            """One device block: n_loc_b real rows + the CHUNK guard tail,
+            """One device block: n_loc_b real rows + the GUARD-row tail,
             guard idx pinned to the dead slot."""
             n_loc_b = label.shape[0]
-            pay = jnp.zeros((n_loc_b + seg.CHUNK, P), jnp.float32)
+            pay = jnp.zeros((n_loc_b + seg.GUARD, P), jnp.float32)
             pay = pay.at[:n_loc_b, :G].set(bins.T.astype(jnp.float32))
             pay = pay.at[:n_loc_b, G].set(label)
             pay = pay.at[:n_loc_b, G + 1].set(weight)

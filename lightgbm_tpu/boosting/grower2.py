@@ -59,7 +59,7 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
     """Returns grow(payload, aux, feature_mask) ->
     (tree arrays dict, payload, aux).
 
-    payload/aux: [N_pad + CHUNK, P] f32 with a CHUNK-row guard tail whose
+    payload/aux: [N_pad + GUARD, P] f32 with a GUARD-row tail whose
     count-mask is 0.  Valid rows are [0, N_pad); the root segment covers all
     of them regardless of the ordering left behind by previous trees.
 
@@ -133,6 +133,12 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         hist_fn = functools.partial(pseg.segment_histogram, **hist_kwargs)
 
         def part_fn(payload, aux, start, count, pred, lv, rv):
+            # the partition kernel spans the full payload width; at
+            # Epsilon-wide P its un-tiled VMEM plan overflows, so only the
+            # histogram rides the Pallas path there
+            if not pseg.partition_fits_vmem(payload.shape[1], B):
+                return seg.partition_segment(payload, aux, start, count,
+                                             pred, lv, rv, cols.value)
             return pseg.partition_segment(payload, aux, start, count, pred,
                                           lv, rv, cols.value, B)
     else:
@@ -164,7 +170,7 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
 
     def grow(payload: jax.Array, aux: jax.Array,
              feature_mask: jax.Array):
-        n_rows = jnp.int32(payload.shape[0] - seg.CHUNK)
+        n_rows = jnp.int32(payload.shape[0] - seg.GUARD)
 
         # mesh-mode machinery is built at trace time (axis_index exists only
         # inside shard_map); find_split closes over the feature mask so the

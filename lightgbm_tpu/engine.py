@@ -12,7 +12,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from .basic import Booster, Dataset
+from .basic import Booster, Dataset, _slice_rows
 from .callback import CallbackEnv, EarlyStopException, log_evaluation
 from .utils.log import Log
 
@@ -361,10 +361,4 @@ def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
 
 
 def _subset_matrix(ds: Dataset, idx: np.ndarray):
-    from .basic import _is_scipy_sparse, _sparse_rows
-    data = ds.data
-    if _is_scipy_sparse(data):
-        return _sparse_rows(data, idx)
-    if hasattr(data, "values"):
-        data = data.values
-    return np.asarray(data, dtype=np.float64)[idx]
+    return _slice_rows(ds.data, idx)

@@ -30,10 +30,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .segment import CHUNK, GUARD
 from .split import MISSING_NAN, MISSING_ZERO
-
-# must match ops.segment.CHUNK (payload guard sizing)
-CHUNK = 256
 
 # per-tile one-hot budget: the expand and one-hot intermediates over one
 # FEATURE TILE are each [CHUNK, ~TILE_FB] f32 (2 MB).  Features are tiled
@@ -59,14 +57,29 @@ def _tiling(num_features: int, num_bins: int):
 
 
 def fits_vmem(num_features: int, num_bins: int) -> bool:
-    """True when the tiled kernel's VMEM plan fits the budget: the expand
-    + one-hot tile intermediates, the [8 * n_tiles, W] accumulator and the
-    double-buffered payload chunk."""
+    """True when the tiled histogram kernel's VMEM plan fits the budget:
+    the expand + one-hot tile intermediates, the [8 * n_tiles, W]
+    accumulator and the double-buffered payload chunk."""
     ft, n_tiles, w = _tiling(num_features, num_bins)
     est = (2 * 4 * CHUNK * w                   # expand + one-hot tiles
            + 4 * 8 * n_tiles * w               # accumulator
            + 2 * 4 * CHUNK * _pad128(num_features + 32)  # chunk x2 (DMA)
            + 4 * ft * w)                       # window expander
+    return est <= _VMEM_BUDGET
+
+
+def partition_fits_vmem(payload_width: int, num_bins: int) -> bool:
+    """True when the partition kernel's VMEM plan fits: its scratch
+    (chunk + two RMW windows) and live row intermediates all span the FULL
+    payload width P — unlike the histogram kernel it has no feature tiling,
+    so very wide payloads (Epsilon-shaped, P ~ 2048) take the portable
+    partition while the histogram still rides the Pallas kernel."""
+    P = payload_width
+    win = CHUNK + 8
+    est = (4 * (CHUNK + 2 * win) * P           # scratch: chunk, wstage, wread
+           + 4 * (3 * CHUNK + win) * P         # live rows: data/lrows/rrows + shifted
+           + 4 * (2 * CHUNK * CHUNK + 2 * win * CHUNK)   # perm/tri + smat/iotas
+           + 4 * CHUNK * num_bins)             # categorical bitset one-hot
     return est <= _VMEM_BUDGET
 
 
@@ -86,14 +99,20 @@ def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
     kernel serialized them)."""
     start = scalars[0]
     count = scalars[1]
-    nch = (count + CHUNK - 1) // CHUNK
+    # HBM row slices must start at a multiple of the f32 sublane tiling (8);
+    # a segment starts anywhere, so chunks stride from the aligned base and
+    # the first `shift` rows are masked out of chunk 0.
+    shift = lax.rem(start, 8)
+    base = start - shift
+    nch = jnp.where(count > 0, (shift + count + CHUNK - 1) // CHUNK, 0)
     n_tiles = -(-F // Ft)
     out_ref[:] = jnp.zeros(out_ref.shape, out_ref.dtype)
     iota_rows = _row_iota()
 
     def dma_for(k, slot):
         return pltpu.make_async_copy(
-            payload_hbm.at[pl.ds(start + k * CHUNK, CHUNK), :],
+            payload_hbm.at[pl.ds(pl.multiple_of(base + k * CHUNK, 8),
+                                 CHUNK), :],
             chunk.at[slot], sem.at[slot])
 
     @pl.when(nch > 0)
@@ -128,7 +147,8 @@ def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
 
         dma_for(k, slot).wait()
         data = chunk[slot]
-        ok = (iota_rows < (count - k * CHUNK)).astype(jnp.float32)
+        ok = ((iota_rows >= shift - k * CHUNK) &
+              (iota_rows < shift + count - k * CHUNK)).astype(jnp.float32)
         # rows 0..2 of vals = (grad, hess, cnt) columns of data, selected by
         # a static 0/1 matrix — Mosaic can't stack 1-D slices into [8, C]
         P = data.shape[1]
@@ -197,9 +217,18 @@ def segment_histogram(payload, start, count, *, num_features, num_bins,
 # partition
 # ---------------------------------------------------------------------------
 
+#: rows in a write window: a write at an arbitrary cursor d becomes a
+#: read-modify-write of the aligned window [d - d%8, ...) — 8 slack rows
+#: cover the worst-case misalignment (sublane tiling of f32 HBM memrefs).
+#: Payload buffers must carry at least this much guard tail past the last
+#: real row, or the final write window DMAs out of bounds.
+WIN = CHUNK + 8
+assert WIN <= GUARD, "segment.GUARD must cover the RMW write window"
+
+
 def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
                       payload_out, aux_out, nl_out,
-                      chunk, compact, blend, sem_in, sem_out, *,
+                      chunk, wstage, wread, sem_in, sem_out, *,
                       P, B, value_col):
     """payload_hbm/aux_hbm are aliased with payload_out/aux_out — the kernel
     reads and writes the same HBM buffers through the `_out` refs."""
@@ -216,19 +245,26 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
     identity = scalars[10]
     left_value = fvals[0]
     right_value = fvals[1]
-    nch = (count + CHUNK - 1) // CHUNK
+    # reads stride CHUNK from the 8-aligned base below `start`; the first
+    # `shift` rows of window 0 belong to the previous segment and mask out
+    shift = lax.rem(start, 8)
+    base = start - shift
+    nch = jnp.where(count > 0, (shift + count + CHUNK - 1) // CHUNK, 0)
     iota_rows = _row_iota()
+    iota_w = lax.broadcasted_iota(jnp.int32, (WIN, 1), 0)[:, 0]
     iota_p = lax.broadcasted_iota(jnp.int32, (1, P), 1)
 
     def read_chunk(src_ref, k, buf):
         dma = pltpu.make_async_copy(
-            src_ref.at[pl.ds(start + k * CHUNK, CHUNK), :], buf, sem_in)
+            src_ref.at[pl.ds(pl.multiple_of(base + k * CHUNK, 8), CHUNK), :],
+            buf, sem_in)
         dma.start()
         dma.wait()
         return buf[:]
 
     def valid_mask(k):
-        return (iota_rows < (count - k * CHUNK)).astype(jnp.int32)
+        return ((iota_rows >= shift - k * CHUNK) &
+                (iota_rows < shift + count - k * CHUNK)).astype(jnp.int32)
 
     def go_left(data, k):
         # select the split feature's storage column by lane reduction
@@ -256,8 +292,6 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
         gl = is_cat * gl_cat + (1 - is_cat) * gl_num
         return gl * valid_mask(k)                                # [C] i32 0/1
 
-    end = start + count
-
     def compact_rows(keep_i, data, value):
         """Stable forward compaction of data rows with keep_i=1 (exclusive
         prefix sum as a strict-lower-triangular matvec — Mosaic has no
@@ -274,51 +308,54 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
         rows = jnp.dot(perm, data, preferred_element_type=jnp.float32)
         return jnp.where(iota_p == value_col, value, rows)
 
-    def write_rows(dst_ref, d, rows, keep_cnt):
-        """Write rows[:keep_cnt] to dst_ref[d:d+keep_cnt).  The DMA is
-        always CHUNK rows; when the window stays inside the segment the
-        over-write tail only clobbers already-consumed rows (the write
-        cursor trails the read cursor), but a window crossing the segment
-        end would corrupt the NEXT leaf's rows — that boundary chunk is
-        blended read-modify-write instead."""
-        @pl.when(d + CHUNK <= end)
-        def _direct():
-            compact[:] = rows
-            dma = pltpu.make_async_copy(
-                compact, dst_ref.at[pl.ds(d, CHUNK), :], sem_out)
-            dma.start()
-            dma.wait()
+    def write_rows(dst_ref, d, rows, keep_cnt, src_off):
+        """Write rows[src_off : src_off+keep_cnt) to dst_ref[d : d+keep_cnt).
 
-        @pl.when(d + CHUNK > end)
-        def _blended():
+        The destination cursor is arbitrary but HBM slices must start
+        8-aligned, so the write is a read-modify-write of the enclosing
+        aligned WIN-row window; the source rows are moved to their in-window
+        offset by a shift-permutation matmul (dynamic sublane rolls are not
+        a Mosaic primitive, matmuls are).  Rows outside [d, d+keep_cnt) are
+        written back with the values just read, so trailing unconsumed rows
+        and the prologue of already-written rows both survive — this also
+        subsumes the old segment-end blend path.  Empty writes (common on
+        skewed splits: most chunks contribute to only one side) skip the
+        whole round trip."""
+        @pl.when(keep_cnt > 0)
+        def _go():
+            sw = lax.rem(d, 8)
+            basew = pl.multiple_of(d - sw, 8)
             dma_r = pltpu.make_async_copy(
-                dst_ref.at[pl.ds(d, CHUNK), :], blend, sem_in)
+                dst_ref.at[pl.ds(basew, WIN), :], wread, sem_in)
             dma_r.start()
             dma_r.wait()
-            keepf = (iota_rows < keep_cnt).astype(jnp.float32)[:, None]
-            compact[:] = keepf * rows + (1.0 - keepf) * blend[:]
+            delta = sw - src_off
+            iota_wi = lax.broadcasted_iota(jnp.int32, (WIN, CHUNK), 0)
+            iota_wj = lax.broadcasted_iota(jnp.int32, (WIN, CHUNK), 1)
+            smat = (iota_wi - iota_wj == delta).astype(jnp.float32)
+            shifted = jnp.dot(smat, rows,
+                              preferred_element_type=jnp.float32)  # [WIN, P]
+            region = ((iota_w >= sw) &
+                      (iota_w < sw + keep_cnt)).astype(jnp.float32)[:, None]
+            wstage[:] = region * shifted + (1.0 - region) * wread[:]
             dma_w = pltpu.make_async_copy(
-                compact, dst_ref.at[pl.ds(d, CHUNK), :], sem_out)
+                wstage, dst_ref.at[pl.ds(basew, WIN), :], sem_out)
             dma_w.start()
             dma_w.wait()
 
     # pass A: ONE read of the segment; lefts forward-compact in place in
-    # payload (write cursor <= read cursor, so full-chunk writes only
-    # clobber consumed rows), rights staged compacted into aux scratch.
+    # payload (the write cursor trails the read cursor, and the RMW windows
+    # write back every row outside the compacted block unchanged), rights
+    # staged compacted into aux scratch.
     def body_a(k, carry):
         nl, nr = carry
         data = read_chunk(payload_out, k, chunk)
         gl = go_left(data, k)
         keep_r = valid_mask(k) - gl
         lrows = compact_rows(gl, data, left_value)
-        write_rows(payload_out, start + nl, lrows, jnp.sum(gl))
+        write_rows(payload_out, start + nl, lrows, jnp.sum(gl), 0)
         rrows = compact_rows(keep_r, data, right_value)
-        # aux is scratch: over-write tails there are harmless, direct DMA
-        compact[:] = rrows
-        dma = pltpu.make_async_copy(
-            compact, aux_out.at[pl.ds(start + nr, CHUNK), :], sem_out)
-        dma.start()
-        dma.wait()
+        write_rows(aux_out, start + nr, rrows, jnp.sum(keep_r), 0)
         return (nl + jnp.sum(gl), nr + jnp.sum(keep_r))
 
     num_left, num_right = lax.fori_loop(
@@ -326,16 +363,20 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
     nl_out[0] = num_left
 
     # pass B: copy the staged rights back behind the lefts (touches only
-    # the rights region, ~half the old blended full-segment pass C)
-    nrch = (num_right + CHUNK - 1) // CHUNK
+    # the rights region, ~half the old blended full-segment pass C).  Window
+    # k of the aligned read stream holds source rows [lo, hi) of the staged
+    # rights; they land at the destination cursor advanced by the rows of
+    # all previous windows.
+    nrch = jnp.where(num_right > 0,
+                     (shift + num_right + CHUNK - 1) // CHUNK, 0)
 
     def body_b(k, _):
-        dma = pltpu.make_async_copy(
-            aux_out.at[pl.ds(start + k * CHUNK, CHUNK), :], chunk, sem_in)
-        dma.start()
-        dma.wait()
-        keep = jnp.minimum(num_right - k * CHUNK, CHUNK)
-        write_rows(payload_out, start + num_left + k * CHUNK, chunk[:], keep)
+        data = read_chunk(aux_out, k, chunk)
+        lo = jnp.maximum(shift - k * CHUNK, 0)
+        hi = jnp.minimum(shift + num_right - k * CHUNK, CHUNK)
+        done = jnp.maximum(k * CHUNK - shift, 0)
+        write_rows(payload_out, start + num_left + done, data,
+                   jnp.maximum(hi - lo, 0), lo)
         return 0
 
     lax.fori_loop(0, nrch, body_b, 0, unroll=False)
@@ -371,8 +412,8 @@ def partition_segment(payload, aux, start, count, pred, left_value,
                        pl.BlockSpec(memory_space=pltpu.SMEM)),
             scratch_shapes=[
                 pltpu.VMEM((CHUNK, P), jnp.float32),
-                pltpu.VMEM((CHUNK, P), jnp.float32),
-                pltpu.VMEM((CHUNK, P), jnp.float32),
+                pltpu.VMEM((WIN, P), jnp.float32),
+                pltpu.VMEM((WIN, P), jnp.float32),
                 pltpu.SemaphoreType.DMA(()),
                 pltpu.SemaphoreType.DMA(()),
             ],

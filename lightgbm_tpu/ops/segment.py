@@ -41,6 +41,13 @@ from .split import MISSING_NAN, MISSING_ZERO
 # permutation matrix [C, C] sit comfortably in VMEM on the Pallas path
 CHUNK = 256
 
+# guard rows past the last real row.  The portable passes write up to CHUNK
+# garbage rows past a segment; the Pallas partition kernel additionally
+# writes aligned CHUNK+8-row windows (HBM row slices must start at a
+# multiple of the f32 sublane tiling of 8, so a write at an arbitrary
+# cursor becomes a read-modify-write of the enclosing aligned window).
+GUARD = CHUNK + 8
+
 
 def resolve_impl(impl: str, num_features: int, num_bins: int) -> str:
     """Pick the segment-engine implementation at trace time.

@@ -64,7 +64,7 @@ def _grow_both(X, y, num_leaves=31, categorical=(), min_data=20):
     F = ds.num_features
     cols = PayloadCols(grad=F, hess=F + 1, cnt=F + 2, value=F + 3)
     P = F + 4
-    payload = np.zeros((n_pad + seg.CHUNK, P), np.float32)
+    payload = np.zeros((n_pad + seg.GUARD, P), np.float32)
     payload[:n_pad, :F] = ds.bins.T
     payload[:n_pad, cols.grad] = grad * mask
     payload[:n_pad, cols.hess] = hess * mask
@@ -171,14 +171,14 @@ def test_masked_counts_match_bagging():
 
     F = ds.num_features
     cols = PayloadCols(grad=F, hess=F + 1, cnt=F + 2, value=F + 3)
-    payload = np.zeros((n_pad + seg.CHUNK, F + 4), np.float32)
+    payload = np.zeros((n_pad + seg.GUARD, F + 4), np.float32)
     payload[:n_pad, :F] = ds.bins.T
     payload[:n_pad, cols.grad] = grad
     payload[:n_pad, cols.hess] = hess
     payload[:n_pad, cols.cnt] = mask
     grow2 = make_partitioned_grower(meta, gcfg, ds.max_num_bin, cols, F)
     tree2, _, _ = grow2(jnp.asarray(payload),
-                        jnp.zeros((n_pad + seg.CHUNK, F + 4), jnp.float32),
+                        jnp.zeros((n_pad + seg.GUARD, F + 4), jnp.float32),
                         fmask)
     out2 = jax.device_get(tree2)
     _assert_same_tree(out1, out2)

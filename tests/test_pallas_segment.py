@@ -19,7 +19,7 @@ P = F + 4
 
 def _payload(n_pad, seed=0):
     rng = np.random.default_rng(seed)
-    pay = np.zeros((n_pad + seg.CHUNK, P), np.float32)
+    pay = np.zeros((n_pad + seg.GUARD, P), np.float32)
     pay[:n_pad, :F] = rng.integers(0, B, size=(n_pad, F))
     pay[:n_pad, F] = rng.standard_normal(n_pad)
     pay[:n_pad, F + 1] = rng.random(n_pad)
@@ -28,7 +28,8 @@ def _payload(n_pad, seed=0):
 
 
 @pytest.mark.parametrize("start,count", [(0, 1000), (256, 700), (100, 37),
-                                         (0, 0), (513, 256)])
+                                         (0, 0), (513, 256), (7, 1),
+                                         (9, 1015), (1023, 1)])
 def test_histogram_matches(start, count):
     pay = _payload(1024)
     ref = seg.segment_histogram(pay, jnp.int32(start), jnp.int32(count),
@@ -55,7 +56,7 @@ def test_histogram_matches_tiled(f, b, start, count):
     p = f + 4
     rng = np.random.default_rng(f + b)
     n_pad = 640
-    pay = np.zeros((n_pad + seg.CHUNK, p), np.float32)
+    pay = np.zeros((n_pad + seg.GUARD, p), np.float32)
     pay[:n_pad, :f] = rng.integers(0, b, size=(n_pad, f))
     pay[:n_pad, f] = rng.standard_normal(n_pad)
     pay[:n_pad, f + 1] = rng.random(n_pad)
@@ -68,6 +69,15 @@ def test_histogram_matches_tiled(f, b, start, count):
                                  **cols)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_partition_vmem_gate():
+    """The partition kernel has no feature tiling: Bosch-wide payloads
+    (P ~ 1024) fit, Epsilon-wide (P ~ 2048) fall back to the portable
+    partition while the histogram stays on the Pallas kernel."""
+    assert pseg.partition_fits_vmem(128, 256)   # Higgs-shaped payload
+    assert pseg.partition_fits_vmem(1024, 64)   # Bosch-shaped payload
+    assert not pseg.partition_fits_vmem(2048, 64)  # Epsilon-shaped payload
 
 
 def test_vmem_gate_admits_benchmark_shapes():
@@ -101,6 +111,9 @@ def _pred(feature=1, threshold=B // 2, default_left=False, is_cat=False,
     (0, 600, dict(is_cat=True,
                   bitset=(np.arange(B) % 3 == 0))),
     (513, 256, dict(feature=0, threshold=0)),
+    (7, 1, {}),
+    (9, 1015, dict(feature=2, threshold=B // 3)),
+    (255, 513, dict(feature=4, threshold=1)),
     # EFB bundle decode: storage col 2 holds an offset-encoded member
     (64, 500, dict(feature=2, threshold=3, offset=5, identity=False,
                    num_bin=9, default_bin=0)),
