@@ -90,3 +90,36 @@ for (Fw, Bw) in ((137, 256), (700, 256), (968, 64), (2000, 64)):
     assert err < 1e-2, err
 print("tiled + double-buffered histogram kernels OK on", jax.default_backend(),
       flush=True)
+
+
+# --- precision: the MXU's default f32 matmul is ONE bf16 pass, which (before
+# the HIGHEST/part-decomposition fixes) rounded every permuted payload value
+# to 8 mantissa bits and collapsed the radix-4096 idx columns.  These checks
+# only bite on real hardware — interpret mode is plain f32.  ---
+IDX = F + 4
+payx = np.zeros((8192 + seg.GUARD, P), np.float32)
+payx[:8192, :F] = rng.integers(0, B, (8192, F))
+gvals = (1.0 + rng.random(8192) * 2.0**-18).astype(np.float32)  # >8 mantissa bits
+payx[:8192, GRAD] = gvals
+payx[:8192, HESS] = 1.0
+payx[:8192, CNT] = 1.0
+payx[:8192, IDX] = np.arange(8192, dtype=np.float32) % 4096
+p_x, _, _ = pseg.partition_segment(
+    jnp.asarray(payx), jnp.zeros_like(jnp.asarray(payx)), jnp.int32(0),
+    jnp.int32(8192), pred, jnp.float32(1.0), jnp.float32(-1.0), VAL, B)
+p_x = np.asarray(p_x)
+assert np.array_equal(np.sort(p_x[:8192, IDX]), np.sort(payx[:8192, IDX])), \
+    "idx columns corrupted by the partition matmul"
+assert np.array_equal(np.sort(p_x[:8192, GRAD]), np.sort(gvals)), \
+    "payload values bf16-rounded by the partition matmul"
+h_x = pseg.segment_histogram(jnp.asarray(payx), jnp.int32(0), jnp.int32(8192),
+                             num_features=F, num_bins=B, grad_col=GRAD,
+                             hess_col=HESS, cnt_col=CNT)
+h64 = np.zeros((F, B), np.float64)
+for f in range(F):
+    np.add.at(h64[f], payx[:8192, f].astype(np.int64), gvals.astype(np.float64))
+gerr = float(np.abs(np.asarray(h_x)[:, :, 0] - h64).max())
+print("hist grad-sum err vs float64: %.3g" % gerr, flush=True)
+assert gerr < 1e-3, gerr   # f32-accumulation class, NOT bf16-input class (~0.5)
+print("PRECISION OK: exact permutation + f32-class histograms on",
+      jax.default_backend(), flush=True)

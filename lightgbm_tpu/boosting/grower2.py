@@ -131,22 +131,24 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
     if impl == "pallas":
         from ..ops import pallas_segment as pseg
         hist_fn = functools.partial(pseg.segment_histogram, **hist_kwargs)
-
-        def part_fn(payload, aux, start, count, pred, lv, rv):
-            # the partition kernel spans the full payload width; at
-            # Epsilon-wide P its un-tiled VMEM plan overflows, so only the
-            # histogram rides the Pallas path there
-            if not pseg.partition_fits_vmem(payload.shape[1], B):
-                return seg.partition_segment(payload, aux, start, count,
-                                             pred, lv, rv, cols.value)
-            return pseg.partition_segment(payload, aux, start, count, pred,
-                                          lv, rv, cols.value, B)
     else:
         hist_fn = functools.partial(seg.segment_histogram, **hist_kwargs)
 
-        def part_fn(payload, aux, start, count, pred, lv, rv):
-            return seg.partition_segment(payload, aux, start, count, pred,
-                                         lv, rv, cols.value)
+    # the partition kernel is gated separately from the histogram: it is
+    # exact at any bin count (HIGHEST-precision permutation) but spans the
+    # full payload width, so Epsilon-wide P overflows its un-tiled VMEM
+    # plan while e.g. a >256-bin config only falls off the HISTOGRAM kernel
+    pallas_part = (cfg.hist_impl != "lax"
+                   and jax.default_backend() == "tpu")
+
+    def part_fn(payload, aux, start, count, pred, lv, rv):
+        if pallas_part:
+            from ..ops import pallas_segment as pseg
+            if pseg.partition_fits_vmem(payload.shape[1], B):
+                return pseg.partition_segment(payload, aux, start, count,
+                                              pred, lv, rv, cols.value, B)
+        return seg.partition_segment(payload, aux, start, count, pred,
+                                     lv, rv, cols.value)
 
     def hist_view(hist_g):
         """[G, B, 3] bundle histogram -> [F, B, 3] per-feature split view."""

@@ -72,13 +72,45 @@ def _hist_scatter(bins: jax.Array, vals: jax.Array, num_bins: int) -> jax.Array:
     return flat.reshape(F, num_bins, 3)
 
 
+def _decompose_vals(vals: jax.Array) -> jax.Array:
+    """[C, 3] (g, h, cnt) → [C, 7] exact bf16 parts (g_hi, g_mid, g_lo,
+    h_hi, h_mid, h_lo, cnt).
+
+    The MXU's default f32 matmul is ONE bf16 pass, which would round the
+    gradients to 8 mantissa bits; each part here IS bf16-representable, so
+    a one-pass contraction against a 0/1 one-hot is exact and the f32
+    histogram is recovered as the sum of the part-histograms — the same
+    trick as the Pallas kernel's vals rows, at none of HIGHEST's 3-6x
+    pass cost."""
+    gh = vals[:, :2]
+    # NOT astype(bf16).astype(f32): under --xla_allow_excess_precision
+    # (set by some TPU runtimes) XLA deletes that round trip, silently
+    # collapsing the parts to (x, 0, 0); reduce_precision is contractual
+    hi = lax.reduce_precision(gh, exponent_bits=8, mantissa_bits=7)
+    r1 = gh - hi
+    mid = lax.reduce_precision(r1, exponent_bits=8, mantissa_bits=7)
+    lo = r1 - mid
+    return jnp.concatenate(
+        [hi[:, :1], mid[:, :1], lo[:, :1],
+         hi[:, 1:], mid[:, 1:], lo[:, 1:], vals[:, 2:]], axis=1)
+
+
+def _recombine_hist(parts: jax.Array) -> jax.Array:
+    """[F, B, 7] part-histograms → [F, B, 3]."""
+    return jnp.stack([parts[..., 0] + parts[..., 1] + parts[..., 2],
+                      parts[..., 3] + parts[..., 4] + parts[..., 5],
+                      parts[..., 6]], axis=-1)
+
+
 def _hist_one_chunk(bins: jax.Array, vals: jax.Array, num_bins: int) -> jax.Array:
-    """One-hot contraction over a row chunk: [F, C] × [C, 3] → [F, B, 3]."""
+    """One-hot contraction over a row chunk: [F, C] × [C, 7] → [F, B, 3]."""
     iota = lax.broadcasted_iota(jnp.int32, (1, 1, num_bins), 2)
     onehot = (bins.astype(jnp.int32)[:, :, None] == iota).astype(jnp.float32)
-    # batch dim F; contract the row-chunk dim (MXU reduction) with vals
-    return jnp.einsum("fcb,cd->fbd", onehot, vals,
-                      preferred_element_type=jnp.float32)
+    # batch dim F; contract the row-chunk dim (MXU reduction) with the
+    # bf16-exact part columns — one-pass precision, exact products
+    return _recombine_hist(
+        jnp.einsum("fcb,cd->fbd", onehot, _decompose_vals(vals),
+                   preferred_element_type=jnp.float32))
 
 
 def subtract_histogram(parent: jax.Array, child: jax.Array) -> jax.Array:

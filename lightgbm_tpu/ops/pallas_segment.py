@@ -59,7 +59,12 @@ def _tiling(num_features: int, num_bins: int):
 def fits_vmem(num_features: int, num_bins: int) -> bool:
     """True when the tiled histogram kernel's VMEM plan fits the budget:
     the expand + one-hot tile intermediates, the [8 * n_tiles, W]
-    accumulator and the double-buffered payload chunk."""
+    accumulator and the double-buffered payload chunk.  Bins are capped at
+    256: the kernel's exactness argument needs every bin value and
+    within-window offset to be bf16-representable (the reference OpenCL
+    family has the same 256-bin kernel ceiling, ocl/histogram256.cl)."""
+    if num_bins > 256:
+        return False
     ft, n_tiles, w = _tiling(num_features, num_bins)
     est = (2 * 4 * CHUNK * w                   # expand + one-hot tiles
            + 4 * 8 * n_tiles * w               # accumulator
@@ -149,17 +154,38 @@ def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
         data = chunk[slot]
         ok = ((iota_rows >= shift - k * CHUNK) &
               (iota_rows < shift + count - k * CHUNK)).astype(jnp.float32)
-        # rows 0..2 of vals = (grad, hess, cnt) columns of data, selected by
-        # a static 0/1 matrix — Mosaic can't stack 1-D slices into [8, C]
+        # The MXU runs f32 matmuls as ONE bf16 pass by default, which would
+        # round the gradients to 8 mantissa bits.  Instead of paying the
+        # 3-pass HIGHEST contract, the M dimension's unused rows carry an
+        # EXACT bf16 decomposition: rows (g_hi, g_mid, g_lo, h_hi, h_mid,
+        # h_lo, cnt) — each part is bf16-representable, so the one-pass
+        # contract is exact and the f32 histogram is recovered as the sum
+        # of three part-histograms.  (Extraction of the g/h/cnt columns is
+        # a tiny matmul — HIGHEST there costs nothing.)
         P = data.shape[1]
         iota_r8 = lax.broadcasted_iota(jnp.int32, (8, P), 0)
         iota_pc = lax.broadcasted_iota(jnp.int32, (8, P), 1)
-        sel = (((iota_r8 == 0) & (iota_pc == grad_col)) |
-               ((iota_r8 == 1) & (iota_pc == hess_col)) |
-               ((iota_r8 == 2) & (iota_pc == cnt_col))).astype(jnp.float32)
-        vals = lax.dot_general(
+        sel = (((iota_r8 < 3) & (iota_pc == grad_col)) |
+               ((iota_r8 >= 3) & (iota_r8 < 6) & (iota_pc == hess_col)) |
+               ((iota_r8 == 6) & (iota_pc == cnt_col))).astype(jnp.float32)
+        raw = lax.dot_general(
             sel, data, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)                  # [8, C]
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST)                     # [8, C]
+        # astype round trips are safe HERE (unlike histogram.py, which
+        # must use lax.reduce_precision): Mosaic lowers the trunc/ext pair
+        # directly and never runs XLA's excess-precision simplifier that
+        # would delete it — validated on hardware by exp/smoke_tpu_kernels
+        # (idx-multiset + grad-bit-survival + float64 checks).
+        hi = raw.astype(jnp.bfloat16).astype(jnp.float32)
+        r1 = raw - hi
+        mid = r1.astype(jnp.bfloat16).astype(jnp.float32)
+        lo = r1 - mid
+        rr = lax.broadcasted_iota(jnp.int32, raw.shape, 0)
+        vals = jnp.where((rr == 0) | (rr == 3), hi,
+                         jnp.where((rr == 1) | (rr == 4), mid,
+                                   jnp.where((rr == 2) | (rr == 5), lo,
+                                             raw)))
         vals = vals * ok[None, :]
         # feature tiles walk the SAME resident chunk — the payload is read
         # from HBM once per histogram no matter how wide it is
@@ -206,9 +232,14 @@ def segment_histogram(payload, start, count, *, num_features, num_bins,
         out_shape=jax.ShapeDtypeStruct((8 * n_tiles, W), jnp.float32),
         interpret=interpret,
     )(scalars, payload)
-    # [8*T, W] -> [T, 8, W] -> grad/hess/cnt of the real window columns
+    # [8*T, W] -> [T, 8, W]; rows are the exact bf16 part-decomposition
+    # (g_hi, g_mid, g_lo, h_hi, h_mid, h_lo, cnt) — recombine, then
     # -> [3, T*Ft, B] -> drop tile padding features -> [F, B, 3]
-    return (out.reshape(n_tiles, 8, W)[:, :3, :Ft * B]
+    r = out.reshape(n_tiles, 8, W)
+    ghc = jnp.stack([r[:, 0] + r[:, 1] + r[:, 2],
+                     r[:, 3] + r[:, 4] + r[:, 5],
+                     r[:, 6]], axis=1)                           # [T, 3, W]
+    return (ghc[:, :, :Ft * B]
             .reshape(n_tiles, 3, Ft, B).transpose(1, 0, 2, 3)
             .reshape(3, n_tiles * Ft, B)[:, :F].transpose(1, 2, 0))
 
@@ -305,7 +336,11 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
         iota_c = lax.broadcasted_iota(jnp.int32, (CHUNK, CHUNK), 0)
         perm = ((dest[None, :] == iota_c) &
                 (keep_i[None, :] > 0)).astype(jnp.float32)
-        rows = jnp.dot(perm, data, preferred_element_type=jnp.float32)
+        # HIGHEST: the default one-pass-bf16 MXU matmul would round every
+        # payload value it permutes (and corrupt the >8-bit idx columns);
+        # the cost is invisible — this kernel is DMA-latency-bound.
+        rows = jnp.dot(perm, data, preferred_element_type=jnp.float32,
+                       precision=lax.Precision.HIGHEST)
         return jnp.where(iota_p == value_col, value, rows)
 
     def write_rows(dst_ref, d, rows, keep_cnt, src_off):
@@ -334,7 +369,8 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
             iota_wj = lax.broadcasted_iota(jnp.int32, (WIN, CHUNK), 1)
             smat = (iota_wi - iota_wj == delta).astype(jnp.float32)
             shifted = jnp.dot(smat, rows,
-                              preferred_element_type=jnp.float32)  # [WIN, P]
+                              preferred_element_type=jnp.float32,
+                              precision=lax.Precision.HIGHEST)     # [WIN, P]
             region = ((iota_w >= sw) &
                       (iota_w < sw + keep_cnt)).astype(jnp.float32)[:, None]
             wstage[:] = region * shifted + (1.0 - region) * wread[:]

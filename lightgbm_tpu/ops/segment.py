@@ -64,6 +64,11 @@ def resolve_impl(impl: str, num_features: int, num_bins: int) -> str:
                 and pallas_segment.fits_vmem(num_features, num_bins)):
             return "pallas"
         return "lax"
+    if impl == "pallas" and num_bins > 256:
+        raise ValueError(
+            "tpu_histogram_impl=pallas requires max_bin <= 256 (the kernel's "
+            "exactness argument needs bf16-representable bin values, like "
+            "the reference's 256-bin OpenCL kernel ceiling)")
     return impl
 
 
@@ -101,12 +106,14 @@ def go_left_chunk(chunk: jax.Array, pred: SplitPredicate) -> jax.Array:
 
 def _compact_matmul(chunk: jax.Array, keep: jax.Array) -> jax.Array:
     """Stable-compact kept rows to the front via a one-hot permutation
-    matmul — the TPU-native scatter."""
+    matmul — the TPU-native scatter.  HIGHEST precision: the TPU MXU's
+    default one-bf16-pass f32 matmul would round every payload value it
+    permutes (and corrupt >8-bit idx columns)."""
     C = chunk.shape[0]
     dest = jnp.cumsum(keep.astype(jnp.int32)) - keep.astype(jnp.int32)
     perm = ((dest[None, :] == jnp.arange(C, dtype=jnp.int32)[:, None])
             & keep[None, :]).astype(chunk.dtype)
-    return perm @ chunk
+    return jnp.matmul(perm, chunk, precision=jax.lax.Precision.HIGHEST)
 
 
 def partition_segment(payload: jax.Array, aux: jax.Array, start: jax.Array,
@@ -211,10 +218,14 @@ def segment_histogram(payload: jax.Array, start: jax.Array, count: jax.Array,
             hist = hist.reshape(F * B, 3).at[jidx.reshape(-1)].add(
                 upd).reshape(F, B, 3)
         else:
+            from .histogram import _decompose_vals, _recombine_hist
             onehot = (binsf[:, :, None] == iota_b[None, None, :]).astype(
                 payload.dtype)                                 # [C, F, B]
-            hist = hist + jnp.einsum("cfb,cd->fbd", onehot, vals,
-                                     preferred_element_type=jnp.float32)
+            # bf16-exact part columns keep the MXU contraction one-pass
+            # AND exact (the default f32 matmul is one bf16 pass)
+            hist = hist + _recombine_hist(
+                jnp.einsum("cfb,cd->fbd", onehot, _decompose_vals(vals),
+                           preferred_element_type=jnp.float32))
         return k + 1, hist
 
     hist0 = jnp.zeros((F, B, 3), jnp.float32)
