@@ -9,6 +9,8 @@ echo "=== 2. grower profile (fixed cost + scaling) ==="
 timeout 500 python exp/prof_grow_small.py 2>&1 | grep "grow:" || true
 echo "=== 3. bench at 2M rows ==="
 BENCH_ROWS=2000000 BENCH_TEST_ROWS=200000 BENCH_ITERS=10 timeout 550 python bench.py 2>&1 | grep '"metric"'
+echo "=== 3b. bench at FULL Higgs scale (10.5M x 28) ==="
+timeout 3000 python bench.py 2>&1 | grep '"metric"' || echo "full-scale bench failed/oom"
 echo "=== 4. mesh fast path on the real chip count (single-chip smoke) ==="
 timeout 400 python - <<'PYEOF' 2>&1 | tail -3
 import numpy as np
