@@ -144,6 +144,10 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
     def part_fn(payload, aux, start, count, pred, lv, rv):
         if pallas_part:
             from ..ops import pallas_segment as pseg
+            if (pseg.PARTITION_ACC_VALIDATED
+                    and pseg.partition_acc_fits_vmem(payload.shape[1], B)):
+                return pseg.partition_segment_acc(payload, aux, start, count,
+                                                  pred, lv, rv, cols.value, B)
             if pseg.partition_fits_vmem(payload.shape[1], B):
                 return pseg.partition_segment(payload, aux, start, count,
                                               pred, lv, rv, cols.value, B)

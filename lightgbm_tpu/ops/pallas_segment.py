@@ -73,6 +73,25 @@ def fits_vmem(num_features: int, num_bins: int) -> bool:
     return est <= _VMEM_BUDGET
 
 
+#: True once exp/smoke_tpu_kernels has validated the accumulator-window
+#: partition kernel on real hardware; until then the RMW kernel stays the
+#: product default (round 4's lesson: interpret mode proves nothing about
+#: Mosaic legality).
+PARTITION_ACC_VALIDATED = False
+
+
+def partition_acc_fits_vmem(payload_width: int, num_bins: int) -> bool:
+    """VMEM plan of the accumulator-window partition kernel: read ring,
+    two [2C, P] accumulators, stage/blend buffers, the part-decomposition
+    intermediates, the [2C, C] placement one-hot machinery (mat + two
+    iotas + tri) and the categorical bitset one-hot."""
+    P, C = payload_width, CHUNK
+    est = (4 * P * 14 * C          # ring(2C) + accs(4C) + stage/rbuf(2C) + parts/placed(~6C)
+           + 4 * 7 * C * C         # mat[2C,C] + iota_2i/2j[2C,C] + tri[C,C]
+           + 4 * C * num_bins)     # categorical bitset one-hot in go_left
+    return est <= _VMEM_BUDGET
+
+
 def partition_fits_vmem(payload_width: int, num_bins: int) -> bool:
     """True when the partition kernel's VMEM plan fits: its scratch
     (chunk + two RMW windows) and live row intermediates all span the FULL
@@ -90,6 +109,55 @@ def partition_fits_vmem(payload_width: int, num_bins: int) -> bool:
 
 def _row_iota():
     return lax.broadcasted_iota(jnp.int32, (CHUNK, 1), 0)[:, 0]
+
+
+def _bf16_parts(data):
+    """Exact bf16 hi/mid/lo decomposition of f32 rows (each part is
+    bf16-representable, so one-pass MXU matmuls against 0/1 matrices are
+    exact; hi+mid+lo reconstructs the f32 value exactly).  astype round
+    trips are safe in Mosaic — see the note in _hist_kernel."""
+    hi = data.astype(jnp.bfloat16).astype(jnp.float32)
+    r1 = data - hi
+    mid = r1.astype(jnp.bfloat16).astype(jnp.float32)
+    lo = r1 - mid
+    return hi, mid, lo
+
+
+def _go_left_rows(scalars, bitset_ref, data, B, iota_p):
+    """[C] i32 0/1 routing of payload rows by the split predicate (without
+    the caller's window-validity mask) — Bin::Split semantics shared by
+    both partition kernels.  Selects the split feature's storage column by
+    lane reduction (dynamic lane indexing is not a Mosaic primitive; the
+    masked sum is), then decodes the EFB bundle value to the feature's own
+    bin.  All predicate logic is i32 arithmetic — Mosaic cannot
+    re-truncate materialized bool vectors back to i1 for select_n."""
+    col = scalars[2]
+    threshold = scalars[3]
+    default_left = scalars[4]
+    is_cat = scalars[5]
+    missing_type = scalars[6]
+    num_bin = scalars[7]
+    default_bin = scalars[8]
+    offset = scalars[9]
+    identity = scalars[10]
+    raw = jnp.sum(jnp.where(iota_p == col, data, 0.0),
+                  axis=1).astype(jnp.int32)                  # [C]
+    e = raw - offset
+    in_range = ((e >= 0) & (e < num_bin - 1)).astype(jnp.int32)
+    bump = (e >= default_bin).astype(jnp.int32)
+    decoded = in_range * (e + bump) + (1 - in_range) * default_bin
+    fbin = identity * raw + (1 - identity) * decoded
+    miss = (((missing_type == MISSING_NAN) &
+             (fbin == num_bin - 1)).astype(jnp.int32) |
+            ((missing_type == MISSING_ZERO) &
+             (fbin == default_bin)).astype(jnp.int32))
+    gl_num = (miss * default_left +
+              (1 - miss) * (fbin <= threshold).astype(jnp.int32))
+    iota_b = lax.broadcasted_iota(jnp.int32, (CHUNK, B), 1)
+    hits = ((fbin[:, None] == iota_b) &
+            (bitset_ref[:] > 0)).astype(jnp.int32)
+    gl_cat = (jnp.sum(hits, axis=1) > 0).astype(jnp.int32)
+    return is_cat * gl_cat + (1 - is_cat) * gl_num
 
 
 # ---------------------------------------------------------------------------
@@ -248,6 +316,12 @@ def segment_histogram(payload, start, count, *, num_features, num_bins,
 # partition
 # ---------------------------------------------------------------------------
 
+#: both partition kernels overrun DMA windows past the segment end: the
+#: RMW kernel by WIN rows, the accumulator kernel by up to a full flushed
+#: window (CHUNK rows past the last real row) — the GUARD tail must cover
+#: whichever is larger.
+assert CHUNK <= GUARD, "segment.GUARD must cover a full flush window"
+
 #: rows in a write window: a write at an arbitrary cursor d becomes a
 #: read-modify-write of the aligned window [d - d%8, ...) — 8 slack rows
 #: cover the worst-case misalignment (sublane tiling of f32 HBM memrefs).
@@ -265,15 +339,6 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
     reads and writes the same HBM buffers through the `_out` refs."""
     start = scalars[0]
     count = scalars[1]
-    col = scalars[2]
-    threshold = scalars[3]
-    default_left = scalars[4]
-    is_cat = scalars[5]
-    missing_type = scalars[6]
-    num_bin = scalars[7]
-    default_bin = scalars[8]
-    offset = scalars[9]
-    identity = scalars[10]
     left_value = fvals[0]
     right_value = fvals[1]
     # reads stride CHUNK from the 8-aligned base below `start`; the first
@@ -298,30 +363,8 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
                 (iota_rows < shift + count - k * CHUNK)).astype(jnp.int32)
 
     def go_left(data, k):
-        # select the split feature's storage column by lane reduction
-        # (dynamic lane indexing is not a Mosaic primitive; the masked sum
-        # is), then decode the EFB bundle value to the feature's own bin.
-        # All predicate logic is i32 arithmetic — Mosaic cannot re-truncate
-        # materialized bool vectors back to i1 for select_n.
-        raw = jnp.sum(jnp.where(iota_p == col, data, 0.0),
-                      axis=1).astype(jnp.int32)                  # [C]
-        e = raw - offset
-        in_range = ((e >= 0) & (e < num_bin - 1)).astype(jnp.int32)
-        bump = (e >= default_bin).astype(jnp.int32)
-        decoded = in_range * (e + bump) + (1 - in_range) * default_bin
-        fbin = identity * raw + (1 - identity) * decoded
-        miss = (((missing_type == MISSING_NAN) &
-                 (fbin == num_bin - 1)).astype(jnp.int32) |
-                ((missing_type == MISSING_ZERO) &
-                 (fbin == default_bin)).astype(jnp.int32))
-        gl_num = (miss * default_left +
-                  (1 - miss) * (fbin <= threshold).astype(jnp.int32))
-        iota_b = lax.broadcasted_iota(jnp.int32, (CHUNK, B), 1)
-        hits = ((fbin[:, None] == iota_b) &
-                (bitset_ref[:] > 0)).astype(jnp.int32)
-        gl_cat = (jnp.sum(hits, axis=1) > 0).astype(jnp.int32)
-        gl = is_cat * gl_cat + (1 - is_cat) * gl_num
-        return gl * valid_mask(k)                                # [C] i32 0/1
+        return _go_left_rows(scalars, bitset_ref, data, B, iota_p) \
+            * valid_mask(k)                                  # [C] i32 0/1
 
     def compact_rows(keep_i, data, value):
         """Stable forward compaction of data rows with keep_i=1 (exclusive
@@ -450,6 +493,253 @@ def partition_segment(payload, aux, start, count, pred, left_value,
                 pltpu.VMEM((CHUNK, P), jnp.float32),
                 pltpu.VMEM((WIN, P), jnp.float32),
                 pltpu.VMEM((WIN, P), jnp.float32),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+        ),
+        out_shape=(jax.ShapeDtypeStruct(payload.shape, payload.dtype),
+                   jax.ShapeDtypeStruct(aux.shape, aux.dtype),
+                   jax.ShapeDtypeStruct((1,), jnp.int32)),
+        input_output_aliases={3: 0, 4: 1},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(scalars, fvals, bitset, payload, aux)
+    return payload_new, aux_new, nl[0]
+
+
+# ---------------------------------------------------------------------------
+# partition, accumulator-window variant
+# ---------------------------------------------------------------------------
+
+C2 = 2 * CHUNK
+
+
+def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
+                payload_out, aux_out, nl_out,
+                ring, lacc, racc, stage, rbuf, sem_ring, sem_w, sem_r, *,
+                P, B, value_col):
+    """Accumulator-window partition: same contract as `_partition_kernel`,
+    restructured around the measured bottleneck (per-chunk latency, not
+    bandwidth).  Lefts and rights accumulate in VMEM windows [2C, P] that
+    flush ALIGNED, FULL chunks to HBM only when a window fills — so the
+    per-chunk read-modify-write round trips of the RMW kernel collapse to
+    one amortized direct write per side, the destination offset is folded
+    into the placement one-hot (no separate shift matmul), reads prefetch
+    on a double-buffered ring, and exactness costs three ONE-pass matmuls
+    on a bf16-exact hi/mid/lo decomposition instead of a 6-pass HIGHEST.
+    Only the LAST window of a segment needs a blend read (its tail crosses
+    into the next leaf's rows)."""
+    start = scalars[0]
+    count = scalars[1]
+    left_value = fvals[0]
+    right_value = fvals[1]
+    shift = lax.rem(start, 8)
+    base = start - shift
+    nch = jnp.where(count > 0, (shift + count + CHUNK - 1) // CHUNK, 0)
+    iota_rows = _row_iota()
+    iota_c2 = lax.broadcasted_iota(jnp.int32, (C2, 1), 0)[:, 0]
+    iota_p = lax.broadcasted_iota(jnp.int32, (1, P), 1)
+    iota_2i = lax.broadcasted_iota(jnp.int32, (C2, CHUNK), 0)
+    iota_2j = lax.broadcasted_iota(jnp.int32, (C2, CHUNK), 1)
+
+    def ring_dma(src_ref, k, slot):
+        return pltpu.make_async_copy(
+            src_ref.at[pl.ds(pl.multiple_of(base + k * CHUNK, 8), CHUNK), :],
+            ring.at[slot], sem_ring.at[slot])
+
+    def valid_mask(k):
+        return ((iota_rows >= shift - k * CHUNK) &
+                (iota_rows < shift + count - k * CHUNK)).astype(jnp.int32)
+
+    def go_left(data, k):
+        return _go_left_rows(scalars, bitset_ref, data, B, iota_p) \
+            * valid_mask(k)                                  # [C] i32 0/1
+
+    def rank_of(keep_i):
+        """Exclusive prefix count of kept rows (tri matvec; <= C, exact)."""
+        tri = (iota_2j[:CHUNK, :] < iota_2i[:CHUNK, :]).astype(jnp.float32)
+        return jnp.dot(tri, keep_i.astype(jnp.float32)[:, None],
+                       preferred_element_type=jnp.float32)[:, 0].astype(jnp.int32)
+
+    def append(acc, parts, dest, member, cnt, off, value):
+        """Place source rows j (member[j]=1) at acc rows dest[j] via a 0/1
+        one-hot applied to the exact parts (three one-pass matmuls), write
+        the child's tree output into the value column, and blend the
+        placed region [off, off+cnt) into the accumulator."""
+        mat = ((iota_2i == dest[None, :]) &
+               (member[None, :] > 0)).astype(jnp.float32)        # [2C, C]
+        hi, mid, lo = parts
+        placed = (jnp.dot(mat, hi, preferred_element_type=jnp.float32) +
+                  jnp.dot(mat, mid, preferred_element_type=jnp.float32) +
+                  jnp.dot(mat, lo, preferred_element_type=jnp.float32))
+        placed = jnp.where(iota_p == value_col, value, placed)
+        # where, NOT an arithmetic blend: rows outside the region may hold
+        # uninitialized accumulator memory, and 0 * NaN poisons a multiply
+        region = ((iota_c2 >= off) & (iota_c2 < off + cnt))[:, None]
+        acc[:] = jnp.where(region, placed, acc[:])
+
+    def flush(acc, dst_ref, wbase):
+        """Write the full first window of the accumulator and slide."""
+        stage[:] = acc[0:CHUNK]
+        dma = pltpu.make_async_copy(
+            stage, dst_ref.at[pl.ds(pl.multiple_of(wbase, 8), CHUNK), :],
+            sem_w)
+        dma.start()
+        dma.wait()
+        acc[0:CHUNK] = acc[CHUNK:C2]
+
+    @pl.when(nch > 0)
+    def _prefetch_first():
+        ring_dma(payload_out, 0, 0).start()
+
+    # ---- pass A: one read of the segment; lefts accumulate toward payload
+    # windows, rights accumulate toward aux staging windows -------------
+    def body_a(k, carry):
+        nl, nr, lo_, ro_, lfl, rfl = carry
+        slot = lax.rem(k, 2)
+
+        @pl.when(k + 1 < nch)
+        def _prefetch_next():
+            ring_dma(payload_out, k + 1, lax.rem(k + 1, 2)).start()
+
+        ring_dma(payload_out, k, slot).wait()
+        data = ring[slot]
+
+        @pl.when(k == 0)
+        def _seed():
+            # the first window's prologue rows belong to the previous
+            # leaf; seeding from chunk 0 makes every later flush a plain
+            # full-window write
+            lacc[0:CHUNK] = data
+
+        parts = _bf16_parts(data)
+        gl = go_left(data, k)
+        keep_r = valid_mask(k) - gl
+        nlk = jnp.sum(gl)
+        nrk = jnp.sum(keep_r)
+        rank_l = rank_of(gl)
+        rank_r = rank_of(keep_r)
+
+        append(lacc, parts, lo_ + rank_l, gl, nlk, lo_, left_value)
+        fl = ((lo_ + nlk) >= CHUNK).astype(jnp.int32)
+
+        @pl.when(fl > 0)
+        def _flush_l():
+            flush(lacc, payload_out, base + lfl * CHUNK)
+
+        append(racc, parts, ro_ + rank_r, keep_r, nrk, ro_, right_value)
+        fr = ((ro_ + nrk) >= CHUNK).astype(jnp.int32)
+
+        @pl.when(fr > 0)
+        def _flush_r():
+            flush(racc, aux_out, base + rfl * CHUNK)
+
+        return (nl + nlk, nr + nrk, lo_ + nlk - fl * CHUNK,
+                ro_ + nrk - fr * CHUNK, lfl + fl, rfl + fr)
+
+    num_left, num_right, lo_, ro_, lfl, rfl = lax.fori_loop(
+        0, nch, body_a,
+        (jnp.int32(0), jnp.int32(0), shift, shift,
+         jnp.int32(0), jnp.int32(0)), unroll=False)
+    nl_out[0] = num_left
+
+    # rights not yet flushed go out as one final aux window (junk tails in
+    # the scratch buffer are harmless)
+    @pl.when(ro_ > 0)
+    def _flush_r_tail():
+        flush(racc, aux_out, base + rfl * CHUNK)
+
+    # ---- pass B: append the staged rights behind the lefts, continuing
+    # in the SAME left accumulator (rights start exactly at the left
+    # cursor — the handoff needs no flush, no read, no shift) -----------
+    nchb = jnp.where(num_right > 0,
+                     (shift + num_right + CHUNK - 1) // CHUNK, 0)
+
+    @pl.when(nchb > 0)
+    def _prefetch_b():
+        ring_dma(aux_out, 0, 0).start()
+
+    def body_b(k, carry):
+        lo_, lfl = carry
+        slot = lax.rem(k, 2)
+
+        @pl.when(k + 1 < nchb)
+        def _prefetch_next():
+            ring_dma(aux_out, k + 1, lax.rem(k + 1, 2)).start()
+
+        ring_dma(aux_out, k, slot).wait()
+        j0 = jnp.maximum(shift - k * CHUNK, 0)
+        j1 = jnp.minimum(shift + num_right - k * CHUNK, CHUNK)
+        cnt = jnp.maximum(j1 - j0, 0)
+        member = ((iota_rows >= j0) & (iota_rows < j1)).astype(jnp.int32)
+        # non-member rows of the staged window can be uninitialized aux
+        # memory; zero them BEFORE the matmul (0 x NaN = NaN would poison
+        # every placed row)
+        data = jnp.where(member[:, None] > 0, ring[slot], 0.0)
+        parts = _bf16_parts(data)
+        dest = iota_rows - j0 + lo_
+        append(lacc, parts, dest, member, cnt, lo_, right_value)
+        fl = ((lo_ + cnt) >= CHUNK).astype(jnp.int32)
+
+        @pl.when(fl > 0)
+        def _flush_l():
+            flush(lacc, payload_out, base + lfl * CHUNK)
+
+        return (lo_ + cnt - fl * CHUNK, lfl + fl)
+
+    lo_, lfl = lax.fori_loop(0, nchb, body_b, (lo_, lfl), unroll=False)
+
+    # ---- final window: its tail crosses into the next leaf's rows — the
+    # one place the kernel pays a blend read ----------------------------
+    @pl.when((count > 0) & (lo_ > 0))
+    def _final():
+        wbase = pl.multiple_of(base + lfl * CHUNK, 8)
+        dma_r = pltpu.make_async_copy(
+            payload_out.at[pl.ds(wbase, CHUNK), :], rbuf, sem_r)
+        dma_r.start()
+        dma_r.wait()
+        region = (iota_rows < lo_)[:, None]
+        stage[:] = jnp.where(region, lacc[0:CHUNK], rbuf[:])
+        dma_w = pltpu.make_async_copy(
+            stage, payload_out.at[pl.ds(wbase, CHUNK), :], sem_w)
+        dma_w.start()
+        dma_w.wait()
+
+
+@functools.partial(jax.jit, static_argnames=("value_col", "num_bins",
+                                             "interpret"))
+def partition_segment_acc(payload, aux, start, count, pred, left_value,
+                          right_value, value_col, num_bins, interpret=False):
+    """Same contract as `partition_segment`, accumulator-window kernel."""
+    P = payload.shape[1]
+    B = num_bins
+    scalars = jnp.stack([
+        start, count, pred.col, pred.threshold,
+        pred.default_left.astype(jnp.int32), pred.is_cat.astype(jnp.int32),
+        pred.missing_type, pred.num_bin, pred.default_bin,
+        pred.offset, pred.identity.astype(jnp.int32),
+    ]).astype(jnp.int32)
+    fvals = jnp.stack([left_value, right_value]).astype(jnp.float32)
+    bitset = pred.bitset.astype(jnp.int32).reshape(1, B)
+    kern = functools.partial(_acc_kernel, P=P, B=B, value_col=value_col)
+    payload_new, aux_new, nl = pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pltpu.SMEM)),
+            scratch_shapes=[
+                pltpu.VMEM((2, CHUNK, P), jnp.float32),   # read ring
+                pltpu.VMEM((C2, P), jnp.float32),         # left accumulator
+                pltpu.VMEM((C2, P), jnp.float32),         # right accumulator
+                pltpu.VMEM((CHUNK, P), jnp.float32),      # flush stage
+                pltpu.VMEM((CHUNK, P), jnp.float32),      # final blend read
+                pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA(()),
                 pltpu.SemaphoreType.DMA(()),
             ],

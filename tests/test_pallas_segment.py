@@ -118,7 +118,9 @@ def _pred(feature=1, threshold=B // 2, default_left=False, is_cat=False,
     (64, 500, dict(feature=2, threshold=3, offset=5, identity=False,
                    num_bin=9, default_bin=0)),
 ])
-def test_partition_matches(start, count, predkw):
+@pytest.mark.parametrize("impl", [pseg.partition_segment,
+                                  pseg.partition_segment_acc])
+def test_partition_matches(start, count, predkw, impl):
     pay = _payload(1024, seed=start + count)
     aux = jnp.zeros_like(pay)
     pred = _pred(**predkw)
@@ -126,10 +128,31 @@ def test_partition_matches(start, count, predkw):
 
     ref_pay, _, ref_nl = seg.partition_segment(
         pay, aux, jnp.int32(start), jnp.int32(count), pred, lv, rv, VALUE_COL)
-    got_pay, _, got_nl = pseg.partition_segment(
+    got_pay, _, got_nl = impl(
         pay, aux, jnp.int32(start), jnp.int32(count), pred, lv, rv,
         VALUE_COL, B, interpret=True)
 
+    assert int(got_nl) == int(ref_nl)
+    np.testing.assert_allclose(np.asarray(got_pay), np.asarray(ref_pay),
+                               rtol=1e-6, atol=0)
+
+
+@pytest.mark.parametrize("start,count", [(0, 1024), (7, 777), (100, 1),
+                                         (256, 512), (513, 511)])
+@pytest.mark.parametrize("skew", ["all_left", "all_right"])
+def test_partition_acc_skewed(start, count, skew):
+    """One-sided splits exercise the accumulator kernel's empty-side and
+    rare-flush paths (all rows route one way; the other accumulator never
+    fills)."""
+    pay = _payload(1024, seed=count)
+    aux = jnp.zeros_like(pay)
+    pred = _pred(threshold=(B if skew == "all_left" else -1))
+    lv, rv = jnp.float32(1.5), jnp.float32(-2.5)
+    ref_pay, _, ref_nl = seg.partition_segment(
+        pay, aux, jnp.int32(start), jnp.int32(count), pred, lv, rv, VALUE_COL)
+    got_pay, _, got_nl = pseg.partition_segment_acc(
+        pay, aux, jnp.int32(start), jnp.int32(count), pred, lv, rv,
+        VALUE_COL, B, interpret=True)
     assert int(got_nl) == int(ref_nl)
     np.testing.assert_allclose(np.asarray(got_pay), np.asarray(ref_pay),
                                rtol=1e-6, atol=0)
