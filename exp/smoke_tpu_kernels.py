@@ -16,7 +16,7 @@ P = 128  # lane-aligned payload width, as the fast path provides on TPU
 GRAD, HESS, CNT, VAL = F, F + 1, F + 2, F + 3
 
 payload = np.zeros((N + seg.GUARD, P), np.float32)
-payload[:N, :F] = rng.integers(0, B - 1, (N, F))
+payload[:N, :F] = rng.integers(0, B, (N, F))
 payload[:N, GRAD] = rng.standard_normal(N)
 payload[:N, HESS] = rng.random(N) + 0.1
 payload[:N, CNT] = 1.0
@@ -69,7 +69,7 @@ for (Fw, Bw) in ((137, 256), (700, 256), (968, 64), (2000, 64)):
     Pw = -(-(Fw + 12) // 128) * 128
     gcol, hcol, ccol = Fw, Fw + 1, Fw + 2
     pay_w = np.zeros((2048 + seg.GUARD, Pw), np.float32)
-    pay_w[:2048, :Fw] = rng.integers(0, Bw - 1, (2048, Fw))
+    pay_w[:2048, :Fw] = rng.integers(0, Bw, (2048, Fw))
     pay_w[:2048, gcol] = rng.standard_normal(2048)
     pay_w[:2048, hcol] = rng.random(2048) + 0.1
     pay_w[:2048, ccol] = 1.0
@@ -159,3 +159,38 @@ for name, fn in (("rmw", lambda p_, a_: pseg.partition_segment(
     print("partition[%s] 8192 rows: median %.2f ms (fetch-forced)"
           % (name, sorted(ts)[2] * 1e3), flush=True)
 print("ACC PARTITION OK on", jax.default_backend(), flush=True)
+
+
+# --- repeat-based one-hot expansion: Mosaic-compile + exactness + speed
+# vs the expand-matmul histogram.  Flip pseg.HIST_REPEAT_VALIDATED once
+# green here. ---
+for (Fr, Br) in ((28, 256), (137, 256), (700, 256)):
+    Pr = -(-(Fr + 12) // 128) * 128
+    gc, hc, cc = Fr, Fr + 1, Fr + 2
+    pay_r = np.zeros((8192 + seg.GUARD, Pr), np.float32)
+    pay_r[:8192, :Fr] = rng.integers(0, Br, (8192, Fr))
+    pay_r[:8192, gc] = rng.standard_normal(8192)
+    pay_r[:8192, hc] = rng.random(8192) + 0.1
+    pay_r[:8192, cc] = 1.0
+    pay_r = jnp.asarray(pay_r)
+    kw = dict(num_features=Fr, num_bins=Br, grad_col=gc, hess_col=hc,
+              cnt_col=cc)
+    h_m = pseg.segment_histogram(pay_r, jnp.int32(128), jnp.int32(7000),
+                                 expand_impl="matmul", **kw)
+    h_r = pseg.segment_histogram(pay_r, jnp.int32(128), jnp.int32(7000),
+                                 expand_impl="repeat", **kw)
+    err_r = float(jnp.abs(np.asarray(h_m) - np.asarray(h_r)).max())
+    print("repeat hist %dx%d max abs err vs matmul: %s" % (Fr, Br, err_r),
+          flush=True)
+    assert err_r < 1e-4, err_r
+    for label in ("matmul", "repeat"):
+        ts = []
+        for i in range(5):
+            t0 = _t.perf_counter()
+            h_ = np.asarray(pseg.segment_histogram(
+                pay_r, jnp.int32(0), jnp.int32(8192 - i),
+                expand_impl=label, **kw))[0, 0, 2]
+            ts.append(_t.perf_counter() - t0)
+        print("hist[%s] %dx%d 8192 rows: median %.2f ms (fetch-forced)"
+              % (label, Fr, Br, sorted(ts)[2] * 1e3), flush=True)
+print("REPEAT HIST OK on", jax.default_backend(), flush=True)

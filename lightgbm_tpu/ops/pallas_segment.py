@@ -79,6 +79,12 @@ def fits_vmem(num_features: int, num_bins: int) -> bool:
 #: Mosaic legality).
 PARTITION_ACC_VALIDATED = False
 
+#: True once the repeat-based one-hot expansion is hardware-validated; it
+#: halves the histogram kernel's MXU work (the expand matmul becomes a
+#: lane-repeat relayout) by building the one-hot in a bin-major tiled
+#: layout that the host epilogue transposes back.
+HIST_REPEAT_VALIDATED = False
+
 
 def partition_acc_fits_vmem(payload_width: int, num_bins: int) -> bool:
     """VMEM plan of the accumulator-window partition kernel: read ring,
@@ -165,7 +171,8 @@ def _go_left_rows(scalars, bitset_ref, data, B, iota_p):
 # ---------------------------------------------------------------------------
 
 def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
-                 F, B, Ft, W, grad_col, hess_col, cnt_col):
+                 F, B, Ft, W, grad_col, hess_col, cnt_col,
+                 expand_impl="matmul"):
     """chunk is a DOUBLE buffer [2, CHUNK, P]: while slot k%2 feeds the
     one-hot matmuls, the DMA for chunk k+1 streams into the other slot —
     the HBM read of the payload hides behind the MXU work (the round-3
@@ -203,13 +210,23 @@ def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
     # at full tile width; a ragged last tile just row-slices E (its junk
     # window columns read expand == 0 and land past Ft*B or in windows of
     # features >= F — both discarded by the host-side slice).
-    iota_fr = lax.broadcasted_iota(jnp.int32, (Ft, W), 0)
-    iota_fc = lax.broadcasted_iota(jnp.int32, (Ft, W), 1)
-    d = iota_fc - iota_fr * B
-    in_win = (d >= 0) & (d < B)
-    E = in_win.astype(jnp.float32)                               # [Ft, W]
-    jmod = jnp.sum(jnp.where(in_win, d, 0), axis=0)              # [W] i32
-    jmod_f = jmod.astype(jnp.float32)
+    if expand_impl == "repeat":
+        # one jdiv compare vector per distinct tile width (full + ragged),
+        # built once before the chunk loop
+        jdivs = {}
+        for t in range(n_tiles):
+            fw = min(Ft, F - t * Ft)
+            if fw not in jdivs:
+                jdivs[fw] = (lax.broadcasted_iota(jnp.int32, (1, fw * B), 1)
+                             // fw).astype(jnp.float32)
+    if expand_impl == "matmul":
+        iota_fr = lax.broadcasted_iota(jnp.int32, (Ft, W), 0)
+        iota_fc = lax.broadcasted_iota(jnp.int32, (Ft, W), 1)
+        d = iota_fc - iota_fr * B
+        in_win = (d >= 0) & (d < B)
+        E = in_win.astype(jnp.float32)                           # [Ft, W]
+        jmod = jnp.sum(jnp.where(in_win, d, 0), axis=0)          # [W] i32
+        jmod_f = jmod.astype(jnp.float32)
 
     def body(k, _):
         slot = lax.rem(k, 2)
@@ -261,30 +278,64 @@ def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
             f0 = t * Ft
             fw = min(Ft, F - f0)
             binsf = data[:, f0:f0 + fw]                          # [C, fw] f32
-            expand = lax.dot_general(
-                binsf, E[:fw, :], dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)              # [C, W]
-            onehot = (expand == jmod_f[None, :]).astype(jnp.float32)
-            out_ref[8 * t:8 * t + 8, :] += lax.dot_general(
-                vals, onehot, dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)              # [8, W]
+            if expand_impl == "repeat":
+                # bin-major tiled one-hot: repeat concatenates B copies of
+                # the tile, so column b*fw + f compares feature f's bin
+                # against b — no expand matmul, the relayout is VPU-cheap,
+                # and the host epilogue untransposes the [B, fw] blocks
+                rep = pltpu.repeat(binsf, B, axis=1)             # [C, fw*B]
+                onehot = (rep == jdivs[fw]).astype(jnp.float32)
+                out_ref[8 * t:8 * t + 8, :fw * B] += lax.dot_general(
+                    vals, onehot,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)          # [8, fw*B]
+            else:
+                expand = lax.dot_general(
+                    binsf, E[:fw, :],
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)          # [C, W]
+                onehot = (expand == jmod_f[None, :]).astype(jnp.float32)
+                out_ref[8 * t:8 * t + 8, :] += lax.dot_general(
+                    vals, onehot,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)          # [8, W]
         return 0
 
     lax.fori_loop(0, nch, body, 0, unroll=False)
 
 
+def segment_histogram(payload, start, count, *, num_features, num_bins,
+                      grad_col, hess_col, cnt_col, interpret=False,
+                      expand_impl=None):
+    """hist[F, B, 3] over payload rows [start, start+count) — TPU kernel.
+
+    The flag default is resolved OUTSIDE the jit cache so flipping
+    HIST_REPEAT_VALIDATED after warm traces takes effect immediately."""
+    if expand_impl is None:
+        expand_impl = "repeat" if HIST_REPEAT_VALIDATED else "matmul"
+    if expand_impl not in ("matmul", "repeat"):
+        raise ValueError("expand_impl must be matmul|repeat, got %r"
+                         % (expand_impl,))
+    return _segment_histogram(payload, start, count,
+                              num_features=num_features, num_bins=num_bins,
+                              grad_col=grad_col, hess_col=hess_col,
+                              cnt_col=cnt_col, interpret=interpret,
+                              expand_impl=expand_impl)
+
+
 @functools.partial(jax.jit, static_argnames=("num_features", "num_bins",
                                              "grad_col", "hess_col",
-                                             "cnt_col", "interpret"))
-def segment_histogram(payload, start, count, *, num_features, num_bins,
-                      grad_col, hess_col, cnt_col, interpret=False):
-    """hist[F, B, 3] over payload rows [start, start+count) — TPU kernel."""
+                                             "cnt_col", "interpret",
+                                             "expand_impl"))
+def _segment_histogram(payload, start, count, *, num_features, num_bins,
+                       grad_col, hess_col, cnt_col, interpret,
+                       expand_impl):
     F, B, P = num_features, num_bins, payload.shape[1]
     Ft, n_tiles, W = _tiling(F, B)
     scalars = jnp.stack([start, count]).astype(jnp.int32)
     kern = functools.partial(_hist_kernel, F=F, B=B, Ft=Ft, W=W,
                              grad_col=grad_col, hess_col=hess_col,
-                             cnt_col=cnt_col)
+                             cnt_col=cnt_col, expand_impl=expand_impl)
     out = pl.pallas_call(
         kern,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -302,11 +353,19 @@ def segment_histogram(payload, start, count, *, num_features, num_bins,
     )(scalars, payload)
     # [8*T, W] -> [T, 8, W]; rows are the exact bf16 part-decomposition
     # (g_hi, g_mid, g_lo, h_hi, h_mid, h_lo, cnt) — recombine, then
-    # -> [3, T*Ft, B] -> drop tile padding features -> [F, B, 3]
+    # untile to [F, B, 3] (feature-major windows in matmul mode, bin-major
+    # [B, fw] blocks in repeat mode)
     r = out.reshape(n_tiles, 8, W)
     ghc = jnp.stack([r[:, 0] + r[:, 1] + r[:, 2],
                      r[:, 3] + r[:, 4] + r[:, 5],
                      r[:, 6]], axis=1)                           # [T, 3, W]
+    if expand_impl == "repeat":
+        tiles = []
+        for t in range(n_tiles):
+            fw = min(Ft, F - t * Ft)
+            tiles.append(ghc[t, :, :fw * B].reshape(3, B, fw)
+                         .transpose(0, 2, 1))                    # [3, fw, B]
+        return jnp.concatenate(tiles, axis=1).transpose(1, 2, 0)
     return (ghc[:, :, :Ft * B]
             .reshape(n_tiles, 3, Ft, B).transpose(1, 0, 2, 3)
             .reshape(3, n_tiles * Ft, B)[:, :F].transpose(1, 2, 0))

@@ -30,13 +30,14 @@ def _payload(n_pad, seed=0):
 @pytest.mark.parametrize("start,count", [(0, 1000), (256, 700), (100, 37),
                                          (0, 0), (513, 256), (7, 1),
                                          (9, 1015), (1023, 1)])
-def test_histogram_matches(start, count):
+@pytest.mark.parametrize("expand", ["matmul", "repeat"])
+def test_histogram_matches(start, count, expand):
     pay = _payload(1024)
     ref = seg.segment_histogram(pay, jnp.int32(start), jnp.int32(count),
                                 num_features=F, num_bins=B, **COLS)
     got = pseg.segment_histogram(pay, jnp.int32(start), jnp.int32(count),
                                  num_features=F, num_bins=B, interpret=True,
-                                 **COLS)
+                                 expand_impl=expand, **COLS)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
 
@@ -47,7 +48,8 @@ def test_histogram_matches(start, count):
     (700, 256, 256, 260),  # Expo/Yahoo shape: 88 tiles, ragged last
     (968, 64, 0, 300),     # Bosch shape at the GPU-recommended max_bin=63
 ])
-def test_histogram_matches_tiled(f, b, start, count):
+@pytest.mark.parametrize("expand", ["matmul", "repeat"])
+def test_histogram_matches_tiled(f, b, start, count, expand):
     """Feature-tiled kernel vs portable engine at wide-feature shapes the
     old F*B <= 8192 gate excluded (reference handles these through the
     OpenCL workgroup grid, ocl/histogram256.cl:73-121)."""
@@ -66,7 +68,7 @@ def test_histogram_matches_tiled(f, b, start, count):
                                 num_features=f, num_bins=b, **cols)
     got = pseg.segment_histogram(pay, jnp.int32(start), jnp.int32(count),
                                  num_features=f, num_bins=b, interpret=True,
-                                 **cols)
+                                 expand_impl=expand, **cols)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
 
