@@ -23,3 +23,16 @@ bst = lgb.train({"objective": "binary", "num_leaves": 255, "verbose": -1},
 assert bst._engine._fast_active, "fell off the fast path on TPU"
 print("single-chip 200k x 28 x 255 leaves: 5 iters ok, fast path active")
 PYEOF
+echo "=== 4b. shard_map + Pallas kernels compile together (1-device TPU mesh) ==="
+timeout 400 python - <<'PYEOF' 2>&1 | tail -3
+import numpy as np
+import lightgbm_tpu as lgb
+rng = np.random.default_rng(0)
+X = rng.standard_normal((100000, 28)).astype(np.float32)
+y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+bst = lgb.train({"objective": "binary", "num_leaves": 63, "verbose": -1,
+                 "tree_learner": "data"},
+                lgb.Dataset(X, label=y), num_boost_round=3)
+assert bst._engine._fast_active, "mesh fast path inactive on TPU"
+print("tree_learner=data on the real-chip mesh: 3 iters ok (Pallas inside shard_map)")
+PYEOF

@@ -143,12 +143,28 @@ for (s_a, c_a) in ((128, 3000), (7, 8000), (513, 256), (0, 8192)):
     print("acc partition (%d,%d): nl=%d err=%s" % (s_a, c_a, int(nl_a), err_a),
           flush=True)
     assert err_a == 0.0, err_a
+p_roll, _, nl_roll = pseg.partition_segment_acc(
+    jnp.asarray(payx), jnp.zeros_like(jnp.asarray(payx)), jnp.int32(7),
+    jnp.int32(8000), pred, jnp.float32(1.5), jnp.float32(-2.5), VAL, B,
+    roll_place=True)
+p_rollref, _, nl_rollref = seg.partition_segment(
+    jnp.asarray(payx), jnp.zeros_like(jnp.asarray(payx)), jnp.int32(7),
+    jnp.int32(8000), pred, jnp.float32(1.5), jnp.float32(-2.5), VAL)
+assert int(nl_roll) == int(nl_rollref)
+err_roll = float(jnp.abs(p_roll - p_rollref).max())
+print("acc+roll partition err:", err_roll, flush=True)
+assert err_roll == 0.0, err_roll
 for name, fn in (("rmw", lambda p_, a_: pseg.partition_segment(
                      p_, a_, jnp.int32(0), jnp.int32(8192), pred,
                      jnp.float32(1.), jnp.float32(-1.), VAL, B)),
                  ("acc", lambda p_, a_: pseg.partition_segment_acc(
                      p_, a_, jnp.int32(0), jnp.int32(8192), pred,
-                     jnp.float32(1.), jnp.float32(-1.), VAL, B))):
+                     jnp.float32(1.), jnp.float32(-1.), VAL, B,
+                     roll_place=False)),
+                 ("acc+roll", lambda p_, a_: pseg.partition_segment_acc(
+                     p_, a_, jnp.int32(0), jnp.int32(8192), pred,
+                     jnp.float32(1.), jnp.float32(-1.), VAL, B,
+                     roll_place=True))):
     ts = []
     for _ in range(5):
         p_, a_ = jnp.asarray(payx), jnp.zeros_like(jnp.asarray(payx))

@@ -85,14 +85,22 @@ PARTITION_ACC_VALIDATED = False
 #: layout that the host epilogue transposes back.
 HIST_REPEAT_VALIDATED = False
 
+#: True once the roll-based placement inside the accumulator kernel is
+#: hardware-validated: a dynamic sublane rotate replaces the [2C, C]
+#: placement one-hot — pass A's matmul halves to [C, C] compaction and
+#: pass B's placement becomes a pure (exact, matmul-free) data movement.
+PARTITION_ACC_ROLL_VALIDATED = False
+
 
 def partition_acc_fits_vmem(payload_width: int, num_bins: int) -> bool:
     """VMEM plan of the accumulator-window partition kernel: read ring,
-    two [2C, P] accumulators, stage/blend buffers, the part-decomposition
-    intermediates, the [2C, C] placement one-hot machinery (mat + two
-    iotas + tri) and the categorical bitset one-hot."""
+    two [2C, P] accumulators, stage/blend buffers, the P-wide placement
+    intermediates (budgeted for the LARGER of the two placement modes —
+    roll mode keeps parts + compacted + doubled + rolled buffers live per
+    side, ~8C rows vs the matmul mode's shared ~5C), the placement
+    one-hot machinery and the categorical bitset one-hot."""
     P, C = payload_width, CHUNK
-    est = (4 * P * 14 * C          # ring(2C) + accs(4C) + stage/rbuf(2C) + parts/placed(~6C)
+    est = (4 * P * 18 * C          # ring(2C) + accs(4C) + stage/rbuf(2C) + placement intermediates(~10C, roll mode worst case)
            + 4 * 7 * C * C         # mat[2C,C] + iota_2i/2j[2C,C] + tri[C,C]
            + 4 * C * num_bins)     # categorical bitset one-hot in go_left
     return est <= _VMEM_BUDGET
@@ -576,7 +584,7 @@ C2 = 2 * CHUNK
 def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
                 payload_out, aux_out, nl_out,
                 ring, lacc, racc, stage, rbuf, sem_ring, sem_w, sem_r, *,
-                P, B, value_col):
+                P, B, value_col, roll_place=False):
     """Accumulator-window partition: same contract as `_partition_kernel`,
     restructured around the measured bottleneck (per-chunk latency, not
     bandwidth).  Lefts and rights accumulate in VMEM windows [2C, P] that
@@ -620,22 +628,37 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
         return jnp.dot(tri, keep_i.astype(jnp.float32)[:, None],
                        preferred_element_type=jnp.float32)[:, 0].astype(jnp.int32)
 
-    def append(acc, parts, dest, member, cnt, off, value):
-        """Place source rows j (member[j]=1) at acc rows dest[j] via a 0/1
-        one-hot applied to the exact parts (three one-pass matmuls), write
-        the child's tree output into the value column, and blend the
-        placed region [off, off+cnt) into the accumulator."""
+    def blend(acc, placed, cnt, off, value):
+        """Write the child's tree output into the value column of the
+        placed rows and blend region [off, off+cnt) into the accumulator.
+        where, NOT an arithmetic blend: rows outside the region may hold
+        uninitialized accumulator memory, and 0 * NaN poisons a multiply."""
+        placed = jnp.where(iota_p == value_col, value, placed)
+        region = ((iota_c2 >= off) & (iota_c2 < off + cnt))[:, None]
+        acc[:] = jnp.where(region, placed, acc[:])
+
+    def place_matmul(parts, dest, member):
+        """[2C, P]: source rows j (member[j]=1) land at rows dest[j] via a
+        0/1 one-hot applied to the exact parts (three one-pass matmuls)."""
         mat = ((iota_2i == dest[None, :]) &
                (member[None, :] > 0)).astype(jnp.float32)        # [2C, C]
         hi, mid, lo = parts
-        placed = (jnp.dot(mat, hi, preferred_element_type=jnp.float32) +
-                  jnp.dot(mat, mid, preferred_element_type=jnp.float32) +
-                  jnp.dot(mat, lo, preferred_element_type=jnp.float32))
-        placed = jnp.where(iota_p == value_col, value, placed)
-        # where, NOT an arithmetic blend: rows outside the region may hold
-        # uninitialized accumulator memory, and 0 * NaN poisons a multiply
-        region = ((iota_c2 >= off) & (iota_c2 < off + cnt))[:, None]
-        acc[:] = jnp.where(region, placed, acc[:])
+        return (jnp.dot(mat, hi, preferred_element_type=jnp.float32) +
+                jnp.dot(mat, mid, preferred_element_type=jnp.float32) +
+                jnp.dot(mat, lo, preferred_element_type=jnp.float32))
+
+    def place_compact_roll(parts, rank, member, off):
+        """[2C, P]: compact kept rows to the top with a [C, C] one-hot
+        (half the placement matmul), then rotate the doubled buffer so
+        they land at [off, off+cnt) — the rotate is exact data movement."""
+        matc = ((iota_2i[:CHUNK, :] == rank[None, :]) &
+                (member[None, :] > 0)).astype(jnp.float32)       # [C, C]
+        hi, mid, lo = parts
+        compacted = (jnp.dot(matc, hi, preferred_element_type=jnp.float32) +
+                     jnp.dot(matc, mid, preferred_element_type=jnp.float32) +
+                     jnp.dot(matc, lo, preferred_element_type=jnp.float32))
+        return pltpu.roll(jnp.concatenate([compacted, compacted], axis=0),
+                          off, axis=0)
 
     def flush(acc, dst_ref, wbase):
         """Write the full first window of the accumulator and slide."""
@@ -671,7 +694,6 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
             # full-window write
             lacc[0:CHUNK] = data
 
-        parts = _bf16_parts(data)
         gl = go_left(data, k)
         keep_r = valid_mask(k) - gl
         nlk = jnp.sum(gl)
@@ -679,14 +701,21 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
         rank_l = rank_of(gl)
         rank_r = rank_of(keep_r)
 
-        append(lacc, parts, lo_ + rank_l, gl, nlk, lo_, left_value)
+        parts = _bf16_parts(data)
+        if roll_place:
+            placed_l = place_compact_roll(parts, rank_l, gl, lo_)
+            placed_r = place_compact_roll(parts, rank_r, keep_r, ro_)
+        else:
+            placed_l = place_matmul(parts, lo_ + rank_l, gl)
+            placed_r = place_matmul(parts, ro_ + rank_r, keep_r)
+        blend(lacc, placed_l, nlk, lo_, left_value)
         fl = ((lo_ + nlk) >= CHUNK).astype(jnp.int32)
 
         @pl.when(fl > 0)
         def _flush_l():
             flush(lacc, payload_out, base + lfl * CHUNK)
 
-        append(racc, parts, ro_ + rank_r, keep_r, nrk, ro_, right_value)
+        blend(racc, placed_r, nrk, ro_, right_value)
         fr = ((ro_ + nrk) >= CHUNK).astype(jnp.int32)
 
         @pl.when(fr > 0)
@@ -732,12 +761,18 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
         cnt = jnp.maximum(j1 - j0, 0)
         member = ((iota_rows >= j0) & (iota_rows < j1)).astype(jnp.int32)
         # non-member rows of the staged window can be uninitialized aux
-        # memory; zero them BEFORE the matmul (0 x NaN = NaN would poison
-        # every placed row)
+        # memory; zero them BEFORE placement (0 x NaN = NaN would poison
+        # every matmul-placed row)
         data = jnp.where(member[:, None] > 0, ring[slot], 0.0)
-        parts = _bf16_parts(data)
-        dest = iota_rows - j0 + lo_
-        append(lacc, parts, dest, member, cnt, lo_, right_value)
+        if roll_place:
+            # staged rights are already contiguous: placement is a pure
+            # rotate of the doubled window — no decomposition, no matmul
+            placed = pltpu.roll(jnp.concatenate([data, data], axis=0),
+                                lo_ - j0 + C2, axis=0)
+        else:
+            parts = _bf16_parts(data)
+            placed = place_matmul(parts, iota_rows - j0 + lo_, member)
+        blend(lacc, placed, cnt, lo_, right_value)
         fl = ((lo_ + cnt) >= CHUNK).astype(jnp.int32)
 
         @pl.when(fl > 0)
@@ -765,11 +800,24 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
         dma_w.wait()
 
 
-@functools.partial(jax.jit, static_argnames=("value_col", "num_bins",
-                                             "interpret"))
 def partition_segment_acc(payload, aux, start, count, pred, left_value,
-                          right_value, value_col, num_bins, interpret=False):
-    """Same contract as `partition_segment`, accumulator-window kernel."""
+                          right_value, value_col, num_bins, interpret=False,
+                          roll_place=None):
+    """Same contract as `partition_segment`, accumulator-window kernel.
+    The roll_place default is resolved OUTSIDE the jit cache so flipping
+    PARTITION_ACC_ROLL_VALIDATED takes effect on warm traces."""
+    if roll_place is None:
+        roll_place = PARTITION_ACC_ROLL_VALIDATED
+    return _partition_segment_acc(payload, aux, start, count, pred,
+                                  left_value, right_value, value_col,
+                                  num_bins, interpret, bool(roll_place))
+
+
+@functools.partial(jax.jit, static_argnames=("value_col", "num_bins",
+                                             "interpret", "roll_place"))
+def _partition_segment_acc(payload, aux, start, count, pred, left_value,
+                           right_value, value_col, num_bins, interpret,
+                           roll_place):
     P = payload.shape[1]
     B = num_bins
     scalars = jnp.stack([
@@ -780,7 +828,8 @@ def partition_segment_acc(payload, aux, start, count, pred, left_value,
     ]).astype(jnp.int32)
     fvals = jnp.stack([left_value, right_value]).astype(jnp.float32)
     bitset = pred.bitset.astype(jnp.int32).reshape(1, B)
-    kern = functools.partial(_acc_kernel, P=P, B=B, value_col=value_col)
+    kern = functools.partial(_acc_kernel, P=P, B=B, value_col=value_col,
+                             roll_place=roll_place)
     payload_new, aux_new, nl = pl.pallas_call(
         kern,
         grid_spec=pltpu.PrefetchScalarGridSpec(

@@ -120,8 +120,11 @@ def _pred(feature=1, threshold=B // 2, default_left=False, is_cat=False,
     (64, 500, dict(feature=2, threshold=3, offset=5, identity=False,
                    num_bin=9, default_bin=0)),
 ])
-@pytest.mark.parametrize("impl", [pseg.partition_segment,
-                                  pseg.partition_segment_acc])
+@pytest.mark.parametrize("impl", [
+    pseg.partition_segment,
+    pseg.partition_segment_acc,
+    lambda *a, **kw: pseg.partition_segment_acc(*a, roll_place=True, **kw),
+])
 def test_partition_matches(start, count, predkw, impl):
     pay = _payload(1024, seed=start + count)
     aux = jnp.zeros_like(pay)
@@ -152,9 +155,10 @@ def test_partition_acc_skewed(start, count, skew):
     lv, rv = jnp.float32(1.5), jnp.float32(-2.5)
     ref_pay, _, ref_nl = seg.partition_segment(
         pay, aux, jnp.int32(start), jnp.int32(count), pred, lv, rv, VALUE_COL)
-    got_pay, _, got_nl = pseg.partition_segment_acc(
-        pay, aux, jnp.int32(start), jnp.int32(count), pred, lv, rv,
-        VALUE_COL, B, interpret=True)
-    assert int(got_nl) == int(ref_nl)
-    np.testing.assert_allclose(np.asarray(got_pay), np.asarray(ref_pay),
-                               rtol=1e-6, atol=0)
+    for roll in (False, True):
+        got_pay, _, got_nl = pseg.partition_segment_acc(
+            pay, aux, jnp.int32(start), jnp.int32(count), pred, lv, rv,
+            VALUE_COL, B, interpret=True, roll_place=roll)
+        assert int(got_nl) == int(ref_nl)
+        np.testing.assert_allclose(np.asarray(got_pay), np.asarray(ref_pay),
+                                   rtol=1e-6, atol=0)
