@@ -182,6 +182,11 @@ def run(n_rows, n_test, num_leaves, measure_iters):
         "platform": __import__("jax").default_backend(),
         "fast_path": bool(getattr(eng, "_fast_active", False)),
         "phases": phases,
+        "phases_note": "phases are measured PIECEWISE (one dispatch + sync "
+                       "per stage), so each absolute value carries the "
+                       "per-dispatch overhead that the fused per-tree "
+                       "program amortizes; sec_per_iter is the honest "
+                       "steady-state number",
     }
     return result
 
