@@ -162,3 +162,24 @@ def test_partition_acc_skewed(start, count, skew):
         assert int(got_nl) == int(ref_nl)
         np.testing.assert_allclose(np.asarray(got_pay), np.asarray(ref_pay),
                                    rtol=1e-6, atol=0)
+
+
+def test_validated_flags_gate_product_paths():
+    """The speculative kernel variants must stay OFF until the hardware
+    smoke flips their flags — and the flags must be consumed OUTSIDE the
+    jit cache so a flip takes effect on warm traces (both defaults resolve
+    in plain Python wrappers)."""
+    assert pseg.PARTITION_ACC_VALIDATED is False
+    assert pseg.PARTITION_ACC_ROLL_VALIDATED is False
+    assert pseg.HIST_REPEAT_VALIDATED is False
+    # acc-kernel gate admits Higgs/Bosch-class widths, rejects Epsilon
+    assert pseg.partition_acc_fits_vmem(128, 256)
+    assert not pseg.partition_acc_fits_vmem(2048, 64)
+    # forcing pallas past the histogram kernel's bin ceiling raises loudly
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        seg.resolve_impl("pallas", 28, 512)
+    with _pytest.raises(ValueError):
+        pseg.segment_histogram(
+            _payload(64), jnp.int32(0), jnp.int32(8), num_features=F,
+            num_bins=B, interpret=True, expand_impl="typo", **COLS)
