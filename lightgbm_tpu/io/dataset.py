@@ -112,10 +112,15 @@ class BinnedDataset:
         n_pad = _round_up(n, row_chunk) if n > row_chunk else _round_up(max(n, 1), 128)
         dtype = np.uint8 if ds.max_num_bin <= 256 else np.uint16
         bins = np.zeros((f, n_pad), dtype=dtype)
-        for j, mapper in enumerate(bin_mappers):
-            if mapper.is_trivial:
-                continue
-            bins[j, :n] = mapper.values_to_bins(X[:, j].astype(np.float64))
+        # native OpenMP ValueToBin over the whole matrix (cpp/ingest.cc)
+        # when every non-trivial feature is numerical; otherwise (or with
+        # no native library) the per-feature Python path
+        from .native import encode_bins
+        if not encode_bins(X, bin_mappers, bins):
+            for j, mapper in enumerate(bin_mappers):
+                if mapper.is_trivial:
+                    continue
+                bins[j, :n] = mapper.values_to_bins(X[:, j].astype(np.float64))
 
         # Exclusive Feature Bundling (reference dataset.cpp:66-210): pack
         # mutually-exclusive sparse features into shared storage columns.

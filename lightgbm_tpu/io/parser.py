@@ -73,6 +73,22 @@ def parse_file(path: str, label_column: int = 0, has_header: Optional[bool] = No
             for t in toks if True)
     if fmt != "libsvm":
         sep = "," if fmt == "csv" else "\t"
+        # native mmap + OpenMP parser first (cpp/ingest.cc — the role of
+        # the reference's native Parser), then the chunked pandas C-engine
+        # pipeline, then the tolerant pure-Python parser
+        n_cols = len(head[1 if has_header and len(head) > 1 else 0]
+                     .rstrip("\n\r").split(sep)) if head else 0
+        if n_cols >= 2:
+            from .native import parse_dense
+            out = parse_dense(path, sep, label_column, has_header, n_cols)
+            if out is not None:
+                X, y = out
+                if num_features is not None and X.shape[1] != num_features:
+                    fixed = np.full((X.shape[0], num_features), np.nan)
+                    fixed[:, :min(X.shape[1], num_features)] = \
+                        X[:, :num_features]
+                    X = fixed
+                return X, y
         out = _parse_delimited_pandas(path, sep, label_column, num_features,
                                       has_header)
         if out is not None:

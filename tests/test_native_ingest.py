@@ -1,0 +1,134 @@
+"""Native (C++) ingest vs the Python parsers and per-feature binning.
+
+The reference's loader is native end to end (dataset_loader.cpp +
+parser.cpp + ValueToBin); cpp/ingest.cc supplies the same native stages
+behind the tolerant Python implementations.  These tests pin byte-exact
+agreement between the two paths.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io import native
+from lightgbm_tpu.io import parser as pmod
+from lightgbm_tpu.io.binning import BinMapper
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+
+needs_native = pytest.mark.skipif(native._load() is None,
+                                  reason="native library unavailable")
+
+
+def _write(tmpdir, text, name="data.csv"):
+    path = os.path.join(tmpdir, name)
+    with open(path, "w") as fh:
+        fh.write(text)
+    return path
+
+
+@needs_native
+def test_parse_dense_matches_python_csv():
+    rng = np.random.default_rng(0)
+    n, f = 997, 7
+    X = np.round(rng.standard_normal((n, f)) * 100, 4)
+    y = rng.integers(0, 2, n)
+    with tempfile.TemporaryDirectory() as td:
+        lines = []
+        for i in range(n):
+            lines.append(",".join([str(int(y[i]))] +
+                                  [repr(float(v)) for v in X[i]]))
+        path = _write(td, "\n".join(lines) + "\n")
+        Xn, yn = native.parse_dense(path, ",", 0, False, f + 1)
+        Xp, yp = pmod._parse_delimited(
+            open(path).readlines(), ",", 0, None)
+        np.testing.assert_array_equal(Xn, Xp)
+        np.testing.assert_array_equal(yn, yp)
+
+
+@needs_native
+def test_parse_dense_missing_markers_and_header():
+    text = ("label\tf0\tf1\tf2\n"
+            "1\t0.5\tna\t-3\n"
+            "0\t\t2.25e2\tNaN\n"
+            "\n"
+            "1\tnull\t?\t7\n")
+    with tempfile.TemporaryDirectory() as td:
+        path = _write(td, text, "data.tsv")
+        Xn, yn = native.parse_dense(path, "\t", 0, True, 4)
+        assert Xn.shape == (3, 3)
+        np.testing.assert_array_equal(yn, [1, 0, 1])
+        assert Xn[0, 0] == 0.5 and np.isnan(Xn[0, 1]) and Xn[0, 2] == -3
+        assert np.isnan(Xn[1, 0]) and Xn[1, 1] == 225.0 and np.isnan(Xn[1, 2])
+        assert np.isnan(Xn[2, 0]) and np.isnan(Xn[2, 1]) and Xn[2, 2] == 7
+
+
+@needs_native
+def test_parse_dense_rejects_ragged_wide_rows():
+    """Rows wider than the schema must fall back to the Python parser
+    (whose widest-row semantics decide the width)."""
+    with tempfile.TemporaryDirectory() as td:
+        path = _write(td, "1,2,3\n0,4,5,6\n")
+        assert native.parse_dense(path, ",", 0, False, 3) is None
+
+
+@needs_native
+def test_parse_file_native_and_python_agree_end_to_end():
+    """parse_file (which now tries native first) against the pure-Python
+    parser on the reference's binary example."""
+    ref = "/root/reference/examples/binary_classification/binary.train"
+    X1, y1 = pmod.parse_file(ref)
+    X2, y2 = pmod._parse_delimited(open(ref).readlines(), "\t", 0, None)
+    np.testing.assert_array_equal(X1, X2)
+    np.testing.assert_array_equal(y1, y2)
+
+
+@needs_native
+def test_encode_bins_matches_python():
+    rng = np.random.default_rng(1)
+    n, f = 4096, 9
+    X = rng.standard_normal((n, f))
+    X[rng.random((n, f)) < 0.1] = np.nan   # exercise NaN missing handling
+    X[:, 3] = np.round(X[:, 3] * 2)        # few distinct values
+    from lightgbm_tpu.config import Config
+    ds = BinnedDataset.from_matrix(X, Config({"max_bin": 255}))
+    ref_bins = np.zeros_like(np.asarray(ds.bins))
+    got = np.asarray(ds.bins)
+    mappers = ds.bin_mappers
+    # recompute with the pure-Python path; storage layouts must agree
+    # (from_matrix used the native encoder when available)
+    for j, m in enumerate(mappers):
+        if m.is_trivial:
+            continue
+        ref_bins[j, :n] = m.values_to_bins(X[:, j].astype(np.float64))
+    np.testing.assert_array_equal(got[:, :n], ref_bins[:, :n])
+
+
+@needs_native
+def test_encode_bins_declines_categorical():
+    X = np.abs(np.random.default_rng(2).integers(0, 5, (256, 2))).astype(float)
+    from lightgbm_tpu.config import Config
+    ds = BinnedDataset.from_matrix(X, Config({"max_bin": 15}),
+                                   categorical_feature=[0])
+    mappers = ds.bin_mappers
+    bins_out = np.zeros((2, 256), np.uint8)
+    assert native.encode_bins(X, mappers, bins_out) is False
+
+
+@needs_native
+def test_parse_dense_overflow_parity_and_label_guards():
+    """1e400 must parse to inf (python float() parity, not NaN); label
+    columns outside the schema decline to the Python path; short lines
+    that end before the label yield NaN labels."""
+    with tempfile.TemporaryDirectory() as td:
+        path = _write(td, "1,1e400,2\n0,-1e400,1e-400\n")
+        Xn, yn = native.parse_dense(path, ",", 0, False, 3)
+        assert np.isposinf(Xn[0, 0]) and np.isneginf(Xn[1, 0])
+        assert Xn[1, 1] == 0.0
+        assert native.parse_dense(path, ",", 5, False, 3) is None
+        assert native.parse_dense(path, ",", -1, False, 3) is None
+        path2 = _write(td, "1,2\n0\n3,4\n", "short.csv")
+        Xs, ys = native.parse_dense(path2, ",", 1, False, 2)
+        np.testing.assert_array_equal(ys[[0, 2]], [2, 4])
+        assert np.isnan(ys[1])
