@@ -17,6 +17,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <string>
 #include <vector>
 
 #include <fcntl.h>
@@ -65,22 +66,25 @@ void unmap_file(Mapped& m) {
   m.fd = -1;
 }
 
-bool line_blank(const char* b, const char* e) {
-  for (const char* p = b; p < e; ++p)
+bool line_blank(const char* b, const char* e, char sep) {
+  for (const char* p = b; p < e; ++p) {
+    if (*p == sep) return false;  // separators make it a data row of
+                                  // empty fields, not a blank line
     if (!std::isspace(static_cast<unsigned char>(*p))) return false;
+  }
   return true;
 }
 
 // skip the header (the first NON-BLANK line — the Python sniffer ignores
 // leading blank lines) if present; returns body start
-const char* body_start(const Mapped& m, int has_header) {
+const char* body_start(const Mapped& m, int has_header, char sep) {
   const char* p = m.data;
   const char* end = m.data + m.size;
   if (!has_header) return p;
   while (p < end) {
     const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
     const char* le = nl ? nl : end;
-    bool blank = line_blank(p, le);
+    bool blank = line_blank(p, le, sep);
     p = nl ? nl + 1 : end;
     if (!blank) break;  // consumed the header line
   }
@@ -104,19 +108,28 @@ bool is_missing_token(const char* b, const char* e) {
 
 double strtod_token(const char* b, const char* e) {
   // terminated copy for strtod (overflow/underflow parity with python
-  // float(): 1e400 -> inf, 1e-400 -> 0.0); long tokens go through a
-  // heap-free bounded buffer — numeric text never exceeds it
+  // float(): 1e400 -> inf, 1e-400 -> 0.0); stack buffer for the common
+  // case, heap for pathological token lengths (never truncate — a
+  // truncated '1e400...' would parse to a wrong FINITE value)
+  size_t len = e - b;
   char buf[64];
-  size_t len = std::min<size_t>(e - b, sizeof(buf) - 1);
-  memcpy(buf, b, len);
-  buf[len] = 0;
+  std::string heap;
+  const char* src;
+  if (len < sizeof(buf)) {
+    memcpy(buf, b, len);
+    buf[len] = 0;
+    src = buf;
+  } else {
+    heap.assign(b, e);
+    src = heap.c_str();
+  }
   char* endp = nullptr;
-  double v = std::strtod(buf, &endp);
-  if (endp != buf + len) return NAN;
+  double v = std::strtod(src, &endp);
+  if (endp != src + len) return NAN;
   return v;
 }
 
-double parse_token(const char* b, const char* e) {
+double parse_token(const char* b, const char* e, bool* bad) {
   // trim; empty/marker tokens -> NaN
   while (b < e && std::isspace(static_cast<unsigned char>(*b))) ++b;
   while (e > b && std::isspace(static_cast<unsigned char>(e[-1]))) --e;
@@ -137,7 +150,10 @@ double parse_token(const char* b, const char* e) {
   if (!std::isnan(v) || is_missing_token(b, e)) return v;
 #endif
   if (is_missing_token(b, e)) return NAN;
-  // remaining oddities (python would raise; the tolerant answer is NaN)
+  // a real text token (not a missing marker): the Python parser would
+  // RAISE here — flag it so the wrapper falls back and the user sees
+  // the loud error instead of silently training on NaNs
+  *bad = true;
   return NAN;
 }
 
@@ -150,7 +166,8 @@ struct Ranges {
   long long total_rows = 0;
 };
 
-Ranges make_ranges(const char* body, const char* eof, int n_threads) {
+Ranges make_ranges(const char* body, const char* eof, int n_threads,
+                   char sep) {
   Ranges r;
   size_t len = eof - body;
   std::vector<const char*> starts(n_threads + 1);
@@ -170,7 +187,7 @@ Ranges make_ranges(const char* body, const char* eof, int n_threads) {
     while (p < e) {
       const char* nl = static_cast<const char*>(memchr(p, '\n', e - p));
       const char* le = nl ? nl : e;
-      if (!line_blank(p, le)) ++c;
+      if (!line_blank(p, le, sep)) ++c;
       p = nl ? nl + 1 : e;
     }
     counts[t] = c;
@@ -202,11 +219,11 @@ int num_threads() {
 extern "C" {
 
 // Number of non-blank data rows (excluding the header), or -1 on error.
-long long LGBMT_CountRows(const char* path, int has_header) {
+long long LGBMT_CountRows(const char* path, int has_header, char sep) {
   Mapped m = map_file(path);
   if (!m.ok()) return -1;
-  const char* body = body_start(m, has_header);
-  Ranges r = make_ranges(body, m.data + m.size, num_threads());
+  const char* body = body_start(m, has_header, sep);
+  Ranges r = make_ranges(body, m.data + m.size, num_threads(), sep);
   long long n = r.total_rows;
   unmap_file(m);
   return n;
@@ -221,10 +238,13 @@ long long LGBMT_CountRows(const char* path, int has_header) {
 int LGBMT_ParseDense(const char* path, char sep, int has_header,
                      long long n_rows, int n_cols, int label_col,
                      double* X, double* y) {
+  // NOTE: the file is memchr-scanned once in CountRows and once more by
+  // this make_ranges — redundant but cheap next to the field parse
+  // (SIMD memchr runs at several GB/s vs ~0.2 GB/s for number parsing)
   Mapped m = map_file(path);
   if (!m.ok()) return -1;
-  const char* body = body_start(m, has_header);
-  Ranges r = make_ranges(body, m.data + m.size, num_threads());
+  const char* body = body_start(m, has_header, sep);
+  Ranges r = make_ranges(body, m.data + m.size, num_threads(), sep);
   if (r.total_rows != n_rows) {
     unmap_file(m);
     return -2;
@@ -233,7 +253,9 @@ int LGBMT_ParseDense(const char* path, char sep, int has_header,
   const long long xbytes_row = n_feat;
   int n_ranges = static_cast<int>(r.begin.size());
   int ragged = 0;
-#pragma omp parallel for schedule(static) reduction(|| : ragged)
+  int bad_token = 0;
+#pragma omp parallel for schedule(static) reduction(|| : ragged) \
+    reduction(|| : bad_token)
   for (int t = 0; t < n_ranges; ++t) {
     const char* p = r.begin[t];
     const char* e = r.end[t];
@@ -241,7 +263,7 @@ int LGBMT_ParseDense(const char* path, char sep, int has_header,
     while (p < e) {
       const char* nl = static_cast<const char*>(memchr(p, '\n', e - p));
       const char* le = nl ? nl : e;
-      if (!line_blank(p, le)) {
+      if (!line_blank(p, le, sep)) {
         double* xrow = X + row * xbytes_row;
         for (int j = 0; j < n_feat; ++j) xrow[j] = NAN;
         int col = 0;
@@ -251,7 +273,9 @@ int LGBMT_ParseDense(const char* path, char sep, int has_header,
           const char* fe = static_cast<const char*>(
               memchr(fb, sep, le - fb));
           if (fe == nullptr) fe = le;
-          double v = parse_token(fb, fe);
+          bool bad = false;
+          double v = parse_token(fb, fe, &bad);
+          if (bad) bad_token = 1;
           if (col == label_col) {
             y[row] = v;
           } else {
@@ -274,7 +298,8 @@ int LGBMT_ParseDense(const char* path, char sep, int has_header,
     }
   }
   unmap_file(m);
-  return ragged ? -4 : 0;
+  if (ragged) return -4;
+  return bad_token ? -5 : 0;
 }
 
 // Numerical ValueToBin (bin.h:452-488 semantics, matching

@@ -25,7 +25,8 @@ def _load():
             from ..capi import load_lib
             lib = load_lib()
             lib.LGBMT_CountRows.restype = ctypes.c_longlong
-            lib.LGBMT_CountRows.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            lib.LGBMT_CountRows.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                            ctypes.c_char]
             lib.LGBMT_ParseDense.restype = ctypes.c_int
             lib.LGBMT_ParseDense.argtypes = [
                 ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
@@ -55,7 +56,7 @@ def parse_dense(path: str, sep: str, label_column: int, has_header: bool,
         return None
     try:
         pathb = path.encode()
-        n = lib.LGBMT_CountRows(pathb, int(has_header))
+        n = lib.LGBMT_CountRows(pathb, int(has_header), sep.encode()[:1])
         if n <= 0:
             return None
         X = np.empty((n, n_cols - 1), dtype=np.float64)

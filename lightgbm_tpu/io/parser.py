@@ -83,12 +83,7 @@ def parse_file(path: str, label_column: int = 0, has_header: Optional[bool] = No
             out = parse_dense(path, sep, label_column, has_header, n_cols)
             if out is not None:
                 X, y = out
-                if num_features is not None and X.shape[1] != num_features:
-                    fixed = np.full((X.shape[0], num_features), np.nan)
-                    fixed[:, :min(X.shape[1], num_features)] = \
-                        X[:, :num_features]
-                    X = fixed
-                return X, y
+                return _fix_width(X, num_features), y
         out = _parse_delimited_pandas(path, sep, label_column, num_features,
                                       has_header)
         if out is not None:
@@ -142,14 +137,19 @@ def _parse_delimited_pandas(path, sep, label_column, num_features,
             return None
         X = np.concatenate(xs) if len(xs) > 1 else xs[0]
         y = np.concatenate(ys) if len(ys) > 1 else ys[0]
-        if num_features is not None and X.shape[1] != num_features:
-            fixed = np.full((X.shape[0], num_features), np.nan)
-            fixed[:, :min(X.shape[1], num_features)] = \
-                X[:, :num_features]
-            X = fixed
-        return X, y
+        return _fix_width(X, num_features), y
     except Exception:
         return None  # ragged/odd file: the tolerant python parser handles it
+
+
+def _fix_width(X, num_features):
+    """Reconcile a parsed matrix to the requested feature count
+    (validation files must align to the training schema)."""
+    if num_features is None or X.shape[1] == num_features:
+        return X
+    fixed = np.full((X.shape[0], num_features), np.nan)
+    fixed[:, :min(X.shape[1], num_features)] = X[:, :num_features]
+    return fixed
 
 
 _MISSING = {"", "na", "nan", "null", "n/a", "none", "?"}

@@ -184,6 +184,12 @@ class LGBMModel(_Base):
             categorical_feature="auto", callbacks=None) -> "LGBMModel":
         # re-read every fit so set_params(objective=...) takes effect
         self._objective = self.objective
+        # the CONCRETE objective (sklearn objective_ fitted attribute):
+        # the callable itself, or the resolved string incl. the default
+        self._fit_objective = (
+            self._objective if callable(self._objective)
+            else (self._objective if isinstance(self._objective, str)
+                  and self._objective else self._default_objective()))
         fobj = _ObjectiveFunctionWrapper(self._objective) if callable(self._objective) else None
         feval = _EvalFunctionWrapper(eval_metric) if callable(eval_metric) else None
         params = self._engine_params()
@@ -252,6 +258,14 @@ class LGBMModel(_Base):
         if self._Booster is None:
             raise LightGBMError("No booster found, call fit first")
         return self._Booster
+
+    @property
+    def objective_(self):
+        """The concrete objective used while fitting (sklearn.py
+        objective_ fitted attribute)."""
+        if self._Booster is None:
+            raise LightGBMError("No objective found, call fit first")
+        return self._fit_objective
 
     @property
     def best_iteration_(self) -> int:

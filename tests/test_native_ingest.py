@@ -132,3 +132,20 @@ def test_parse_dense_overflow_parity_and_label_guards():
         Xs, ys = native.parse_dense(path2, ",", 1, False, 2)
         np.testing.assert_array_equal(ys[[0, 2]], [2, 4])
         assert np.isnan(ys[1])
+
+
+@needs_native
+def test_parse_dense_declines_text_tokens_and_keeps_sep_only_rows():
+    """A real text cell (not a missing marker) declines to the Python
+    parser, which raises loudly — silent NaN-corruption is worse than an
+    error.  Separator-only lines are data rows of empty fields (the
+    pandas-path semantics), not blank lines."""
+    with tempfile.TemporaryDirectory() as td:
+        p1 = _write(td, "1,red,3\n0,2,4\n")
+        assert native.parse_dense(p1, ",", 0, False, 3) is None
+        p2 = _write(td, "1\t2\t3\n\t\t\n4\t5\t6\n", "w.tsv")
+        X, y = native.parse_dense(p2, "\t", 0, False, 3)
+        assert X.shape == (3, 2) and np.isnan(X[1]).all()
+        p3 = _write(td, "1," + "1" + "0" * 400 + ",2\n", "o.csv")
+        X, _ = native.parse_dense(p3, ",", 0, False, 3)
+        assert np.isposinf(X[0, 0])
