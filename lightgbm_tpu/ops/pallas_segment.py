@@ -660,14 +660,27 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
         return pltpu.roll(jnp.concatenate([compacted, compacted], axis=0),
                           off, axis=0)
 
-    def flush(acc, dst_ref, wbase):
-        """Write the full first window of the accumulator and slide."""
-        stage[:] = acc[0:CHUNK]
-        dma = pltpu.make_async_copy(
-            stage, dst_ref.at[pl.ds(pl.multiple_of(wbase, 8), CHUNK), :],
-            sem_w)
-        dma.start()
-        dma.wait()
+    def drain(dst_ref, stage_buf, sem, pend):
+        """Wait a still-flying flush before its staging buffer/semaphore
+        is reused or the kernel exits (the descriptor's address only
+        sizes the semaphore wait; the in-flight copy's target differs)."""
+        @pl.when(pend > 0)
+        def _():
+            pltpu.make_async_copy(
+                stage_buf, dst_ref.at[pl.ds(0, CHUNK), :], sem).wait()
+
+    def flush(acc, dst_ref, wbase, stage_buf, sem, pend):
+        """Write the full first window of the accumulator and slide.
+        The DMA is NOT waited here: it flies while the next chunks
+        compute, and the NEXT flush (which needs the staging buffer)
+        waits it — flush windows are disjoint from every later access
+        until then.  The slide is safe immediately: the DMA reads the
+        staging copy, not the accumulator."""
+        drain(dst_ref, stage_buf, sem, pend)
+        stage_buf[:] = acc[0:CHUNK]
+        pltpu.make_async_copy(
+            stage_buf, dst_ref.at[pl.ds(pl.multiple_of(wbase, 8), CHUNK), :],
+            sem).start()
         acc[0:CHUNK] = acc[CHUNK:C2]
 
     @pl.when(nch > 0)
@@ -677,7 +690,7 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
     # ---- pass A: one read of the segment; lefts accumulate toward payload
     # windows, rights accumulate toward aux staging windows -------------
     def body_a(k, carry):
-        nl, nr, lo_, ro_, lfl, rfl = carry
+        nl, nr, lo_, ro_, lfl, rfl, pl_, pr_ = carry
         slot = lax.rem(k, 2)
 
         @pl.when(k + 1 < nch)
@@ -713,29 +726,34 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
 
         @pl.when(fl > 0)
         def _flush_l():
-            flush(lacc, payload_out, base + lfl * CHUNK)
+            flush(lacc, payload_out, base + lfl * CHUNK, stage, sem_w, pl_)
 
         blend(racc, placed_r, nrk, ro_, right_value)
         fr = ((ro_ + nrk) >= CHUNK).astype(jnp.int32)
 
         @pl.when(fr > 0)
         def _flush_r():
-            flush(racc, aux_out, base + rfl * CHUNK)
+            flush(racc, aux_out, base + rfl * CHUNK, rbuf, sem_r, pr_)
 
         return (nl + nlk, nr + nrk, lo_ + nlk - fl * CHUNK,
-                ro_ + nrk - fr * CHUNK, lfl + fl, rfl + fr)
+                ro_ + nrk - fr * CHUNK, lfl + fl, rfl + fr,
+                jnp.maximum(pl_, fl), jnp.maximum(pr_, fr))
 
-    num_left, num_right, lo_, ro_, lfl, rfl = lax.fori_loop(
+    (num_left, num_right, lo_, ro_, lfl, rfl, pl_, pr_) = lax.fori_loop(
         0, nch, body_a,
         (jnp.int32(0), jnp.int32(0), shift, shift,
-         jnp.int32(0), jnp.int32(0)), unroll=False)
+         jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+        unroll=False)
     nl_out[0] = num_left
 
     # rights not yet flushed go out as one final aux window (junk tails in
-    # the scratch buffer are harmless)
+    # the scratch buffer are harmless); pass B reads aux, so drain the
+    # right-flush pipeline before it starts
     @pl.when(ro_ > 0)
     def _flush_r_tail():
-        flush(racc, aux_out, base + rfl * CHUNK)
+        flush(racc, aux_out, base + rfl * CHUNK, rbuf, sem_r, pr_)
+
+    drain(aux_out, rbuf, sem_r, jnp.maximum(pr_, (ro_ > 0).astype(jnp.int32)))
 
     # ---- pass B: append the staged rights behind the lefts, continuing
     # in the SAME left accumulator (rights start exactly at the left
@@ -748,7 +766,7 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
         ring_dma(aux_out, 0, 0).start()
 
     def body_b(k, carry):
-        lo_, lfl = carry
+        lo_, lfl, pl_ = carry
         slot = lax.rem(k, 2)
 
         @pl.when(k + 1 < nchb)
@@ -777,11 +795,16 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
 
         @pl.when(fl > 0)
         def _flush_l():
-            flush(lacc, payload_out, base + lfl * CHUNK)
+            flush(lacc, payload_out, base + lfl * CHUNK, stage, sem_w, pl_)
 
-        return (lo_ + cnt - fl * CHUNK, lfl + fl)
+        return (lo_ + cnt - fl * CHUNK, lfl + fl, jnp.maximum(pl_, fl))
 
-    lo_, lfl = lax.fori_loop(0, nchb, body_b, (lo_, lfl), unroll=False)
+    lo_, lfl, pl_ = lax.fori_loop(0, nchb, body_b, (lo_, lfl, pl_),
+                                  unroll=False)
+
+    # the final RMW below reuses the left staging buffer and the kernel
+    # must not exit with a flying DMA — drain the left-flush pipeline
+    drain(payload_out, stage, sem_w, pl_)
 
     # ---- final window: its tail crosses into the next leaf's rows — the
     # one place the kernel pays a blend read ----------------------------
