@@ -33,6 +33,12 @@ REFERENCE_HIGGS_AUC = 0.845154           # @500 iters, real Higgs
 
 
 def synth_higgs(n_rows: int, n_feat: int = 28, seed: int = 7):
+    """Synthetic workload at a configurable shape (default: Higgs 28
+    features).  BENCH_FEATURES/BENCH_BINS let a hardware session take
+    readings at the other BASELINE.md shapes (MS-LTR 137, Expo 700)."""
+    if n_feat < 4:
+        raise SystemExit("BENCH_FEATURES must be >= 4 (the synthetic "
+                         "signal uses the first four columns)")
     rng = np.random.default_rng(seed)
     X = rng.standard_normal((n_rows, n_feat)).astype(np.float32)
     w = rng.standard_normal(n_feat)
@@ -107,6 +113,8 @@ def main():
     n_test = int(os.environ.get("BENCH_TEST_ROWS", 500_000))
     num_leaves = int(os.environ.get("BENCH_LEAVES", 255))
     measure_iters = int(os.environ.get("BENCH_ITERS", 20))
+    n_feat = int(os.environ.get("BENCH_FEATURES", 28))
+    max_bin = int(os.environ.get("BENCH_BINS", 255))
 
     if os.environ.get("BENCH_NO_PROBE") != "1" and not _device_probe():
         # accelerator unreachable: re-exec on CPU at reduced scale so the
@@ -120,7 +128,10 @@ def main():
         env.update({"BENCH_NO_PROBE": "1",
                     "BENCH_ROWS": str(min(n_rows, 200_000)),
                     "BENCH_TEST_ROWS": str(min(n_test, 50_000)),
-                    "BENCH_ITERS": str(min(measure_iters, 5))})
+                    "BENCH_ITERS": str(min(measure_iters, 5)),
+                    "BENCH_LEAVES": str(num_leaves),
+                    "BENCH_FEATURES": str(n_feat),
+                    "BENCH_BINS": str(max_bin)})
         os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
                   env)
 
@@ -131,7 +142,8 @@ def main():
     last_err = None
     for attempt_rows in (n_rows, n_rows // 2, n_rows // 4):
         try:
-            result = run(attempt_rows, n_test, num_leaves, measure_iters)
+            result = run(attempt_rows, n_test, num_leaves, measure_iters,
+                         n_feat, max_bin)
             print(json.dumps(result))
             return
         except Exception as e:  # RESOURCE_EXHAUSTED etc.
@@ -141,16 +153,16 @@ def main():
     raise last_err
 
 
-def run(n_rows, n_test, num_leaves, measure_iters):
+def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
     import lightgbm_tpu as lgb
     from lightgbm_tpu.ops import segment as lseg
 
-    X, y = synth_higgs(n_rows + n_test)
+    X, y = synth_higgs(n_rows + n_test, n_feat=n_feat)
     Xte, yte = X[n_rows:], y[n_rows:]
     X, y = X[:n_rows], y[:n_rows]
 
     params = {"objective": "binary", "metric": "auc",
-              "num_leaves": num_leaves, "max_bin": 255,
+              "num_leaves": num_leaves, "max_bin": max_bin,
               "learning_rate": 0.1, "verbose": -1}
     train = lgb.Dataset(X, label=y)
     bst = lgb.Booster(params, train)
@@ -169,16 +181,19 @@ def run(n_rows, n_test, num_leaves, measure_iters):
 
     eng = bst._engine
     result = {
-        "metric": "boosting iters/sec, Higgs-scale binary (%.1fM x 28, %d leaves, 255 bins)"
-                  % (n_rows / 1e6, num_leaves),
+        "metric": "boosting iters/sec, Higgs-scale binary (%.1fM x %d, %d leaves, %d bins)"
+                  % (n_rows / 1e6, n_feat, num_leaves, max_bin),
         "value": round(iters_per_sec, 4),
         "unit": "iters/sec",
-        "vs_baseline": round(iters_per_sec / BASELINE_ITERS_PER_SEC, 4),
+        # the published baseline is the Higgs shape; a cross-workload
+        # ratio would be meaningless for other BENCH_FEATURES/BENCH_BINS
+        "vs_baseline": (round(iters_per_sec / BASELINE_ITERS_PER_SEC, 4)
+                        if (n_feat, max_bin) == (28, 255) else None),
         "sec_per_iter": round(dt / measure_iters, 4),
         "n_rows": n_rows,
         "held_out_auc_at_%d" % bst.current_iteration(): round(test_auc, 6),
         "reference_real_higgs_auc_at_500": REFERENCE_HIGGS_AUC,
-        "hist_engine": lseg.resolve_impl("auto", 28, 256),
+        "hist_engine": lseg.resolve_impl("auto", n_feat, max_bin + 1),
         "platform": __import__("jax").default_backend(),
         "fast_path": bool(getattr(eng, "_fast_active", False)),
         "phases": phases,

@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Flip the hardware-validation flags after a GREEN smoke run.
+
+Usage: python exp/flip_validated.py acc [roll] [repeat]
+
+Only run this after `exp/smoke_tpu_kernels.py` passed ON A REAL TPU —
+the flags gate kernels whose Mosaic legality interpret mode cannot
+prove.  Edits lightgbm_tpu/ops/pallas_segment.py in place and re-runs
+the interpret test grid as a sanity check.
+"""
+import re
+import subprocess
+import sys
+
+FLAGS = {"acc": "PARTITION_ACC_VALIDATED",
+         "roll": "PARTITION_ACC_ROLL_VALIDATED",
+         "repeat": "HIST_REPEAT_VALIDATED"}
+PATH = "lightgbm_tpu/ops/pallas_segment.py"
+
+names = sys.argv[1:]
+if not names or any(n not in FLAGS for n in names):
+    sys.exit("usage: flip_validated.py {acc|roll|repeat}...")
+src = open(PATH).read()
+for n in names:
+    flag = FLAGS[n]
+    new, cnt = re.subn(r"^%s = False$" % flag, "%s = True" % flag,
+                       src, flags=re.M)
+    if cnt != 1:
+        sys.exit("could not flip %s (already True?)" % flag)
+    src = new
+    print("flipped", flag)
+orig = open(PATH).read()
+open(PATH, "w").write(src)
+rc = subprocess.run([sys.executable, "-m", "pytest",
+                     "tests/test_pallas_segment.py", "-q",
+                     "--deselect",
+                     "tests/test_pallas_segment.py::test_validated_flags_gate_product_paths"]).returncode
+if rc != 0:
+    open(PATH, "w").write(orig)   # never leave flipped flags with a red grid
+    print("interpret grid FAILED — flags reverted")
+sys.exit(rc)
