@@ -139,45 +139,88 @@ def main():
     # bench on OOM — fall back to half scale (n_rows is reported, and
     # vs_baseline stays an honest iters/sec ratio against the 10.5M-row
     # reference number)
-    last_err = None
-    for attempt_rows in (n_rows, n_rows // 2, n_rows // 4):
+    rungs = (n_rows, n_rows // 2, n_rows // 4)
+    last_msg = None
+    for i, attempt_rows in enumerate(rungs):
         try:
             result = run(attempt_rows, n_test, num_leaves, measure_iters,
                          n_feat, max_bin)
             print(json.dumps(result))
             return
         except Exception as e:  # RESOURCE_EXHAUSTED etc.
-            last_err = e
+            # keep only the MESSAGE and leave the handler promptly: while
+            # the handler runs, exc_info pins run()'s frame (payload +
+            # aux, ~10 GB at full scale); it is the handler EXIT that
+            # frees it for the next rung
+            last_msg = "%s: %s" % (type(e).__name__, e)
             sys.stderr.write("bench failed at %d rows: %s\n"
-                             % (attempt_rows, e))
-    raise last_err
+                             % (attempt_rows, last_msg))
+        if i + 1 == len(rungs):
+            break
+        if "UNAVAILABLE" in last_msg or "crashed" in last_msg:
+            # the TPU worker died.  This process's PJRT client is stale
+            # and cannot reconnect — wait for the worker to come back,
+            # then RE-EXEC at the next rung for a fresh client.
+            sys.stderr.write("bench: waiting for TPU worker restart\n")
+            for _ in range(5):
+                if _device_probe():
+                    break
+                time.sleep(20)
+            env = dict(os.environ)
+            env.update({"BENCH_ROWS": str(rungs[i + 1]),
+                        "BENCH_TEST_ROWS": str(n_test),
+                        "BENCH_ITERS": str(measure_iters),
+                        "BENCH_LEAVES": str(num_leaves),
+                        "BENCH_FEATURES": str(n_feat),
+                        "BENCH_BINS": str(max_bin)})
+            sys.stderr.write("bench: re-exec at %d rows\n" % rungs[i + 1])
+            os.execve(sys.executable,
+                      [sys.executable, os.path.abspath(__file__)], env)
+    raise SystemExit("bench: all attempts failed; last error: " + last_msg)
 
 
 def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
     import lightgbm_tpu as lgb
     from lightgbm_tpu.ops import segment as lseg
 
+    def stage(msg):
+        sys.stderr.write("bench stage: %s\n" % msg)
+        sys.stderr.flush()
+
     X, y = synth_higgs(n_rows + n_test, n_feat=n_feat)
     Xte, yte = X[n_rows:], y[n_rows:]
     X, y = X[:n_rows], y[:n_rows]
+    stage("synth done (%d rows)" % n_rows)
 
     params = {"objective": "binary", "metric": "auc",
               "num_leaves": num_leaves, "max_bin": max_bin,
               "learning_rate": 0.1, "verbose": -1}
     train = lgb.Dataset(X, label=y)
     bst = lgb.Booster(params, train)
+    stage("booster built")
     # warm-up: binning + compile + first iterations
     for _ in range(3):
         bst.update()
+    stage("warmup done")
     t0 = time.time()
     for _ in range(measure_iters):
         bst.update()
     dt = time.time() - t0
     iters_per_sec = measure_iters / dt
+    stage("measured %.4f s/iter" % (dt / measure_iters))
 
-    phases = phase_times(bst)
+    # predict BEFORE the piecewise phase diagnostics: the phases section
+    # re-dispatches the standalone stage programs (extra compiles); if it
+    # takes the worker down, the headline result must already be in hand
     pred = bst.predict(Xte, device=True)
     test_auc = float(auc_score(yte, pred))
+    stage("predict+auc done")
+    try:
+        phases = phase_times(bst)
+        stage("phases done")
+    except Exception as e:
+        phases = {"error": "%s: %s" % (type(e).__name__, e)}
+        stage("phases FAILED (diagnostics only): %s" % phases["error"])
 
     eng = bst._engine
     result = {

@@ -322,8 +322,12 @@ class _FastState:
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def snap_scores(payload):
-            return payload.at[:, snap0:snap0 + K].set(
-                payload[:, score0:score0 + K])
+            # K lane-masked passes, not a slice DUS — see
+            # seg.payload_col_write (the K wheres fuse into one pass)
+            for kk in range(K):
+                payload = seg.payload_col_write(payload, snap0 + kk,
+                                                payload[:, score0 + kk])
+            return payload
 
         idx_col = self.idx_col
 
@@ -334,7 +338,8 @@ class _FastState:
             column routes the gather (Bagging, gbdt.cpp:213-295).  Guard
             rows route to the appended dead slot and stay masked out."""
             combined = jnp.concatenate([combined, jnp.zeros(1, jnp.float32)])
-            return payload.at[:, cnt_col].set(combined[read_idx(payload)])
+            return seg.payload_col_write(payload, cnt_col,
+                                         combined[read_idx(payload)])
 
         rowwise = getattr(obj, "is_rowwise", True) if obj is not None else True
         label_orig, weight_orig = gbdt.label_dev, gbdt.weight_dev
@@ -347,10 +352,10 @@ class _FastState:
                 g, h = obj.get_gradients_multi(snap, payload[:, G],
                                                payload[:, G + 1])
                 valid = payload[:, cnt_col]
-                payload = payload.at[:, grad_col].set(
-                    jnp.take(g, k, axis=0) * valid)
-                return payload.at[:, hess_col].set(
-                    jnp.take(h, k, axis=0) * valid)
+                payload = seg.payload_col_write(
+                    payload, grad_col, jnp.take(g, k, axis=0) * valid)
+                return seg.payload_col_write(
+                    payload, hess_col, jnp.take(h, k, axis=0) * valid)
         else:
             def _fill_body(payload, k):
                 """Non-rowwise objectives (lambdarank/xendcg: gradients
@@ -369,10 +374,10 @@ class _FastState:
                 gp = jnp.pad(g, ((0, 0), (0, 1)))
                 hp = jnp.pad(h, ((0, 0), (0, 1)))
                 valid = payload[:, cnt_col]
-                payload = payload.at[:, grad_col].set(
-                    jnp.take(gp, k, axis=0)[idx] * valid)
-                return payload.at[:, hess_col].set(
-                    jnp.take(hp, k, axis=0)[idx] * valid)
+                payload = seg.payload_col_write(
+                    payload, grad_col, jnp.take(gp, k, axis=0)[idx] * valid)
+                return seg.payload_col_write(
+                    payload, hess_col, jnp.take(hp, k, axis=0)[idx] * valid)
 
         @functools.partial(jax.jit, donate_argnums=(0,),
                            static_argnames=("k",))
@@ -383,7 +388,7 @@ class _FastState:
                            static_argnames=("k",))
         def apply_score(payload, lr, k):
             upd = payload[:, self.value_col] * lr
-            return payload.at[:, score0 + k].add(upd)
+            return seg.payload_col_write(payload, score0 + k, upd, "add")
 
         grower = self.grower
         value_col = self.value_col
@@ -397,7 +402,7 @@ class _FastState:
             # stumps must not move the scores (gbdt.cpp stops instead)
             upd = jnp.where(out["num_leaves"] > 1,
                             payload[:, value_col] * lr, 0.0)
-            payload = payload.at[:, score0 + k].add(upd)
+            payload = seg.payload_col_write(payload, score0 + k, upd, "add")
             return out, payload, aux
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -416,11 +421,11 @@ class _FastState:
                                            payload[:, G + 1])
 
         def _write_sampled(payload, g, h, k, gw, cm):
-            payload = payload.at[:, grad_col].set(
-                jnp.take(g, k, axis=0) * gw)
-            payload = payload.at[:, hess_col].set(
-                jnp.take(h, k, axis=0) * gw)
-            return payload.at[:, cnt_col].set(cm)
+            payload = seg.payload_col_write(payload, grad_col,
+                                            jnp.take(g, k, axis=0) * gw)
+            payload = seg.payload_col_write(payload, hess_col,
+                                            jnp.take(h, k, axis=0) * gw)
+            return seg.payload_col_write(payload, cnt_col, cm)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step_sampled(payload, aux, fmask, lr, k, key, enabled):
@@ -447,8 +452,8 @@ class _FastState:
             g, h = _all_grads(payload)
             valid = payload[:, bvalid_col]
             gw, cm = sample_hook(g * valid, h * valid, valid, key, enabled)
-            payload = payload.at[:, gweight_col].set(gw)
-            return payload.at[:, cnt_col].set(cm)
+            payload = seg.payload_col_write(payload, gweight_col, gw)
+            return seg.payload_col_write(payload, cnt_col, cm)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step_masked(payload, aux, fmask, lr, k):
@@ -476,15 +481,16 @@ class _FastState:
                     axis=1)[:, 0].astype(jnp.int32))
             nd = lax.fori_loop(0, depth_iters_fs, body,
                                jnp.zeros(n_rows, jnp.int32))
-            return payload.at[:, score0 + k].add(leaf_scaled[~nd])
+            return seg.payload_col_write(payload, score0 + k,
+                                         leaf_scaled[~nd], "add")
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def apply_const_score(payload, delta, k):
-            return payload.at[:, score0 + k].add(delta)
+            return seg.payload_col_write(payload, score0 + k, delta, "add")
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def scale_score(payload, factor, k):
-            return payload.at[:, score0 + k].multiply(factor)
+            return seg.payload_col_write(payload, score0 + k, factor, "mul")
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step_rf(payload, aux, fmask):
@@ -496,8 +502,8 @@ class _FastState:
             g, h = obj.get_gradients_multi(zeros, payload[:, G],
                                            payload[:, G + 1])
             valid = payload[:, cnt_col]
-            payload = payload.at[:, grad_col].set(g[0] * valid)
-            payload = payload.at[:, hess_col].set(h[0] * valid)
+            payload = seg.payload_col_write(payload, grad_col, g[0] * valid)
+            payload = seg.payload_col_write(payload, hess_col, h[0] * valid)
             return grower.__wrapped__(payload, aux, fmask) \
                 if hasattr(grower, "__wrapped__") else grower(payload, aux,
                                                               fmask)
@@ -505,7 +511,8 @@ class _FastState:
         @functools.partial(jax.jit, donate_argnums=(0,))
         def rf_score_update(payload, tree_dev, leaf_scaled, m):
             """score = (score*m + tree)/(m+1) in one dispatch."""
-            payload = payload.at[:, score0].multiply(m / (m + 1.0))
+            payload = seg.payload_col_write(payload, score0,
+                                            m / (m + 1.0), "mul")
             return payload_tree_add.__wrapped__(
                 payload, tree_dev, leaf_scaled / (m + 1.0), jnp.int32(0))
 

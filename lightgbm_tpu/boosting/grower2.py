@@ -265,7 +265,7 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         # rows start as one root segment with the root Newton step as the
         # per-row output (covers the unsplittable-stump case)
         root_out = out_fn(root_g, root_h)
-        payload = payload.at[:, cols.value].set(root_out)
+        payload = seg.payload_col_write(payload, cols.value, root_out)
 
         real0 = res0.gain
         root_rank = jnp.int32(-1)

@@ -77,19 +77,19 @@ def fits_vmem(num_features: int, num_bins: int) -> bool:
 #: partition kernel on real hardware; until then the RMW kernel stays the
 #: product default (round 4's lesson: interpret mode proves nothing about
 #: Mosaic legality).
-PARTITION_ACC_VALIDATED = False
+PARTITION_ACC_VALIDATED = True
 
 #: True once the repeat-based one-hot expansion is hardware-validated; it
 #: halves the histogram kernel's MXU work (the expand matmul becomes a
 #: lane-repeat relayout) by building the one-hot in a bin-major tiled
 #: layout that the host epilogue transposes back.
-HIST_REPEAT_VALIDATED = False
+HIST_REPEAT_VALIDATED = True
 
 #: True once the roll-based placement inside the accumulator kernel is
 #: hardware-validated: a dynamic sublane rotate replaces the [2C, C]
 #: placement one-hot — pass A's matmul halves to [C, C] compaction and
 #: pass B's placement becomes a pure (exact, matmul-free) data movement.
-PARTITION_ACC_ROLL_VALIDATED = False
+PARTITION_ACC_ROLL_VALIDATED = True
 
 
 def partition_acc_fits_vmem(payload_width: int, num_bins: int) -> bool:
@@ -101,7 +101,11 @@ def partition_acc_fits_vmem(payload_width: int, num_bins: int) -> bool:
     one-hot machinery and the categorical bitset one-hot."""
     P, C = payload_width, CHUNK
     est = (4 * P * 18 * C          # ring(2C) + accs(4C) + stage/rbuf(2C) + placement intermediates(~10C, roll mode worst case)
-           + 4 * 7 * C * C         # mat[2C,C] + iota_2i/2j[2C,C] + tri[C,C]
+           + 4 * 8 * C * C         # worst mode's [*, C] one-hot machinery:
+                                   #   matmul: mat[2C,C] + iota_2i[2C,C] +
+                                   #           rank's ri/rj/tri [C,C] x3 (7C*C)
+                                   #   roll:   matc + fresh iota + ri/rj/tri,
+                                   #           [C,C] x5 (5C*C); 8C*C covers both
            + 4 * C * num_bins)     # categorical bitset one-hot in go_left
     return est <= _VMEM_BUDGET
 
@@ -607,7 +611,6 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
     iota_c2 = lax.broadcasted_iota(jnp.int32, (C2, 1), 0)[:, 0]
     iota_p = lax.broadcasted_iota(jnp.int32, (1, P), 1)
     iota_2i = lax.broadcasted_iota(jnp.int32, (C2, CHUNK), 0)
-    iota_2j = lax.broadcasted_iota(jnp.int32, (C2, CHUNK), 1)
 
     def ring_dma(src_ref, k, slot):
         return pltpu.make_async_copy(
@@ -623,8 +626,15 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
             * valid_mask(k)                                  # [C] i32 0/1
 
     def rank_of(keep_i):
-        """Exclusive prefix count of kept rows (tri matvec; <= C, exact)."""
-        tri = (iota_2j[:CHUNK, :] < iota_2i[:CHUNK, :]).astype(jnp.float32)
+        """Exclusive prefix count of kept rows (tri matvec; <= C, exact).
+        The iotas are built at [C, C] directly: slicing the [2C, C] ones
+        (e.g. iota_2j[:CHUNK]) crashes Mosaic's ApplyVectorLayout — a
+        broadcasted iota is stored replicated along its constant dim, and
+        vector.extract_strided_slice asks that dim for more vregs than the
+        replicated layout holds (hardware-bisected, round 4)."""
+        ri = lax.broadcasted_iota(jnp.int32, (CHUNK, CHUNK), 0)
+        rj = lax.broadcasted_iota(jnp.int32, (CHUNK, CHUNK), 1)
+        tri = (rj < ri).astype(jnp.float32)
         return jnp.dot(tri, keep_i.astype(jnp.float32)[:, None],
                        preferred_element_type=jnp.float32)[:, 0].astype(jnp.int32)
 
@@ -651,8 +661,10 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
         """[2C, P]: compact kept rows to the top with a [C, C] one-hot
         (half the placement matmul), then rotate the doubled buffer so
         they land at [off, off+cnt) — the rotate is exact data movement."""
-        matc = ((iota_2i[:CHUNK, :] == rank[None, :]) &
+        matc = ((lax.broadcasted_iota(jnp.int32, (CHUNK, CHUNK), 0) ==
+                 rank[None, :]) &
                 (member[None, :] > 0)).astype(jnp.float32)       # [C, C]
+        # fresh [C, C] iota, NOT iota_2i[:CHUNK] — see rank_of
         hi, mid, lo = parts
         compacted = (jnp.dot(matc, hi, preferred_element_type=jnp.float32) +
                      jnp.dot(matc, mid, preferred_element_type=jnp.float32) +

@@ -72,6 +72,29 @@ def resolve_impl(impl: str, num_features: int, num_bins: int) -> str:
     return impl
 
 
+def payload_col_write(payload: jax.Array, col, vec, op: str = "set"):
+    """payload[:, col] <op>= vec as a lane-masked elementwise select.
+
+    A DUS column write (``payload.at[:, col].set(vec)``) on the lane-tiled
+    [N, P] payload makes XLA materialize BOTH a payload-sized copy and the
+    [N, 1] update operand re-tiled to the payload's T(8, 128) layout — a
+    128x padding expansion.  At 10.5M rows that is 2 x 5 GB of HLO temp,
+    which OOMs the 16 GB v5e (measured from the compiler's HBM breakdown,
+    round 4).  The masked select instead fuses into ONE in-place
+    elementwise pass over the donated buffer; consecutive writes fuse
+    together.  `col` may be a traced scalar; `vec` a [N] vector or scalar.
+    """
+    mask = lax.broadcasted_iota(jnp.int32, (1, payload.shape[1]), 1) == col
+    v = vec if jnp.ndim(vec) == 0 else vec[:, None]
+    if op == "add":
+        v = payload + v
+    elif op == "mul":
+        v = payload * v
+    else:
+        assert op == "set", op
+    return jnp.where(mask, v, payload)
+
+
 class SplitPredicate(NamedTuple):
     """Scalars describing one split's routing decision
     (Bin::Split semantics, src/io/dense_bin.hpp:190-283).  `col` is the

@@ -165,13 +165,15 @@ def test_partition_acc_skewed(start, count, skew):
 
 
 def test_validated_flags_gate_product_paths():
-    """The speculative kernel variants must stay OFF until the hardware
-    smoke flips their flags — and the flags must be consumed OUTSIDE the
-    jit cache so a flip takes effect on warm traces (both defaults resolve
-    in plain Python wrappers)."""
-    assert pseg.PARTITION_ACC_VALIDATED is False
-    assert pseg.PARTITION_ACC_ROLL_VALIDATED is False
-    assert pseg.HIST_REPEAT_VALIDATED is False
+    """The speculative kernel variants were hardware-validated in round
+    4's second window (exp/smoke_tpu_kernels.py: exact at every tested
+    geometry on a real v5e) and their flags flipped ON — this pins the
+    validated state so an accidental revert is loud.  The flags must be
+    consumed OUTSIDE the jit cache so a flip takes effect on warm traces
+    (both defaults resolve in plain Python wrappers)."""
+    assert pseg.PARTITION_ACC_VALIDATED is True
+    assert pseg.PARTITION_ACC_ROLL_VALIDATED is True
+    assert pseg.HIST_REPEAT_VALIDATED is True
     # acc-kernel gate admits Higgs/Bosch-class widths, rejects Epsilon
     assert pseg.partition_acc_fits_vmem(128, 256)
     assert not pseg.partition_acc_fits_vmem(2048, 64)
