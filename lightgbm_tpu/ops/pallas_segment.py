@@ -316,6 +316,14 @@ def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
     lax.fori_loop(0, nch, body, 0, unroll=False)
 
 
+#: widest F*B the repeat expansion is the default for.  The round-4
+#: hardware race (exp/smoke_tpu_kernels.py, fetch-forced medians at 8192
+#: rows): repeat wins at 28x256 (79.8 vs 91.8 ms), washes at 137x256
+#: (133.3 vs 131.2), loses at 700x256 (304.0 vs 252.9) — the bin-major
+#: epilogue's per-tile untranspose grows with the tile count.
+REPEAT_MAX_FB = 16384
+
+
 def segment_histogram(payload, start, count, *, num_features, num_bins,
                       grad_col, hess_col, cnt_col, interpret=False,
                       expand_impl=None):
@@ -324,7 +332,9 @@ def segment_histogram(payload, start, count, *, num_features, num_bins,
     The flag default is resolved OUTSIDE the jit cache so flipping
     HIST_REPEAT_VALIDATED after warm traces takes effect immediately."""
     if expand_impl is None:
-        expand_impl = "repeat" if HIST_REPEAT_VALIDATED else "matmul"
+        expand_impl = ("repeat" if HIST_REPEAT_VALIDATED
+                       and num_features * num_bins <= REPEAT_MAX_FB
+                       else "matmul")
     if expand_impl not in ("matmul", "repeat"):
         raise ValueError("expand_impl must be matmul|repeat, got %r"
                          % (expand_impl,))
