@@ -185,3 +185,37 @@ def test_validated_flags_gate_product_paths():
         pseg.segment_histogram(
             _payload(64), jnp.int32(0), jnp.int32(8), num_features=F,
             num_bins=B, interpret=True, expand_impl="typo", **COLS)
+
+
+def test_payload_col_write_matches_dus():
+    """seg.payload_col_write is the lane-masked replacement for the DUS
+    column writes that OOM'd the chip at full scale (round 4); it must
+    match .at[:, col].set/.add/.multiply exactly for vector and scalar
+    values and for traced column indices."""
+    rng = np.random.default_rng(3)
+    pay = jnp.asarray(rng.standard_normal((64, 8)).astype(np.float32))
+    vec = jnp.asarray(rng.standard_normal(64).astype(np.float32))
+
+    np.testing.assert_array_equal(
+        seg.payload_col_write(pay, 3, vec), pay.at[:, 3].set(vec))
+    np.testing.assert_array_equal(
+        seg.payload_col_write(pay, 5, vec, "add"), pay.at[:, 5].add(vec))
+    np.testing.assert_array_equal(
+        seg.payload_col_write(pay, 0, vec, "mul"),
+        pay.at[:, 0].multiply(vec))
+    # scalar value broadcast, each op
+    np.testing.assert_array_equal(
+        seg.payload_col_write(pay, 7, 2.5), pay.at[:, 7].set(2.5))
+    np.testing.assert_array_equal(
+        seg.payload_col_write(pay, 1, 2.5, "add"), pay.at[:, 1].add(2.5))
+    np.testing.assert_array_equal(
+        seg.payload_col_write(pay, 2, 0.5, "mul"),
+        pay.at[:, 2].multiply(0.5))
+
+    # traced column index (the fused step passes k as a traced scalar)
+    @jax.jit
+    def via_traced_col(p, c, v):
+        return seg.payload_col_write(p, c, v, "add")
+
+    np.testing.assert_array_equal(
+        via_traced_col(pay, jnp.int32(4), vec), pay.at[:, 4].add(vec))
