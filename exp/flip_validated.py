@@ -14,7 +14,8 @@ import sys
 
 FLAGS = {"acc": "PARTITION_ACC_VALIDATED",
          "roll": "PARTITION_ACC_ROLL_VALIDATED",
-         "repeat": "HIST_REPEAT_VALIDATED"}
+         "repeat": "HIST_REPEAT_VALIDATED",
+         "merged": "PARTITION_HIST_VALIDATED"}
 PATH = "lightgbm_tpu/ops/pallas_segment.py"
 
 names = sys.argv[1:]
@@ -34,7 +35,9 @@ open(PATH, "w").write(src)
 rc = subprocess.run([sys.executable, "-m", "pytest",
                      "tests/test_pallas_segment.py", "-q",
                      "--deselect",
-                     "tests/test_pallas_segment.py::test_validated_flags_gate_product_paths"]).returncode
+                     "tests/test_pallas_segment.py::test_validated_flags_gate_product_paths",
+                     "--deselect",
+                     "tests/test_pallas_segment.py::test_partition_hist_flag_staged_off"]).returncode
 if rc != 0:
     open(PATH, "w").write(orig)   # never leave flipped flags with a red grid
     print("interpret grid FAILED — flags reverted")

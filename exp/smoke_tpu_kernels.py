@@ -210,3 +210,75 @@ for (Fr, Br) in ((28, 256), (137, 256), (700, 256)):
         print("hist[%s] %dx%d 8192 rows: median %.2f ms (fetch-forced)"
               % (label, Fr, Br, sorted(ts)[2] * 1e3), flush=True)
 print("REPEAT HIST OK on", jax.default_backend(), flush=True)
+
+
+# --- merged partition+hist kernel: Mosaic-compile + exactness + speed vs
+# the split acc-partition + hist pair.  Flip pseg.PARTITION_HIST_VALIDATED
+# once this section is green on real hardware. ---
+MF, MB = 28, 256
+MP = 128
+mg, mh, mc, MVAL = MF, MF + 1, MF + 2, MF + 3
+pay_m = np.zeros((8192 + seg.GUARD, MP), np.float32)
+pay_m[:8192, :MF] = rng.integers(0, MB, (8192, MF))
+pay_m[:8192, mg] = rng.standard_normal(8192)
+pay_m[:8192, mh] = rng.random(8192) + 0.1
+pay_m[:8192, mc] = 1.0
+pay_m = jnp.asarray(pay_m)
+pred_m = seg.SplitPredicate(
+    col=jnp.int32(2), threshold=jnp.int32(100),
+    default_left=jnp.bool_(True), is_cat=jnp.bool_(False),
+    missing_type=jnp.int32(0), num_bin=jnp.int32(MB),
+    default_bin=jnp.int32(0), offset=jnp.int32(0),
+    identity=jnp.bool_(True), bitset=jnp.zeros(MB, jnp.int32))
+mkw = dict(num_features=MF, grad_col=mg, hess_col=mh, cnt_col=mc)
+for (s_m, c_m) in ((128, 3000), (7, 8000), (513, 256)):
+    p_m, a_m, nl_m, hl_m, hr_m = pseg.partition_segment_hist(
+        pay_m, jnp.zeros_like(pay_m), jnp.int32(s_m), jnp.int32(c_m),
+        pred_m, jnp.float32(1.5), jnp.float32(-2.5), MVAL, MB, **mkw)
+    p_mr, _, nl_mr = seg.partition_segment(
+        pay_m, jnp.zeros_like(pay_m), jnp.int32(s_m), jnp.int32(c_m),
+        pred_m, jnp.float32(1.5), jnp.float32(-2.5), MVAL)
+    assert int(nl_m) == int(nl_mr), (s_m, c_m, int(nl_m), int(nl_mr))
+    perr_m = float(jnp.abs(p_m - p_mr).max())
+    hl_ref = seg.segment_histogram(p_mr, jnp.int32(s_m), nl_mr,
+                                   num_bins=MB, **mkw)
+    hr_ref = seg.segment_histogram(p_mr, jnp.int32(s_m) + nl_mr,
+                                   jnp.int32(c_m) - nl_mr,
+                                   num_bins=MB, **mkw)
+    herr = max(float(jnp.abs(hl_m - hl_ref).max()),
+               float(jnp.abs(hr_m - hr_ref).max()))
+    print("merged part+hist (%d,%d): nl=%d perr=%s herr=%.3g"
+          % (s_m, c_m, int(nl_m), perr_m, herr), flush=True)
+    assert perr_m == 0.0, perr_m
+    assert herr < 1e-3, herr
+# race: merged kernel vs (acc partition + one smaller-child hist) — the
+# product's per-split device work in each mode
+
+
+def _split_mode(p_, a_):
+    h_ = pseg.segment_histogram(p_, jnp.int32(0), jnp.int32(4096),
+                                num_bins=MB, **mkw)
+    out_ = pseg.partition_segment_acc(
+        p_, a_, jnp.int32(0), jnp.int32(8192), pred_m,
+        jnp.float32(1.), jnp.float32(-1.), MVAL, MB)
+    jax.block_until_ready(h_)
+    return out_
+
+
+def _merged_mode(p_, a_):
+    return pseg.partition_segment_hist(
+        p_, a_, jnp.int32(0), jnp.int32(8192), pred_m,
+        jnp.float32(1.), jnp.float32(-1.), MVAL, MB, **mkw)
+
+
+for name, fn in (("split: acc+hist", _split_mode), ("merged", _merged_mode)):
+    ts = []
+    for _ in range(5):
+        p_, a_ = jnp.asarray(pay_m), jnp.zeros_like(pay_m)
+        _ = np.asarray(p_)[0, 0]
+        t0 = _t.perf_counter()
+        nl_ = int(fn(p_, a_)[2])
+        ts.append(_t.perf_counter() - t0)
+    print("per-split device work[%s] 8192 rows: median %.2f ms (fetch-forced)"
+          % (name, sorted(ts)[2] * 1e3), flush=True)
+print("MERGED PART+HIST OK on", jax.default_backend(), flush=True)

@@ -135,8 +135,10 @@ def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
                     ds: BinnedDataset, cols: PayloadCols, payload_width: int,
                     bundle_map=None, forced=None, mesh=None, mesh_axis=None,
                     mode="data", top_k=20):
+    from ..ops import pallas_segment as _pseg
     key = (cfg, max_num_bin, ds.bins.shape, cols, payload_width,
            _bundle_key(ds), forced, mesh, mesh_axis, mode, top_k,
+           _pseg.PARTITION_HIST_VALIDATED,   # flips grower structure
            tuple((m.num_bin, m.missing_type, m.default_bin, m.is_trivial, m.bin_type)
                  for m in ds.bin_mappers),
            ds.monotone_constraints.tobytes(), ds.feature_penalty.tobytes())
@@ -146,7 +148,7 @@ def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
             grower = make_partitioned_grower(
                 meta_dev, cfg, max_num_bin, cols, ds.num_features,
                 bundle_map=bundle_map, num_columns=ds.bins.shape[0],
-                forced=forced)
+                forced=forced, payload_width=payload_width)
         else:
             # the mesh fast path: the SAME partitioned engine per shard
             # (local row blocks partition locally), collectives at the

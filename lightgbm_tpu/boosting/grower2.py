@@ -55,7 +55,9 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                             num_columns: int = None,
                             forced: ForcedSchedule = None,
                             axis_name: str = None, mode: str = "data",
-                            num_machines: int = 1, top_k: int = 20):
+                            num_machines: int = 1, top_k: int = 20,
+                            merged_hist: bool = None,
+                            payload_width: int = None):
     """Returns grow(payload, aux, feature_mask) ->
     (tree arrays dict, payload, aux).
 
@@ -154,6 +156,42 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         return seg.partition_segment(payload, aux, start, count, pred,
                                      lv, rv, cols.value)
 
+    # ---- merged partition+hist mode (serial only): one kernel per split
+    # computes the partition AND both children's histograms from the same
+    # row pass — the parent histogram, subtraction trick and device
+    # histogram pool all retire (their roles fold into the partition walk;
+    # reference feature_histogram.hpp:505-826).  Auto = hardware-validated
+    # flag + pallas kernels + VMEM fit; tests may force it on the portable
+    # engines (partition, then walk each child's contiguous rows).
+    if merged_hist is None:
+        from ..ops import pallas_segment as _pseg
+        # the VMEM fit is part of the AUTO decision: a non-fitting shape
+        # would land on part_hist_fn's portable fallback, which walks BOTH
+        # children (strictly worse than smaller-child + subtraction)
+        merged_hist = (not meshed and pallas_part and impl == "pallas"
+                       and _pseg.PARTITION_HIST_VALIDATED
+                       and payload_width is not None
+                       and _pseg.partition_hist_fits_vmem(payload_width,
+                                                          G, B))
+    merged_hist = bool(merged_hist) and not meshed
+
+    if merged_hist:
+        from ..ops import pallas_segment as _pseg
+
+        def part_hist_fn(payload, aux, start, count, pred, lv, rv):
+            if (pallas_part and impl == "pallas"
+                    and _pseg.partition_hist_fits_vmem(
+                        payload.shape[1], G, B)):
+                return _pseg.partition_segment_hist(
+                    payload, aux, start, count, pred, lv, rv,
+                    cols.value, B, num_features=G, grad_col=cols.grad,
+                    hess_col=cols.hess, cnt_col=cols.cnt)
+            payload, aux, nl = part_fn(payload, aux, start, count, pred,
+                                       lv, rv)
+            hl = hist_fn(payload, start, nl)
+            hr = hist_fn(payload, start + nl, count - nl)
+            return payload, aux, nl, hl, hr
+
     def hist_view(hist_g):
         """[G, B, 3] bundle histogram -> [F, B, 3] per-feature split view."""
         if not bundled:
@@ -166,8 +204,11 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
     # parent was evicted recomputes it by walking the (still contiguous)
     # parent segment — cheap under the O(rows-touched) engine
     POOL = cfg.hist_pool_slots if 0 < cfg.hist_pool_slots < L else L
-    pooled = POOL < L
-    assert POOL >= 2, "histogram pool needs at least 2 slots"
+    pooled = POOL < L and not merged_hist
+    if merged_hist:
+        POOL = 1   # no device hist state at all in merged mode
+    else:
+        assert POOL >= 2, "histogram pool needs at least 2 slots"
 
     if forced is not None:
         from .forced import make_forced_machinery
@@ -278,8 +319,6 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         state = {
             "payload": payload,
             "aux": aux,
-            "hist": jnp.zeros((POOL, Gh, B, 3),
-                              jnp.float32).at[0].set(hist_root),
             "seg_start": jnp.zeros(L, jnp.int32),
             "seg_cnt": jnp.zeros(L, jnp.int32).at[0].set(n_rows),
             "sum_g": jnp.zeros(L, jnp.float32).at[0].set(root_g),
@@ -314,6 +353,12 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             "internal_count": jnp.zeros(ni, jnp.float32),
             "num_leaves": jnp.int32(1),
         }
+        if not merged_hist:
+            # per-leaf (or pooled) histogram state exists only for the
+            # subtraction trick; merged mode gets both child histograms
+            # from the partition kernel itself
+            state["hist"] = jnp.zeros((POOL, Gh, B, 3),
+                                      jnp.float32).at[0].set(hist_root)
         if forced is not None:
             # pending forced rank per leaf, and the REAL (not priority) gain
             # of each leaf's stored best split, for honest split_gain records
@@ -348,26 +393,6 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             start = st["seg_start"][best_leaf]
             count = st["seg_cnt"][best_leaf]
 
-            # parent histogram: read the pool slot, or rebuild it from the
-            # (still contiguous) parent segment if it was evicted
-            if pooled:
-                # NOTE: the rebuild branch runs a collective in mesh modes;
-                # the pool bookkeeping is replicated-in-value, so every
-                # shard takes the same branch and the psum pairs up
-                pslot = st["slot_of_leaf"][best_leaf]
-                hist_parent = lax.cond(
-                    pslot >= 0,
-                    lambda: st["hist"][jnp.maximum(pslot, 0)],
-                    lambda: reduce_hist(hist_fn(st["payload"], start,
-                                                count)))
-            else:
-                hist_parent = st["hist"][best_leaf]
-
-            payload, aux, nl_raw = part_fn(
-                st["payload"], st["aux"], start, count, pred,
-                st["blo"][best_leaf], st["bro"][best_leaf])
-            nr_raw = count - nl_raw
-
             # child aggregates: left from the stored split, right by diff
             lg, lh, lcnt = (st["blg"][best_leaf], st["blh"][best_leaf],
                             st["blc"][best_leaf])
@@ -375,17 +400,48 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                           st["cnt"][best_leaf])
             rg, rh, rcnt = pg - lg, ph - lh, pc - lcnt
 
-            # histograms: build only the smaller child, derive the sibling by
-            # subtraction.  The choice uses masked counts (like grower.py and
-            # the reference's num_data comparison) so both growers build the
-            # direct histogram on the same child and stay bit-comparable.
-            left_smaller = lcnt <= rcnt
-            h_start = jnp.where(left_smaller, start, start + nl_raw)
-            h_count = jnp.where(left_smaller, nl_raw, nr_raw)
-            hist_small = reduce_hist(hist_fn(payload, h_start, h_count))
-            hist_big = hist_parent - hist_small
-            new_left = jnp.where(left_smaller, hist_small, hist_big)
-            new_right = jnp.where(left_smaller, hist_big, hist_small)
+            if merged_hist:
+                # one kernel: partition + BOTH children's histograms from
+                # the same row pass (no parent hist, no subtraction, no
+                # pool).  Serial-only, so reduce_hist is identity.
+                payload, aux, nl_raw, new_left, new_right = part_hist_fn(
+                    st["payload"], st["aux"], start, count, pred,
+                    st["blo"][best_leaf], st["bro"][best_leaf])
+                nr_raw = count - nl_raw
+            else:
+                # parent histogram: read the pool slot, or rebuild it from
+                # the (still contiguous) parent segment if it was evicted
+                if pooled:
+                    # NOTE: the rebuild branch runs a collective in mesh
+                    # modes; the pool bookkeeping is replicated-in-value,
+                    # so every shard takes the same branch and the psum
+                    # pairs up
+                    pslot = st["slot_of_leaf"][best_leaf]
+                    hist_parent = lax.cond(
+                        pslot >= 0,
+                        lambda: st["hist"][jnp.maximum(pslot, 0)],
+                        lambda: reduce_hist(hist_fn(st["payload"], start,
+                                                    count)))
+                else:
+                    hist_parent = st["hist"][best_leaf]
+
+                payload, aux, nl_raw = part_fn(
+                    st["payload"], st["aux"], start, count, pred,
+                    st["blo"][best_leaf], st["bro"][best_leaf])
+                nr_raw = count - nl_raw
+
+                # histograms: build only the smaller child, derive the
+                # sibling by subtraction.  The choice uses masked counts
+                # (like grower.py and the reference's num_data comparison)
+                # so both growers build the direct histogram on the same
+                # child and stay bit-comparable.
+                left_smaller = lcnt <= rcnt
+                h_start = jnp.where(left_smaller, start, start + nl_raw)
+                h_count = jnp.where(left_smaller, nl_raw, nr_raw)
+                hist_small = reduce_hist(hist_fn(payload, h_start, h_count))
+                hist_big = hist_parent - hist_small
+                new_left = jnp.where(left_smaller, hist_small, hist_big)
+                new_right = jnp.where(left_smaller, hist_big, hist_small)
             if pooled:
                 slot_of_leaf = st["slot_of_leaf"]
                 leaf_of_slot = st["leaf_of_slot"]
@@ -417,7 +473,7 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                 slot_of_leaf = slot_of_leaf.at[s].set(rslot)
                 hist = st["hist"].at[lslot].set(new_left)
                 hist = hist.at[rslot].set(new_right)
-            else:
+            elif not merged_hist:
                 hist = st["hist"].at[best_leaf].set(new_left)
                 hist = hist.at[s].set(new_right)
 
@@ -463,7 +519,8 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             st_new = dict(st)
             st_new["payload"] = payload
             st_new["aux"] = aux
-            st_new["hist"] = hist
+            if not merged_hist:
+                st_new["hist"] = hist
             if pooled:
                 st_new["slot_of_leaf"] = slot_of_leaf
                 st_new["leaf_of_slot"] = leaf_of_slot

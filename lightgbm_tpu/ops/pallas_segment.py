@@ -92,6 +92,35 @@ HIST_REPEAT_VALIDATED = True
 PARTITION_ACC_ROLL_VALIDATED = True
 
 
+#: True once the merged partition+histogram kernel is hardware-validated:
+#: pass A of the accumulator partition already has every parent row in
+#: VMEM, so BOTH children's histograms fall out of one shared one-hot per
+#: tile (only the [8, C] value rows differ by side mask) — the separate
+#: per-split histogram kernel, its row reads, the parent histogram, the
+#: subtraction trick and the device histogram pool all become dead code.
+#: OFF until exp/smoke_tpu_kernels.py proves the Mosaic lowering on a
+#: real chip (round-4 discipline).
+PARTITION_HIST_VALIDATED = False
+
+
+def partition_hist_fits_vmem(payload_width: int, num_features: int,
+                             num_bins: int) -> bool:
+    """VMEM plan of the merged partition+histogram kernel: the acc
+    partition's plan plus the histogram tile machinery and TWO [8T, W]
+    part-accumulators (left + right child).  Higgs/MS-LTR shapes fit;
+    Expo-wide accumulators (88 tiles) overflow and fall back to the
+    split kernels."""
+    if num_bins > 256:
+        return False
+    ft, n_tiles, w = _tiling(num_features, num_bins)
+    P, C = payload_width, CHUNK
+    est_acc = (4 * P * 18 * C + 4 * 8 * C * C + 4 * C * num_bins)
+    est_hist = (2 * 4 * CHUNK * w              # expand/rep + one-hot tile
+                + 2 * 4 * 8 * n_tiles * w      # two child accumulators
+                + 4 * ft * w)                  # window expander
+    return est_acc + est_hist <= _VMEM_BUDGET
+
+
 def partition_acc_fits_vmem(payload_width: int, num_bins: int) -> bool:
     """VMEM plan of the accumulator-window partition kernel: read ring,
     two [2C, P] accumulators, stage/blend buffers, the P-wide placement
@@ -373,10 +402,14 @@ def _segment_histogram(payload, start, count, *, num_features, num_bins,
         out_shape=jax.ShapeDtypeStruct((8 * n_tiles, W), jnp.float32),
         interpret=interpret,
     )(scalars, payload)
-    # [8*T, W] -> [T, 8, W]; rows are the exact bf16 part-decomposition
-    # (g_hi, g_mid, g_lo, h_hi, h_mid, h_lo, cnt) — recombine, then
-    # untile to [F, B, 3] (feature-major windows in matmul mode, bin-major
-    # [B, fw] blocks in repeat mode)
+    return _untile_hist(out, F, B, Ft, n_tiles, W, expand_impl)
+
+
+def _untile_hist(out, F, B, Ft, n_tiles, W, expand_impl):
+    """[8*T, W] kernel accumulator -> [F, B, 3].  Rows are the exact bf16
+    part-decomposition (g_hi, g_mid, g_lo, h_hi, h_mid, h_lo, cnt) —
+    recombine, then untile (feature-major windows in matmul mode,
+    bin-major [B, fw] blocks in repeat mode)."""
     r = out.reshape(n_tiles, 8, W)
     ghc = jnp.stack([r[:, 0] + r[:, 1] + r[:, 2],
                      r[:, 3] + r[:, 4] + r[:, 5],
@@ -596,9 +629,8 @@ C2 = 2 * CHUNK
 
 
 def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
-                payload_out, aux_out, nl_out,
-                ring, lacc, racc, stage, rbuf, sem_ring, sem_w, sem_r, *,
-                P, B, value_col, roll_place=False):
+                payload_out, aux_out, nl_out, *rest,
+                P, B, value_col, roll_place=False, hist_cfg=None):
     """Accumulator-window partition: same contract as `_partition_kernel`,
     restructured around the measured bottleneck (per-chunk latency, not
     bandwidth).  Lefts and rights accumulate in VMEM windows [2C, P] that
@@ -609,7 +641,22 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
     on a double-buffered ring, and exactness costs three ONE-pass matmuls
     on a bf16-exact hi/mid/lo decomposition instead of a 6-pass HIGHEST.
     Only the LAST window of a segment needs a blend read (its tail crosses
-    into the next leaf's rows)."""
+    into the next leaf's rows).
+
+    With `hist_cfg` set (the merged partition+hist kernel), pass A also
+    accumulates BOTH children's histograms from the resident ring chunks:
+    the per-tile one-hot is shared (bins don't depend on the side), only
+    the [8, C] part-value rows are masked per side — so two extra [8, W]
+    contractions per tile buy both child histograms with ZERO extra HBM
+    row traffic, retiring the separate per-split histogram kernel, the
+    parent histogram, the subtraction trick and the device histogram pool
+    (reference FeatureHistogram::Subtract / HistogramPool,
+    feature_histogram.hpp:505-826, folded into the partition walk)."""
+    if hist_cfg is None:
+        (ring, lacc, racc, stage, rbuf, sem_ring, sem_w, sem_r) = rest
+    else:
+        (hl_ref, hr_ref, ring, lacc, racc, stage, rbuf,
+         sem_ring, sem_w, sem_r) = rest
     start = scalars[0]
     count = scalars[1]
     left_value = fvals[0]
@@ -705,6 +752,88 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
             sem).start()
         acc[0:CHUNK] = acc[CHUNK:C2]
 
+    if hist_cfg is not None:
+        # one-hot machinery identical to _hist_kernel (see the notes
+        # there); built once before the chunk loop, shared by both sides
+        Fh, Bh = hist_cfg["F"], hist_cfg["B"]
+        Fth, Wh = hist_cfg["Ft"], hist_cfg["W"]
+        n_tiles_h = -(-Fh // Fth)
+        h_expand = hist_cfg["expand_impl"]
+        gcol, hcol, ccol = (hist_cfg["grad_col"], hist_cfg["hess_col"],
+                            hist_cfg["cnt_col"])
+        hl_ref[:] = jnp.zeros(hl_ref.shape, hl_ref.dtype)
+        hr_ref[:] = jnp.zeros(hr_ref.shape, hr_ref.dtype)
+        if h_expand == "repeat":
+            jdivs = {}
+            for t in range(n_tiles_h):
+                fw = min(Fth, Fh - t * Fth)
+                if fw not in jdivs:
+                    jdivs[fw] = (lax.broadcasted_iota(
+                        jnp.int32, (1, fw * Bh), 1) // fw).astype(jnp.float32)
+        else:
+            iota_fr = lax.broadcasted_iota(jnp.int32, (Fth, Wh), 0)
+            iota_fc = lax.broadcasted_iota(jnp.int32, (Fth, Wh), 1)
+            dwin = iota_fc - iota_fr * Bh
+            in_win = (dwin >= 0) & (dwin < Bh)
+            E = in_win.astype(jnp.float32)                       # [Ft, W]
+            jmod_f = jnp.sum(jnp.where(in_win, dwin, 0),
+                             axis=0).astype(jnp.float32)         # [W]
+        iota_r8 = lax.broadcasted_iota(jnp.int32, (8, P), 0)
+        iota_pc8 = lax.broadcasted_iota(jnp.int32, (8, P), 1)
+        sel8 = (((iota_r8 < 3) & (iota_pc8 == gcol)) |
+                ((iota_r8 >= 3) & (iota_r8 < 6) & (iota_pc8 == hcol)) |
+                ((iota_r8 == 6) & (iota_pc8 == ccol))).astype(jnp.float32)
+
+        def hist_accumulate(data, gl, keep_r):
+            """Both children's part-histograms from the resident chunk:
+            one shared one-hot per tile, one [8, W] contraction per side.
+            Rows are (g_hi, g_mid, g_lo, h_hi, h_mid, h_lo, cnt) exact
+            bf16 parts — same exactness argument as _hist_kernel."""
+            raw = lax.dot_general(
+                sel8, data, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=lax.Precision.HIGHEST)                 # [8, C]
+            hi = raw.astype(jnp.bfloat16).astype(jnp.float32)
+            r1 = raw - hi
+            mid = r1.astype(jnp.bfloat16).astype(jnp.float32)
+            lo = r1 - mid
+            rr = lax.broadcasted_iota(jnp.int32, raw.shape, 0)
+            vals = jnp.where((rr == 0) | (rr == 3), hi,
+                             jnp.where((rr == 1) | (rr == 4), mid,
+                                       jnp.where((rr == 2) | (rr == 5), lo,
+                                                 raw)))
+            vl = vals * gl.astype(jnp.float32)[None, :]
+            vr = vals * keep_r.astype(jnp.float32)[None, :]
+            for t in range(n_tiles_h):
+                f0 = t * Fth
+                fw = min(Fth, Fh - f0)
+                binsf = data[:, f0:f0 + fw]                      # [C, fw]
+                if h_expand == "repeat":
+                    rep = pltpu.repeat(binsf, Bh, axis=1)
+                    onehot = (rep == jdivs[fw]).astype(jnp.float32)
+                    hl_ref[8 * t:8 * t + 8, :fw * Bh] += lax.dot_general(
+                        vl, onehot,
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                    hr_ref[8 * t:8 * t + 8, :fw * Bh] += lax.dot_general(
+                        vr, onehot,
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                else:
+                    expand = lax.dot_general(
+                        binsf, E[:fw, :],
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)      # [C, W]
+                    onehot = (expand == jmod_f[None, :]).astype(jnp.float32)
+                    hl_ref[8 * t:8 * t + 8, :] += lax.dot_general(
+                        vl, onehot,
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                    hr_ref[8 * t:8 * t + 8, :] += lax.dot_general(
+                        vr, onehot,
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+
     @pl.when(nch > 0)
     def _prefetch_first():
         ring_dma(payload_out, 0, 0).start()
@@ -731,6 +860,8 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
 
         gl = go_left(data, k)
         keep_r = valid_mask(k) - gl
+        if hist_cfg is not None:
+            hist_accumulate(data, gl, keep_r)
         nlk = jnp.sum(gl)
         nrk = jnp.sum(keep_r)
         rank_l = rank_of(gl)
@@ -905,3 +1036,89 @@ def _partition_segment_acc(payload, aux, start, count, pred, left_value,
         interpret=interpret,
     )(scalars, fvals, bitset, payload, aux)
     return payload_new, aux_new, nl[0]
+
+
+def partition_segment_hist(payload, aux, start, count, pred, left_value,
+                           right_value, value_col, num_bins, *,
+                           num_features, grad_col, hess_col, cnt_col,
+                           interpret=False, roll_place=None,
+                           expand_impl=None):
+    """Merged partition + both-child histograms (one kernel, one read of
+    the split leaf's rows).  Same partition contract as
+    `partition_segment_acc`, plus the two children's [F, B, 3] histograms
+    — the device-side subtraction trick and histogram pool become
+    unnecessary for callers of this kernel.  Flag defaults resolve
+    OUTSIDE the jit cache (see partition_segment_acc)."""
+    if roll_place is None:
+        roll_place = PARTITION_ACC_ROLL_VALIDATED
+    if expand_impl is None:
+        expand_impl = ("repeat" if HIST_REPEAT_VALIDATED
+                       and num_features * num_bins <= REPEAT_MAX_FB
+                       else "matmul")
+    return _partition_segment_hist(payload, aux, start, count, pred,
+                                   left_value, right_value, value_col,
+                                   num_bins, num_features, grad_col,
+                                   hess_col, cnt_col, interpret,
+                                   bool(roll_place), expand_impl)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "value_col", "num_bins", "num_features", "grad_col", "hess_col",
+    "cnt_col", "interpret", "roll_place", "expand_impl"))
+def _partition_segment_hist(payload, aux, start, count, pred, left_value,
+                            right_value, value_col, num_bins, num_features,
+                            grad_col, hess_col, cnt_col, interpret,
+                            roll_place, expand_impl):
+    P = payload.shape[1]
+    B = num_bins
+    F = num_features
+    Ft, n_tiles, W = _tiling(F, B)
+    scalars = jnp.stack([
+        start, count, pred.col, pred.threshold,
+        pred.default_left.astype(jnp.int32), pred.is_cat.astype(jnp.int32),
+        pred.missing_type, pred.num_bin, pred.default_bin,
+        pred.offset, pred.identity.astype(jnp.int32),
+    ]).astype(jnp.int32)
+    fvals = jnp.stack([left_value, right_value]).astype(jnp.float32)
+    bitset = pred.bitset.astype(jnp.int32).reshape(1, B)
+    hist_cfg = dict(F=F, B=B, Ft=Ft, W=W, grad_col=grad_col,
+                    hess_col=hess_col, cnt_col=cnt_col,
+                    expand_impl=expand_impl)
+    kern = functools.partial(_acc_kernel, P=P, B=B, value_col=value_col,
+                             roll_place=roll_place, hist_cfg=hist_cfg)
+    payload_new, aux_new, nl, hl, hr = pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pltpu.SMEM),
+                       pl.BlockSpec(memory_space=pltpu.VMEM),
+                       pl.BlockSpec(memory_space=pltpu.VMEM)),
+            scratch_shapes=[
+                pltpu.VMEM((2, CHUNK, P), jnp.float32),   # read ring
+                pltpu.VMEM((C2, P), jnp.float32),         # left accumulator
+                pltpu.VMEM((C2, P), jnp.float32),         # right accumulator
+                pltpu.VMEM((CHUNK, P), jnp.float32),      # flush stage
+                pltpu.VMEM((CHUNK, P), jnp.float32),      # final blend read
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+        ),
+        out_shape=(jax.ShapeDtypeStruct(payload.shape, payload.dtype),
+                   jax.ShapeDtypeStruct(aux.shape, aux.dtype),
+                   jax.ShapeDtypeStruct((1,), jnp.int32),
+                   jax.ShapeDtypeStruct((8 * n_tiles, W), jnp.float32),
+                   jax.ShapeDtypeStruct((8 * n_tiles, W), jnp.float32)),
+        input_output_aliases={3: 0, 4: 1},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(scalars, fvals, bitset, payload, aux)
+    hist_l = _untile_hist(hl, F, B, Ft, n_tiles, W, expand_impl)
+    hist_r = _untile_hist(hr, F, B, Ft, n_tiles, W, expand_impl)
+    return payload_new, aux_new, nl[0], hist_l, hist_r

@@ -200,3 +200,52 @@ def test_histogram_pool_recompute_matches():
     tiny = lgb.train({**params, "histogram_pool_size": 0.025},
                      lgb.Dataset(X, label=y), num_boost_round=8)
     assert_models_equivalent(tiny.model_to_string(), full.model_to_string())
+
+
+def test_merged_hist_mode_same_tree():
+    """merged_hist=True (partition emits both child histograms directly;
+    no parent hist, no subtraction, no pool) must grow the same tree as
+    the default subtraction engine — direct child sums only differ from
+    parent-minus-sibling at ulp level, which a benign problem never
+    turns into a structure flip."""
+    X, y = _make_problem(seed=13)
+    config = Config({"objective": "binary", "max_bin": 63,
+                     "num_leaves": 31, "min_data_in_leaf": 20})
+    ds = BinnedDataset.from_matrix(X, config, row_chunk=1024)
+    meta = _feature_meta_device(ds)
+    n_pad = ds.num_data_padded
+    gcfg = GrowerConfig(num_leaves=31, max_depth=-1, lambda_l1=0.0,
+                        lambda_l2=0.1, max_delta_step=0.0,
+                        min_data_in_leaf=20,
+                        min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0,
+                        row_chunk=n_pad, with_categorical=False)
+    n = len(y)
+    grad = np.zeros(n_pad, np.float32)
+    hess = np.zeros(n_pad, np.float32)
+    grad[:n] = 0.5 - y
+    hess[:n] = 0.25
+    mask = np.zeros(n_pad, np.float32)
+    mask[:n] = 1.0
+    F = ds.num_features
+    cols = PayloadCols(grad=F, hess=F + 1, cnt=F + 2, value=F + 3)
+    P = F + 4
+    payload = np.zeros((n_pad + seg.GUARD, P), np.float32)
+    payload[:n_pad, :F] = ds.bins.T
+    payload[:n_pad, cols.grad] = grad * mask
+    payload[:n_pad, cols.hess] = hess * mask
+    payload[:n_pad, cols.cnt] = mask
+    fmask = jnp.ones(F, bool)
+    outs = []
+    for merged in (False, True):
+        grow = make_partitioned_grower(meta, gcfg, ds.max_num_bin, cols, F,
+                                       merged_hist=merged)
+        tree, _, _ = grow(jnp.asarray(payload),
+                          jnp.zeros_like(jnp.asarray(payload)), fmask)
+        outs.append(jax.device_get(tree))
+    _assert_same_tree(outs[0], outs[1])
+    nl = int(outs[0]["num_leaves"])
+    assert nl > 4
+    np.testing.assert_array_equal(outs[0]["seg_start"][:nl],
+                                  outs[1]["seg_start"][:nl])
+    np.testing.assert_array_equal(outs[0]["seg_cnt"][:nl],
+                                  outs[1]["seg_cnt"][:nl])

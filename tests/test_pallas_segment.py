@@ -219,3 +219,74 @@ def test_payload_col_write_matches_dus():
 
     np.testing.assert_array_equal(
         via_traced_col(pay, jnp.int32(4), vec), pay.at[:, 4].add(vec))
+
+
+@pytest.mark.parametrize("start,count", [(0, 1000), (256, 700), (100, 37),
+                                         (513, 256), (7, 1), (0, 0)])
+@pytest.mark.parametrize("expand", ["matmul", "repeat"])
+def test_partition_hist_merged(start, count, expand):
+    """Merged partition+hist kernel: the partition must match the portable
+    engine exactly, and both child histograms must match portable segment
+    walks over the partitioned payload."""
+    pay = _payload(1024, seed=start + count + 1)
+    aux = jnp.zeros_like(pay)
+    pred = _pred(feature=1, threshold=B // 2)
+    lv, rv = jnp.float32(-0.25), jnp.float32(0.75)
+    p2, _, nl, hl, hr = pseg.partition_segment_hist(
+        pay, aux, jnp.int32(start), jnp.int32(count), pred, lv, rv,
+        VALUE_COL, B, num_features=F, interpret=True, expand_impl=expand,
+        **COLS)
+    pr, _, nlr = seg.partition_segment(
+        pay, aux, jnp.int32(start), jnp.int32(count), pred, lv, rv,
+        VALUE_COL)
+    assert int(nl) == int(nlr)
+    np.testing.assert_allclose(np.asarray(p2), np.asarray(pr),
+                               rtol=1e-6, atol=0)
+    hlr = seg.segment_histogram(pr, jnp.int32(start), nlr,
+                                num_features=F, num_bins=B, **COLS)
+    hrr = seg.segment_histogram(pr, jnp.int32(start) + nlr,
+                                jnp.int32(count) - nlr,
+                                num_features=F, num_bins=B, **COLS)
+    np.testing.assert_allclose(np.asarray(hl), np.asarray(hlr),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(hr), np.asarray(hrr),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_partition_hist_flag_staged_off():
+    """The merged kernel stays OFF until hardware-validated (round-4
+    discipline: interpret mode proves nothing about Mosaic legality), and
+    its VMEM gate admits Higgs/MS-LTR but not Expo-wide accumulators."""
+    assert pseg.PARTITION_HIST_VALIDATED is False
+    assert pseg.partition_hist_fits_vmem(128, 28, 256)    # Higgs
+    assert pseg.partition_hist_fits_vmem(128, 137, 64)    # MS-LTR @ 64 bins
+    # MS-LTR at 256 bins (13.1M plan) and Expo-wide (88 tiles) exceed the
+    # budget and fall back to the split acc-partition + hist kernels
+    assert not pseg.partition_hist_fits_vmem(256, 137, 256)
+    assert not pseg.partition_hist_fits_vmem(896, 700, 256)
+
+
+@pytest.mark.parametrize("expand", ["matmul", "repeat"])
+def test_partition_hist_matches_hist_kernel(expand):
+    """The merged kernel's tile machinery is a sibling copy of
+    _hist_kernel's (a trace-time share was rejected: _hist_kernel is
+    hardware-validated and must not be restructured blind) — this pins
+    the two against each other so divergence is loud."""
+    pay = _payload(1024, seed=42)
+    aux = jnp.zeros_like(pay)
+    pred = _pred(feature=2, threshold=B // 3)
+    p2, _, nl, hl, hr = pseg.partition_segment_hist(
+        pay, aux, jnp.int32(64), jnp.int32(900), pred, jnp.float32(1.0),
+        jnp.float32(-1.0), VALUE_COL, B, num_features=F, interpret=True,
+        expand_impl=expand, **COLS)
+    hl_k = pseg.segment_histogram(p2, jnp.int32(64), nl, num_features=F,
+                                  num_bins=B, interpret=True,
+                                  expand_impl=expand, **COLS)
+    hr_k = pseg.segment_histogram(p2, jnp.int32(64) + nl,
+                                  jnp.int32(900) - nl, num_features=F,
+                                  num_bins=B, interpret=True,
+                                  expand_impl=expand, **COLS)
+    np.testing.assert_allclose(np.asarray(hl), np.asarray(hl_k),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hr), np.asarray(hr_k),
+                               rtol=1e-5, atol=1e-5)
