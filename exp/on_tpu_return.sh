@@ -5,10 +5,12 @@ set -e -o pipefail
 cd "$(dirname "$0")/.."
 echo "=== 1. kernels exact vs portable (incl. the 2-pass partition) ==="
 timeout 400 python exp/smoke_tpu_kernels.py 2>&1 | grep -vE "WARN|INFO|libtpu|common_lib|Failed to find|Logging" | tail -8
-echo "=== 1b. IF step 1 was green: flip the validated kernel flags ==="
-echo "   (manual decision: inspect the smoke's ACC/REPEAT sections, then"
-echo "    python exp/flip_validated.py acc roll repeat   # as validated"
-echo "    and re-run this script so steps 2+ measure the flipped kernels)"
+echo "=== 1b. IF step 1 was green: flip remaining validated kernel flags ==="
+echo "   (acc/roll/repeat were validated + flipped in round 4's second"
+echo "    window; the MERGED partition+hist kernel is the staged one now:"
+echo "    inspect the smoke's MERGED PART+HIST section, then"
+echo "    python exp/flip_validated.py merged"
+echo "    and re-run this script so steps 2+ measure the flipped kernel)"
 echo "=== 2. grower profile (fixed cost + scaling) ==="
 timeout 500 python exp/prof_grow_small.py 2>&1 | grep "grow:" || true
 echo "=== 3. bench at 2M rows ==="
