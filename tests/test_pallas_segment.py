@@ -292,3 +292,40 @@ def test_partition_hist_matches_hist_kernel(expand):
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(hr), np.asarray(hr_k),
                                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("predkw", [
+    dict(is_cat=True, bitset=(np.arange(B) % 3 == 0)),
+    dict(feature=2, threshold=3, offset=5, identity=False, num_bin=9,
+         default_bin=0),
+    dict(missing_type=2, default_left=True, threshold=3),
+])
+def test_partition_hist_merged_predicates(predkw):
+    """Merged kernel under categorical-bitset, EFB-decode and
+    missing-routing predicates, with some rows bagged out (zeroed
+    grad/hess/cnt must contribute nothing to either child histogram while
+    the rows still move)."""
+    pay = np.array(_payload(1024, seed=99))   # writable copy
+    rng = np.random.default_rng(7)
+    out_bag = rng.random(1024) < 0.3
+    pay[:1024][out_bag, F:F + 3] = 0.0
+    pay = jnp.asarray(pay)
+    aux = jnp.zeros_like(pay)
+    pred = _pred(**predkw)
+    lv, rv = jnp.float32(0.5), jnp.float32(-0.5)
+    p2, _, nl, hl, hr = pseg.partition_segment_hist(
+        pay, aux, jnp.int32(0), jnp.int32(1024), pred, lv, rv,
+        VALUE_COL, B, num_features=F, interpret=True, **COLS)
+    pr, _, nlr = seg.partition_segment(
+        pay, aux, jnp.int32(0), jnp.int32(1024), pred, lv, rv, VALUE_COL)
+    assert int(nl) == int(nlr)
+    np.testing.assert_allclose(np.asarray(p2), np.asarray(pr),
+                               rtol=1e-6, atol=0)
+    hlr = seg.segment_histogram(pr, jnp.int32(0), nlr, num_features=F,
+                                num_bins=B, **COLS)
+    hrr = seg.segment_histogram(pr, nlr, jnp.int32(1024) - nlr,
+                                num_features=F, num_bins=B, **COLS)
+    np.testing.assert_allclose(np.asarray(hl), np.asarray(hlr),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(hr), np.asarray(hrr),
+                               rtol=1e-4, atol=1e-4)
