@@ -31,6 +31,18 @@ import numpy as np
 BASELINE_ITERS_PER_SEC = 500.0 / 238.51  # reference CPU Higgs
 REFERENCE_HIGGS_AUC = 0.845154           # @500 iters, real Higgs
 
+#: most recent bench measured on REAL TPU hardware (updated by hand after
+#: every hardware session).  Included in the CPU-fallback JSON so a
+#: dead-tunnel round still surfaces the verified on-chip state; the
+#: "platform" field of the main record stays honest about what THIS run
+#: measured.
+LAST_VERIFIED_TPU = {
+    "sec_per_iter": 1.311, "iters_per_sec": 0.763, "vs_baseline": 0.364,
+    "n_rows": 10_500_000, "n_features": 28, "num_leaves": 255,
+    "held_out_auc_at_13": 0.891144, "platform": "tpu v5e (1 chip)",
+    "measured": "2026-07-31, round 4 second hardware window",
+}
+
 
 def synth_higgs(n_rows: int, n_feat: int = 28, seed: int = 7):
     """Synthetic workload at a configurable shape (default: Higgs 28
@@ -246,6 +258,11 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
                        "program amortizes; sec_per_iter is the honest "
                        "steady-state number",
     }
+    if result["platform"] != "tpu":
+        # dead-tunnel fallback: carry the most recent REAL-hardware
+        # measurement alongside (clearly labeled; this run's own numbers
+        # above describe only what this run measured)
+        result["last_verified_tpu"] = LAST_VERIFIED_TPU
     return result
 
 
