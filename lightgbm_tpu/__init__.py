@@ -9,6 +9,7 @@ from .config import Config
 from .engine import CVBooster, cv, train
 from .plotting import (create_tree_digraph, plot_importance, plot_metric,
                        plot_tree)
+from .parallel.launch import init_distributed
 from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 from .utils.log import LightGBMError
 
@@ -20,4 +21,4 @@ __all__ = ["Dataset", "Booster", "Config", "train", "cv", "CVBooster",
            "record_evaluation", "reset_parameter",
            "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
            "plot_importance", "plot_metric", "plot_tree",
-           "create_tree_digraph"]
+           "create_tree_digraph", "init_distributed"]

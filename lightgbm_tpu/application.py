@@ -55,6 +55,7 @@ class Application:
         self.task = self.raw_params.pop("task", "train")
 
     def run(self) -> None:
+        self._maybe_init_network()
         if self.task == "train":
             self.train()
         elif self.task in ("predict", "prediction", "test"):
@@ -65,6 +66,22 @@ class Application:
             self.refit()
         else:
             Log.fatal("Unknown task type %s", self.task)
+
+    def _maybe_init_network(self) -> None:
+        """Reference CLI parity: a cluster config (machines= or
+        machine_list_filename=) brings the network up before the task
+        runs (application.cpp Network::Init) — here that is
+        jax.distributed over the same machine list."""
+        p = {Config.resolve_alias(k): v for k, v in self.raw_params.items()}
+        machines = p.get("machines", "")
+        mfile = p.get("machine_list_filename", "")
+        if not machines and not mfile:
+            return
+        from .parallel.launch import init_distributed
+        init_distributed(machines=machines or None,
+                         machine_list_filename=mfile or None,
+                         local_listen_port=int(p.get("local_listen_port",
+                                                     12400)))
 
     # -- data loading --------------------------------------------------------
     def _load(self, path: str, num_features: Optional[int] = None):

@@ -159,12 +159,7 @@ class Config:
         "gpu_platform_id": "no OpenCL on TPU; the visible TPU chips are used",
         "gpu_device_id": "no OpenCL on TPU; the visible TPU chips are used",
         "gpu_use_dp": "histogram accumulation is always f32 on the MXU",
-        "machines": "XLA/ICI owns transport; launch with jax.distributed",
-        "machine_list_filename":
-            "XLA/ICI owns transport; launch with jax.distributed",
-        "local_listen_port":
-            "XLA/ICI owns transport; launch with jax.distributed",
-        "time_out": "XLA/ICI owns transport; launch with jax.distributed",
+        "time_out": "XLA's transport owns connection timeouts",
         "is_enable_sparse":
             "EFB-then-densify policy is always used (docs/STORAGE.md)",
         "sparse_threshold":

@@ -1,0 +1,71 @@
+"""Multi-host launch: reference machine-list semantics -> jax.distributed.
+
+Role of the reference's Network::Init bootstrap (config `machines` /
+`machine_list_filename` / `local_listen_port`, src/network/): list
+parsing, rank-by-own-position resolution, and the single-machine
+early-out are testable on one host; the actual multi-process
+`jax.distributed.initialize` handshake needs real hosts.
+"""
+import socket
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.parallel.launch import (init_distributed,
+                                          parse_machine_list, resolve_rank)
+
+
+def test_parse_machines_string():
+    assert parse_machine_list("10.0.0.1:123,10.0.0.2:456") == [
+        ("10.0.0.1", 123), ("10.0.0.2", 456)]
+    # port defaults to local_listen_port, reference config.h default 12400
+    assert parse_machine_list("a,b", default_port=777) == [
+        ("a", 777), ("b", 777)]
+
+
+def test_parse_machine_list_file(tmp_path):
+    f = tmp_path / "mlist.txt"
+    f.write_text("# cluster\n10.0.0.1 123\n10.0.0.2:456\n\n")
+    assert parse_machine_list(machine_list_filename=str(f)) == [
+        ("10.0.0.1", 123), ("10.0.0.2", 456)]
+    with pytest.raises(ValueError):
+        parse_machine_list()
+
+
+def test_resolve_rank_explicit_and_env(monkeypatch):
+    mlist = [("a", 1), ("b", 2), ("c", 3)]
+    assert resolve_rank(mlist, node_rank=2) == 2
+    monkeypatch.setenv("LIGHTGBM_TPU_NODE_RANK", "1")
+    assert resolve_rank(mlist) == 1
+    with pytest.raises(ValueError):
+        resolve_rank(mlist, node_rank=3)
+
+
+def test_resolve_rank_by_local_address():
+    mlist = [("10.255.0.9", 1), (socket.gethostname(), 2)]
+    assert resolve_rank(mlist) == 1
+    mlist2 = [("127.0.0.1", 1), ("10.255.0.9", 2)]
+    assert resolve_rank(mlist2) == 0
+    with pytest.raises(ValueError):
+        resolve_rank([("10.255.0.9", 1)])
+
+
+def test_single_machine_early_out():
+    """num_machines==1 path: no coordinator needed (Network::Init
+    early-out) — and the public API surface exists."""
+    assert lgb.init_distributed is init_distributed
+    rank = init_distributed(machines="127.0.0.1:12400")
+    assert rank == 0
+
+
+def test_booster_with_single_machine_config():
+    """A reference-style single-machine cluster config on the Booster
+    trains normally (the binding's machines->NetworkInit path)."""
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((500, 5)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "machines": "127.0.0.1:12400"},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    assert bst.current_iteration() == 3

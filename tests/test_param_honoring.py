@@ -52,14 +52,25 @@ def test_unimplemented_params_warn(capsys):
     lgb_log.reset_log_level(lgb_log.LogLevel.WARNING)
     X, y = _data()
     lgb.train({"objective": "binary", "verbose": 0,
-               "machines": "10.0.0.1:123,10.0.0.2:123",
                "sparse_threshold": 0.5},
               lgb.Dataset(X, label=y), num_boost_round=1)
     err = capsys.readouterr()
     text = err.out + err.err
-    assert "machines is accepted but not implemented" in text
     assert "sparse_threshold is accepted but not implemented" in text
     lgb_log.reset_log_level(lgb_log.LogLevel.INFO)
+
+
+def test_machines_param_is_honored_not_warned():
+    """`machines` used to be accepted-but-warned; it now drives
+    jax.distributed bootstrap (parallel/launch.py).  A machine list that
+    does not contain this host fails fast — the reference's
+    Network::Init raises the same way on a bad machine list."""
+    import pytest
+    X, y = _data()
+    with pytest.raises(Exception, match="machine list"):
+        lgb.train({"objective": "binary", "verbose": -1,
+                   "machines": "10.255.0.1:123,10.255.0.2:123"},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
 
 
 def test_default_valued_unimplemented_params_stay_silent(capsys):
