@@ -55,7 +55,11 @@ class Application:
         self.task = self.raw_params.pop("task", "train")
 
     def run(self) -> None:
-        self._maybe_init_network()
+        if self.task in ("train", "refit"):
+            # reference parity: Network::Init runs inside InitTrain only
+            # (application.cpp:168-171) — predict/convert stay local even
+            # when the conf still carries the cluster's machine list
+            self._maybe_init_network()
         if self.task == "train":
             self.train()
         elif self.task in ("predict", "prediction", "test"):
@@ -68,20 +72,16 @@ class Application:
             Log.fatal("Unknown task type %s", self.task)
 
     def _maybe_init_network(self) -> None:
-        """Reference CLI parity: a cluster config (machines= or
-        machine_list_filename=) brings the network up before the task
-        runs (application.cpp Network::Init) — here that is
-        jax.distributed over the same machine list."""
+        """Reference CLI parity: a training task with a cluster config
+        brings the network up first (application.cpp Network::Init) —
+        here that is jax.distributed over the same machine list."""
+        from types import SimpleNamespace
+        from .parallel.launch import maybe_init_distributed
         p = {Config.resolve_alias(k): v for k, v in self.raw_params.items()}
-        machines = p.get("machines", "")
-        mfile = p.get("machine_list_filename", "")
-        if not machines and not mfile:
-            return
-        from .parallel.launch import init_distributed
-        init_distributed(machines=machines or None,
-                         machine_list_filename=mfile or None,
-                         local_listen_port=int(p.get("local_listen_port",
-                                                     12400)))
+        maybe_init_distributed(SimpleNamespace(
+            machines=p.get("machines", ""),
+            machine_list_filename=p.get("machine_list_filename", ""),
+            local_listen_port=p.get("local_listen_port", 12400)))
 
     # -- data loading --------------------------------------------------------
     def _load(self, path: str, num_features: Optional[int] = None):

@@ -385,16 +385,8 @@ class Booster:
             # reference-binding parity: a cluster config on the Booster
             # brings the network up (basic.py:1470 machines -> NetworkInit);
             # here that is jax.distributed over the same machine list
-            machines = getattr(self.config, "machines", "") or ""
-            mfile = getattr(self.config, "machine_list_filename", "") or ""
-            if machines or mfile:
-                from .parallel.launch import init_distributed
-                init_distributed(
-                    machines=machines or None,
-                    machine_list_filename=mfile or None,
-                    local_listen_port=int(getattr(self.config,
-                                                  "local_listen_port",
-                                                  12400)))
+            from .parallel.launch import maybe_init_distributed
+            maybe_init_distributed(self.config)
             train_set.construct(self.config)
             obj = self.config.objective
             self._objective = create_objective(obj, self.config) \

@@ -4,21 +4,22 @@
 The reference brings up its own socket/MPI collective network from
 `machines` / `machine_list_filename` + `local_listen_port`
 (src/network/linkers_socket.cpp: every host holds the full machine list;
-its rank is its own position in that list).  Here the transport is XLA's
-— ICI within a pod slice, DCN across hosts — and the only bootstrap
-needed is `jax.distributed.initialize(coordinator, num_processes,
-process_id)`.  This module performs the same list -> (coordinator, rank)
-resolution the reference performs, so a reference-style cluster config
-launches a JAX multi-host run unchanged:
+its rank is the position of its own ip:port pair in that list).  Here
+the transport is XLA's — ICI within a pod slice, DCN across hosts — and
+the only bootstrap needed is `jax.distributed.initialize(coordinator,
+num_processes, process_id)`.  This module performs the same
+list -> (coordinator, rank) resolution, so a reference-style cluster
+config launches a JAX multi-host run unchanged:
 
     import lightgbm_tpu as lgb
     lgb.init_distributed(machines="10.0.0.1:12400,10.0.0.2:12400")
     # ... then ordinary lgb.train(params with tree_learner=data ...)
 
-Rank resolution order (reference: Network::Init matches local IPs
-against the list): an explicit `node_rank` argument, the
+Rank resolution order: an explicit `node_rank` argument, the
 LIGHTGBM_TPU_NODE_RANK environment variable, then matching this host's
-addresses against the machine list.
+addresses against the list (ties between several local entries — the
+same-host multi-process layout — break on `local_listen_port`, exactly
+the reference's ip AND port match, linkers_socket.cpp:37).
 """
 from __future__ import annotations
 
@@ -28,7 +29,8 @@ from typing import List, Optional, Tuple
 
 from ..utils.log import Log
 
-__all__ = ["parse_machine_list", "resolve_rank", "init_distributed"]
+__all__ = ["parse_machine_list", "resolve_rank", "init_distributed",
+           "maybe_init_distributed"]
 
 
 def parse_machine_list(machines: str = None,
@@ -43,8 +45,11 @@ def parse_machine_list(machines: str = None,
         entries = [m.strip() for m in machines.split(",") if m.strip()]
     elif machine_list_filename:
         with open(machine_list_filename) as fh:
-            entries = [ln.strip().replace(" ", ":") for ln in fh
-                       if ln.strip() and not ln.startswith("#")]
+            for ln in fh:
+                ln = ln.strip()
+                if not ln or ln.startswith("#"):
+                    continue
+                entries.append(":".join(ln.replace(":", " ").split()))
     if not entries:
         raise ValueError(
             "init_distributed needs `machines` or `machine_list_filename`")
@@ -69,11 +74,13 @@ def _local_addresses() -> set:
 
 
 def resolve_rank(machine_list: List[Tuple[str, int]],
-                 node_rank: Optional[int] = None) -> int:
-    """This process's rank = its machine's position in the list (the
-    reference's Network::Init semantics).  Explicit node_rank (arg or
-    LIGHTGBM_TPU_NODE_RANK) wins; otherwise local interface addresses
-    are matched against the list."""
+                 node_rank: Optional[int] = None,
+                 local_listen_port: Optional[int] = None) -> int:
+    """This process's rank = the position of its own ip:port pair in the
+    list (reference Network::Init / linkers_socket.cpp:37).  Explicit
+    node_rank (arg or LIGHTGBM_TPU_NODE_RANK) wins; otherwise local
+    interface addresses are matched, with ties between several local
+    entries (same-host multi-process) broken by `local_listen_port`."""
     if node_rank is None and os.environ.get("LIGHTGBM_TPU_NODE_RANK"):
         node_rank = int(os.environ["LIGHTGBM_TPU_NODE_RANK"])
     if node_rank is not None:
@@ -82,17 +89,47 @@ def resolve_rank(machine_list: List[Tuple[str, int]],
                              % (node_rank, len(machine_list)))
         return node_rank
     local = _local_addresses()
-    for i, (host, _port) in enumerate(machine_list):
+
+    def is_local(host: str) -> bool:
         if host in local:
-            return i
+            return True
         try:
-            if socket.gethostbyname(host) in local:
-                return i
+            return socket.gethostbyname(host) in local
         except OSError:
-            continue
+            return False
+
+    matches = [i for i, (host, _p) in enumerate(machine_list)
+               if is_local(host)]
+    if len(matches) > 1 and local_listen_port is not None:
+        port_matches = [i for i in matches
+                        if machine_list[i][1] == local_listen_port]
+        if len(port_matches) == 1:
+            return port_matches[0]
+        raise ValueError(
+            "several machine-list entries are this host and "
+            "local_listen_port=%s does not pick exactly one of %r; "
+            "pass node_rank= or set LIGHTGBM_TPU_NODE_RANK"
+            % (local_listen_port, [machine_list[i] for i in matches]))
+    if matches:
+        if len(matches) > 1:
+            raise ValueError(
+                "several machine-list entries are this host %r; set "
+                "local_listen_port per process, or node_rank= / "
+                "LIGHTGBM_TPU_NODE_RANK"
+                % ([machine_list[i] for i in matches],))
+        return matches[0]
     raise ValueError(
         "none of this host's addresses appear in the machine list %r; "
         "pass node_rank= or set LIGHTGBM_TPU_NODE_RANK" % (machine_list,))
+
+
+def _already_initialized() -> bool:
+    import jax
+    try:
+        return bool(jax.distributed.is_initialized())
+    except AttributeError:   # older jax: probe the client directly
+        from jax._src import distributed as _dist
+        return _dist.global_state.client is not None
 
 
 def init_distributed(machines: str = None,
@@ -107,25 +144,24 @@ def init_distributed(machines: str = None,
     tree learners (`tree_learner=data|voting|feature`) shard over all of
     them; `num_machines` then counts DEVICES, not hosts
     (docs/DISTRIBUTED.md documents the deliberate divergence)."""
+    import jax
+    if _already_initialized():
+        # idempotent (cv folds, repeated Boosters): keep the live cluster
+        # — and skip the DNS walk of the machine list entirely
+        Log.info("jax.distributed already initialized; keeping the "
+                 "existing cluster")
+        from jax._src import distributed as _dist
+        pid = getattr(_dist.global_state, "process_id", 0)
+        return int(pid or 0)
     mlist = parse_machine_list(machines, machine_list_filename,
                                default_port=local_listen_port)
-    rank = resolve_rank(mlist, node_rank)
-    coord = "%s:%d" % mlist[0]
-    import jax
-    try:
-        already = jax.distributed.is_initialized()
-    except AttributeError:   # older jax: probe the client directly
-        from jax._src import distributed as _dist
-        already = _dist.global_state.client is not None
-    if already:
-        Log.info("jax.distributed already initialized; keeping the "
-                 "existing cluster (rank request was %d)", rank)
-        return rank
     if len(mlist) == 1:
         # single machine: nothing to coordinate — exactly the reference's
         # num_machines==1 no-network path (Network::Init early-out)
         Log.info("machine list has one entry; skipping jax.distributed")
         return 0
+    rank = resolve_rank(mlist, node_rank, local_listen_port)
+    coord = "%s:%d" % mlist[0]
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=len(mlist),
                                process_id=rank)
@@ -133,3 +169,18 @@ def init_distributed(machines: str = None,
              "%d devices visible", len(mlist), rank, coord,
              len(jax.devices()))
     return rank
+
+
+def maybe_init_distributed(cfg) -> Optional[int]:
+    """Shared Booster/CLI gate: bring the network up from a Config-like
+    object iff it actually describes a multi-machine run.  The reference
+    only calls Network::Init when is_parallel (application.cpp:168-171)
+    — a single-entry machine list or an absent one is the local path."""
+    machines = getattr(cfg, "machines", "") or ""
+    mfile = getattr(cfg, "machine_list_filename", "") or ""
+    if not machines and not mfile:
+        return None
+    port = int(getattr(cfg, "local_listen_port", 12400) or 12400)
+    return init_distributed(machines=machines or None,
+                            machine_list_filename=mfile or None,
+                            local_listen_port=port)

@@ -26,11 +26,27 @@ def test_parse_machines_string():
 
 def test_parse_machine_list_file(tmp_path):
     f = tmp_path / "mlist.txt"
-    f.write_text("# cluster\n10.0.0.1 123\n10.0.0.2:456\n\n")
+    # tabs, runs of spaces and indented comments must all parse
+    f.write_text("# cluster\n10.0.0.1 123\n10.0.0.2:456\n"
+                 "10.0.0.3\t789\n10.0.0.4   321\n   # standby\n\n")
     assert parse_machine_list(machine_list_filename=str(f)) == [
-        ("10.0.0.1", 123), ("10.0.0.2", 456)]
+        ("10.0.0.1", 123), ("10.0.0.2", 456), ("10.0.0.3", 789),
+        ("10.0.0.4", 321)]
     with pytest.raises(ValueError):
         parse_machine_list()
+
+
+def test_resolve_rank_same_host_port_tiebreak():
+    """Same-host multi-process lists (reference-valid: two workers on one
+    ip, distinct local_listen_ports) rank by the port match
+    (linkers_socket.cpp:37 matches ip AND port)."""
+    mlist = [("127.0.0.1", 12400), ("127.0.0.1", 12401)]
+    assert resolve_rank(mlist, local_listen_port=12401) == 1
+    assert resolve_rank(mlist, local_listen_port=12400) == 0
+    with pytest.raises(ValueError, match="several"):
+        resolve_rank(mlist)           # ambiguous without a port
+    with pytest.raises(ValueError, match="does not pick exactly one"):
+        resolve_rank(mlist, local_listen_port=9999)
 
 
 def test_resolve_rank_explicit_and_env(monkeypatch):
