@@ -227,12 +227,21 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
     pred = bst.predict(Xte, device=True)
     test_auc = float(auc_score(yte, pred))
     stage("predict+auc done")
-    try:
-        phases = phase_times(bst)
-        stage("phases done")
-    except Exception as e:
-        phases = {"error": "%s: %s" % (type(e).__name__, e)}
-        stage("phases FAILED (diagnostics only): %s" % phases["error"])
+    if n_rows > 5_000_000 and os.environ.get("BENCH_PHASES") != "1":
+        # the piecewise section compiles the standalone stage programs; a
+        # full-scale run crashed the tunneled TPU worker twice at/after
+        # this point while the training loop itself was clean — keep the
+        # diagnostics opt-in at full scale until the stage trail pins it
+        phases = {"skipped": "full-scale piecewise diagnostics are opt-in "
+                             "(BENCH_PHASES=1); see ROUND4_NOTES.md"}
+        stage("phases skipped at full scale")
+    else:
+        try:
+            phases = phase_times(bst)
+            stage("phases done")
+        except Exception as e:
+            phases = {"error": "%s: %s" % (type(e).__name__, e)}
+            stage("phases FAILED (diagnostics only): %s" % phases["error"])
 
     eng = bst._engine
     result = {
