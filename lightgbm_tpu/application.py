@@ -81,7 +81,8 @@ class Application:
         maybe_init_distributed(SimpleNamespace(
             machines=p.get("machines", ""),
             machine_list_filename=p.get("machine_list_filename", ""),
-            local_listen_port=p.get("local_listen_port", 12400)))
+            local_listen_port=p.get("local_listen_port", 12400),
+            num_machines=p.get("num_machines", 1)))
 
     # -- data loading --------------------------------------------------------
     def _load(self, path: str, num_features: Optional[int] = None):

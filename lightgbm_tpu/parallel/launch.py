@@ -174,12 +174,23 @@ def init_distributed(machines: str = None,
 def maybe_init_distributed(cfg) -> Optional[int]:
     """Shared Booster/CLI gate: bring the network up from a Config-like
     object iff it actually describes a multi-machine run.  The reference
-    only calls Network::Init when is_parallel (application.cpp:168-171)
-    — a single-entry machine list or an absent one is the local path."""
+    only calls Network::Init when is_parallel — `num_machines > 1`
+    (application.cpp:168-171; config.cpp CheckParamConflict): its own
+    example confs carry `machine_list_file = mlist.txt` next to
+    `num_machines = 1` and never read the file.  An inline `machines`
+    list implies the count like the reference binding does
+    (python-package basic.py:1470-1475 derives num_machines from it)."""
     machines = getattr(cfg, "machines", "") or ""
     mfile = getattr(cfg, "machine_list_filename", "") or ""
     if not machines and not mfile:
         return None
+    num_machines = int(getattr(cfg, "num_machines", 1) or 1)
+    if machines:
+        num_machines = max(num_machines,
+                           len([m for m in machines.split(",")
+                                if m.strip()]))
+    if num_machines <= 1:
+        return None   # reference is_parallel gate: the local path
     port = int(getattr(cfg, "local_listen_port", 12400) or 12400)
     return init_distributed(machines=machines or None,
                             machine_list_filename=mfile or None,

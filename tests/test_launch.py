@@ -85,3 +85,19 @@ def test_booster_with_single_machine_config():
                      "machines": "127.0.0.1:12400"},
                     lgb.Dataset(X, label=y), num_boost_round=3)
     assert bst.current_iteration() == 3
+
+
+def test_machine_list_file_ignored_when_num_machines_1():
+    """The reference's own example confs set machine_list_file=mlist.txt
+    NEXT TO num_machines=1 — Network::Init is gated on is_parallel, so
+    the file is never read (it need not even exist).  Round-4 regression:
+    the first launch wiring opened it unconditionally and broke every
+    consistency test."""
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((400, 5)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "machine_list_filename": "this_file_does_not_exist.txt",
+                     "num_machines": 1, "local_listen_port": 12400},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    assert bst.current_iteration() == 2
