@@ -75,14 +75,9 @@ class Application:
         """Reference CLI parity: a training task with a cluster config
         brings the network up first (application.cpp Network::Init) —
         here that is jax.distributed over the same machine list."""
-        from types import SimpleNamespace
         from .parallel.launch import maybe_init_distributed
-        p = {Config.resolve_alias(k): v for k, v in self.raw_params.items()}
-        maybe_init_distributed(SimpleNamespace(
-            machines=p.get("machines", ""),
-            machine_list_filename=p.get("machine_list_filename", ""),
-            local_listen_port=p.get("local_listen_port", 12400),
-            num_machines=p.get("num_machines", 1)))
+        maybe_init_distributed({Config.resolve_alias(k): v
+                                for k, v in self.raw_params.items()})
 
     # -- data loading --------------------------------------------------------
     def _load(self, path: str, num_features: Optional[int] = None):

@@ -353,17 +353,20 @@ def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
 REPEAT_MAX_FB = 16384
 
 
+def _default_expand_impl(num_features: int, num_bins: int) -> str:
+    """Shared flag+shape default for every kernel with a one-hot expand
+    stage; resolved OUTSIDE the jit caches so a flag flip takes effect on
+    warm traces."""
+    return ("repeat" if HIST_REPEAT_VALIDATED
+            and num_features * num_bins <= REPEAT_MAX_FB else "matmul")
+
+
 def segment_histogram(payload, start, count, *, num_features, num_bins,
                       grad_col, hess_col, cnt_col, interpret=False,
                       expand_impl=None):
-    """hist[F, B, 3] over payload rows [start, start+count) — TPU kernel.
-
-    The flag default is resolved OUTSIDE the jit cache so flipping
-    HIST_REPEAT_VALIDATED after warm traces takes effect immediately."""
+    """hist[F, B, 3] over payload rows [start, start+count) — TPU kernel."""
     if expand_impl is None:
-        expand_impl = ("repeat" if HIST_REPEAT_VALIDATED
-                       and num_features * num_bins <= REPEAT_MAX_FB
-                       else "matmul")
+        expand_impl = _default_expand_impl(num_features, num_bins)
     if expand_impl not in ("matmul", "repeat"):
         raise ValueError("expand_impl must be matmul|repeat, got %r"
                          % (expand_impl,))
@@ -1052,9 +1055,7 @@ def partition_segment_hist(payload, aux, start, count, pred, left_value,
     if roll_place is None:
         roll_place = PARTITION_ACC_ROLL_VALIDATED
     if expand_impl is None:
-        expand_impl = ("repeat" if HIST_REPEAT_VALIDATED
-                       and num_features * num_bins <= REPEAT_MAX_FB
-                       else "matmul")
+        expand_impl = _default_expand_impl(num_features, num_bins)
     return _partition_segment_hist(payload, aux, start, count, pred,
                                    left_value, right_value, value_col,
                                    num_bins, num_features, grad_col,

@@ -180,18 +180,23 @@ def maybe_init_distributed(cfg) -> Optional[int]:
     `num_machines = 1` and never read the file.  An inline `machines`
     list implies the count like the reference binding does
     (python-package basic.py:1470-1475 derives num_machines from it)."""
-    machines = getattr(cfg, "machines", "") or ""
-    mfile = getattr(cfg, "machine_list_filename", "") or ""
+    def get(key, default):
+        if isinstance(cfg, dict):
+            return cfg.get(key, default)
+        return getattr(cfg, key, default)
+
+    machines = get("machines", "") or ""
+    mfile = get("machine_list_filename", "") or ""
     if not machines and not mfile:
         return None
-    num_machines = int(getattr(cfg, "num_machines", 1) or 1)
+    num_machines = int(get("num_machines", 1) or 1)
     if machines:
         num_machines = max(num_machines,
                            len([m for m in machines.split(",")
                                 if m.strip()]))
     if num_machines <= 1:
         return None   # reference is_parallel gate: the local path
-    port = int(getattr(cfg, "local_listen_port", 12400) or 12400)
+    port = int(get("local_listen_port", 12400) or 12400)
     return init_distributed(machines=machines or None,
                             machine_list_filename=mfile or None,
                             local_listen_port=port)
