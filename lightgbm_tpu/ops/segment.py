@@ -28,6 +28,7 @@ a partial-chunk write.
 """
 from __future__ import annotations
 
+import os
 from typing import NamedTuple
 
 import jax
@@ -38,8 +39,14 @@ from .bundle import decode_bin
 from .split import MISSING_NAN, MISSING_ZERO
 
 # rows per chunk: small enough that the joint one-hot [C, F*B] and the
-# permutation matrix [C, C] sit comfortably in VMEM on the Pallas path
-CHUNK = 256
+# permutation matrix [C, C] sit comfortably in VMEM on the Pallas path.
+# LIGHTGBM_TPU_CHUNK lets a hardware session A/B larger chunks (fewer
+# per-chunk DMA waits, more VMEM per buffer — every kernel's VMEM-fit
+# plan recomputes from this constant); the tested/shipped default is 256.
+# Exactness is chunk-size-independent up to 2^24 (f32-exact prefix
+# counts); the sublane alignment story only needs CHUNK % 8 == 0.
+CHUNK = int(os.environ.get("LIGHTGBM_TPU_CHUNK", "256"))
+assert CHUNK % 8 == 0 and 8 <= CHUNK <= 2048, CHUNK
 
 # guard rows past the last real row.  The portable passes write up to CHUNK
 # garbage rows past a segment; the Pallas partition kernel additionally

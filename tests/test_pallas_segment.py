@@ -53,7 +53,8 @@ def test_histogram_matches_tiled(f, b, start, count, expand):
     """Feature-tiled kernel vs portable engine at wide-feature shapes the
     old F*B <= 8192 gate excluded (reference handles these through the
     OpenCL workgroup grid, ocl/histogram256.cl:73-121)."""
-    assert pseg.fits_vmem(f, b), "gate must admit this shape now"
+    if seg.CHUNK == 256:   # gate expectations assume the default chunk
+        assert pseg.fits_vmem(f, b), "gate must admit this shape now"
     cols = dict(grad_col=f, hess_col=f + 1, cnt_col=f + 2)
     p = f + 4
     rng = np.random.default_rng(f + b)
@@ -77,6 +78,8 @@ def test_partition_vmem_gate():
     """The partition kernel has no feature tiling: Bosch-wide payloads
     (P ~ 1024) fit, Epsilon-wide (P ~ 2048) fall back to the portable
     partition while the histogram stays on the Pallas kernel."""
+    if seg.CHUNK != 256:
+        pytest.skip("VMEM gate expectations assume the default CHUNK")
     assert pseg.partition_fits_vmem(128, 256)   # Higgs-shaped payload
     assert pseg.partition_fits_vmem(1024, 64)   # Bosch-shaped payload
     assert not pseg.partition_fits_vmem(2048, 64)  # Epsilon-shaped payload
@@ -85,6 +88,8 @@ def test_partition_vmem_gate():
 def test_vmem_gate_admits_benchmark_shapes():
     """Every BASELINE.md dense workload shape must ride the TPU kernel;
     only the extreme wide-sparse shapes (pre-EFB Allstate) may fall back."""
+    if seg.CHUNK != 256:
+        pytest.skip("VMEM gate expectations assume the default CHUNK")
     assert pseg.fits_vmem(28, 255)    # Higgs
     assert pseg.fits_vmem(137, 256)   # MS-LTR
     assert pseg.fits_vmem(700, 256)   # Expo / Yahoo LTR
@@ -259,6 +264,8 @@ def test_partition_hist_flag_staged_off():
     exp/smoke_tpu_kernels.py validates the Mosaic lowering on a real chip
     (round-4 discipline), True once exp/flip_validated.py merged ran
     after a green smoke."""
+    if seg.CHUNK != 256:
+        pytest.skip("VMEM gate expectations assume the default CHUNK")
     assert pseg.PARTITION_HIST_VALIDATED in (False, True)
     assert pseg.partition_hist_fits_vmem(128, 28, 256)    # Higgs
     assert pseg.partition_hist_fits_vmem(128, 137, 64)    # MS-LTR @ 64 bins
