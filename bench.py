@@ -137,6 +137,11 @@ def main():
                          "falling back to CPU at reduced scale\n")
         import __graft_entry__ as ge
         env = ge._hermetic_cpu_env(1)
+        # the whitelist env has no PYTHONPATH; this re-exec runs WITHOUT
+        # the -I -S bootstrap, so module reachability must ride PYTHONPATH
+        # (covers pip --target provisioning; trigger vars are gone, so a
+        # sitecustomize in these dirs stays inert)
+        env["PYTHONPATH"] = os.pathsep.join(ge._package_search_paths())
         env.update({"BENCH_NO_PROBE": "1",
                     "BENCH_ROWS": str(min(n_rows, 200_000)),
                     "BENCH_TEST_ROWS": str(min(n_test, 50_000)),

@@ -91,28 +91,101 @@ def test_dryrun_multichip_entry():
     g.dryrun_multichip(NDEV)
 
 
-def test_dryrun_env_is_hermetic_against_dead_tunnel(monkeypatch):
-    """The round-3 driver failure mode: an accelerator sitecustomize on
-    PYTHONPATH plus JAX_PLATFORMS pointing at a dead tunnel.  The dryrun's
-    scrubbed environment must bring a fresh interpreter up on the virtual
-    CPU platform regardless — proven by actually starting one."""
-    import os
+def _hostile_tunnel_env(monkeypatch, tmp_path):
+    """Simulate every plugin pathway the driver's environment has carried
+    across rounds — INCLUDING ones the round-4 blacklist never named.
+
+    - the real axon trigger vars with an unroutable pool IP (dead tunnel)
+    - a sitecustomize in a PYTHONPATH dir with NO 'axon' in its name that
+      kills the interpreter outright (rc=77) — dir-name scrubbing keeps it
+    - PYTHONSTARTUP pointing at the same kill-script
+    - an unknown future trigger var no blacklist could anticipate
+    """
+    evil = tmp_path / "site_ext"
+    evil.mkdir()
+    (evil / "sitecustomize.py").write_text("import os; os._exit(77)\n")
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.255.255.1")  # unroutable
+    monkeypatch.setenv("PALLAS_AXON_REMOTE_COMPILE", "1")
+    monkeypatch.setenv("PYTHONPATH", str(evil))
+    monkeypatch.setenv("PYTHONSTARTUP", str(evil / "sitecustomize.py"))
+    monkeypatch.setenv("FUTURE_ACCEL_PLUGIN_TRIGGER", "1")
+
+
+def test_dryrun_env_is_hermetic_against_dead_tunnel(monkeypatch, tmp_path):
+    """The 4-round driver failure mode: accelerator plugin pathways in the
+    environment plus JAX_PLATFORMS pointing at a dead tunnel.  The dryrun's
+    whitelist environment + isolated interpreter must come up on the
+    virtual CPU platform regardless — proven by actually starting one."""
     import subprocess
     import sys
     import __graft_entry__ as g
 
-    monkeypatch.setenv("JAX_PLATFORMS", "axon")
-    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.255.255.1")  # unroutable
-    monkeypatch.setenv("PALLAS_AXON_REMOTE_COMPILE", "1")
-    monkeypatch.setenv("PYTHONPATH", "/root/.axon_site" + os.pathsep
-                       + os.environ.get("PYTHONPATH", ""))
+    _hostile_tunnel_env(monkeypatch, tmp_path)
     env = g._hermetic_cpu_env(NDEV)
+    # whitelist semantics: NOTHING unexpected survives, named or not
     assert env["JAX_PLATFORMS"] == "cpu"
     assert "PALLAS_AXON_POOL_IPS" not in env
-    assert "axon" not in env.get("PYTHONPATH", "")
-    check = ("import jax; assert jax.default_backend() == 'cpu', "
-             "jax.default_backend(); assert len(jax.devices()) >= %d" % NDEV)
-    proc = subprocess.run([sys.executable, "-c", check], env=env, timeout=120)
+    assert "PYTHONPATH" not in env
+    assert "PYTHONSTARTUP" not in env
+    assert "FUTURE_ACCEL_PLUGIN_TRIGGER" not in env
+    check = ("import sys; sys.path[:0] = %r; "
+             "import jax; assert jax.default_backend() == 'cpu', "
+             "jax.default_backend(); assert len(jax.devices()) >= %d"
+             % (g._package_search_paths(), NDEV))
+    proc = subprocess.run([sys.executable, "-I", "-S", "-c", check],
+                          env=env, timeout=120)
+    assert proc.returncode == 0
+
+
+def test_dryrun_full_path_survives_hostile_env(monkeypatch, tmp_path):
+    """End-to-end: the PUBLIC dryrun_multichip API completes under the
+    hostile environment.  If any pathway leaks, the kill-script
+    sitecustomize exits 77 or the dead-tunnel plugin hangs, and the
+    subprocess raises — so success here IS the hermeticity proof."""
+    import __graft_entry__ as g
+
+    _hostile_tunnel_env(monkeypatch, tmp_path)
+    # force the subprocess path even if this pytest runs provisioned
+    monkeypatch.setenv("XLA_FLAGS", "")
+    g.dryrun_multichip(NDEV)
+
+
+def test_dryrun_bootstrap_blocks_plugin_imports():
+    """The bootstrap's meta-path guard: accelerator-plugin module families
+    are unimportable inside the hermetic interpreter, and jax's
+    ``jax_plugins`` namespace scan sees an empty stub — covering the
+    plugin-by-entry-point and plugin-inside-site-packages pathways that
+    no environment scrub can reach."""
+    import subprocess
+    import sys
+    import __graft_entry__ as g
+
+    probe = g._DRYRUN_BOOTSTRAP % {"paths": g._package_search_paths(),
+                                   "n": 0}
+    splice_target = ("import __graft_entry__ as g\n"
+                     "g._dryrun_multichip_impl(0, hard_watchdog=True)")
+    assert splice_target in probe, \
+        "bootstrap tail changed — update this test's splice target"
+    probe = probe.replace(
+        splice_target,
+        "\n".join([
+            "for mod in ('axon', 'axon.register', 'jax_plugins.axon',"
+            " 'libtpu', 'sitecustomize'):",
+            "    try:",
+            "        __import__(mod)",
+            "    except ModuleNotFoundError:",
+            "        pass",
+            "    else:",
+            "        raise SystemExit('%s imported' % mod)",
+            "import jax_plugins",
+            "assert list(jax_plugins.__path__) == []",
+            "import jax",
+            "assert jax.default_backend() == 'cpu'",
+        ]))
+    env = g._hermetic_cpu_env(2)
+    proc = subprocess.run([sys.executable, "-I", "-S", "-c", probe],
+                          env=env, timeout=120)
     assert proc.returncode == 0
 
 
