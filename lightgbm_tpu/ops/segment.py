@@ -45,8 +45,20 @@ from .split import MISSING_NAN, MISSING_ZERO
 # plan recomputes from this constant); the tested/shipped default is 256.
 # Exactness is chunk-size-independent up to 2^24 (f32-exact prefix
 # counts); the sublane alignment story only needs CHUNK % 8 == 0.
-CHUNK = int(os.environ.get("LIGHTGBM_TPU_CHUNK", "256"))
-assert CHUNK % 8 == 0 and 8 <= CHUNK <= 2048, CHUNK
+# a ValueError (not assert): the sublane-alignment assumption is baked
+# into every Pallas kernel and the GUARD sizing, and the check must
+# survive python -O
+_chunk_raw = os.environ.get("LIGHTGBM_TPU_CHUNK", "256")
+try:
+    CHUNK = int(_chunk_raw)
+except ValueError:
+    raise ValueError(
+        "LIGHTGBM_TPU_CHUNK must be an integer multiple of 8 in "
+        "[8, 2048], got %r" % _chunk_raw) from None
+if CHUNK % 8 != 0 or not 8 <= CHUNK <= 2048:
+    raise ValueError(
+        "LIGHTGBM_TPU_CHUNK must be a multiple of 8 in [8, 2048], got %d"
+        % CHUNK)
 
 # guard rows past the last real row.  The portable passes write up to CHUNK
 # garbage rows past a segment; the Pallas partition kernel additionally

@@ -190,7 +190,18 @@ def maybe_init_distributed(cfg) -> Optional[int]:
     if not machines and not mfile:
         return None
     num_machines = int(get("num_machines", 1) or 1)
-    if machines:
+    # an inline machines list implies the count ONLY when num_machines was
+    # not explicitly set: the reference binding lets an explicit param win
+    # (basic.py:1483 params.get('num_machines', num_machines)), so a conf
+    # carrying a machines list next to num_machines=1 means serial intent
+    # and must not block waiting for peers.
+    if isinstance(cfg, dict):
+        explicit = "num_machines" in cfg
+    else:
+        # raw_params is Config's public record of user-supplied params
+        # (alias-resolved), so explicitness survives Config refactors
+        explicit = "num_machines" in getattr(cfg, "raw_params", {})
+    if machines and not explicit:
         num_machines = max(num_machines,
                            len([m for m in machines.split(",")
                                 if m.strip()]))

@@ -101,3 +101,55 @@ def test_machine_list_file_ignored_when_num_machines_1():
                      "num_machines": 1, "local_listen_port": 12400},
                     lgb.Dataset(X, label=y), num_boost_round=2)
     assert bst.current_iteration() == 2
+
+
+def test_inline_machines_with_explicit_num_machines_1_stays_serial():
+    """ADVICE round 4 (medium): a reference-style conf can carry an inline
+    `machines` list next to an EXPLICIT num_machines=1 — serial intent.
+    The reference binding lets the explicit param win (basic.py:1483);
+    deriving the count from the list here would block in
+    jax.distributed.initialize waiting for peers that never come.  The
+    two-peer list below makes any regression hang/raise instead of
+    training."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.parallel.launch import maybe_init_distributed
+
+    cfg = Config({"objective": "binary", "num_machines": 1,
+                  "machines": "127.0.0.1:12400,10.255.255.1:12400"})
+    assert maybe_init_distributed(cfg) is None
+    # dict path (the CLI hands resolved params as a mapping)
+    assert maybe_init_distributed(
+        {"num_machines": 1,
+         "machines": "127.0.0.1:12400,10.255.255.1:12400"}) is None
+    # and end-to-end through the Booster
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((400, 5)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "num_machines": 1,
+                     "machines": "127.0.0.1:12400,10.255.255.1:12400"},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    assert bst.current_iteration() == 2
+
+
+def test_inline_machines_without_explicit_count_still_derives():
+    """The complement: with num_machines UNSET, an inline two-peer list
+    still implies a parallel run (the reference binding derives the count
+    from len(machines)) — the gate must NOT early-out to serial."""
+    from lightgbm_tpu.parallel import launch as L
+
+    called = {}
+
+    def fake_init(machines=None, machine_list_filename=None,
+                  local_listen_port=12400):
+        called["machines"] = machines
+        return 0
+
+    orig = L.init_distributed
+    L.init_distributed = fake_init
+    try:
+        rank = L.maybe_init_distributed(
+            {"machines": "127.0.0.1:12400,10.255.255.1:12400"})
+    finally:
+        L.init_distributed = orig
+    assert rank == 0 and "machines" in called
