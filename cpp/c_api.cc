@@ -10,6 +10,7 @@
 // (lightgbm_tpu/models/tree.py) so all three agree bit-for-bit.
 
 #include "lightgbm_tpu_c_api.h"
+#include "c_internal.h"
 
 #ifdef _OPENMP
 #include <omp.h>
@@ -106,6 +107,7 @@ enum class Transform {
 };
 
 struct Model {
+  const uint32_t magic = lgbm_tpu_internal::kNativeBoosterMagic;
   int num_class = 1;
   int num_tree_per_iteration = 1;
   int max_feature_idx = 0;
@@ -280,6 +282,21 @@ int Fail(const std::string& msg) {
   return -1;
 }
 
+}  // namespace
+
+namespace lgbm_tpu_internal {
+void SetLastError(const std::string& msg) { g_last_error = msg; }
+
+namespace {
+const TrainHooks* g_train_hooks = nullptr;
+}  // namespace
+
+void RegisterTrainHooks(const TrainHooks* hooks) { g_train_hooks = hooks; }
+const TrainHooks* GetTrainHooks() { return g_train_hooks; }
+}  // namespace lgbm_tpu_internal
+
+namespace {
+
 // one row's scores/leaf-indices — shared by the dense and CSR entry points
 void PredictRow(const Model& m, const double* row, int predict_type,
                 int iters, int used_trees, double* out_row) {
@@ -299,7 +316,16 @@ void PredictRow(const Model& m, const double* row, int predict_type,
   }
 }
 
-Model* AsModel(BoosterHandle h) { return static_cast<Model*>(h); }
+// Resolve a public handle to a native Model*: training boosters (embedded
+// Python, c_train.cc) are re-synced into their native model cache so every
+// shared entry point below runs identical code for both booster kinds.
+Model* AsModel(BoosterHandle h) {
+  if (lgbm_tpu_internal::IsTrainBooster(h)) {
+    h = lgbm_tpu_internal::GetTrainHooks()->booster_native(h);
+    if (h == nullptr) return nullptr;
+  }
+  return static_cast<Model*>(h);
+}
 
 int LoadModel(const std::string& text, int* out_num_iterations,
               BoosterHandle* out) {
@@ -335,22 +361,33 @@ int LGBM_BoosterLoadModelFromString(const char* model_str,
 }
 
 int LGBM_BoosterFree(BoosterHandle handle) {
-  delete AsModel(handle);
+  if (lgbm_tpu_internal::IsTrainBooster(handle))
+    return lgbm_tpu_internal::GetTrainHooks()->booster_free(handle);
+  delete static_cast<Model*>(handle);
   return 0;
 }
 
 int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len) {
-  *out_len = AsModel(handle)->num_class;
+  Model* m = AsModel(handle);
+  if (m == nullptr) return -1;
+  *out_len = m->num_class;
   return 0;
 }
 
 int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len) {
-  *out_len = AsModel(handle)->max_feature_idx + 1;
+  Model* m = AsModel(handle);
+  if (m == nullptr) return -1;
+  *out_len = m->max_feature_idx + 1;
   return 0;
 }
 
 int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration) {
-  *out_iteration = AsModel(handle)->NumIterations();
+  if (lgbm_tpu_internal::IsTrainBooster(handle))
+    return lgbm_tpu_internal::GetTrainHooks()->booster_current_iteration(
+        handle, out_iteration);
+  Model* m = AsModel(handle);
+  if (m == nullptr) return -1;
+  *out_iteration = m->NumIterations();
   return 0;
 }
 
@@ -358,6 +395,7 @@ int LGBM_BoosterSaveModel(BoosterHandle handle, int num_iteration,
                           const char* filename) {
   int64_t len = 0;
   Model* m = AsModel(handle);
+  if (m == nullptr) return -1;
   (void)num_iteration;  // full stored text; truncation is a Python-side task
   std::ofstream f(filename);
   if (!f) return Fail(std::string("cannot open for write: ") + filename);
@@ -371,6 +409,7 @@ int LGBM_BoosterSaveModelToString(BoosterHandle handle, int num_iteration,
                                   char* out_str) {
   (void)num_iteration;
   Model* m = AsModel(handle);
+  if (m == nullptr) return -1;
   *out_len = static_cast<int64_t>(m->text.size()) + 1;
   if (buffer_len >= *out_len && out_str != nullptr) {
     std::memcpy(out_str, m->text.c_str(), m->text.size() + 1);
@@ -385,6 +424,7 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
                               int64_t* out_len, double* out_result) {
   (void)parameter;
   Model* m = AsModel(handle);
+  if (m == nullptr) return -1;
   int nfeat = m->max_feature_idx + 1;
   if (ncol < nfeat)
     return Fail("input has " + std::to_string(ncol) + " columns, model needs " +
@@ -433,6 +473,7 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
   (void)parameter;
   (void)nelem;
   Model* m = AsModel(handle);
+  if (m == nullptr) return -1;
   if (indptr_type != C_API_DTYPE_INT32 && indptr_type != C_API_DTYPE_INT64)
     return Fail("indptr_type must be C_API_DTYPE_INT32/INT64, got " +
                 std::to_string(indptr_type));

@@ -1,0 +1,52 @@
+/* Internal seam between the dependency-free prediction runtime
+ * (lib_lightgbm_tpu.so, c_api.cc) and the embedded-Python training
+ * backend (lib_lightgbm_tpu_train.so, c_train.cc).
+ *
+ * Both booster kinds travel through the SAME public BoosterHandle (the
+ * reference c_api has one handle type for loaded and trained boosters);
+ * a leading magic word distinguishes them so the shared entry points can
+ * dispatch.  The training library REGISTERS its dispatch hooks into the
+ * base library from an ELF constructor at load time — the base library
+ * carries no Python (or training-library) dependency, so prediction-only
+ * deployments stay dependency-free, exactly as the public header
+ * advertises. */
+#ifndef LIGHTGBM_TPU_C_INTERNAL_H_
+#define LIGHTGBM_TPU_C_INTERNAL_H_
+
+#include <cstdint>
+#include <string>
+
+namespace lgbm_tpu_internal {
+
+// ASCII tags: "NBST" native booster, "TBST" training booster, "TDAT"
+// training dataset.  Every handle struct starts with one.
+constexpr uint32_t kNativeBoosterMagic = 0x5453424Eu;
+constexpr uint32_t kTrainBoosterMagic = 0x54534254u;
+constexpr uint32_t kTrainDatasetMagic = 0x54414454u;
+
+inline uint32_t HandleMagic(const void* h) {
+  return h ? *static_cast<const uint32_t*>(h) : 0u;
+}
+
+// Hooks the training library provides to the base library.
+struct TrainHooks {
+  // Current model parsed into a native booster (cached; re-synced after
+  // every update/rollback).  Returns nullptr on error (message set).
+  void* (*booster_native)(void* h);
+  int (*booster_free)(void* h);
+  int (*booster_current_iteration)(void* h, int* out);
+};
+
+// --- implemented in c_api.cc (the base library) ---
+void SetLastError(const std::string& msg);
+// Called once from the training library's ELF constructor.
+void RegisterTrainHooks(const TrainHooks* hooks);
+const TrainHooks* GetTrainHooks();
+
+inline bool IsTrainBooster(const void* h) {
+  return HandleMagic(h) == kTrainBoosterMagic && GetTrainHooks() != nullptr;
+}
+
+}  // namespace lgbm_tpu_internal
+
+#endif  /* LIGHTGBM_TPU_C_INTERNAL_H_ */

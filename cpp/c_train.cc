@@ -1,0 +1,597 @@
+// Training side of the C ABI: LGBM_Dataset* / LGBM_BoosterCreate /
+// LGBM_BoosterUpdateOneIter[Custom] parity with the reference c_api
+// (include/LightGBM/c_api.h:48-460, src/c_api.cpp Booster/Dataset
+// sections), driving THIS framework's real training engine in-process by
+// embedding CPython.
+//
+// Design: the reference's C training surface is a marshalling layer over
+// its C++ Booster; ours is a marshalling layer over the JAX engine (the
+// compute path is XLA either way — the C caller gets the same TPU
+// kernels as a Python caller).  A trained booster carries a native
+// Model* cache (c_api.cc) re-parsed from its model text after every
+// update, so every existing prediction/save entry point serves trained
+// and loaded boosters with the exact same hardware-validated code.
+//
+// The embedded interpreter initializes lazily on the first training
+// call; prediction-only users never start Python.  All entry points are
+// GIL-correct (PyGILState_Ensure/Release) and may be called from any
+// thread.
+#include "lightgbm_tpu_c_api.h"
+#include "c_internal.h"
+
+#include <Python.h>
+#include <dlfcn.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+using lgbm_tpu_internal::kTrainBoosterMagic;
+using lgbm_tpu_internal::kTrainDatasetMagic;
+using lgbm_tpu_internal::HandleMagic;
+using lgbm_tpu_internal::SetLastError;
+
+struct TrainDataset {
+  const uint32_t magic = kTrainDatasetMagic;
+  PyObject* ds = nullptr;  // lightgbm_tpu.Dataset
+};
+
+struct TrainBooster {
+  const uint32_t magic = kTrainBoosterMagic;
+  PyObject* bst = nullptr;      // lightgbm_tpu.Booster
+  void* native = nullptr;       // cached LGBM_BoosterLoadModelFromString
+  bool dirty = true;            // model changed since last native sync
+  std::mutex sync_mu;           // serializes the dirty-check/free/swap
+};
+
+// Helper functions executed inside the embedded interpreter.  Keeping the
+// marshalling in Python keeps the C side to plain PyObject_CallMethod
+// calls; everything here routes straight into the public package API.
+const char* kHelperSource = R"PY(
+import numpy as np
+import lightgbm_tpu as lgb
+
+
+def _params(s):
+    out = {}
+    for tok in (s or '').replace('\t', ' ').replace(',', ' ').split():
+        if '=' in tok:
+            k, v = tok.split('=', 1)
+            out[k] = v
+    return out
+
+
+def dataset_from_file(fname, params, ref):
+    return lgb.Dataset(fname, reference=ref, params=_params(params),
+                       free_raw_data=False)
+
+
+def dataset_from_mat(mv, dtype_code, nrow, ncol, is_row_major, params, ref):
+    dt = np.float32 if dtype_code == 0 else np.float64
+    a = np.frombuffer(mv, dtype=dt)
+    a = a.reshape(nrow, ncol) if is_row_major else a.reshape(ncol, nrow).T
+    return lgb.Dataset(np.array(a, copy=True), reference=ref,
+                       params=_params(params), free_raw_data=False)
+
+
+def dataset_set_field(ds, name, mv, dtype_code):
+    dt = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}[dtype_code]
+    ds.set_field(name, np.frombuffer(mv, dtype=dt).copy())
+
+
+def dataset_num_data(ds):
+    ds.construct()
+    return int(ds.num_data())
+
+
+def dataset_num_feature(ds):
+    ds.construct()
+    return int(ds.num_feature())
+
+
+def booster_create(ds, params):
+    return lgb.Booster(params=_params(params), train_set=ds)
+
+
+def booster_add_valid(bst, ds, name):
+    bst.add_valid(ds, name)
+
+
+def booster_update(bst):
+    return 1 if bst.update() else 0
+
+
+def booster_update_custom(bst, gmv, hmv, n):
+    g = np.frombuffer(gmv, dtype=np.float32, count=n).copy()
+    h = np.frombuffer(hmv, dtype=np.float32, count=n).copy()
+    return 1 if bst.update(fobj=lambda preds, ds: (g, h)) else 0
+
+
+def booster_rollback(bst):
+    bst.rollback_one_iter()
+
+
+def booster_current_iteration(bst):
+    return int(bst.current_iteration())
+
+
+def booster_model_string(bst, num_iteration):
+    return bst.model_to_string(num_iteration=num_iteration)
+
+
+def booster_get_eval(bst, data_idx):
+    res = bst.eval_train() if data_idx == 0 else bst.eval_valid()
+    if data_idx > 0:
+        names = []
+        for r in res:
+            if r[0] not in names:
+                names.append(r[0])
+        if data_idx - 1 >= len(names):
+            raise IndexError('data_idx %d out of range' % data_idx)
+        want = names[data_idx - 1]
+        res = [r for r in res if r[0] == want]
+    return [float(r[2]) for r in res]
+
+
+def booster_grad_len(bst):
+    ds = bst.train_set
+    ds.construct()
+    k = getattr(bst._engine, 'num_tree_per_iteration', 1)
+    return int(ds.num_data()) * int(k)
+
+
+def network_init(machines, local_listen_port, num_machines):
+    if num_machines <= 1:
+        return 0
+    return int(lgb.init_distributed(machines=machines,
+                                    local_listen_port=local_listen_port)
+               or 0)
+)PY";
+
+PyObject* g_helpers = nullptr;  // module dict holding the helpers
+std::once_flag g_py_once;
+bool g_py_ok = false;
+void InitPython();
+
+std::string PyErrString() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return msg;
+}
+
+void InitPython() {
+  bool we_initialized = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    we_initialized = true;
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  // make the package importable: LIGHTGBM_TPU_ROOT wins, then the repo
+  // root next to this shared library (the parent of the cpp/ dir the .so
+  // lives in, located via dladdr); a pip install resolves through the
+  // normal sys.path instead
+  {
+    std::string boot =
+        "import os, sys\n"
+        "for _cand in (";
+    const char* env_root = std::getenv("LIGHTGBM_TPU_ROOT");
+    if (env_root != nullptr)
+      boot += "r'''" + std::string(env_root) + "''', ";
+    Dl_info info;
+    if (dladdr(reinterpret_cast<void*>(&InitPython), &info) != 0 &&
+        info.dli_fname != nullptr) {
+      std::string so(info.dli_fname);
+      auto cut = so.find_last_of('/');
+      if (cut != std::string::npos) {
+        std::string so_dir = so.substr(0, cut);
+        auto cut2 = so_dir.find_last_of('/');
+        if (cut2 != std::string::npos)
+          boot += "r'''" + so_dir.substr(0, cut2) + "''', ";
+      }
+    }
+    boot +=
+        "):\n"
+        "    if _cand and os.path.isdir(_cand) and _cand not in sys.path:\n"
+        "        sys.path.insert(0, _cand)\n";
+    PyRun_SimpleString(boot.c_str());
+  }
+  PyObject* mod = PyModule_New("_lgbm_tpu_c_helpers");
+  PyObject* mdict = PyModule_GetDict(mod);
+  PyDict_SetItemString(mdict, "__builtins__", PyEval_GetBuiltins());
+  PyObject* res = PyRun_String(kHelperSource, Py_file_input, mdict, mdict);
+  if (res == nullptr) {
+    SetLastError("failed to initialize embedded training helpers: " +
+                 PyErrString());
+    Py_DECREF(mod);
+  } else {
+    Py_DECREF(res);
+    g_helpers = mod;  // keep the module (and its dict) alive forever
+    g_py_ok = true;
+  }
+  PyGILState_Release(g);
+  if (we_initialized) {
+    // release the GIL acquired by Py_Initialize so other threads can use
+    // PyGILState_Ensure; the interpreter stays alive for the process
+    PyEval_SaveThread();
+  }
+}
+
+// RAII: ensure interpreter + helpers + GIL for the current scope.
+struct PyScope {
+  PyGILState_STATE g;
+  bool ok;
+  PyScope() : ok(false) {
+    std::call_once(g_py_once, InitPython);
+    if (!g_py_ok) return;
+    g = PyGILState_Ensure();
+    ok = true;
+  }
+  ~PyScope() {
+    if (ok) PyGILState_Release(g);
+  }
+};
+
+PyObject* Helper(const char* name) {
+  return PyObject_GetAttrString(g_helpers, name);
+}
+
+// Call helpers[name](*args) with a fresh reference result; nullptr on
+// error (message recorded).
+PyObject* CallHelper(const char* name, PyObject* args) {
+  PyObject* fn = Helper(name);
+  PyObject* out = nullptr;
+  if (fn != nullptr) {
+    out = PyObject_CallObject(fn, args);
+    Py_DECREF(fn);
+  }
+  if (out == nullptr) SetLastError(std::string(name) + ": " + PyErrString());
+  Py_XDECREF(args);
+  return out;
+}
+
+int FailPy(const char* where) {
+  SetLastError(std::string(where) + ": " + PyErrString());
+  PyErr_Clear();
+  return -1;
+}
+
+TrainBooster* AsTrain(BoosterHandle h) { return static_cast<TrainBooster*>(h); }
+TrainDataset* AsDataset(DatasetHandle h) {
+  if (HandleMagic(h) != kTrainDatasetMagic) return nullptr;
+  return static_cast<TrainDataset*>(h);
+}
+
+void* TrainBoosterNative(void* h) {
+  TrainBooster* tb = AsTrain(h);
+  // serialize the dirty-check/free/swap: two concurrent first-predicts
+  // must not both parse-and-free (use-after-free / double-free); after
+  // the winner syncs, the loser sees !dirty and reuses the cache
+  std::lock_guard<std::mutex> lock(tb->sync_mu);
+  if (!tb->dirty && tb->native != nullptr) return tb->native;
+  PyScope py;
+  if (!py.ok) return nullptr;
+  PyObject* s = CallHelper("booster_model_string",
+                           Py_BuildValue("(Oi)", tb->bst, -1));
+  if (s == nullptr) return nullptr;
+  const char* text = PyUnicode_AsUTF8(s);
+  void* fresh = nullptr;
+  int num_iter = 0;
+  int rc = text == nullptr
+               ? -1
+               : LGBM_BoosterLoadModelFromString(text, &num_iter, &fresh);
+  Py_DECREF(s);
+  if (rc != 0) return nullptr;
+  if (tb->native != nullptr) LGBM_BoosterFree(tb->native);
+  tb->native = fresh;
+  tb->dirty = false;
+  return tb->native;
+}
+
+int TrainBoosterFree(void* h) {
+  TrainBooster* tb = AsTrain(h);
+  if (tb->native != nullptr) LGBM_BoosterFree(tb->native);
+  if (tb->bst != nullptr) {
+    PyScope py;
+    if (py.ok) Py_DECREF(tb->bst);
+  }
+  delete tb;
+  return 0;
+}
+
+int TrainBoosterCurrentIteration(void* h, int* out) {
+  PyScope py;
+  if (!py.ok) return -1;
+  PyObject* r = CallHelper("booster_current_iteration",
+                           Py_BuildValue("(O)", AsTrain(h)->bst));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+// registered into the base library when this library loads
+const lgbm_tpu_internal::TrainHooks g_hooks = {
+    &TrainBoosterNative, &TrainBoosterFree, &TrainBoosterCurrentIteration};
+
+__attribute__((constructor)) void RegisterHooks() {
+  lgbm_tpu_internal::RegisterTrainHooks(&g_hooks);
+}
+
+}  // namespace
+
+extern "C" {
+
+int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
+                               DatasetHandle reference, DatasetHandle* out) {
+  PyScope py;
+  if (!py.ok) return -1;
+  TrainDataset* ref = AsDataset(reference);
+  PyObject* r = CallHelper(
+      "dataset_from_file",
+      Py_BuildValue("(ssO)", filename, parameters ? parameters : "",
+                    ref ? ref->ds : Py_None));
+  if (r == nullptr) return -1;
+  TrainDataset* d = new TrainDataset;
+  d->ds = r;
+  *out = d;
+  return 0;
+}
+
+int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
+                              int32_t ncol, int is_row_major,
+                              const char* parameters, DatasetHandle reference,
+                              DatasetHandle* out) {
+  PyScope py;
+  if (!py.ok) return -1;
+  if (data_type != C_API_DTYPE_FLOAT32 && data_type != C_API_DTYPE_FLOAT64) {
+    SetLastError("data_type must be float32/float64");
+    return -1;
+  }
+  Py_ssize_t esz = data_type == C_API_DTYPE_FLOAT32 ? 4 : 8;
+  PyObject* mv = PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)),
+      static_cast<Py_ssize_t>(nrow) * ncol * esz, PyBUF_READ);
+  if (mv == nullptr) return FailPy("LGBM_DatasetCreateFromMat");
+  TrainDataset* ref = AsDataset(reference);
+  PyObject* r = CallHelper(
+      "dataset_from_mat",
+      Py_BuildValue("(NiiiisO)", mv, data_type, nrow, ncol, is_row_major,
+                    parameters ? parameters : "", ref ? ref->ds : Py_None));
+  if (r == nullptr) return -1;
+  TrainDataset* d = new TrainDataset;
+  d->ds = r;
+  *out = d;
+  return 0;
+}
+
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int num_element, int type) {
+  PyScope py;
+  if (!py.ok) return -1;
+  TrainDataset* d = AsDataset(handle);
+  if (d == nullptr) {
+    SetLastError("not a dataset handle");
+    return -1;
+  }
+  Py_ssize_t esz = (type == C_API_DTYPE_FLOAT64 || type == C_API_DTYPE_INT64)
+                       ? 8
+                       : 4;
+  PyObject* mv = PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(field_data)),
+      static_cast<Py_ssize_t>(num_element) * esz, PyBUF_READ);
+  if (mv == nullptr) return FailPy("LGBM_DatasetSetField");
+  PyObject* r = CallHelper(
+      "dataset_set_field",
+      Py_BuildValue("(OsNi)", d->ds, field_name, mv, type));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out) {
+  PyScope py;
+  if (!py.ok) return -1;
+  TrainDataset* d = AsDataset(handle);
+  if (d == nullptr) {
+    SetLastError("not a dataset handle");
+    return -1;
+  }
+  PyObject* r = CallHelper("dataset_num_data", Py_BuildValue("(O)", d->ds));
+  if (r == nullptr) return -1;
+  *out = static_cast<int32_t>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out) {
+  PyScope py;
+  if (!py.ok) return -1;
+  TrainDataset* d = AsDataset(handle);
+  if (d == nullptr) {
+    SetLastError("not a dataset handle");
+    return -1;
+  }
+  PyObject* r = CallHelper("dataset_num_feature",
+                           Py_BuildValue("(O)", d->ds));
+  if (r == nullptr) return -1;
+  *out = static_cast<int32_t>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetFree(DatasetHandle handle) {
+  TrainDataset* d = AsDataset(handle);
+  if (d == nullptr) return 0;
+  PyScope py;
+  if (py.ok) Py_XDECREF(d->ds);
+  delete d;
+  return 0;
+}
+
+int LGBM_BoosterCreate(DatasetHandle train_data, const char* parameters,
+                       BoosterHandle* out) {
+  PyScope py;
+  if (!py.ok) return -1;
+  TrainDataset* d = AsDataset(train_data);
+  if (d == nullptr) {
+    SetLastError("train_data is not a dataset handle");
+    return -1;
+  }
+  PyObject* r = CallHelper(
+      "booster_create",
+      Py_BuildValue("(Os)", d->ds, parameters ? parameters : ""));
+  if (r == nullptr) return -1;
+  TrainBooster* b = new TrainBooster;
+  b->bst = r;
+  *out = b;
+  return 0;
+}
+
+int LGBM_BoosterAddValidData(BoosterHandle handle, DatasetHandle valid_data) {
+  PyScope py;
+  if (!py.ok) return -1;
+  if (!lgbm_tpu_internal::IsTrainBooster(handle)) {
+    SetLastError("not a training booster");
+    return -1;
+  }
+  TrainDataset* d = AsDataset(valid_data);
+  if (d == nullptr) {
+    SetLastError("valid_data is not a dataset handle");
+    return -1;
+  }
+  TrainBooster* tb = AsTrain(handle);
+  PyObject* r = CallHelper("booster_add_valid",
+                           Py_BuildValue("(OOs)", tb->bst, d->ds, "valid"));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished) {
+  PyScope py;
+  if (!py.ok) return -1;
+  if (!lgbm_tpu_internal::IsTrainBooster(handle)) {
+    SetLastError("not a training booster");
+    return -1;
+  }
+  TrainBooster* tb = AsTrain(handle);
+  PyObject* r = CallHelper("booster_update", Py_BuildValue("(O)", tb->bst));
+  if (r == nullptr) return -1;
+  if (is_finished) *is_finished = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  tb->dirty = true;
+  return 0;
+}
+
+int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle, const float* grad,
+                                    const float* hess, int* is_finished) {
+  PyScope py;
+  if (!py.ok) return -1;
+  if (!lgbm_tpu_internal::IsTrainBooster(handle)) {
+    SetLastError("not a training booster");
+    return -1;
+  }
+  TrainBooster* tb = AsTrain(handle);
+  // gradient length = num_data * num_class, resolved on the python side
+  PyObject* nobj = CallHelper("booster_grad_len",
+                              Py_BuildValue("(O)", tb->bst));
+  if (nobj == nullptr) return -1;
+  long n = PyLong_AsLong(nobj);
+  Py_DECREF(nobj);
+  if (n <= 0) {
+    SetLastError("cannot determine gradient length for custom update");
+    return -1;
+  }
+  Py_ssize_t bytes = static_cast<Py_ssize_t>(n) * 4;
+  PyObject* gmv = PyMemoryView_FromMemory(
+      const_cast<char*>(reinterpret_cast<const char*>(grad)), bytes,
+      PyBUF_READ);
+  PyObject* hmv = PyMemoryView_FromMemory(
+      const_cast<char*>(reinterpret_cast<const char*>(hess)), bytes,
+      PyBUF_READ);
+  if (gmv == nullptr || hmv == nullptr) {
+    Py_XDECREF(gmv);
+    Py_XDECREF(hmv);
+    return FailPy("LGBM_BoosterUpdateOneIterCustom");
+  }
+  PyObject* r = CallHelper(
+      "booster_update_custom",
+      Py_BuildValue("(ONNl)", tb->bst, gmv, hmv, n));
+  if (r == nullptr) return -1;
+  if (is_finished) *is_finished = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  tb->dirty = true;
+  return 0;
+}
+
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
+  PyScope py;
+  if (!py.ok) return -1;
+  if (!lgbm_tpu_internal::IsTrainBooster(handle)) {
+    SetLastError("not a training booster");
+    return -1;
+  }
+  TrainBooster* tb = AsTrain(handle);
+  PyObject* r = CallHelper("booster_rollback", Py_BuildValue("(O)", tb->bst));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  tb->dirty = true;
+  return 0;
+}
+
+int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
+                        double* out_results) {
+  PyScope py;
+  if (!py.ok) return -1;
+  if (!lgbm_tpu_internal::IsTrainBooster(handle)) {
+    SetLastError("not a training booster");
+    return -1;
+  }
+  PyObject* r = CallHelper(
+      "booster_get_eval",
+      Py_BuildValue("(Oi)", AsTrain(handle)->bst, data_idx));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  *out_len = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    out_results[i] = PyFloat_AsDouble(PyList_GetItem(r, i));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                     int listen_time_out, int num_machines) {
+  (void)listen_time_out;  // XLA collectives own connection management
+  PyScope py;
+  if (!py.ok) return -1;
+  PyObject* r = CallHelper(
+      "network_init",
+      Py_BuildValue("(sii)", machines ? machines : "", local_listen_port,
+                    num_machines));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_NetworkFree() {
+  // jax.distributed teardown happens at process exit; matching the
+  // reference's idempotent Network::Dispose contract
+  return 0;
+}
+
+}  // extern "C"

@@ -1,13 +1,20 @@
-/* C API for lightgbm-tpu's native model runtime.
+/* C API for lightgbm-tpu's native runtime.
  *
- * Deployment-side parity with the reference c_api.h (src/c_api.cpp): the
- * functions a serving stack needs — load a text model, inspect it, predict
- * dense matrices, save — implemented as a dependency-free C++17 shared
- * library.  TRAINING entry points (LGBM_DatasetCreate*, LGBM_BoosterUpdate*)
- * are deliberately absent: training in this framework is the JAX/TPU path
- * (Python `lightgbm_tpu` package or the CLI), and a C shim around a Python
- * interpreter would be slower and heavier than calling Python directly.
- * Constants and signatures mirror the reference so existing C/C++ serving
+ * Parity with the reference c_api.h (src/c_api.cpp) on both sides of the
+ * model lifecycle:
+ *
+ * - PREDICTION (load a text model, inspect, predict dense/CSR, save) is a
+ *   dependency-free C++17 runtime — no Python, no JAX.
+ * - TRAINING (LGBM_DatasetCreate*, LGBM_BoosterCreate/UpdateOneIter*,
+ *   c_api.h:48-460 parity) drives this framework's real training engine
+ *   in-process by embedding CPython lazily on first use: the compute path
+ *   is XLA/TPU either way, and the C caller gets the same kernels as a
+ *   Python caller.  Trained boosters flow through the SAME BoosterHandle
+ *   as loaded ones — every predict/save entry point works on both (the
+ *   trained model is re-parsed into the native runtime after each
+ *   update, so predictions are bit-identical to a loaded model file).
+ *
+ * Constants and signatures mirror the reference so existing C/C++
  * integrations recompile against this header unchanged.
  */
 #ifndef LIGHTGBM_TPU_C_API_H_
@@ -20,6 +27,7 @@ extern "C" {
 #endif
 
 typedef void* BoosterHandle;
+typedef void* DatasetHandle;
 
 #define C_API_DTYPE_FLOAT32 (0)
 #define C_API_DTYPE_FLOAT64 (1)
@@ -82,6 +90,59 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
                               int predict_type, int num_iteration,
                               const char* parameter, int64_t* out_len,
                               double* out_result);
+
+/* ---- training surface (embedded-engine; reference c_api.h:48-460) ----
+ * parameters strings use the reference's "key=value key2=value2" form.
+ * If the package is not importable from the default sys.path, set
+ * LIGHTGBM_TPU_ROOT to the repo/site dir before the first training call.
+ */
+
+int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
+                               DatasetHandle reference, DatasetHandle* out);
+
+int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
+                              int32_t ncol, int is_row_major,
+                              const char* parameters, DatasetHandle reference,
+                              DatasetHandle* out);
+
+/* field_name: label / weight / init_score / group (reference SetField). */
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int num_element, int type);
+
+int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out);
+
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out);
+
+int LGBM_DatasetFree(DatasetHandle handle);
+
+int LGBM_BoosterCreate(DatasetHandle train_data, const char* parameters,
+                       BoosterHandle* out);
+
+int LGBM_BoosterAddValidData(BoosterHandle handle, DatasetHandle valid_data);
+
+/* One boosting iteration; *is_finished = 1 when no further splits met the
+ * requirements (reference semantics). */
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+
+/* Custom objective: grad/hess are num_data * num_class float32. */
+int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle, const float* grad,
+                                    const float* hess, int* is_finished);
+
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
+
+/* Metric values for data_idx (0 = training, i > 0 = i-th valid set). */
+int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
+                        double* out_results);
+
+/* Distributed bootstrap (reference Network::Init / LGBM_NetworkInit):
+ * machines = "ip:port,ip:port,...".  Maps onto jax.distributed — see
+ * docs/DISTRIBUTED.md.  The function-pointer transport variant
+ * (LGBM_NetworkInitWithFunctions) has no analogue: collectives are
+ * compiled into the XLA program and cannot be user-supplied. */
+int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                     int listen_time_out, int num_machines);
+
+int LGBM_NetworkFree();
 
 #ifdef __cplusplus
 }

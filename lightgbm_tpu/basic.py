@@ -446,11 +446,29 @@ class Booster:
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         if self._engine is None:
             raise LightGBMError("Cannot add validation data to a loaded Booster")
-        data.construct(self.config)
-        if data.reference is None or data.reference is not self.train_set:
-            Log.warning("Validation set was not created with reference=train_set; "
-                        "binning with training mappers")
+        # the reference MUST be attached BEFORE construct(): validation
+        # bins are only meaningful against the TRAINING bin mappers (the
+        # reference binding force-sets it in engine.train via
+        # set_reference(train_set)).  A valid set already constructed
+        # against different mappers is re-binned — scoring it would
+        # traverse training split_bins over foreign bin ids.
+        if data is self.train_set:
+            # eval-on-train (cv eval_train_metric, add_valid(train_set)):
+            # already binned with its own mappers BY DEFINITION — attaching
+            # a self-reference would wipe the engine's binning and recurse
+            pass
+        elif data.reference is not self.train_set:
+            if data._binned is not None:
+                Log.warning("Validation set was constructed without "
+                            "reference=train_set; re-binning with training "
+                            "mappers")
+                data._binned = None
+            else:
+                Log.warning("Validation set was not created with "
+                            "reference=train_set; binning with training "
+                            "mappers")
             data.reference = self.train_set
+        data.construct(self.config)
         metrics = create_metrics(self.config.metric, self.config)
         self._engine.add_valid(name, data.binned, metrics)
         self._valid_names.append(name)

@@ -194,18 +194,30 @@ class _FastState:
         G = ds.bins.shape[0]   # storage columns (EFB bundles, G <= F)
         K = gbdt.num_tree_per_iteration
         n_pad = ds.num_data_padded
-        self.G, self.K, self.n_pad = G, K, n_pad
         # mesh fast path: rows live in ndev device blocks of n_loc real rows
         # + a GUARD-row tail EACH (the partition kernels overrun into the
         # guard, so it must sit at the end of every LOCAL block, not just
         # the global tail).  Guard rows carry idx == n_pad — a dead slot
         # that every original-order consumer (bag refresh, score sync)
         # filters or routes to a zero entry.  Serial is the ndev == 1 case.
-        mesh = gbdt.mesh if gbdt.parallel_mode in ("data", "voting") else None
+        #
+        # feature-parallel: every block is the FULL row set (the reference
+        # learner holds full data per rank) with the storage columns
+        # permuted owned-first; original-order consumers work unchanged
+        # because their idx-routed scatters are idempotent across the
+        # duplicate blocks.
+        mesh = gbdt.mesh if gbdt.parallel_mode in ("data", "voting",
+                                                   "feature") else None
+        feature_par = mesh is not None and gbdt.parallel_mode == "feature"
+        self.feature_par = feature_par
+        if feature_par:
+            # the padded feature axis (shard multiple) IS the storage width
+            G = G + gbdt._fmask_pad
+        self.G, self.K, self.n_pad = G, K, n_pad
         self.mesh = mesh
         ndev = int(mesh.shape[gbdt.mesh_axis]) if mesh is not None else 1
         self.ndev = ndev
-        n_loc = n_pad // ndev
+        n_loc = n_pad if feature_par else n_pad // ndev
         self.n_loc = n_loc
         n_rows = (n_loc + seg.GUARD) * ndev
         self.n_rows = n_rows
@@ -291,6 +303,31 @@ class _FastState:
         if mesh is None:
             build = jax.jit(functools.partial(build_block,
                                               idx0=jnp.int32(0)))
+        elif feature_par:
+            from jax.sharding import PartitionSpec as PS
+            ax = gbdt.mesh_axis
+            Gloc_f = G // ndev
+
+            def build_local_feat(bins_l, label_f, weight_f, vmask_f,
+                                 score_f):
+                # bins arrive feature-sharded [Gloc, N]; gather the full
+                # matrix once and lay this shard's columns first — the
+                # partitioned grower's histogram then walks only the
+                # leading Gloc columns
+                my = lax.axis_index(ax)
+                bins_all = lax.all_gather(bins_l, ax, axis=0, tiled=True)
+                off = my * Gloc_f
+                l_ = jnp.arange(G, dtype=jnp.int32)
+                perm = jnp.where(l_ < Gloc_f, off + l_,
+                                 jnp.where(l_ - Gloc_f < off,
+                                           l_ - Gloc_f, l_))
+                return build_block(bins_all[perm], label_f, weight_f,
+                                   vmask_f, score_f, jnp.int32(0))
+
+            build = jax.jit(jax.shard_map(
+                build_local_feat, mesh=mesh,
+                in_specs=(PS(ax, None), PS(), PS(), PS(), PS(None, None)),
+                out_specs=PS(ax, None), check_vma=False))
         else:
             from jax.sharding import PartitionSpec as PS
             ax = gbdt.mesh_axis
@@ -469,22 +506,52 @@ class _FastState:
         meta_fs = gbdt.meta_dev
         depth_iters_fs = max(gbdt.grower_cfg.num_leaves - 1, 1)
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def payload_tree_add(payload, tree_dev, leaf_scaled, k):
+        def _tree_add_body(payload, tree_dev, leaf_scaled, k, col_of):
             """score[:, k] += leaf_scaled[leaf(x)] routed by the payload's
             OWN bin columns — rows sit in partition order and the bins ride
             along, so DART's drop/normalize score edits (and any other
-            tree replay) never need the original row order."""
+            tree replay) never need the original row order.  col_of maps a
+            per-row global storage-column array to this payload's layout
+            (identity everywhere except feature-parallel's owned-first
+            permutation)."""
             bins_cols = payload[:, :G]
             body = _make_decision_body(
                 tree_dev, meta_fs, bmap_fs,
                 lambda f: jnp.take_along_axis(
-                    bins_cols, bmap_fs.f_group[f][:, None],
+                    bins_cols, col_of(bmap_fs.f_group[f])[:, None],
                     axis=1)[:, 0].astype(jnp.int32))
             nd = lax.fori_loop(0, depth_iters_fs, body,
-                               jnp.zeros(n_rows, jnp.int32))
+                               jnp.zeros(payload.shape[0], jnp.int32))
             return seg.payload_col_write(payload, score0 + k,
                                          leaf_scaled[~nd], "add")
+
+        if feature_par:
+            from jax.sharding import PartitionSpec as PS
+            ax_f = gbdt.mesh_axis
+            Gloc_pta = G // ndev
+
+            def _pta_local(payload_l, tree_dev, leaf_scaled, k):
+                my = lax.axis_index(ax_f)
+                off = my * Gloc_pta
+
+                def col_of(g):
+                    return jnp.where(g < off, Gloc_pta + g,
+                                     jnp.where(g < off + Gloc_pta,
+                                               g - off, g))
+
+                return _tree_add_body(payload_l, tree_dev, leaf_scaled, k,
+                                      col_of)
+
+            payload_tree_add = jax.jit(jax.shard_map(
+                _pta_local, mesh=mesh,
+                in_specs=(PS(ax_f, None), PS(), PS(), PS()),
+                out_specs=PS(ax_f, None), check_vma=False),
+                donate_argnums=(0,))
+        else:
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def payload_tree_add(payload, tree_dev, leaf_scaled, k):
+                return _tree_add_body(payload, tree_dev, leaf_scaled, k,
+                                      lambda g: g)
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def apply_const_score(payload, delta, k):
@@ -947,19 +1014,25 @@ class GBDT:
     # -- one boosting iteration (gbdt.cpp:387-482) ---------------------------
     def _fast_eligible(self) -> bool:
         """The partition-ordered fast path covers the serial GBDT (with or
-        without bagging), the row-sharded mesh learners (tree_learner=
-        data|voting — the partitioned engine runs per shard with
-        collectives at the histogram boundary; feature-parallel keeps the
-        masked engine, its rows are replicated not partitioned), ranking
-        objectives (original-order gradient fill through the index
-        column), leaf-output renewal (except under GOSS), and row counts
-        up to 2^31 (radix-split index columns past 2^24)."""
+        without bagging), ALL THREE mesh learners (tree_learner=
+        data|voting run the partitioned engine per row shard with
+        collectives at the histogram boundary; tree_learner=feature runs
+        it per feature shard over replicated rows with owned-first column
+        permutation — except under forced splits or GOSS, which keep the
+        legacy masked engine), ranking objectives (original-order gradient
+        fill through the index column), leaf-output renewal (except under
+        GOSS), and row counts up to 2^31 (radix-split index columns past
+        2^24)."""
         cfg = self.config
         return ((type(self) is GBDT
                  or getattr(self, "_fast_sample_hook", None) is not None
                  or getattr(self, "_fast_variant_ok", False))
                 and (self.mesh is None
-                     or self.parallel_mode in ("data", "voting"))
+                     or self.parallel_mode in ("data", "voting")
+                     or (self.parallel_mode == "feature"
+                         and self.forced_schedule is None
+                         and getattr(self, "_fast_sample_hook", None)
+                         is None))
                 and self.objective is not None
                 # non-rowwise objectives (ranking) ride the fast path via
                 # the original-order gradient fill; GOSS's fused sampling
@@ -1017,6 +1090,11 @@ class GBDT:
         init_score = self._boost_from_average()
         fs = self._fast_enter()
         fmask = self._feature_sample()
+        if fs.feature_par and self._fmask_pad:
+            # the partitioned grower pads the mask to the shard multiple
+            # itself; _feature_sample's padding serves the legacy masked
+            # engine only
+            fmask = fmask[:self.train_set.num_features]
         self._fast_refresh_bag(fs)
         if fs.K > 1:
             fs.payload = fs._snap_scores(fs.payload)

@@ -88,6 +88,16 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
     - mode="voting": histograms stay local; shards vote top_k features by
       local gain, only the vote winners' histograms are `psum`ed (PV-Tree,
       voting_parallel_tree_learner.cpp), constraints scaled 1/num_machines.
+    - mode="feature": FULL rows per shard with the payload's storage
+      columns permuted OWNED-FIRST (shard r's columns [r*Gloc, (r+1)*Gloc)
+      lead its payload); histograms/search cover only the owned leading
+      columns — the O(rows-touched) cost model with 1/n of the column
+      work — the winner crosses the wire as one SyncUpGlobalBestSplit
+      blob, and each shard partitions its full rows locally with the
+      winner's column translated into its own layout.  This mirrors
+      FeatureParallelTreeLearner (feature_parallel_tree_learner.cpp:21-69:
+      full data per rank, feature-sliced search, no row movement).
+      Unbundled/unforced only; the caller builds the permuted payload.
     """
     L = cfg.num_leaves
     B = num_bins_max
@@ -101,18 +111,26 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
     replicated = meshed and (bundled or forced is not None)
     scatter_mode = meshed and not replicated and mode == "data"
     voting_mode = meshed and not replicated and mode == "voting"
+    feature_mode = meshed and mode == "feature"
     if meshed:
-        assert mode in ("data", "voting"), \
-            "partitioned mesh grower supports data|voting (feature-parallel " \
-            "rides the masked engine)"
+        assert mode in ("data", "voting", "feature"), \
+            "partitioned mesh grower supports data|voting|feature"
+    if feature_mode:
+        # feature-parallel keeps full rows per shard with an OWNED-FIRST
+        # column permutation (the caller lays the payload out that way),
+        # so the histogram walk covers only the shard's own columns; EFB
+        # and forced splits need whole-histogram views and stay on the
+        # replicated/legacy paths (gbdt falls back before reaching here)
+        assert not bundled and forced is None, \
+            "feature-parallel partitioned engine is unbundled/unforced only"
     n_mach = max(num_machines, 1)
-    if scatter_mode:
+    if scatter_mode or feature_mode:
         Gp = -(-G // n_mach) * n_mach
         padg = Gp - G
         Gloc = Gp // n_mach
-    # width of a pooled histogram: the owned scatter slice in data mode,
+    # width of a pooled histogram: the owned slice in data/feature mode,
     # the full (local or replicated) blob otherwise
-    Gh = Gloc if scatter_mode else G
+    Gh = Gloc if (scatter_mode or feature_mode) else G
 
     find_kwargs = dict(
         l1=cfg.lambda_l1, l2=cfg.lambda_l2, max_delta_step=cfg.max_delta_step,
@@ -127,9 +145,12 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
     out_fn = functools.partial(leaf_output, l1=cfg.lambda_l1, l2=cfg.lambda_l2,
                                max_delta_step=cfg.max_delta_step)
 
-    hist_kwargs = dict(num_features=G, num_bins=B, grad_col=cols.grad,
+    # feature mode's payload columns are permuted owned-first, so the
+    # histogram (and its engine/VMEM-fit choice) covers Gloc columns only
+    Ghist = Gloc if feature_mode else G
+    hist_kwargs = dict(num_features=Ghist, num_bins=B, grad_col=cols.grad,
                        hess_col=cols.hess, cnt_col=cols.cnt)
-    impl = seg.resolve_impl(cfg.hist_impl, G, B)
+    impl = seg.resolve_impl(cfg.hist_impl, Ghist, B)
     if impl == "pallas":
         from ..ops import pallas_segment as pseg
         hist_fn = functools.partial(pseg.segment_histogram, **hist_kwargs)
@@ -222,7 +243,14 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         # mesh-mode machinery is built at trace time (axis_index exists only
         # inside shard_map); find_split closes over the feature mask so the
         # split loop below is mode-agnostic
-        if scatter_mode:
+        localize_col = None
+        if scatter_mode or feature_mode:
+            # shared owned-column search: shard `my` owns global storage
+            # columns [my*Gloc, (my+1)*Gloc) — in data mode as its
+            # psum_scatter slice of the reduced histogram, in feature mode
+            # as the leading columns of its permuted payload — and the
+            # winner is broadcast with the SyncUpGlobalBestSplit allreduce
+            # (parallel_tree_learner.h:183-206)
             my = lax.axis_index(axis_name)
             f_offset = my * Gloc
             meta_p = pad_feature_meta(meta, Gp) if padg else meta
@@ -236,11 +264,26 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                        else feature_mask)
             fmask_loc = lax.dynamic_slice_in_dim(fmask_p, f_offset, Gloc)
 
-            def reduce_hist(h):
-                if padg:
-                    h = jnp.pad(h, ((0, padg), (0, 0), (0, 0)))
-                return lax.psum_scatter(h, axis_name, scatter_dimension=0,
-                                        tiled=True)
+            if scatter_mode:
+                def reduce_hist(h):
+                    if padg:
+                        h = jnp.pad(h, ((0, padg), (0, 0), (0, 0)))
+                    return lax.psum_scatter(h, axis_name,
+                                            scatter_dimension=0, tiled=True)
+            else:
+                # feature mode: hist_fn already produced the owned slice
+                # over the full rows — nothing crosses the wire
+                # (FeatureParallelTreeLearner holds full data per rank,
+                # feature_parallel_tree_learner.cpp:21-69)
+                def reduce_hist(h):
+                    return h
+
+                def localize_col(g):
+                    # inverse of the owned-first column permutation:
+                    # [owned block | columns before it | columns after it]
+                    return jnp.where(
+                        g < f_offset, Gloc + g,
+                        jnp.where(g < f_offset + Gloc, g - f_offset, g))
 
             def find_split(hist_loc, sg, sh, cnt, **constraints):
                 return bcast_from_winner(
@@ -292,8 +335,18 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         # every row lands in exactly one bin of storage column 0, so the
         # root totals fall out of the histogram — no separate full-data pass
         totals = jnp.sum(hist_root_local[0], axis=0)
-        if meshed:
+        if meshed and not feature_mode:
             totals = lax.psum(totals, axis_name)
+        elif feature_mode:
+            # every shard sees FULL rows, so its local column-0 totals are
+            # already global IN VALUE — but fp summation order differs per
+            # column at ulp level, and the winner's split outputs are
+            # computed against these totals by whichever shard owns it.
+            # Pin global column 0's totals (shard 0's, the exact sums the
+            # serial engine uses) onto every shard so all shards — and the
+            # serial learner — agree bit-for-bit.
+            totals = lax.psum(jnp.where(my == 0, totals,
+                                        jnp.zeros_like(totals)), axis_name)
         hist_root = reduce_hist(hist_root_local)
         root_g, root_h, root_c = totals[0], totals[1], totals[2]
         if cfg.with_monotone:
@@ -378,8 +431,14 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             when a positive-gain split exists (under lax.cond)."""
             node = s - 1
             f = st["bfeat"][best_leaf]
+            gcol = bmap.f_group[f]
+            if localize_col is not None:
+                # feature mode: the winner carries the GLOBAL feature id;
+                # this shard's payload stores that column at its permuted
+                # position
+                gcol = localize_col(gcol)
             pred = SplitPredicate(
-                col=bmap.f_group[f],
+                col=gcol,
                 threshold=st["bbin"][best_leaf],
                 default_left=st["bdleft"][best_leaf],
                 is_cat=st["bcat"][best_leaf],
