@@ -15,7 +15,8 @@ import sys
 FLAGS = {"acc": "PARTITION_ACC_VALIDATED",
          "roll": "PARTITION_ACC_ROLL_VALIDATED",
          "repeat": "HIST_REPEAT_VALIDATED",
-         "merged": "PARTITION_HIST_VALIDATED"}
+         "merged": "PARTITION_HIST_VALIDATED",
+         "colblock": "HIST_COLBLOCK_VALIDATED"}
 PATH = "lightgbm_tpu/ops/pallas_segment.py"
 
 names = sys.argv[1:]
@@ -37,7 +38,9 @@ rc = subprocess.run([sys.executable, "-m", "pytest",
                      "--deselect",
                      "tests/test_pallas_segment.py::test_validated_flags_gate_product_paths",
                      "--deselect",
-                     "tests/test_pallas_segment.py::test_partition_hist_flag_staged_off"]).returncode
+                     "tests/test_pallas_segment.py::test_partition_hist_flag_staged_off",
+                     "--deselect",
+                     "tests/test_pallas_segment.py::test_colblock_flag_staged_off"]).returncode
 if rc != 0:
     open(PATH, "w").write(orig)   # never leave flipped flags with a red grid
     print("interpret grid FAILED — flags reverted")

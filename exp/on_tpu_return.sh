@@ -7,10 +7,11 @@ echo "=== 1. kernels exact vs portable (incl. the 2-pass partition) ==="
 timeout 400 python exp/smoke_tpu_kernels.py 2>&1 | grep -vE "WARN|INFO|libtpu|common_lib|Failed to find|Logging" | tail -8
 echo "=== 1b. IF step 1 was green: flip remaining validated kernel flags ==="
 echo "   (acc/roll/repeat were validated + flipped in round 4's second"
-echo "    window; the MERGED partition+hist kernel is the staged one now:"
-echo "    inspect the smoke's MERGED PART+HIST section, then"
-echo "    python exp/flip_validated.py merged"
-echo "    and re-run this script so steps 2+ measure the flipped kernel)"
+echo "    window; TWO staged kernels now: the MERGED partition+hist and"
+echo "    the COLBLOCK ultra-wide histogram engine — inspect the smoke's"
+echo "    MERGED PART+HIST and COLBLOCK HIST sections, then"
+echo "    python exp/flip_validated.py merged colblock"
+echo "    and re-run this script so steps 2+ measure the flipped kernels)"
 echo "=== 2. grower profile (fixed cost + scaling) ==="
 timeout 500 python exp/prof_grow_small.py 2>&1 | grep "grow:" || true
 echo "=== 3. bench at 2M rows ==="
@@ -42,3 +43,19 @@ bst = lgb.train({"objective": "binary", "num_leaves": 63, "verbose": -1,
 assert bst._engine._fast_active, "mesh fast path inactive on TPU"
 print("tree_learner=data on the real-chip mesh: 3 iters ok (Pallas inside shard_map)")
 PYEOF
+echo "=== 5. in-loop chunk-size A/B (VERDICT r4 #7 lever) ==="
+LIGHTGBM_TPU_CHUNK=512 BENCH_ROWS=2000000 BENCH_TEST_ROWS=200000 BENCH_ITERS=10 \
+  timeout 550 python bench.py 2>&1 | grep '"metric"' || echo "chunk=512 A/B failed"
+echo "=== 6. feature-parallel fast path on the real chip ==="
+timeout 400 python - <<'PYEOF2' 2>&1 | tail -2
+import numpy as np
+import lightgbm_tpu as lgb
+rng = np.random.default_rng(0)
+X = rng.standard_normal((100000, 28)).astype(np.float32)
+y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+bst = lgb.train({"objective": "binary", "num_leaves": 63, "verbose": -1,
+                 "tree_learner": "feature"},
+                lgb.Dataset(X, label=y), num_boost_round=3)
+print("tree_learner=feature on the real chip: 3 iters ok, fast=%s"
+      % bst._engine._fast_active)
+PYEOF2

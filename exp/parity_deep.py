@@ -129,15 +129,16 @@ def main():
         _, our_curve = run_ours(Xtr, ytr, Xte, yte, leaves, lr)
         print("our curve tail:", our_curve[-3:], flush=True)
 
-        ref_final = ref_curve[-1][1]
-        our_final = our_curve[-1][1]
+        ref_final = float(ref_curve[-1][1])
+        our_final = float(our_curve[-1][1])
         results["higgs_shaped_200k"] = {
             "n_train": len(ytr), "n_test": len(yte), "num_leaves": leaves,
             "learning_rate": lr, "iterations": ITERS,
-            "reference_curve": ref_curve, "our_curve": our_curve,
+            "reference_curve": [[int(i), float(v)] for i, v in ref_curve],
+            "our_curve": [[int(i), float(v)] for i, v in our_curve],
             "reference_final_auc": ref_final, "our_final_auc": our_final,
             "abs_diff": abs(ref_final - our_final), "atol": ATOL,
-            "pass": abs(ref_final - our_final) <= ATOL,
+            "pass": bool(abs(ref_final - our_final) <= ATOL),
         }
         print("final AUC: ours %.5f vs reference %.5f (|diff| %.5f, "
               "atol %.3f)" % (our_final, ref_final,

@@ -282,3 +282,39 @@ for name, fn in (("split: acc+hist", _split_mode), ("merged", _merged_mode)):
     print("per-split device work[%s] 8192 rows: median %.2f ms (fetch-forced)"
           % (name, sorted(ts)[2] * 1e3), flush=True)
 print("MERGED PART+HIST OK on", jax.default_backend(), flush=True)
+
+
+# --- column-block histogram engine: Mosaic-compile + exactness at an
+# ultra-wide payload (the raw-Allstate / Epsilon class that overflows the
+# single-pass plan), including the two-window DMA the single-pass kernel
+# never issues.  Flip pseg.HIST_COLBLOCK_VALIDATED once this section is
+# green on real hardware. ---
+CBF, CBB = 1500, 64            # spans 3 column blocks + ragged tail
+CBP = -(-(CBF + 8) // 128) * 128
+pay_cb = np.zeros((8192 + seg.GUARD, CBP), np.float32)
+pay_cb[:8192, :CBF] = rng.integers(0, CBB, (8192, CBF))
+pay_cb[:8192, CBF] = rng.standard_normal(8192)
+pay_cb[:8192, CBF + 1] = rng.random(8192) + 0.1
+pay_cb[:8192, CBF + 2] = 1.0
+pay_cb = jnp.asarray(pay_cb)
+cbkw = dict(num_features=CBF, num_bins=CBB, grad_col=CBF,
+            hess_col=CBF + 1, cnt_col=CBF + 2)
+assert pseg.fits_vmem_colblock(CBF, CBB, CBP, CBF, CBF + 1, CBF + 2)
+for (s_cb, c_cb) in ((0, 8000), (7, 4097), (513, 256)):
+    h_cb = pseg.segment_histogram_colblock(
+        pay_cb, jnp.int32(s_cb), jnp.int32(c_cb), **cbkw)
+    h_ref = seg.segment_histogram(pay_cb, jnp.int32(s_cb),
+                                  jnp.int32(c_cb), **cbkw)
+    err_cb = float(jnp.abs(h_cb - h_ref).max())
+    print("colblock hist (%d,%d): err=%.3g" % (s_cb, c_cb, err_cb),
+          flush=True)
+    assert err_cb < 1e-3, err_cb
+ts = []
+for i in range(5):
+    t0 = _t.perf_counter()
+    _ = np.asarray(pseg.segment_histogram_colblock(
+        pay_cb, jnp.int32(0), jnp.int32(8192 - i), **cbkw))[0, 0, 2]
+    ts.append(_t.perf_counter() - t0)
+print("colblock hist %dx%d 8192 rows: median %.2f ms (fetch-forced)"
+      % (CBF, CBB, sorted(ts)[2] * 1e3), flush=True)
+print("COLBLOCK HIST OK on", jax.default_backend(), flush=True)

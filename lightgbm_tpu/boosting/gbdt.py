@@ -138,7 +138,8 @@ def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
     from ..ops import pallas_segment as _pseg
     key = (cfg, max_num_bin, ds.bins.shape, cols, payload_width,
            _bundle_key(ds), forced, mesh, mesh_axis, mode, top_k,
-           _pseg.PARTITION_HIST_VALIDATED,   # flips grower structure
+           _pseg.PARTITION_HIST_VALIDATED,   # these two flip grower
+           _pseg.HIST_COLBLOCK_VALIDATED,    # structure when toggled
            tuple((m.num_bin, m.missing_type, m.default_bin, m.is_trivial, m.bin_type)
                  for m in ds.bin_mappers),
            ds.monotone_constraints.tobytes(), ds.feature_penalty.tobytes())
@@ -162,7 +163,8 @@ def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
                 jit=False, bundle_map=bundle_map,
                 num_columns=ds.bins.shape[0], forced=forced,
                 axis_name=ax, mode=mode,
-                num_machines=int(mesh.shape[ax]), top_k=top_k)
+                num_machines=int(mesh.shape[ax]), top_k=top_k,
+                payload_width=payload_width)
             tree_specs = dict.fromkeys(_PTREE_REPLICATED, P())
             # per-device row segments come back stacked [ndev * L]
             tree_specs["seg_start"] = P(ax)

@@ -155,7 +155,22 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         from ..ops import pallas_segment as pseg
         hist_fn = functools.partial(pseg.segment_histogram, **hist_kwargs)
     else:
-        hist_fn = functools.partial(seg.segment_histogram, **hist_kwargs)
+        # ultra-wide payloads (raw Allstate 4228x256, Epsilon-dense) fall
+        # off the single-pass kernel's VMEM plan; the column-block sibling
+        # engine serves them once hardware-validated
+        from ..ops import pallas_segment as pseg
+        colblock = (cfg.hist_impl != "lax"
+                    and jax.default_backend() == "tpu"
+                    and pseg.HIST_COLBLOCK_VALIDATED
+                    and payload_width is not None
+                    and pseg.fits_vmem_colblock(
+                        Ghist, B, payload_width, cols.grad, cols.hess,
+                        cols.cnt))
+        if colblock:
+            hist_fn = functools.partial(pseg.segment_histogram_colblock,
+                                        **hist_kwargs)
+        else:
+            hist_fn = functools.partial(seg.segment_histogram, **hist_kwargs)
 
     # the partition kernel is gated separately from the histogram: it is
     # exact at any bin count (HIGHEST-precision permutation) but spans the

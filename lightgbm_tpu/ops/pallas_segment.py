@@ -92,6 +92,23 @@ HIST_REPEAT_VALIDATED = True
 PARTITION_ACC_ROLL_VALIDATED = True
 
 
+#: True once the COLUMN-BLOCK histogram engine is hardware-validated: it
+#: serves ultra-wide payloads (raw Allstate 4228x256, Epsilon-dense 2000
+#: cols) that overflow the single-pass kernel's VMEM plan, by running the
+#: sibling kernel once per 128-aligned feature-column block — each pass
+#: DMAs only its own lane windows (block + aux columns), so total HBM
+#: traffic matches the single-pass kernel while VMEM stays bounded by the
+#: block width.  OFF until exp/smoke_tpu_kernels.py proves the Mosaic
+#: lowering on a real chip (round-4 discipline: interpret mode proves
+#: nothing about Mosaic legality, esp. the two-window DMA).
+HIST_COLBLOCK_VALIDATED = False
+
+#: feature-column block width (payload lanes) for the column-block engine;
+#: 128-aligned by construction.  512 keeps the per-pass plan ~10 MB at
+#: B=256 (64 tiles * 2048 accumulator + block/aux chunk buffers).
+COLBLOCK_WIDTH = 512
+
+
 #: True once the merged partition+histogram kernel is hardware-validated:
 #: pass A of the accumulator partition already has every parent row in
 #: VMEM, so BOTH children's histograms fall out of one shared one-hot per
@@ -427,6 +444,229 @@ def _untile_hist(out, F, B, Ft, n_tiles, W, expand_impl):
     return (ghc[:, :, :Ft * B]
             .reshape(n_tiles, 3, Ft, B).transpose(1, 0, 2, 3)
             .reshape(3, n_tiles * Ft, B)[:, :F].transpose(1, 2, 0))
+
+
+# ---------------------------------------------------------------------------
+# column-block histogram engine (ultra-wide payloads)
+# ---------------------------------------------------------------------------
+
+def colblock_plan(num_features: int, num_bins: int, payload_width: int,
+                  grad_col: int, hess_col: int, cnt_col: int):
+    """Lane-window plan for the column-block engine, or None.
+
+    Returns (blocks, aux_lo, aux_w): blocks is [(col_lo, fcount, width)]
+    with col_lo/width multiples of 128 (Mosaic DMA slices span whole lane
+    tiles), and [aux_lo, aux_lo+aux_w) covers the grad/hess/cnt lanes."""
+    if num_bins > 256:
+        return None
+    P = payload_width
+    if P % 128 != 0:
+        # the engine slices lane windows; the training payload is always
+        # lane-padded on TPU (_FastState.P), so this only excludes ad-hoc
+        # callers, who keep the single-pass kernel or the portable path
+        return None
+    lo = min(grad_col, hess_col, cnt_col)
+    hi = max(grad_col, hess_col, cnt_col) + 1
+    aux_lo = (lo // 128) * 128
+    aux_w = -(-(hi - aux_lo) // 128) * 128
+    if aux_lo + aux_w > P or num_features > P:
+        return None
+    blocks = []
+    c = 0
+    while c < num_features:
+        bw = min(COLBLOCK_WIDTH, P - c)
+        blocks.append((c, min(num_features - c, bw), bw))
+        c += bw
+    return blocks, aux_lo, aux_w
+
+
+def fits_vmem_colblock(num_features: int, num_bins: int, payload_width: int,
+                       grad_col: int, hess_col: int, cnt_col: int) -> bool:
+    """True when every per-block pass of the column-block engine fits the
+    VMEM budget (same cost model as fits_vmem, but chunk buffers span only
+    the block + aux windows and the accumulator only the block's tiles)."""
+    plan = colblock_plan(num_features, num_bins, payload_width,
+                         grad_col, hess_col, cnt_col)
+    if plan is None:
+        return False
+    blocks, _, aux_w = plan
+    worst_f = max(f for _, f, _ in blocks)
+    worst_bw = max(bw for _, _, bw in blocks)
+    ft, n_tiles, w = _tiling(worst_f, num_bins)
+    est = (2 * 4 * CHUNK * w                   # expand + one-hot tiles
+           + 4 * 8 * n_tiles * w               # block accumulator
+           + 2 * 4 * CHUNK * (worst_bw + aux_w)  # block+aux chunks x2 (DMA)
+           + 4 * ft * w)                       # window expander
+    return est <= _VMEM_BUDGET
+
+
+def _hist_colblock_kernel(scalars, payload_hbm, out_ref, chunk_blk,
+                          chunk_aux, sem, *, Fb, B, Ft, W, col_lo, aux_lo,
+                          g_off, h_off, c_off, expand_impl):
+    """Sibling of _hist_kernel for ONE feature-column block of an
+    ultra-wide payload (a trace-time share was rejected for the same
+    reason as the merged kernel's: _hist_kernel is hardware-validated and
+    must not be restructured blind; test_colblock_matches_hist_kernel
+    pins the two against each other).
+
+    Differences from the parent: each chunk DMAs TWO lane windows — the
+    block's own columns [col_lo, col_lo+BW) and the aux window carrying
+    grad/hess/cnt — instead of the full payload width, so VMEM scales
+    with the block width and total HBM traffic across all blocks matches
+    the single-pass kernel's one full read."""
+    start = scalars[0]
+    count = scalars[1]
+    shift = lax.rem(start, 8)
+    base = start - shift
+    nch = jnp.where(count > 0, (shift + count + CHUNK - 1) // CHUNK, 0)
+    n_tiles = -(-Fb // Ft)
+    BW = chunk_blk.shape[2]
+    AW = chunk_aux.shape[2]
+    out_ref[:] = jnp.zeros(out_ref.shape, out_ref.dtype)
+    iota_rows = _row_iota()
+
+    def dmas_for(k, slot):
+        rows = pl.ds(pl.multiple_of(base + k * CHUNK, 8), CHUNK)
+        return (pltpu.make_async_copy(
+                    payload_hbm.at[rows, pl.ds(col_lo, BW)],
+                    chunk_blk.at[slot], sem.at[slot, 0]),
+                pltpu.make_async_copy(
+                    payload_hbm.at[rows, pl.ds(aux_lo, AW)],
+                    chunk_aux.at[slot], sem.at[slot, 1]))
+
+    @pl.when(nch > 0)
+    def _prefetch_first():
+        for d in dmas_for(0, 0):
+            d.start()
+
+    if expand_impl == "repeat":
+        jdivs = {}
+        for t in range(n_tiles):
+            fw = min(Ft, Fb - t * Ft)
+            if fw not in jdivs:
+                jdivs[fw] = (lax.broadcasted_iota(jnp.int32, (1, fw * B), 1)
+                             // fw).astype(jnp.float32)
+    if expand_impl == "matmul":
+        iota_fr = lax.broadcasted_iota(jnp.int32, (Ft, W), 0)
+        iota_fc = lax.broadcasted_iota(jnp.int32, (Ft, W), 1)
+        d = iota_fc - iota_fr * B
+        in_win = (d >= 0) & (d < B)
+        E = in_win.astype(jnp.float32)
+        jmod = jnp.sum(jnp.where(in_win, d, 0), axis=0)
+        jmod_f = jmod.astype(jnp.float32)
+
+    def body(k, _):
+        slot = lax.rem(k, 2)
+
+        @pl.when(k + 1 < nch)
+        def _prefetch_next():
+            for d in dmas_for(k + 1, lax.rem(k + 1, 2)):
+                d.start()
+
+        for d in dmas_for(k, slot):
+            d.wait()
+        data = chunk_blk[slot]
+        aux = chunk_aux[slot]
+        ok = ((iota_rows >= shift - k * CHUNK) &
+              (iota_rows < shift + count - k * CHUNK)).astype(jnp.float32)
+        # exact bf16 part-decomposition of grad/hess (see _hist_kernel)
+        iota_r8 = lax.broadcasted_iota(jnp.int32, (8, AW), 0)
+        iota_pc = lax.broadcasted_iota(jnp.int32, (8, AW), 1)
+        sel = (((iota_r8 < 3) & (iota_pc == g_off)) |
+               ((iota_r8 >= 3) & (iota_r8 < 6) & (iota_pc == h_off)) |
+               ((iota_r8 == 6) & (iota_pc == c_off))).astype(jnp.float32)
+        raw = lax.dot_general(
+            sel, aux, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST)                     # [8, C]
+        hi = raw.astype(jnp.bfloat16).astype(jnp.float32)
+        r1 = raw - hi
+        mid = r1.astype(jnp.bfloat16).astype(jnp.float32)
+        lo = r1 - mid
+        rr = lax.broadcasted_iota(jnp.int32, raw.shape, 0)
+        vals = jnp.where((rr == 0) | (rr == 3), hi,
+                         jnp.where((rr == 1) | (rr == 4), mid,
+                                   jnp.where((rr == 2) | (rr == 5), lo,
+                                             raw)))
+        vals = vals * ok[None, :]
+        for t in range(n_tiles):
+            f0 = t * Ft
+            fw = min(Ft, Fb - f0)
+            binsf = data[:, f0:f0 + fw]
+            if expand_impl == "repeat":
+                rep = pltpu.repeat(binsf, B, axis=1)
+                onehot = (rep == jdivs[fw]).astype(jnp.float32)
+                out_ref[8 * t:8 * t + 8, :fw * B] += lax.dot_general(
+                    vals, onehot,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            else:
+                expand = lax.dot_general(
+                    binsf, E[:fw, :],
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                onehot = (expand == jmod_f[None, :]).astype(jnp.float32)
+                out_ref[8 * t:8 * t + 8, :] += lax.dot_general(
+                    vals, onehot,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+        return 0
+
+    lax.fori_loop(0, nch, body, 0, unroll=False)
+
+
+def segment_histogram_colblock(payload, start, count, *, num_features,
+                               num_bins, grad_col, hess_col, cnt_col,
+                               interpret=False, expand_impl=None):
+    """hist[F, B, 3] over an ULTRA-WIDE payload: one sibling-kernel pass
+    per 128-aligned feature-column block (colblock_plan)."""
+    plan = colblock_plan(num_features, num_bins, payload.shape[1],
+                         grad_col, hess_col, cnt_col)
+    if plan is None:
+        raise ValueError("column-block plan unavailable for this payload")
+    blocks, aux_lo, aux_w = plan
+    outs = []
+    for (col_lo, fb, bw) in blocks:
+        ei = expand_impl or _default_expand_impl(fb, num_bins)
+        outs.append(_segment_histogram_colblock(
+            payload, start, count, num_features=fb, num_bins=num_bins,
+            col_lo=col_lo, block_w=bw, aux_lo=aux_lo, aux_w=aux_w,
+            g_off=grad_col - aux_lo, h_off=hess_col - aux_lo,
+            c_off=cnt_col - aux_lo, interpret=interpret, expand_impl=ei))
+    return jnp.concatenate(outs, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_features", "num_bins", "col_lo", "block_w", "aux_lo", "aux_w",
+    "g_off", "h_off", "c_off", "interpret", "expand_impl"))
+def _segment_histogram_colblock(payload, start, count, *, num_features,
+                                num_bins, col_lo, block_w, aux_lo, aux_w,
+                                g_off, h_off, c_off, interpret,
+                                expand_impl):
+    Fb, B = num_features, num_bins
+    Ft, n_tiles, W = _tiling(Fb, B)
+    scalars = jnp.stack([start, count]).astype(jnp.int32)
+    kern = functools.partial(_hist_colblock_kernel, Fb=Fb, B=B, Ft=Ft, W=W,
+                             col_lo=col_lo, aux_lo=aux_lo, g_off=g_off,
+                             h_off=h_off, c_off=c_off,
+                             expand_impl=expand_impl)
+    out = pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((2, CHUNK, block_w), jnp.float32),
+                pltpu.VMEM((2, CHUNK, aux_w), jnp.float32),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((8 * n_tiles, W), jnp.float32),
+        interpret=interpret,
+    )(scalars, payload)
+    return _untile_hist(out, Fb, B, Ft, n_tiles, W, expand_impl)
 
 
 # ---------------------------------------------------------------------------

@@ -340,3 +340,85 @@ def test_partition_hist_merged_predicates(predkw):
                                rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(np.asarray(hr), np.asarray(hrr),
                                rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# column-block engine (ultra-wide payloads)
+# ---------------------------------------------------------------------------
+
+def _wide_payload(n_pad, F_wide, B_wide, seed=0):
+    """Ultra-wide payload: F_wide bin columns, aux (grad/hess/cnt) after
+    them, lane-padded width like the fast path's _FastState.P."""
+    rng = np.random.default_rng(seed)
+    P_wide = -(-(F_wide + 8) // 128) * 128
+    pay = np.zeros((n_pad + seg.GUARD, P_wide), np.float32)
+    pay[:n_pad, :F_wide] = rng.integers(0, B_wide, size=(n_pad, F_wide))
+    pay[:n_pad, F_wide] = rng.standard_normal(n_pad)
+    pay[:n_pad, F_wide + 1] = rng.random(n_pad)
+    pay[:n_pad, F_wide + 2] = 1.0
+    cols = dict(grad_col=F_wide, hess_col=F_wide + 1, cnt_col=F_wide + 2)
+    return jnp.asarray(pay), cols
+
+
+def test_colblock_flag_staged_off():
+    # pinned OFF until a hardware smoke validates the two-window DMA
+    # lowering; flip in the SAME commit as exp/flip_validated.py colblock
+    assert pseg.HIST_COLBLOCK_VALIDATED is False
+
+
+@pytest.mark.parametrize("fw,bw", [(4228, 256), (2000, 64), (700, 256)])
+def test_colblock_plan_and_gate(fw, bw):
+    """Raw-Allstate / Epsilon / Expo widths all get a colblock plan whose
+    per-pass VMEM fits, even where the single-pass kernel's plan cannot."""
+    pay, cols = _wide_payload(8, fw, min(bw, 32))  # tiny rows; plan only
+    P_wide = pay.shape[1]
+    assert pseg.fits_vmem_colblock(fw, bw, P_wide, **{
+        "grad_col": cols["grad_col"], "hess_col": cols["hess_col"],
+        "cnt_col": cols["cnt_col"]})
+    if (fw, bw) == (4228, 256):
+        # the one benchmark shape the single-pass kernel cannot plan
+        assert not pseg.fits_vmem(fw, bw)
+    blocks, aux_lo, aux_w = pseg.colblock_plan(
+        fw, bw, P_wide, cols["grad_col"], cols["hess_col"],
+        cols["cnt_col"])
+    assert sum(f for _, f, _ in blocks) == fw
+    assert all(lo % 128 == 0 and w % 128 == 0 for lo, _, w in blocks)
+    assert aux_lo % 128 == 0 and aux_lo + aux_w <= P_wide
+    assert aux_lo <= cols["grad_col"] < aux_lo + aux_w
+    assert aux_lo <= cols["cnt_col"] < aux_lo + aux_w
+
+
+@pytest.mark.parametrize("start,count", [(0, 1000), (256, 700), (100, 37),
+                                         (0, 0), (7, 1), (9, 1015)])
+def test_colblock_matches_portable_wide(start, count):
+    """Exactness at an ultra-wide shape (1500 features x 16 bins keeps
+    interpret-mode runtime sane while spanning multiple 512-lane blocks
+    and a ragged tail)."""
+    Fw, Bw = 1500, 16
+    pay, cols = _wide_payload(1024, Fw, Bw, seed=5)
+    ref = seg.segment_histogram(pay, jnp.int32(start), jnp.int32(count),
+                                num_features=Fw, num_bins=Bw, **cols)
+    got = pseg.segment_histogram_colblock(
+        pay, jnp.int32(start), jnp.int32(count), num_features=Fw,
+        num_bins=Bw, interpret=True, **cols)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("expand", ["matmul", "repeat"])
+def test_colblock_matches_hist_kernel(expand):
+    """At a width BOTH engines handle, the colblock sibling must equal the
+    hardware-validated single-pass kernel bit-for-bit (interpret mode) —
+    the same pinning discipline as the merged kernel."""
+    pay = _payload(1024, seed=42)
+    # the colblock engine requires a lane-padded payload (the fast path's
+    # _FastState.P guarantee); pad the narrow test payload to 128 lanes
+    pay128 = jnp.pad(pay, ((0, 0), (0, 128 - pay.shape[1])))
+    ref = pseg.segment_histogram(pay128, jnp.int32(0), jnp.int32(1000),
+                                 num_features=F, num_bins=B,
+                                 interpret=True, expand_impl=expand,
+                                 **COLS)
+    got = pseg.segment_histogram_colblock(
+        pay128, jnp.int32(0), jnp.int32(1000), num_features=F, num_bins=B,
+        interpret=True, expand_impl=expand, **COLS)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))

@@ -143,7 +143,12 @@ def test_voting_restricted_vote_accuracy(binary_data):
         return -np.mean(yt * np.log(p) + (1 - yt) * np.log(1 - p))
 
     ls, lv = logloss(serial), logloss(par)
-    assert lv < ls + 0.02, (lv, ls)
+    # regression-pinned (round 5): measured delta on this config is
+    # 0.00057; 0.003 leaves ~5x platform headroom while still catching
+    # vote-quality drift that the old 0.02 bound (35x the real gap)
+    # would have slept through
+    assert lv < ls + 0.003, (lv, ls)
+    assert lv < 0.56, lv
 
 
 @pytest.mark.parametrize("boosting,extra", [
