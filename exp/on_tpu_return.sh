@@ -56,6 +56,6 @@ y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
 bst = lgb.train({"objective": "binary", "num_leaves": 63, "verbose": -1,
                  "tree_learner": "feature"},
                 lgb.Dataset(X, label=y), num_boost_round=3)
-print("tree_learner=feature on the real chip: 3 iters ok, fast=%s"
-      % bst._engine._fast_active)
+assert bst._engine._fast_active, "feature-parallel fell off the fast path"
+print("tree_learner=feature on the real chip: 3 iters ok, fast path active")
 PYEOF2

@@ -512,8 +512,9 @@ def _hist_colblock_kernel(scalars, payload_hbm, out_ref, chunk_blk,
     Differences from the parent: each chunk DMAs TWO lane windows — the
     block's own columns [col_lo, col_lo+BW) and the aux window carrying
     grad/hess/cnt — instead of the full payload width, so VMEM scales
-    with the block width and total HBM traffic across all blocks matches
-    the single-pass kernel's one full read."""
+    with the block width.  Bin columns are read once across all blocks;
+    the aux window is re-read per block (~25% extra HBM traffic at
+    raw-Allstate geometry — the price of bounded VMEM)."""
     start = scalars[0]
     count = scalars[1]
     shift = lax.rem(start, 8)

@@ -178,3 +178,31 @@ def test_binary_example_variants_long(tmp_path, variant_extra, min_rel):
     # tree sequences differ by construction; the parity claim is quality
     assert auc_ours > auc_ref - min_rel, (auc_ours, auc_ref)
     assert auc_ours > 0.75
+
+
+def test_higgs_shaped_deep_two_sided_parity(tmp_path):
+    """VERDICT r4 #5: metric CLOSENESS at depth, two-sided — not the
+    one-sided drift bound above.  Higgs-shaped synthetic at 50k rows, 63
+    leaves, 300 rounds, both engines trained on the SAME tsv the
+    reference CLI reads; measured gap 0.0038 absolute (ours 0.8185 vs
+    reference 0.8223), pinned at 0.008.  The full-scale evidence (200k
+    rows, 500 rounds: ours 0.8305 vs reference 0.8296, gap 0.0009) is
+    recorded with both curves in docs/PARITY_DEEP.json by
+    exp/parity_deep.py."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "exp"))
+    import parity_deep as pd
+    # pin the depth the 0.008 bound was calibrated at, regardless of any
+    # PARITY_ITERS the shell exported for standalone parity_deep runs
+    pd.ITERS = 300
+    (Xtr, ytr), (Xte, yte) = pd.higgs_shaped(n_train=50_000, n_test=12_500)
+    tf = str(tmp_path / "tr.tsv")
+    sf = str(tmp_path / "te.tsv")
+    pd.write_tsv(tf, Xtr, ytr)
+    pd.write_tsv(sf, Xte, yte)
+    _, ref_curve = pd.run_reference(tf, sf, str(tmp_path), 63, 0.1)
+    _, our_curve = pd.run_ours(Xtr, ytr, Xte, yte, 63, 0.1)
+    ref_final, our_final = ref_curve[-1][1], our_curve[-1][1]
+    assert abs(ref_final - our_final) < 0.008, (our_final, ref_final)
+    assert our_final > 0.8
