@@ -16,7 +16,8 @@ FLAGS = {"acc": "PARTITION_ACC_VALIDATED",
          "roll": "PARTITION_ACC_ROLL_VALIDATED",
          "repeat": "HIST_REPEAT_VALIDATED",
          "merged": "PARTITION_HIST_VALIDATED",
-         "colblock": "HIST_COLBLOCK_VALIDATED"}
+         "colblock": "HIST_COLBLOCK_VALIDATED",
+         "ring4": "PARTITION_RING4_VALIDATED"}
 PATH = "lightgbm_tpu/ops/pallas_segment.py"
 
 names = sys.argv[1:]
@@ -40,7 +41,9 @@ rc = subprocess.run([sys.executable, "-m", "pytest",
                      "--deselect",
                      "tests/test_pallas_segment.py::test_partition_hist_flag_staged_off",
                      "--deselect",
-                     "tests/test_pallas_segment.py::test_colblock_flag_staged_off"]).returncode
+                     "tests/test_pallas_segment.py::test_colblock_flag_staged_off",
+                     "--deselect",
+                     "tests/test_pallas_segment.py::test_ring4_flag_staged_off"]).returncode
 if rc != 0:
     open(PATH, "w").write(orig)   # never leave flipped flags with a red grid
     print("interpret grid FAILED — flags reverted")

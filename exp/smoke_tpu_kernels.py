@@ -318,3 +318,52 @@ for i in range(5):
 print("colblock hist %dx%d 8192 rows: median %.2f ms (fetch-forced)"
       % (CBF, CBB, sorted(ts)[2] * 1e3), flush=True)
 print("COLBLOCK HIST OK on", jax.default_backend(), flush=True)
+
+
+# --- 4-deep read ring for the acc partition: Mosaic-compile + exactness
+# + race vs the validated 2-deep ring (the per-chunk DMA wait is the
+# measured bottleneck; depth 4 issues three chunks ahead).  Flip
+# pseg.PARTITION_RING4_VALIDATED once green AND the race favors (or
+# ties) depth 4. ---
+for rd in (2, 4):
+    p_r4, _, nl_r4 = pseg.partition_segment_acc(
+        jnp.asarray(pay_m), jnp.zeros_like(pay_m), jnp.int32(128),
+        jnp.int32(7000), pred_m, jnp.float32(1.5), jnp.float32(-2.5),
+        MVAL, MB, ring_depth=rd)
+    if rd == 2:
+        p_ref_r, nl_ref_r = np.asarray(p_r4), int(nl_r4)
+    else:
+        assert int(nl_r4) == nl_ref_r
+        err_r4 = float(np.abs(np.asarray(p_r4) - p_ref_r).max())
+        print("ring4 vs ring2 exactness: err=%.3g" % err_r4, flush=True)
+        assert err_r4 == 0.0, err_r4
+for rd in (2, 4):
+    ts = []
+    for _ in range(5):
+        p_, a_ = jnp.asarray(pay_m), jnp.zeros_like(pay_m)
+        _ = np.asarray(p_)[0, 0]
+        t0 = _t.perf_counter()
+        nl_ = int(pseg.partition_segment_acc(
+            p_, a_, jnp.int32(0), jnp.int32(8192), pred_m,
+            jnp.float32(1.), jnp.float32(-1.), MVAL, MB,
+            ring_depth=rd)[2])
+        ts.append(_t.perf_counter() - t0)
+    print("acc partition ring=%d 8192 rows: median %.2f ms (fetch-forced)"
+          % (rd, sorted(ts)[2] * 1e3), flush=True)
+print("RING OK on", jax.default_backend(), flush=True)
+# the flip also switches the MERGED kernel's ring: validate it at depth 4
+p_m4, _, nl_m4, hl_m4, hr_m4 = pseg.partition_segment_hist(
+    jnp.asarray(pay_m), jnp.zeros_like(pay_m), jnp.int32(128),
+    jnp.int32(7000), pred_m, jnp.float32(1.5), jnp.float32(-2.5),
+    MVAL, MB, ring_depth=4, **mkw)
+p_m2, _, nl_m2, hl_m2, hr_m2 = pseg.partition_segment_hist(
+    jnp.asarray(pay_m), jnp.zeros_like(pay_m), jnp.int32(128),
+    jnp.int32(7000), pred_m, jnp.float32(1.5), jnp.float32(-2.5),
+    MVAL, MB, ring_depth=2, **mkw)
+assert int(nl_m4) == int(nl_m2)
+err_m4 = max(float(jnp.abs(p_m4 - p_m2).max()),
+             float(jnp.abs(hl_m4 - hl_m2).max()),
+             float(jnp.abs(hr_m4 - hr_m2).max()))
+print("merged kernel ring4 vs ring2: err=%.3g" % err_m4, flush=True)
+assert err_m4 == 0.0, err_m4
+print("RING(MERGED) OK on", jax.default_backend(), flush=True)

@@ -92,6 +92,24 @@ HIST_REPEAT_VALIDATED = True
 PARTITION_ACC_ROLL_VALIDATED = True
 
 
+#: True once the 4-deep read ring is hardware-validated for the
+#: accumulator partition kernel (and its merged variant).  The validated
+#: default is the 2-deep ring: prefetch issues one chunk ahead, so a DMA
+#: latency longer than one chunk's compute stalls every iteration —
+#: round 4 measured the kernel latency-bound at ~2% of HBM bandwidth.
+#: Depth 4 issues three chunks ahead (ring slots are a parameter, the
+#: instruction mix is unchanged), trading 2*C*P*4 bytes of VMEM for up
+#: to 3x more latency hiding.  OFF until the smoke's RING section
+#: proves it on a real chip and races the depths.
+PARTITION_RING4_VALIDATED = False
+
+
+def _ring_depth_default() -> int:
+    """Single source of the flag-to-depth mapping (kernels + VMEM gates
+    must agree on the scratch the flag buys)."""
+    return 4 if PARTITION_RING4_VALIDATED else 2
+
+
 #: True once the COLUMN-BLOCK histogram engine is hardware-validated: it
 #: serves ultra-wide payloads (raw Allstate 4228x256, Epsilon-dense 2000
 #: cols) that overflow the single-pass kernel's VMEM plan, by running the
@@ -131,22 +149,28 @@ def partition_hist_fits_vmem(payload_width: int, num_features: int,
         return False
     ft, n_tiles, w = _tiling(num_features, num_bins)
     P, C = payload_width, CHUNK
-    est_acc = (4 * P * 18 * C + 4 * 8 * C * C + 4 * C * num_bins)
+    ring_depth = _ring_depth_default()
+    est_acc = ((ring_depth - 2) * 4 * P * C
+               + 4 * P * 18 * C + 4 * 8 * C * C + 4 * C * num_bins)
     est_hist = (2 * 4 * CHUNK * w              # expand/rep + one-hot tile
                 + 2 * 4 * 8 * n_tiles * w      # two child accumulators
                 + 4 * ft * w)                  # window expander
     return est_acc + est_hist <= _VMEM_BUDGET
 
 
-def partition_acc_fits_vmem(payload_width: int, num_bins: int) -> bool:
+def partition_acc_fits_vmem(payload_width: int, num_bins: int,
+                            ring_depth: int = None) -> bool:
     """VMEM plan of the accumulator-window partition kernel: read ring,
     two [2C, P] accumulators, stage/blend buffers, the P-wide placement
     intermediates (budgeted for the LARGER of the two placement modes —
     roll mode keeps parts + compacted + doubled + rolled buffers live per
     side, ~8C rows vs the matmul mode's shared ~5C), the placement
     one-hot machinery and the categorical bitset one-hot."""
+    if ring_depth is None:
+        ring_depth = _ring_depth_default()
     P, C = payload_width, CHUNK
-    est = (4 * P * 18 * C          # ring(2C) + accs(4C) + stage/rbuf(2C) + placement intermediates(~10C, roll mode worst case)
+    est = ((ring_depth - 2) * 4 * P * C   # ring slots past the baseline 2
+           + 4 * P * 18 * C   # ring(2C) + accs(4C) + stage/rbuf(2C) + placement intermediates(~10C, roll mode worst case)
            + 4 * 8 * C * C         # worst mode's [*, C] one-hot machinery:
                                    #   matmul: mat[2C,C] + iota_2i[2C,C] +
                                    #           rank's ri/rj/tri [C,C] x3 (7C*C)
@@ -1078,19 +1102,25 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
                         dimension_numbers=(((1,), (0,)), ((), ())),
                         preferred_element_type=jnp.float32)
 
+    R = ring.shape[0]   # ring depth: 2 validated, 4 staged (RING4 flag)
+
     @pl.when(nch > 0)
     def _prefetch_first():
-        ring_dma(payload_out, 0, 0).start()
+        # fill the ring: R-1 chunks in flight before the loop starts
+        for i in range(R - 1):
+            @pl.when(i < nch)
+            def _start(i=i):
+                ring_dma(payload_out, i, i).start()
 
     # ---- pass A: one read of the segment; lefts accumulate toward payload
     # windows, rights accumulate toward aux staging windows -------------
     def body_a(k, carry):
         nl, nr, lo_, ro_, lfl, rfl, pl_, pr_ = carry
-        slot = lax.rem(k, 2)
+        slot = lax.rem(k, R)
 
-        @pl.when(k + 1 < nch)
+        @pl.when(k + R - 1 < nch)
         def _prefetch_next():
-            ring_dma(payload_out, k + 1, lax.rem(k + 1, 2)).start()
+            ring_dma(payload_out, k + R - 1, lax.rem(k + R - 1, R)).start()
 
         ring_dma(payload_out, k, slot).wait()
         data = ring[slot]
@@ -1160,15 +1190,18 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
 
     @pl.when(nchb > 0)
     def _prefetch_b():
-        ring_dma(aux_out, 0, 0).start()
+        for i in range(R - 1):
+            @pl.when(i < nchb)
+            def _start(i=i):
+                ring_dma(aux_out, i, i).start()
 
     def body_b(k, carry):
         lo_, lfl, pl_ = carry
-        slot = lax.rem(k, 2)
+        slot = lax.rem(k, R)
 
-        @pl.when(k + 1 < nchb)
+        @pl.when(k + R - 1 < nchb)
         def _prefetch_next():
-            ring_dma(aux_out, k + 1, lax.rem(k + 1, 2)).start()
+            ring_dma(aux_out, k + R - 1, lax.rem(k + R - 1, R)).start()
 
         ring_dma(aux_out, k, slot).wait()
         j0 = jnp.maximum(shift - k * CHUNK, 0)
@@ -1222,22 +1255,26 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
 
 def partition_segment_acc(payload, aux, start, count, pred, left_value,
                           right_value, value_col, num_bins, interpret=False,
-                          roll_place=None):
+                          roll_place=None, ring_depth=None):
     """Same contract as `partition_segment`, accumulator-window kernel.
-    The roll_place default is resolved OUTSIDE the jit cache so flipping
-    PARTITION_ACC_ROLL_VALIDATED takes effect on warm traces."""
+    Flag defaults (roll_place, ring_depth) resolve OUTSIDE the jit cache
+    so flipping the validated flags takes effect on warm traces."""
     if roll_place is None:
         roll_place = PARTITION_ACC_ROLL_VALIDATED
+    if ring_depth is None:
+        ring_depth = _ring_depth_default()
     return _partition_segment_acc(payload, aux, start, count, pred,
                                   left_value, right_value, value_col,
-                                  num_bins, interpret, bool(roll_place))
+                                  num_bins, interpret, bool(roll_place),
+                                  int(ring_depth))
 
 
 @functools.partial(jax.jit, static_argnames=("value_col", "num_bins",
-                                             "interpret", "roll_place"))
+                                             "interpret", "roll_place",
+                                             "ring_depth"))
 def _partition_segment_acc(payload, aux, start, count, pred, left_value,
                            right_value, value_col, num_bins, interpret,
-                           roll_place):
+                           roll_place, ring_depth):
     P = payload.shape[1]
     B = num_bins
     scalars = jnp.stack([
@@ -1262,12 +1299,12 @@ def _partition_segment_acc(payload, aux, start, count, pred, left_value,
                        pl.BlockSpec(memory_space=pl.ANY),
                        pl.BlockSpec(memory_space=pltpu.SMEM)),
             scratch_shapes=[
-                pltpu.VMEM((2, CHUNK, P), jnp.float32),   # read ring
+                pltpu.VMEM((ring_depth, CHUNK, P), jnp.float32),  # read ring
                 pltpu.VMEM((C2, P), jnp.float32),         # left accumulator
                 pltpu.VMEM((C2, P), jnp.float32),         # right accumulator
                 pltpu.VMEM((CHUNK, P), jnp.float32),      # flush stage
                 pltpu.VMEM((CHUNK, P), jnp.float32),      # final blend read
-                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((ring_depth,)),
                 pltpu.SemaphoreType.DMA(()),
                 pltpu.SemaphoreType.DMA(()),
             ],
@@ -1286,7 +1323,7 @@ def partition_segment_hist(payload, aux, start, count, pred, left_value,
                            right_value, value_col, num_bins, *,
                            num_features, grad_col, hess_col, cnt_col,
                            interpret=False, roll_place=None,
-                           expand_impl=None):
+                           expand_impl=None, ring_depth=None):
     """Merged partition + both-child histograms (one kernel, one read of
     the split leaf's rows).  Same partition contract as
     `partition_segment_acc`, plus the two children's [F, B, 3] histograms
@@ -1295,22 +1332,25 @@ def partition_segment_hist(payload, aux, start, count, pred, left_value,
     OUTSIDE the jit cache (see partition_segment_acc)."""
     if roll_place is None:
         roll_place = PARTITION_ACC_ROLL_VALIDATED
+    if ring_depth is None:
+        ring_depth = _ring_depth_default()
     if expand_impl is None:
         expand_impl = _default_expand_impl(num_features, num_bins)
     return _partition_segment_hist(payload, aux, start, count, pred,
                                    left_value, right_value, value_col,
                                    num_bins, num_features, grad_col,
                                    hess_col, cnt_col, interpret,
-                                   bool(roll_place), expand_impl)
+                                   bool(roll_place), expand_impl,
+                                   int(ring_depth))
 
 
 @functools.partial(jax.jit, static_argnames=(
     "value_col", "num_bins", "num_features", "grad_col", "hess_col",
-    "cnt_col", "interpret", "roll_place", "expand_impl"))
+    "cnt_col", "interpret", "roll_place", "expand_impl", "ring_depth"))
 def _partition_segment_hist(payload, aux, start, count, pred, left_value,
                             right_value, value_col, num_bins, num_features,
                             grad_col, hess_col, cnt_col, interpret,
-                            roll_place, expand_impl):
+                            roll_place, expand_impl, ring_depth):
     P = payload.shape[1]
     B = num_bins
     F = num_features
@@ -1342,12 +1382,12 @@ def _partition_segment_hist(payload, aux, start, count, pred, left_value,
                        pl.BlockSpec(memory_space=pltpu.VMEM),
                        pl.BlockSpec(memory_space=pltpu.VMEM)),
             scratch_shapes=[
-                pltpu.VMEM((2, CHUNK, P), jnp.float32),   # read ring
+                pltpu.VMEM((ring_depth, CHUNK, P), jnp.float32),  # read ring
                 pltpu.VMEM((C2, P), jnp.float32),         # left accumulator
                 pltpu.VMEM((C2, P), jnp.float32),         # right accumulator
                 pltpu.VMEM((CHUNK, P), jnp.float32),      # flush stage
                 pltpu.VMEM((CHUNK, P), jnp.float32),      # final blend read
-                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((ring_depth,)),
                 pltpu.SemaphoreType.DMA(()),
                 pltpu.SemaphoreType.DMA(()),
             ],

@@ -129,6 +129,11 @@ def _pred(feature=1, threshold=B // 2, default_left=False, is_cat=False,
     pseg.partition_segment,
     pseg.partition_segment_acc,
     lambda *a, **kw: pseg.partition_segment_acc(*a, roll_place=True, **kw),
+    # staged 4-deep read ring (PARTITION_RING4_VALIDATED): same instruction
+    # mix, deeper prefetch — exactness must be depth-independent
+    lambda *a, **kw: pseg.partition_segment_acc(*a, ring_depth=4, **kw),
+    lambda *a, **kw: pseg.partition_segment_acc(*a, roll_place=True,
+                                                ring_depth=4, **kw),
 ])
 def test_partition_matches(start, count, predkw, impl):
     pay = _payload(1024, seed=start + count)
@@ -364,6 +369,31 @@ def test_colblock_flag_staged_off():
     # pinned OFF until a hardware smoke validates the two-window DMA
     # lowering; flip in the SAME commit as exp/flip_validated.py colblock
     assert pseg.HIST_COLBLOCK_VALIDATED is False
+
+
+@pytest.mark.parametrize("ring_depth", [2, 4])
+def test_merged_kernel_ring_depths(ring_depth):
+    """The ring flag also drives the merged kernel — exactness at both
+    depths (the flip's smoke validates Mosaic legality for BOTH)."""
+    pay = _payload(1024, seed=9)
+    aux = jnp.zeros_like(pay)
+    pred = _pred(feature=2, threshold=B // 3)
+    p4, a4, nl4, hl4, hr4 = pseg.partition_segment_hist(
+        pay, aux, jnp.int32(100), jnp.int32(800), pred,
+        jnp.float32(0.5), jnp.float32(-0.5), VALUE_COL, B,
+        num_features=F, interpret=True, ring_depth=ring_depth, **COLS)
+    ref_pay, _, ref_nl = seg.partition_segment(
+        pay, aux, jnp.int32(100), jnp.int32(800), pred,
+        jnp.float32(0.5), jnp.float32(-0.5), VALUE_COL)
+    assert int(nl4) == int(ref_nl)
+    np.testing.assert_allclose(np.asarray(p4), np.asarray(ref_pay),
+                               rtol=1e-6, atol=0)
+
+
+def test_ring4_flag_staged_off():
+    # pinned OFF until the smoke's RING section validates + races the
+    # 4-deep ring; flip in the SAME commit as flip_validated.py ring4
+    assert pseg.PARTITION_RING4_VALIDATED is False
 
 
 @pytest.mark.parametrize("fw,bw", [(4228, 256), (2000, 64), (700, 256)])
