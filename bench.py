@@ -105,6 +105,42 @@ def phase_times(bst, reps=3):
     return {k: round(v / reps * 1e3, 2) for k, v in acc.items()}
 
 
+#: per-flag verdicts from the staged-kernel probe (None = probe not run);
+#: recorded in the bench JSON so an unattended hardware window leaves
+#: evidence for the human flip (exp/flip_validated.py)
+STAGED_REPORT = None
+
+
+def _staged_kernel_probe():
+    """Validate the staged kernels on-chip in a killable subprocess
+    (exp/smoke_staged.py) and enable, IN-PROCESS ONLY, the flags that
+    passed exactness + won/tied their race.  A Mosaic crash or hang in
+    unvalidated code costs the verdict, never the bench: the subprocess
+    dies alone and every flag stays at its validated default."""
+    global STAGED_REPORT
+    import subprocess
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "exp", "smoke_staged.py")
+    timeout = int(os.environ.get("BENCH_STAGED_TIMEOUT", "600"))
+    try:
+        r = subprocess.run([sys.executable, script], timeout=timeout,
+                           capture_output=True, text=True)
+        line = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+        STAGED_REPORT = json.loads(line[-1]) if line else {
+            "error": "no verdict line (rc=%d)" % r.returncode}
+    except subprocess.TimeoutExpired:
+        STAGED_REPORT = {"error": "staged probe exceeded %ds" % timeout}
+    except Exception as e:
+        STAGED_REPORT = {"error": "%s: %s" % (type(e).__name__, e)}
+    verdicts = (STAGED_REPORT or {}).get("verdicts", {})
+    if any(verdicts.values()):
+        from lightgbm_tpu.ops import pallas_segment as pseg
+        for name, flag in pseg.STAGED_FLAGS.items():
+            if verdicts.get(name):
+                setattr(pseg, flag, True)
+    sys.stderr.write("bench: staged-kernel probe %s\n" % STAGED_REPORT)
+
+
 def _device_probe() -> bool:
     """True when the accelerator platform initializes promptly.  A dead
     axon tunnel HANGS jax.devices(), which would hang the whole bench —
@@ -128,7 +164,24 @@ def main():
     n_feat = int(os.environ.get("BENCH_FEATURES", 28))
     max_bin = int(os.environ.get("BENCH_BINS", 255))
 
-    if os.environ.get("BENCH_NO_PROBE") != "1" and not _device_probe():
+    if os.environ.get("BENCH_NO_PROBE") != "1" and _device_probe():
+        # live accelerator: let an unattended window validate the staged
+        # kernels before measuring (BENCH_STAGED=0 opts out).  A
+        # crash-retry rung re-execs with BENCH_STAGED=0, so a staged
+        # kernel that passed the small smoke but died at bench scale
+        # cannot defeat every retry.
+        if os.environ.get("BENCH_STAGED", "1") != "0":
+            _staged_kernel_probe()
+        else:
+            prior = os.environ.get("BENCH_STAGED_PRIOR")
+            if prior:
+                global STAGED_REPORT
+                STAGED_REPORT = {
+                    "prior": json.loads(prior),
+                    "note": "staged kernels DISABLED on this crash-retry "
+                            "rung (they may or may not have caused the "
+                            "crash; the prior verdicts are evidence only)"}
+    elif os.environ.get("BENCH_NO_PROBE") != "1":
         # accelerator unreachable: re-exec on CPU at reduced scale so the
         # round still records an honest (clearly labeled) number.  The env
         # scrub is the dryrun's hermetic one — a dead tunnel's plugin must
@@ -189,7 +242,12 @@ def main():
                         "BENCH_ITERS": str(measure_iters),
                         "BENCH_LEAVES": str(num_leaves),
                         "BENCH_FEATURES": str(n_feat),
-                        "BENCH_BINS": str(max_bin)})
+                        "BENCH_BINS": str(max_bin),
+                        # see _staged_kernel_probe: never re-enable staged
+                        # kernels on a crash-retry rung
+                        "BENCH_STAGED": "0"})
+            if STAGED_REPORT is not None:
+                env["BENCH_STAGED_PRIOR"] = json.dumps(STAGED_REPORT)
             sys.stderr.write("bench: re-exec at %d rows\n" % rungs[i + 1])
             os.execve(sys.executable,
                       [sys.executable, os.path.abspath(__file__)], env)
@@ -272,6 +330,11 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
                        "program amortizes; sec_per_iter is the honest "
                        "steady-state number",
     }
+    if STAGED_REPORT is not None:
+        # which staged kernels the pre-measure probe validated and enabled
+        # for THIS run (in-process; the tree's defaults are unchanged —
+        # flip them by hand with exp/flip_validated.py using this evidence)
+        result["staged_kernels"] = STAGED_REPORT
     if result["platform"] != "tpu":
         # dead-tunnel fallback: carry the most recent REAL-hardware
         # measurement alongside (clearly labeled; this run's own numbers

@@ -12,17 +12,21 @@ import re
 import subprocess
 import sys
 
+PATH = "lightgbm_tpu/ops/pallas_segment.py"
+
+# the STAGED kernel names come from the shared registry (STAGED_FLAGS in
+# pallas_segment.py) so flip/smoke/bench can never disagree on names;
+# importing the module would pull jax, so read the literal instead
 FLAGS = {"acc": "PARTITION_ACC_VALIDATED",
          "roll": "PARTITION_ACC_ROLL_VALIDATED",
-         "repeat": "HIST_REPEAT_VALIDATED",
-         "merged": "PARTITION_HIST_VALIDATED",
-         "colblock": "HIST_COLBLOCK_VALIDATED",
-         "ring4": "PARTITION_RING4_VALIDATED"}
-PATH = "lightgbm_tpu/ops/pallas_segment.py"
+         "repeat": "HIST_REPEAT_VALIDATED"}
+_m = re.search(r"STAGED_FLAGS = \{(.*?)\}", open(PATH).read(), re.S)
+for k, v in re.findall(r'"(\w+)":\s*"(\w+)"', _m.group(1)):
+    FLAGS[k] = v
 
 names = sys.argv[1:]
 if not names or any(n not in FLAGS for n in names):
-    sys.exit("usage: flip_validated.py {acc|roll|repeat}...")
+    sys.exit("usage: flip_validated.py {%s}..." % "|".join(sorted(FLAGS)))
 src = open(PATH).read()
 for n in names:
     flag = FLAGS[n]

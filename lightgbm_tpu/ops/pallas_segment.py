@@ -104,6 +104,17 @@ PARTITION_ACC_ROLL_VALIDATED = True
 PARTITION_RING4_VALIDATED = False
 
 
+#: staged-flag registry: verdict/flip name -> module flag.  Shared by
+#: exp/flip_validated.py (human flips), exp/smoke_staged.py (verdict
+#: names) and bench.py (in-process enablement) so the three can never
+#: disagree on names.
+STAGED_FLAGS = {
+    "merged": "PARTITION_HIST_VALIDATED",
+    "colblock": "HIST_COLBLOCK_VALIDATED",
+    "ring4": "PARTITION_RING4_VALIDATED",
+}
+
+
 def _ring_depth_default() -> int:
     """Single source of the flag-to-depth mapping (kernels + VMEM gates
     must agree on the scratch the flag buys)."""
