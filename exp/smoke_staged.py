@@ -21,7 +21,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-verdicts = {"merged": False, "colblock": False, "ring4": False}
+verdicts = {"merged": False, "colblock": False, "ring4": False,
+            "blocks": False}
 notes = {}
 
 
@@ -149,6 +150,55 @@ def main():
         verdicts["colblock"] = ms_cb <= ms_port * 1.05
     except Exception as e:
         notes["colblock"] = "%s: %s" % (type(e).__name__, str(e)[:300])
+
+    # ---- column-block PARTITION (ultra-wide): exact vs portable, race
+    # vs portable (its activation shapes have no other kernel path) ----
+    try:
+        PBF, PBB = 1200, 64
+        PBP = -(-(PBF + 8) // 128) * 128
+        paypb = np.zeros((N + seg.GUARD, PBP), np.float32)
+        paypb[:N, :PBF] = rng.integers(0, PBB, (N, PBF))
+        paypb[:N, PBF] = rng.standard_normal(N)
+        paypb[:N, PBF + 1] = rng.random(N) + 0.1
+        paypb[:N, PBF + 2] = 1.0
+        paypb = jnp.asarray(paypb)
+        PBVAL = PBF + 3
+        predpb = seg.SplitPredicate(
+            col=jnp.int32(700), threshold=jnp.int32(30),
+            default_left=jnp.bool_(True), is_cat=jnp.bool_(False),
+            missing_type=jnp.int32(0), num_bin=jnp.int32(PBB),
+            default_bin=jnp.int32(0), offset=jnp.int32(0),
+            identity=jnp.bool_(True), bitset=jnp.zeros(PBB, jnp.int32))
+        for (s_, c_) in ((128, 3000), (7, 8000)):
+            pb, _, nlb = pseg.partition_segment_acc_blocks(
+                paypb, jnp.zeros_like(paypb), jnp.int32(s_), jnp.int32(c_),
+                predpb, jnp.float32(1.5), jnp.float32(-2.5), PBVAL, PBB)
+            pr, _, nlr = seg.partition_segment(
+                paypb, jnp.zeros_like(paypb), jnp.int32(s_), jnp.int32(c_),
+                predpb, jnp.float32(1.5), jnp.float32(-2.5), PBVAL)
+            assert int(nlb) == int(nlr)
+            assert float(jnp.abs(pb - pr).max()) == 0.0
+
+        def blocks_fn():
+            out = pseg.partition_segment_acc_blocks(
+                paypb, jnp.zeros_like(paypb), jnp.int32(0), jnp.int32(N),
+                predpb, jnp.float32(1.), jnp.float32(-1.), PBVAL, PBB)
+            np.asarray(out[0])[0, 0]
+
+        def portable_fn():
+            out = seg.partition_segment(
+                paypb, jnp.zeros_like(paypb), jnp.int32(0), jnp.int32(N),
+                predpb, jnp.float32(1.), jnp.float32(-1.), PBVAL)
+            np.asarray(out[0])[0, 0]
+
+        blocks_fn(); portable_fn()
+        ms_b = median_ms(blocks_fn)
+        ms_p = median_ms(portable_fn)
+        notes["blocks_ms"] = {"blocks": round(ms_b, 2),
+                              "portable": round(ms_p, 2)}
+        verdicts["blocks"] = ms_b <= ms_p * 1.05
+    except Exception as e:
+        notes["blocks"] = "%s: %s" % (type(e).__name__, str(e)[:300])
 
     # ---- 4-deep ring: exact vs depth 2, race both depths (acc AND
     # merged variants must both be legal before the shared flag flips) ----

@@ -367,3 +367,57 @@ err_m4 = max(float(jnp.abs(p_m4 - p_m2).max()),
 print("merged kernel ring4 vs ring2: err=%.3g" % err_m4, flush=True)
 assert err_m4 == 0.0, err_m4
 print("RING(MERGED) OK on", jax.default_backend(), flush=True)
+
+
+# --- column-block PARTITION: Mosaic-compile + exactness at an ultra-wide
+# payload (Epsilon/raw-Allstate class; the full-width partition kernels
+# cannot plan VMEM there).  Includes the one new Mosaic pattern of the
+# family: the snapshot kernel's traced-but-128-aligned lane base.  Flip
+# pseg.PARTITION_BLOCKS_VALIDATED once green and the race beats the
+# portable partition. ---
+PBF, PBB = 1200, 64
+PBP = -(-(PBF + 8) // 128) * 128
+pay_pb = np.zeros((8192 + seg.GUARD, PBP), np.float32)
+pay_pb[:8192, :PBF] = rng.integers(0, PBB, (8192, PBF))
+pay_pb[:8192, PBF] = rng.standard_normal(8192)
+pay_pb[:8192, PBF + 1] = rng.random(8192) + 0.1
+pay_pb[:8192, PBF + 2] = 1.0
+pay_pb = jnp.asarray(pay_pb)
+PBVAL = PBF + 3
+pred_pb = seg.SplitPredicate(
+    col=jnp.int32(700), threshold=jnp.int32(30),
+    default_left=jnp.bool_(True), is_cat=jnp.bool_(False),
+    missing_type=jnp.int32(0), num_bin=jnp.int32(PBB),
+    default_bin=jnp.int32(0), offset=jnp.int32(0),
+    identity=jnp.bool_(True), bitset=jnp.zeros(PBB, jnp.int32))
+for (s_pb, c_pb) in ((128, 3000), (7, 8000), (513, 256)):
+    p_pb, _, nl_pb = pseg.partition_segment_acc_blocks(
+        pay_pb, jnp.zeros_like(pay_pb), jnp.int32(s_pb), jnp.int32(c_pb),
+        pred_pb, jnp.float32(1.5), jnp.float32(-2.5), PBVAL, PBB)
+    p_pr, _, nl_pr = seg.partition_segment(
+        pay_pb, jnp.zeros_like(pay_pb), jnp.int32(s_pb), jnp.int32(c_pb),
+        pred_pb, jnp.float32(1.5), jnp.float32(-2.5), PBVAL)
+    assert int(nl_pb) == int(nl_pr), (s_pb, c_pb, int(nl_pb), int(nl_pr))
+    err_pb = float(jnp.abs(p_pb - p_pr).max())
+    print("blocks partition (%d,%d): nl=%d err=%.3g"
+          % (s_pb, c_pb, int(nl_pb), err_pb), flush=True)
+    assert err_pb == 0.0, err_pb
+for name, fn in (
+    ("portable", lambda p_, a_: seg.partition_segment(
+        p_, a_, jnp.int32(0), jnp.int32(8192), pred_pb,
+        jnp.float32(1.), jnp.float32(-1.), PBVAL)),
+    ("blocks", lambda p_, a_: pseg.partition_segment_acc_blocks(
+        p_, a_, jnp.int32(0), jnp.int32(8192), pred_pb,
+        jnp.float32(1.), jnp.float32(-1.), PBVAL, PBB)),
+):
+    ts = []
+    for _ in range(5):
+        p_, a_ = jnp.asarray(pay_pb), jnp.zeros_like(pay_pb)
+        _ = np.asarray(p_)[0, 0]
+        t0 = _t.perf_counter()
+        out_ = fn(p_, a_)
+        _ = np.asarray(out_[0])[0, 0]
+        ts.append(_t.perf_counter() - t0)
+    print("ultra-wide partition[%s] 8192x%d rows: median %.2f ms "
+          "(fetch-forced)" % (name, PBP, sorted(ts)[2] * 1e3), flush=True)
+print("BLOCKS PARTITION OK on", jax.default_backend(), flush=True)

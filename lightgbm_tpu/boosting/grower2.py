@@ -189,6 +189,15 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             if pseg.partition_fits_vmem(payload.shape[1], B):
                 return pseg.partition_segment(payload, aux, start, count,
                                               pred, lv, rv, cols.value, B)
+            if (pseg.PARTITION_BLOCKS_VALIDATED
+                    and payload.shape[1] % 128 == 0
+                    and pseg.partition_blocks_fits_vmem(
+                        payload.shape[1], B)):
+                # ultra-wide payloads: per-lane-window passes with a
+                # shared routing read (Epsilon/raw-Allstate class)
+                return pseg.partition_segment_acc_blocks(
+                    payload, aux, start, count, pred, lv, rv,
+                    cols.value, B)
         return seg.partition_segment(payload, aux, start, count, pred,
                                      lv, rv, cols.value)
 

@@ -104,6 +104,14 @@ PARTITION_ACC_ROLL_VALIDATED = True
 PARTITION_RING4_VALIDATED = False
 
 
+#: True once the COLUMN-BLOCK partition kernel (ultra-wide payloads:
+#: Epsilon-dense 2048 lanes, raw-Allstate 4352) is hardware-validated:
+#: one accumulator-partition pass per 512-lane window, each pass routing
+#: rows from a separately-DMA'd 128-lane split-column window (a traced
+#: but 128-aligned lane base — the one Mosaic pattern in this family not
+#: yet proven on a chip).  OFF until the smoke's BLOCKS section is green.
+PARTITION_BLOCKS_VALIDATED = False
+
 #: staged-flag registry: verdict/flip name -> module flag.  Shared by
 #: exp/flip_validated.py (human flips), exp/smoke_staged.py (verdict
 #: names) and bench.py (in-process enablement) so the three can never
@@ -112,6 +120,7 @@ STAGED_FLAGS = {
     "merged": "PARTITION_HIST_VALIDATED",
     "colblock": "HIST_COLBLOCK_VALIDATED",
     "ring4": "PARTITION_RING4_VALIDATED",
+    "blocks": "PARTITION_BLOCKS_VALIDATED",
 }
 
 
@@ -1415,3 +1424,400 @@ def _partition_segment_hist(payload, aux, start, count, pred, left_value,
     hist_l = _untile_hist(hl, F, B, Ft, n_tiles, W, expand_impl)
     hist_r = _untile_hist(hr, F, B, Ft, n_tiles, W, expand_impl)
     return payload_new, aux_new, nl[0], hist_l, hist_r
+
+
+# ---------------------------------------------------------------------------
+# partition, column-block variant (ultra-wide payloads)
+# ---------------------------------------------------------------------------
+
+def partition_blocks_fits_vmem(payload_width: int, num_bins: int,
+                               block_w: int = None) -> bool:
+    """VMEM plan of ONE column-block partition pass: the acc kernel's plan
+    at the block width plus the split-column ring (128 lanes per slot)."""
+    if block_w is None:
+        block_w = COLBLOCK_WIDTH
+    ring_depth = _ring_depth_default()
+    C = CHUNK
+    bw = min(block_w, payload_width)
+    est = ((ring_depth - 2) * 4 * bw * C
+           + 4 * bw * 18 * C
+           + ring_depth * 4 * 128 * C          # split-column ring
+           + 4 * 8 * C * C
+           + 4 * C * num_bins)
+    return est <= _VMEM_BUDGET
+
+
+def _snap_window_kernel(scalars, payload_hbm, snap_out, buf, sem):
+    """Copy the split column's 128-lane window for the segment's chunk
+    span into a side buffer, BEFORE any block pass rewrites those lanes —
+    all routing reads then come from this frozen snapshot, so every pass
+    computes the identical permutation no matter which block owns the
+    split column.  This is also the ONE kernel with a traced (but
+    128-aligned) lane base; the block passes read the snapshot at lane 0."""
+    start = scalars[0]
+    count = scalars[1]
+    win_lo = scalars[11]
+    shift = lax.rem(start, 8)
+    base = start - shift
+    nch = jnp.where(count > 0, (shift + count + CHUNK - 1) // CHUNK, 0)
+
+    def body(k, _):
+        rows = pl.ds(pl.multiple_of(base + k * CHUNK, 8), CHUNK)
+        d_in = pltpu.make_async_copy(
+            payload_hbm.at[rows, pl.ds(pl.multiple_of(win_lo, 128), 128)],
+            buf, sem)
+        d_in.start()
+        d_in.wait()
+        d_out = pltpu.make_async_copy(buf, snap_out.at[rows, :], sem)
+        d_out.start()
+        d_out.wait()
+        return 0
+
+    lax.fori_loop(0, nch, body, 0, unroll=False)
+
+
+def _acc_blocks_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
+                       snap_hbm, payload_out, aux_out, nl_out,
+                       ring, ringc, lacc, racc, stage, rbuf,
+                       sem_ring, sem_w, sem_r, *,
+                       BW, B, col_lo, value_col_local, roll_place=False):
+    """One column-block pass of the accumulator partition for payloads too
+    wide for `_acc_kernel`'s full-width VMEM plan (Epsilon-dense 2048
+    lanes, raw-Allstate 4352).  A sibling copy, NOT a refactor of the
+    hardware-validated parent (the merged/colblock precedent): each chunk
+    DMAs TWO lane windows — this block's columns [col_lo, col_lo+BW) and
+    the 128-lane window containing the split column (its base arrives as
+    scalars[11], a traced but 128-aligned offset) — routes rows from the
+    split window, and moves ONLY the block's lanes through the place/
+    accumulate/flush machinery.  Every pass over the same segment computes
+    the identical routing, so the passes together apply one consistent
+    row permutation to the full payload width with per-pass VMEM bounded
+    by the block width, at the price of re-reading the split window once
+    per block (128 lanes per 512-lane block: ~25%).
+
+    scalars[2] (the split column) arrives LOCALIZED to the split window
+    by the wrapper; scalars[11] is the window base in payload lanes."""
+    start = scalars[0]
+    count = scalars[1]
+    left_value = fvals[0]
+    right_value = fvals[1]
+    shift = lax.rem(start, 8)
+    base = start - shift
+    nch = jnp.where(count > 0, (shift + count + CHUNK - 1) // CHUNK, 0)
+    iota_rows = _row_iota()
+    iota_c2 = lax.broadcasted_iota(jnp.int32, (C2, 1), 0)[:, 0]
+    iota_p = lax.broadcasted_iota(jnp.int32, (1, BW), 1)
+    iota_w128 = lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+    iota_2i = lax.broadcasted_iota(jnp.int32, (C2, CHUNK), 0)
+    R = ring.shape[0]
+
+    def ring_dmas(src_ref, k, slot):
+        rows = pl.ds(pl.multiple_of(base + k * CHUNK, 8), CHUNK)
+        return (pltpu.make_async_copy(
+                    src_ref.at[rows, pl.ds(col_lo, BW)],
+                    ring.at[slot], sem_ring.at[slot, 0]),
+                pltpu.make_async_copy(
+                    snap_hbm.at[rows, :],
+                    ringc.at[slot], sem_ring.at[slot, 1]))
+
+    def valid_mask(k):
+        return ((iota_rows >= shift - k * CHUNK) &
+                (iota_rows < shift + count - k * CHUNK)).astype(jnp.int32)
+
+    def go_left(cdata, k):
+        return _go_left_rows(scalars, bitset_ref, cdata, B, iota_w128) \
+            * valid_mask(k)
+
+    def rank_of(keep_i):
+        ri = lax.broadcasted_iota(jnp.int32, (CHUNK, CHUNK), 0)
+        rj = lax.broadcasted_iota(jnp.int32, (CHUNK, CHUNK), 1)
+        tri = (rj < ri).astype(jnp.float32)
+        return jnp.dot(tri, keep_i.astype(jnp.float32)[:, None],
+                       preferred_element_type=jnp.float32)[:, 0] \
+            .astype(jnp.int32)
+
+    def blend(acc, placed, cnt, off, value):
+        # value_col_local is -1 for every block except the one carrying
+        # the value column; -1 matches no lane and the write is a no-op
+        placed = jnp.where(iota_p == value_col_local, value, placed)
+        region = ((iota_c2 >= off) & (iota_c2 < off + cnt))[:, None]
+        acc[:] = jnp.where(region, placed, acc[:])
+
+    def place_matmul(parts, dest, member):
+        mat = ((iota_2i == dest[None, :]) &
+               (member[None, :] > 0)).astype(jnp.float32)
+        hi, mid, lo = parts
+        return (jnp.dot(mat, hi, preferred_element_type=jnp.float32) +
+                jnp.dot(mat, mid, preferred_element_type=jnp.float32) +
+                jnp.dot(mat, lo, preferred_element_type=jnp.float32))
+
+    def place_compact_roll(parts, rank, member, off):
+        matc = ((lax.broadcasted_iota(jnp.int32, (CHUNK, CHUNK), 0) ==
+                 rank[None, :]) &
+                (member[None, :] > 0)).astype(jnp.float32)
+        hi, mid, lo = parts
+        compacted = (jnp.dot(matc, hi, preferred_element_type=jnp.float32) +
+                     jnp.dot(matc, mid, preferred_element_type=jnp.float32) +
+                     jnp.dot(matc, lo, preferred_element_type=jnp.float32))
+        return pltpu.roll(jnp.concatenate([compacted, compacted], axis=0),
+                          off, axis=0)
+
+    def drain(dst_ref, stage_buf, sem, pend):
+        @pl.when(pend > 0)
+        def _():
+            pltpu.make_async_copy(
+                stage_buf,
+                dst_ref.at[pl.ds(0, CHUNK), pl.ds(col_lo, BW)], sem).wait()
+
+    def flush(acc, dst_ref, wbase, stage_buf, sem, pend):
+        drain(dst_ref, stage_buf, sem, pend)
+        stage_buf[:] = acc[0:CHUNK]
+        pltpu.make_async_copy(
+            stage_buf,
+            dst_ref.at[pl.ds(pl.multiple_of(wbase, 8), CHUNK),
+                       pl.ds(col_lo, BW)], sem).start()
+        acc[0:CHUNK] = acc[CHUNK:C2]
+
+    @pl.when(nch > 0)
+    def _prefetch_first():
+        for i in range(R - 1):
+            @pl.when(i < nch)
+            def _start(i=i):
+                for d in ring_dmas(payload_out, i, i):
+                    d.start()
+
+    def body_a(k, carry):
+        nl, nr, lo_, ro_, lfl, rfl, pl_, pr_ = carry
+        slot = lax.rem(k, R)
+
+        @pl.when(k + R - 1 < nch)
+        def _prefetch_next():
+            for d in ring_dmas(payload_out, k + R - 1,
+                               lax.rem(k + R - 1, R)):
+                d.start()
+
+        for d in ring_dmas(payload_out, k, slot):
+            d.wait()
+        data = ring[slot]
+        cdata = ringc[slot]
+
+        @pl.when(k == 0)
+        def _seed():
+            lacc[0:CHUNK] = data
+
+        gl = go_left(cdata, k)
+        keep_r = valid_mask(k) - gl
+        nlk = jnp.sum(gl)
+        nrk = jnp.sum(keep_r)
+        rank_l = rank_of(gl)
+        rank_r = rank_of(keep_r)
+
+        parts = _bf16_parts(data)
+        if roll_place:
+            placed_l = place_compact_roll(parts, rank_l, gl, lo_)
+            placed_r = place_compact_roll(parts, rank_r, keep_r, ro_)
+        else:
+            placed_l = place_matmul(parts, lo_ + rank_l, gl)
+            placed_r = place_matmul(parts, ro_ + rank_r, keep_r)
+        blend(lacc, placed_l, nlk, lo_, left_value)
+        fl = ((lo_ + nlk) >= CHUNK).astype(jnp.int32)
+
+        @pl.when(fl > 0)
+        def _flush_l():
+            flush(lacc, payload_out, base + lfl * CHUNK, stage, sem_w, pl_)
+
+        blend(racc, placed_r, nrk, ro_, right_value)
+        fr = ((ro_ + nrk) >= CHUNK).astype(jnp.int32)
+
+        @pl.when(fr > 0)
+        def _flush_r():
+            flush(racc, aux_out, base + rfl * CHUNK, rbuf, sem_r, pr_)
+
+        return (nl + nlk, nr + nrk, lo_ + nlk - fl * CHUNK,
+                ro_ + nrk - fr * CHUNK, lfl + fl, rfl + fr,
+                jnp.maximum(pl_, fl), jnp.maximum(pr_, fr))
+
+    (num_left, num_right, lo_, ro_, lfl, rfl, pl_, pr_) = lax.fori_loop(
+        0, nch, body_a,
+        (jnp.int32(0), jnp.int32(0), shift, shift,
+         jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+        unroll=False)
+    nl_out[0] = num_left
+
+    @pl.when(ro_ > 0)
+    def _flush_r_tail():
+        flush(racc, aux_out, base + rfl * CHUNK, rbuf, sem_r, pr_)
+
+    drain(aux_out, rbuf, sem_r,
+          jnp.maximum(pr_, (ro_ > 0).astype(jnp.int32)))
+
+    # pass B: append the staged rights behind the lefts.  The staged rows
+    # live in the SAME block lane window of aux; the split-column ring is
+    # not needed (membership is positional), so only the block window
+    # streams.
+    nchb = jnp.where(num_right > 0,
+                     (shift + num_right + CHUNK - 1) // CHUNK, 0)
+
+    def ring_dma_b(k, slot):
+        rows = pl.ds(pl.multiple_of(base + k * CHUNK, 8), CHUNK)
+        return pltpu.make_async_copy(
+            aux_out.at[rows, pl.ds(col_lo, BW)],
+            ring.at[slot], sem_ring.at[slot, 0])
+
+    @pl.when(nchb > 0)
+    def _prefetch_b():
+        for i in range(R - 1):
+            @pl.when(i < nchb)
+            def _start(i=i):
+                ring_dma_b(i, i).start()
+
+    def body_b(k, carry):
+        lo_, lfl, pl_ = carry
+        slot = lax.rem(k, R)
+
+        @pl.when(k + R - 1 < nchb)
+        def _prefetch_next():
+            ring_dma_b(k + R - 1, lax.rem(k + R - 1, R)).start()
+
+        ring_dma_b(k, slot).wait()
+        j0 = jnp.maximum(shift - k * CHUNK, 0)
+        j1 = jnp.minimum(shift + num_right - k * CHUNK, CHUNK)
+        cnt = jnp.maximum(j1 - j0, 0)
+        member = ((iota_rows >= j0) & (iota_rows < j1)).astype(jnp.int32)
+        data = jnp.where(member[:, None] > 0, ring[slot], 0.0)
+        if roll_place:
+            placed = pltpu.roll(jnp.concatenate([data, data], axis=0),
+                                lo_ - j0 + C2, axis=0)
+        else:
+            parts = _bf16_parts(data)
+            placed = place_matmul(parts, iota_rows - j0 + lo_, member)
+        blend(lacc, placed, cnt, lo_, right_value)
+        fl = ((lo_ + cnt) >= CHUNK).astype(jnp.int32)
+
+        @pl.when(fl > 0)
+        def _flush_l():
+            flush(lacc, payload_out, base + lfl * CHUNK, stage, sem_w, pl_)
+
+        return (lo_ + cnt - fl * CHUNK, lfl + fl, jnp.maximum(pl_, fl))
+
+    lo_, lfl, pl_ = lax.fori_loop(0, nchb, body_b, (lo_, lfl, pl_),
+                                  unroll=False)
+    drain(payload_out, stage, sem_w, pl_)
+
+    @pl.when((count > 0) & (lo_ > 0))
+    def _final():
+        wbase = pl.multiple_of(base + lfl * CHUNK, 8)
+        dma_r = pltpu.make_async_copy(
+            payload_out.at[pl.ds(wbase, CHUNK), pl.ds(col_lo, BW)],
+            rbuf, sem_r)
+        dma_r.start()
+        dma_r.wait()
+        region = (iota_rows < lo_)[:, None]
+        stage[:] = jnp.where(region, lacc[0:CHUNK], rbuf[:])
+        dma_w = pltpu.make_async_copy(
+            stage, payload_out.at[pl.ds(wbase, CHUNK), pl.ds(col_lo, BW)],
+            sem_w)
+        dma_w.start()
+        dma_w.wait()
+
+
+def partition_segment_acc_blocks(payload, aux, start, count, pred,
+                                 left_value, right_value, value_col,
+                                 num_bins, interpret=False, roll_place=None,
+                                 ring_depth=None, block_w=None):
+    """Same contract as `partition_segment`, applied block-by-block over
+    the payload's lane windows (ultra-wide payloads).  Flag defaults
+    resolve OUTSIDE the jit cache (see partition_segment_acc)."""
+    if roll_place is None:
+        roll_place = PARTITION_ACC_ROLL_VALIDATED
+    if ring_depth is None:
+        ring_depth = _ring_depth_default()
+    if block_w is None:
+        block_w = COLBLOCK_WIDTH
+    return _partition_segment_acc_blocks(
+        payload, aux, start, count, pred, left_value, right_value,
+        value_col, num_bins, interpret, bool(roll_place), int(ring_depth),
+        int(block_w))
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "value_col", "num_bins", "interpret", "roll_place", "ring_depth",
+    "block_w"))
+def _partition_segment_acc_blocks(payload, aux, start, count, pred,
+                                  left_value, right_value, value_col,
+                                  num_bins, interpret, roll_place,
+                                  ring_depth, block_w):
+    P = payload.shape[1]
+    if P % 128 != 0:
+        raise ValueError("column-block partition requires a lane-padded "
+                         "payload (P %% 128 == 0), got %d" % P)
+    B = num_bins
+    win_lo = (pred.col // 128) * 128
+    scalars = jnp.stack([
+        start, count, pred.col - win_lo, pred.threshold,
+        pred.default_left.astype(jnp.int32), pred.is_cat.astype(jnp.int32),
+        pred.missing_type, pred.num_bin, pred.default_bin,
+        pred.offset, pred.identity.astype(jnp.int32), win_lo,
+    ]).astype(jnp.int32)
+    fvals = jnp.stack([left_value, right_value]).astype(jnp.float32)
+    bitset = pred.bitset.astype(jnp.int32).reshape(1, B)
+    # freeze the split column's window before any pass rewrites its lanes
+    snap = pl.pallas_call(
+        _snap_window_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.VMEM((CHUNK, 128), jnp.float32),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((payload.shape[0], 128),
+                                       jnp.float32),
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(scalars, payload)
+    nl = None
+    c = 0
+    while c < P:
+        bw = min(block_w, P - c)
+        vloc = value_col - c if c <= value_col < c + bw else -1
+        kern = functools.partial(_acc_blocks_kernel, BW=bw, B=B, col_lo=c,
+                                 value_col_local=vloc,
+                                 roll_place=roll_place)
+        payload, aux, nl_k = pl.pallas_call(
+            kern,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(1,),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                          pl.BlockSpec(memory_space=pl.ANY),
+                          pl.BlockSpec(memory_space=pl.ANY),
+                          pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                           pl.BlockSpec(memory_space=pl.ANY),
+                           pl.BlockSpec(memory_space=pltpu.SMEM)),
+                scratch_shapes=[
+                    pltpu.VMEM((ring_depth, CHUNK, bw), jnp.float32),
+                    pltpu.VMEM((ring_depth, CHUNK, 128), jnp.float32),
+                    pltpu.VMEM((C2, bw), jnp.float32),
+                    pltpu.VMEM((C2, bw), jnp.float32),
+                    pltpu.VMEM((CHUNK, bw), jnp.float32),
+                    pltpu.VMEM((CHUNK, bw), jnp.float32),
+                    pltpu.SemaphoreType.DMA((ring_depth, 2)),
+                    pltpu.SemaphoreType.DMA(()),
+                    pltpu.SemaphoreType.DMA(()),
+                ],
+            ),
+            out_shape=(jax.ShapeDtypeStruct(payload.shape, payload.dtype),
+                       jax.ShapeDtypeStruct(aux.shape, aux.dtype),
+                       jax.ShapeDtypeStruct((1,), jnp.int32)),
+            input_output_aliases={3: 0, 4: 1},
+            compiler_params=pltpu.CompilerParams(has_side_effects=True),
+            interpret=interpret,
+        )(scalars, fvals, bitset, payload, aux, snap)
+        nl = nl_k if nl is None else nl
+        c += bw
+    return payload, aux, nl[0]

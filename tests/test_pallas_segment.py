@@ -452,3 +452,83 @@ def test_colblock_matches_hist_kernel(expand):
         pay128, jnp.int32(0), jnp.int32(1000), num_features=F, num_bins=B,
         interpret=True, expand_impl=expand, **COLS)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+# ---------------------------------------------------------------------------
+# column-block partition (ultra-wide payloads)
+# ---------------------------------------------------------------------------
+
+def test_blocks_flag_staged_off():
+    # pinned OFF until the smoke's BLOCKS section validates the dynamic
+    # 128-aligned split-window DMA on a chip; flip in the SAME commit as
+    # flip_validated.py blocks
+    assert pseg.PARTITION_BLOCKS_VALIDATED is False
+
+
+def test_partition_blocks_vmem_gate():
+    if seg.CHUNK != 256:
+        pytest.skip("VMEM gate expectations assume the default CHUNK")
+    # the shapes the full-width kernels cannot plan
+    assert pseg.partition_blocks_fits_vmem(2048, 64)    # Epsilon payload
+    assert pseg.partition_blocks_fits_vmem(4352, 256)   # raw Allstate
+    assert not pseg.partition_fits_vmem(2048, 64)
+    assert not pseg.partition_acc_fits_vmem(4352, 256)
+
+
+@pytest.mark.parametrize("start,count,predkw", [
+    (0, 1000, {}),
+    (256, 700, dict(feature=3, threshold=4)),
+    (100, 37, dict(missing_type=2, default_left=True, threshold=3)),
+    (0, 600, dict(is_cat=True, bitset=(np.arange(B) % 3 == 0))),
+    (7, 1, {}),
+    (9, 1015, dict(feature=2, threshold=B // 3)),
+    # EFB bundle decode through the split-window scalars
+    (64, 500, dict(feature=2, threshold=3, offset=5, identity=False,
+                   num_bin=9, default_bin=0)),
+])
+@pytest.mark.parametrize("roll", [False, True])
+def test_partition_blocks_matches(start, count, predkw, roll):
+    """Ultra-wide payload (5 lane windows incl. a ragged 128-lane tail):
+    the per-block passes must reproduce the portable partition exactly —
+    one consistent permutation across every window, value column written
+    only by its own block."""
+    Fw = 1200
+    Pw = -(-(Fw + 8) // 128) * 128   # 1280: 2x512 + 1x256 windows
+    rng = np.random.default_rng(start + count)
+    n_pad = 1024
+    pay = np.zeros((n_pad + seg.GUARD, Pw), np.float32)
+    pay[:n_pad, :Fw] = rng.integers(0, B, size=(n_pad, Fw))
+    pay[:n_pad, Fw] = rng.standard_normal(n_pad)
+    pay[:n_pad, Fw + 1] = rng.random(n_pad)
+    pay[:n_pad, Fw + 2] = 1.0
+    pay = jnp.asarray(pay)
+    aux = jnp.zeros_like(pay)
+    vcol = Fw + 3
+    pred = _pred(**predkw)
+    lv, rv = jnp.float32(-0.25), jnp.float32(0.75)
+    ref_pay, _, ref_nl = seg.partition_segment(
+        pay, aux, jnp.int32(start), jnp.int32(count), pred, lv, rv, vcol)
+    got_pay, _, got_nl = pseg.partition_segment_acc_blocks(
+        pay, aux, jnp.int32(start), jnp.int32(count), pred, lv, rv,
+        vcol, B, interpret=True, roll_place=roll)
+    assert int(got_nl) == int(ref_nl)
+    np.testing.assert_allclose(np.asarray(got_pay), np.asarray(ref_pay),
+                               rtol=1e-6, atol=0)
+
+
+def test_partition_blocks_narrow_pin():
+    """At a width the validated acc kernel also handles, blocks (one
+    window) must agree with it bit-for-bit — the sibling-pin discipline."""
+    pay = _payload(1024, seed=11)
+    pay128 = jnp.pad(pay, ((0, 0), (0, 128 - pay.shape[1])))
+    aux = jnp.zeros_like(pay128)
+    pred = _pred(feature=2, threshold=B // 3)
+    lv, rv = jnp.float32(1.5), jnp.float32(-2.5)
+    ref_pay, _, ref_nl = pseg.partition_segment_acc(
+        pay128, aux, jnp.int32(100), jnp.int32(800), pred, lv, rv,
+        VALUE_COL, B, interpret=True)
+    got_pay, _, got_nl = pseg.partition_segment_acc_blocks(
+        pay128, aux, jnp.int32(100), jnp.int32(800), pred, lv, rv,
+        VALUE_COL, B, interpret=True)
+    assert int(got_nl) == int(ref_nl)
+    np.testing.assert_array_equal(np.asarray(got_pay), np.asarray(ref_pay))
