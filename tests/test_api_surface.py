@@ -219,3 +219,34 @@ def test_scipy_sparse_dok_input():
     bst = lgb.train({"objective": "binary", "verbose": -1},
                     lgb.Dataset(X, label=y), num_boost_round=3)
     assert np.isfinite(bst.predict(X)).all()
+
+
+def test_smoke_staged_verdict_contract():
+    """bench.py's unattended staged-kernel probe parses the LAST json line
+    of exp/smoke_staged.py and maps verdict names through
+    pallas_segment.STAGED_FLAGS — the three must stay in sync, and on a
+    non-TPU backend every verdict must be False (nothing gets enabled)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from lightgbm_tpu.ops import pallas_segment as pseg
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "exp", "smoke_staged.py")],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stderr[-500:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert lines, r.stdout
+    report = json.loads(lines[-1])
+    assert set(report["verdicts"]) == set(pseg.STAGED_FLAGS)
+    assert not any(report["verdicts"].values())
+    # every registered flag exists on the module and is currently staged
+    # OFF in-tree (flips happen via exp/flip_validated.py with evidence)
+    for flag in pseg.STAGED_FLAGS.values():
+        assert getattr(pseg, flag) is False
