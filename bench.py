@@ -105,6 +105,26 @@ def phase_times(bst, reps=3):
     return {k: round(v / reps * 1e3, 2) for k, v in acc.items()}
 
 
+#: scale the piecewise phase diagnostics run at when the headline scale is
+#: too big for them: full-scale piecewise crashed the tunneled TPU worker
+#: twice in round 4 while 2M was repeatedly stable (docs/PERFORMANCE.md)
+MID_PHASE_ROWS = 2_000_000
+
+
+def phase_times_midscale(X, y, params, rows):
+    """Piecewise phase telemetry on a FRESH mid-scale booster — runs by
+    default when the headline scale skips the piecewise section, so every
+    bench record carries a phase split from a scale that does not crash
+    (VERDICT r5 Weak #7)."""
+    import lightgbm_tpu as lgb
+    bst = lgb.Booster(dict(params), lgb.Dataset(X[:rows], label=y[:rows]))
+    for _ in range(2):
+        bst.update()
+    out = phase_times(bst)
+    out["measured_at_rows"] = rows
+    return out
+
+
 #: per-flag verdicts from the staged-kernel probe (None = probe not run);
 #: recorded in the bench JSON so an unattended hardware window leaves
 #: evidence for the human flip (exp/flip_validated.py)
@@ -270,6 +290,12 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
     params = {"objective": "binary", "metric": "auc",
               "num_leaves": num_leaves, "max_bin": max_bin,
               "learning_rate": 0.1, "verbose": -1}
+    # frontier batching (Config.tpu_frontier_batch): BENCH_FRONTIER_BATCH=K
+    # lets a session A/B the batched grower; on a TPU pallas config the
+    # grower additionally stages behind FRONTIER_BATCH_VALIDATED
+    fbatch = int(os.environ.get("BENCH_FRONTIER_BATCH", "1") or 1)
+    if fbatch > 1:
+        params["tpu_frontier_batch"] = fbatch
     train = lgb.Dataset(X, label=y)
     bst = lgb.Booster(params, train)
     stage("booster built")
@@ -293,11 +319,19 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
     if n_rows > 5_000_000 and os.environ.get("BENCH_PHASES") != "1":
         # the piecewise section compiles the standalone stage programs; a
         # full-scale run crashed the tunneled TPU worker twice at/after
-        # this point while the training loop itself was clean — keep the
-        # diagnostics opt-in at full scale until the stage trail pins it
-        phases = {"skipped": "full-scale piecewise diagnostics are opt-in "
-                             "(BENCH_PHASES=1); see ROUND4_NOTES.md"}
-        stage("phases skipped at full scale")
+        # this point while the training loop itself was clean — so at full
+        # scale the phase split is measured on a FRESH booster at a mid
+        # scale (2M) that has been stable across every session, instead of
+        # being skipped outright (BENCH_PHASES=1 still forces full scale)
+        try:
+            phases = phase_times_midscale(X, y, params,
+                                          min(MID_PHASE_ROWS, n_rows))
+            stage("phases (mid-scale) done")
+        except Exception as e:
+            phases = {"error": "%s: %s" % (type(e).__name__, e),
+                      "note": "mid-scale phase booster failed; headline "
+                              "result above is unaffected"}
+            stage("mid-scale phases FAILED (diagnostics only)")
     else:
         try:
             phases = phase_times(bst)
@@ -323,6 +357,16 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
         "hist_engine": lseg.resolve_impl("auto", n_feat, max_bin + 1),
         "platform": __import__("jax").default_backend(),
         "fast_path": bool(getattr(eng, "_fast_active", False)),
+        # frontier-batch telemetry: sequential grower rounds per tree
+        # (== num_leaves-1 unless the batched grower engaged) and the
+        # per-round device dispatch mix the round count multiplies
+        "split_rounds_per_tree": getattr(eng, "split_rounds_per_tree",
+                                         lambda: None)(),
+        "frontier_batch": fbatch,
+        "dispatches_per_round": ({"partition": fbatch, "histogram": 1,
+                                  "split_search": 1} if fbatch > 1 else
+                                 {"partition": 1, "histogram": 1,
+                                  "split_search": 1}),
         "phases": phases,
         "phases_note": "phases are measured PIECEWISE (one dispatch + sync "
                        "per stage), so each absolute value carries the "

@@ -135,6 +135,10 @@ def booster_get_eval(bst, data_idx):
     return [float(r[2]) for r in res]
 
 
+def booster_eval_names(bst):
+    return [str(m.name) for m in bst._engine.train_metrics]
+
+
 def booster_grad_len(bst):
     ds = bst.train_set
     ds.construct()
@@ -570,6 +574,42 @@ int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
   *out_len = static_cast<int>(n);
   for (Py_ssize_t i = 0; i < n; ++i)
     out_results[i] = PyFloat_AsDouble(PyList_GetItem(r, i));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len) {
+  PyScope py;
+  if (!py.ok) return -1;
+  if (!lgbm_tpu_internal::IsTrainBooster(handle)) {
+    SetLastError("not a training booster");
+    return -1;
+  }
+  PyObject* r = CallHelper("booster_eval_names",
+                           Py_BuildValue("(O)", AsTrain(handle)->bst));
+  if (r == nullptr) return -1;
+  *out_len = static_cast<int>(PyList_Size(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
+                             char** out_strs) {
+  PyScope py;
+  if (!py.ok) return -1;
+  if (!lgbm_tpu_internal::IsTrainBooster(handle)) {
+    SetLastError("not a training booster");
+    return -1;
+  }
+  PyObject* r = CallHelper("booster_eval_names",
+                           Py_BuildValue("(O)", AsTrain(handle)->bst));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  *out_len = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* name = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    std::strcpy(out_strs[i], name != nullptr ? name : "");
+  }
   Py_DECREF(r);
   return 0;
 }

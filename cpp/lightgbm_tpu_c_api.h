@@ -134,6 +134,16 @@ int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
 int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
                         double* out_results);
 
+/* Number of evaluation metrics — callers size LGBM_BoosterGetEval's
+ * out_results (and GetEvalNames' out_strs) with this, matching the
+ * reference pairing (c_api.h GetEvalCounts/GetEvalNames). */
+int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len);
+
+/* Metric names; out_strs must hold GetEvalCounts pointers to buffers of
+ * at least 128 bytes each (the reference's unsized-strcpy contract). */
+int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
+                             char** out_strs);
+
 /* Distributed bootstrap (reference Network::Init / LGBM_NetworkInit):
  * machines = "ip:port,ip:port,...".  Maps onto jax.distributed — see
  * docs/DISTRIBUTED.md.  The function-pointer transport variant

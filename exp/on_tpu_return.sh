@@ -7,11 +7,17 @@ echo "=== 1. kernels exact vs portable (incl. the 2-pass partition) ==="
 timeout 400 python exp/smoke_tpu_kernels.py 2>&1 | grep -vE "WARN|INFO|libtpu|common_lib|Failed to find|Logging" | tail -8
 echo "=== 1b. IF step 1 was green: flip remaining validated kernel flags ==="
 echo "   (acc/roll/repeat were validated + flipped in round 4's second"
-echo "    window; TWO staged kernels now: the MERGED partition+hist and"
-echo "    the COLBLOCK ultra-wide histogram engine — inspect the smoke's"
-echo "    MERGED PART+HIST and COLBLOCK HIST sections, then"
-echo "    python exp/flip_validated.py merged colblock"
+echo "    window; staged kernels now: MERGED partition+hist, COLBLOCK"
+echo "    ultra-wide histogram, BLOCKS partition, RING4, and the"
+echo "    FRONTIER batched histogram — inspect the smoke sections, then"
+echo "    python exp/flip_validated.py merged colblock frontier ..."
 echo "    and re-run this script so steps 2+ measure the flipped kernels)"
+echo "   NOTE: this round's CPU jax changed pltpu.repeat's INTERPRET"
+echo "   emulation to element-wise repeat (the kernels' one-hot math"
+echo "   assumes the hardware-validated tile-concat layout, so the"
+echo "   repeat-mode interpret tests fail on CPU).  Step 1 + the smoke's"
+echo "   exactness legs decide whether REAL hardware semantics moved too;"
+echo "   if they did, HIST_REPEAT_VALIDATED must be reverted to False."
 echo "=== 2. grower profile (fixed cost + scaling) ==="
 timeout 500 python exp/prof_grow_small.py 2>&1 | grep "grow:" || true
 echo "=== 3. bench at 2M rows ==="
@@ -43,6 +49,12 @@ bst = lgb.train({"objective": "binary", "num_leaves": 63, "verbose": -1,
 assert bst._engine._fast_active, "mesh fast path inactive on TPU"
 print("tree_learner=data on the real-chip mesh: 3 iters ok (Pallas inside shard_map)")
 PYEOF
+echo "=== 4c. frontier-batched grower A/B (after flip_validated.py frontier) ==="
+echo "    (staged: FRONTIER_BATCH_VALIDATED gates the batched grower on the"
+echo "     pallas path; the A/B only measures the lever once it is flipped."
+echo "     Compare sec_per_iter and split_rounds_per_tree against step 3.)"
+BENCH_FRONTIER_BATCH=8 BENCH_ROWS=2000000 BENCH_TEST_ROWS=200000 BENCH_ITERS=10 \
+  timeout 550 python bench.py 2>&1 | grep '"metric"' || echo "frontier A/B failed"
 echo "=== 5. in-loop chunk-size A/B (VERDICT r4 #7 lever) ==="
 LIGHTGBM_TPU_CHUNK=512 BENCH_ROWS=2000000 BENCH_TEST_ROWS=200000 BENCH_ITERS=10 \
   timeout 550 python bench.py 2>&1 | grep '"metric"' || echo "chunk=512 A/B failed"

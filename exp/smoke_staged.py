@@ -22,7 +22,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 verdicts = {"merged": False, "colblock": False, "ring4": False,
-            "blocks": False}
+            "blocks": False, "frontier": False}
 notes = {}
 
 
@@ -113,6 +113,41 @@ def main():
         verdicts["merged"] = ms_merged <= ms_split * 1.05
     except Exception as e:
         notes["merged"] = "%s: %s" % (type(e).__name__, str(e)[:300])
+
+    # ---- frontier-batched histogram: K segments, one grid-(K,) dispatch.
+    # Exact vs the validated single-segment kernel per slice, then race K
+    # sequential dispatches vs one batched dispatch (the lever is the
+    # per-dispatch sequencing cost frontier batching amortizes).  Also
+    # answers the pltpu.repeat semantics question on this jax: this
+    # kernel shares the expand machinery, so a layout flip fails the
+    # exactness leg loudly instead of silently on the bench. ----
+    try:
+        starts = jnp.asarray([0, 2048, 4096, 7, 6144, 0], jnp.int32)
+        counts = jnp.asarray([2000, 2048, 1000, 2041, 2000, 0], jnp.int32)
+        hb = pseg.segment_histogram_batched(pay, starts, counts,
+                                            num_bins=B, **kw)
+        for k in range(6):
+            h1 = pseg.segment_histogram(pay, starts[k], counts[k],
+                                        num_bins=B, **kw)
+            assert float(jnp.abs(hb[k] - h1).max()) == 0.0, k
+
+        def seq_mode():
+            for k in range(6):
+                np.asarray(pseg.segment_histogram(
+                    pay, starts[k], counts[k], num_bins=B, **kw))[0, 0, 2]
+
+        def batched_mode():
+            np.asarray(pseg.segment_histogram_batched(
+                pay, starts, counts, num_bins=B, **kw))[0, 0, 0, 2]
+
+        seq_mode(); batched_mode()
+        ms_seq = median_ms(seq_mode)
+        ms_bat = median_ms(batched_mode)
+        notes["frontier_ms"] = {"sequential6": round(ms_seq, 2),
+                                "batched6": round(ms_bat, 2)}
+        verdicts["frontier"] = ms_bat <= ms_seq * 1.05
+    except Exception as e:
+        notes["frontier"] = "%s: %s" % (type(e).__name__, str(e)[:300])
 
     # ---- colblock ultra-wide hist: exact vs portable, race vs portable
     # (its activation shapes otherwise run the portable lax path) ----

@@ -125,8 +125,8 @@ def _fetch_packed(out: Dict) -> Dict[str, np.ndarray]:
 #: grower2 tree-dict fields that are replicated in value across a mesh
 #: (everything except the per-device row-segment bookkeeping)
 _PTREE_REPLICATED = (
-    "num_leaves", "leaf_value", "leaf_count", "leaf_sum_g", "leaf_sum_h",
-    "split_feature", "split_bin", "split_gain", "default_left",
+    "num_leaves", "split_rounds", "leaf_value", "leaf_count", "leaf_sum_g",
+    "leaf_sum_h", "split_feature", "split_bin", "split_gain", "default_left",
     "split_is_cat", "split_cat_bitset", "left_child", "right_child",
     "internal_value", "internal_count")
 
@@ -138,8 +138,15 @@ def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
     from ..ops import pallas_segment as _pseg
     key = (cfg, max_num_bin, ds.bins.shape, cols, payload_width,
            _bundle_key(ds), forced, mesh, mesh_axis, mode, top_k,
-           _pseg.PARTITION_HIST_VALIDATED,   # these two flip grower
-           _pseg.HIST_COLBLOCK_VALIDATED,    # structure when toggled
+           # every staged flag that flips grower structure or kernel
+           # choice when toggled: an in-process flip (bench probe,
+           # exp/flip_validated.py rerun) must always rebuild the grower,
+           # as the flag docstrings promise
+           _pseg.PARTITION_HIST_VALIDATED,
+           _pseg.HIST_COLBLOCK_VALIDATED,
+           _pseg.PARTITION_BLOCKS_VALIDATED,
+           _pseg.PARTITION_RING4_VALIDATED,
+           _pseg.FRONTIER_BATCH_VALIDATED,
            tuple((m.num_bin, m.missing_type, m.default_bin, m.is_trivial, m.bin_type)
                  for m in ds.bin_mappers),
            ds.monotone_constraints.tobytes(), ds.feature_penalty.tobytes())
@@ -717,6 +724,11 @@ class GBDT:
         self.iter = 0
         self.timer = PhaseTimer(bool(getattr(config, "tpu_profile_phases",
                                              False)))
+        # frontier-batch telemetry: sequential device rounds the growers
+        # paid, accumulated per finished tree (bench split_rounds_per_tree;
+        # == num_leaves-1 per tree unless tpu_frontier_batch > 1 engaged)
+        self.split_rounds_total = 0
+        self.trees_finished = 0
         self.shrinkage_rate = float(config.learning_rate)
         self.num_class = int(config.num_class)
         self.num_tree_per_iteration = objective.num_model_per_iteration \
@@ -845,7 +857,9 @@ class GBDT:
             hist_impl=str(getattr(config, "tpu_histogram_impl", "auto")
                           or "auto"),
             hist_pool_slots=self._hist_pool_slots(config, train_set),
-            with_monotone=bool(np.any(train_set.monotone_constraints)))
+            with_monotone=bool(np.any(train_set.monotone_constraints)),
+            frontier_batch=max(1, int(getattr(config, "tpu_frontier_batch",
+                                              1) or 1)))
         self.grower = _cached_grower(self.meta_dev, self.grower_cfg,
                                      train_set.max_num_bin, train_set,
                                      bundle_map=self.bundle_map
@@ -1511,6 +1525,11 @@ class GBDT:
         apply shrinkage and first-tree bias (gbdt.cpp:450-456)."""
         host = _fetch_packed(out)
         nl = int(host["num_leaves"])
+        # legacy masked grower reports no round counter: its loop is one
+        # round per split by construction
+        self.split_rounds_total += int(host.get("split_rounds",
+                                                max(nl - 1, 0)))
+        self.trees_finished += 1
         L = self.grower_cfg.num_leaves
         tree = Tree(max(L, 2))
         tree.num_leaves = nl
@@ -1595,6 +1614,14 @@ class GBDT:
             "right_child": out["right_child"],
         }
         return tree, tree_dev, leaf_value_dev_f
+
+    def split_rounds_per_tree(self) -> Optional[float]:
+        """Mean sequential grower rounds per finished tree (telemetry for
+        the frontier-batch fixed-cost claim: < num_leaves - 1 means the
+        batched grower committed more than one split per round)."""
+        if self.trees_finished == 0:
+            return None
+        return self.split_rounds_total / self.trees_finished
 
     # -- evaluation ----------------------------------------------------------
     def raw_train_score(self) -> np.ndarray:

@@ -28,7 +28,8 @@ from jax import lax
 from ..ops.bundle import decode_bin, expand_histogram
 from ..ops.histogram import build_histogram
 from ..ops.split import (FeatureMeta, K_MIN_SCORE, MISSING_NAN, MISSING_ZERO,
-                         SplitResult, find_best_split, leaf_output,
+                         SplitResult, find_best_split,
+                         find_best_split_batched, leaf_output,
                          pad_feature_meta, per_feature_best_gains)
 
 
@@ -62,6 +63,12 @@ class GrowerConfig(NamedTuple):
     # param): 0 = one slot per leaf (unbounded); otherwise LRU-evicted
     # cache with recompute-on-miss over the leaf's row segment
     hist_pool_slots: int = 0
+    # frontier-batch window (Config.tpu_frontier_batch): > 1 lets the
+    # partitioned grower evaluate up to this many frontier leaves per
+    # round (one batched histogram dispatch + one fused cross-leaf split
+    # search) while committing splits in exact sequential argmax order —
+    # byte-identical models, fewer sequential rounds per tree
+    frontier_batch: int = 1
 
 
 def propagate_monotone_bounds(blo, bro, is_num, mono_f, pmin, pmax):
@@ -282,6 +289,21 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
                 return find(hist_view(hist), sg, sh, cnt, fmask,
                             **constraints)
 
+        if axis_name is None and not with_mono:
+            # serial children evaluations run through the SAME stacked-fori
+            # search as the partitioned growers (find_best_split_batched's
+            # exactness note): the search compiles identically at every
+            # batch size, so gains stay bit-comparable across engines
+            def find_split2(hl, hr, lg, lh, lc, rg, rh, rc, fmask):
+                hists = jnp.stack([hl, hr])
+                if bundled:
+                    hists = jax.vmap(hist_view)(hists)
+                res2 = find_best_split_batched(
+                    hists, jnp.stack([lg, rg]), jnp.stack([lh, rh]),
+                    jnp.stack([lc, rc]), fmask, meta=meta, **find_kwargs)
+                return (jax.tree_util.tree_map(lambda a: a[0], res2),
+                        jax.tree_util.tree_map(lambda a: a[1], res2))
+
         totals = jnp.sum(vals, axis=0)
         if axis_name and not feature_mode:
             totals = lax.psum(totals, axis_name)
@@ -429,6 +451,10 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
                                    min_constraint=lmin, max_constraint=lmax)
                 res_r = find_split(new_right, rg, rh, rcnt, feature_mask,
                                    min_constraint=rmin, max_constraint=rmax)
+            elif axis_name is None:
+                lmin = lmax = rmin = rmax = None
+                res_l, res_r = find_split2(new_left, new_right, lg, lh,
+                                           lcnt, rg, rh, rcnt, feature_mask)
             else:
                 lmin = lmax = rmin = rmax = None
                 res_l = find_split(new_left, lg, lh, lcnt, feature_mask)

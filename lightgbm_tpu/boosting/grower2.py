@@ -31,7 +31,8 @@ from jax import lax
 
 from ..ops.bundle import BundleMap, expand_histogram, identity_bundle_map
 from ..ops.split import (FeatureMeta, K_MIN_SCORE, SplitResult,
-                         find_best_split, leaf_output, pad_feature_meta,
+                         find_best_split, find_best_split_batched,
+                         leaf_output, pad_feature_meta,
                          per_feature_best_gains)
 from ..ops import segment as seg
 from ..ops.segment import SplitPredicate
@@ -150,7 +151,12 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
     Ghist = Gloc if feature_mode else G
     hist_kwargs = dict(num_features=Ghist, num_bins=B, grad_col=cols.grad,
                        hess_col=cols.hess, cnt_col=cols.cnt)
-    impl = seg.resolve_impl(cfg.hist_impl, Ghist, B)
+    # the real payload width reaches the VMEM gate: the kernel DMAs full
+    # rows even when it histograms only the owned leading columns
+    # (feature-parallel), so the num_features-based estimate under-budgeted
+    # exactly where Ghist << payload_width
+    impl = seg.resolve_impl(cfg.hist_impl, Ghist, B, payload_width)
+    hist_engine = impl
     if impl == "pallas":
         from ..ops import pallas_segment as pseg
         hist_fn = functools.partial(pseg.segment_histogram, **hist_kwargs)
@@ -169,8 +175,10 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         if colblock:
             hist_fn = functools.partial(pseg.segment_histogram_colblock,
                                         **hist_kwargs)
+            hist_engine = "colblock"
         else:
             hist_fn = functools.partial(seg.segment_histogram, **hist_kwargs)
+            hist_engine = "lax"
 
     # the partition kernel is gated separately from the histogram: it is
     # exact at any bin count (HIGHEST-precision permutation) but spans the
@@ -254,6 +262,48 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         POOL = 1   # no device hist state at all in merged mode
     else:
         assert POOL >= 2, "histogram pool needs at least 2 slots"
+
+    # ---- frontier batching (Config.tpu_frontier_batch > 1) --------------
+    # A gain-ordered window of up to K frontier leaves is EVALUATED per
+    # round (K partitions of disjoint segments, ONE batched histogram
+    # dispatch for the K smaller children, ONE fused cross-leaf split
+    # search over the 2K children), then splits COMMIT by replaying the
+    # sequential grower's argmax order against the cached evaluations — a
+    # pop outside the evaluated window ends the round.  Leaf-wise
+    # semantics are exact (byte-identical models): splitting one leaf
+    # never changes another frontier leaf's rows, histogram or best split
+    # (segments are disjoint and the partition is stable), so an
+    # evaluation is the same bits whenever it runs, and the commit replay
+    # IS the sequential order.  Serial unforced/unpooled/non-monotone
+    # configs only; everything else keeps the sequential loop.
+    fb_req = max(int(getattr(cfg, "frontier_batch", 1) or 1), 1)
+    # every serial unforced non-monotone grower evaluates children through
+    # the SAME stacked-fori search (find_best_split_batched), whatever its
+    # window size — XLA compiles a find embedded directly in the do_split
+    # body differently than one in a fori body (duplicated-consumer fma
+    # contraction), and the ~1e-5 gain drift would break the batched
+    # grower's byte-identical-model guarantee against the K = 1 grower
+    stacked_find = not meshed and forced is None and not cfg.with_monotone
+    frontier_batched = (fb_req > 1 and L > 2 and stacked_find
+                       and not merged_hist and not pooled)
+    if frontier_batched and hist_engine == "pallas":
+        # staged OFF like the other TPU levers: the sequential grower
+        # stays the hardware-validated path until the batched kernel's
+        # Mosaic lowering is proven on a real chip (smoke FRONTIER
+        # section, then exp/flip_validated.py frontier)
+        from ..ops import pallas_segment as _pseg_fb
+        frontier_batched = _pseg_fb.FRONTIER_BATCH_VALIDATED
+    elif frontier_batched and hist_engine != "lax":
+        frontier_batched = False   # no batched colblock sibling (yet)
+    frontier_k = min(fb_req, L - 1) if frontier_batched else 1
+    if frontier_batched:
+        if hist_engine == "pallas":
+            from ..ops import pallas_segment as _pseg_fb2
+            hist_batched_fn = functools.partial(
+                _pseg_fb2.segment_histogram_batched, **hist_kwargs)
+        else:
+            hist_batched_fn = functools.partial(
+                seg.segment_histogram_batched, **hist_kwargs)
 
     if forced is not None:
         from .forced import make_forced_machinery
@@ -355,6 +405,15 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                 return find(hist_view(h), sg, sh, cnt, feature_mask,
                             **constraints)
 
+        if stacked_find:
+            def find_split_batched(hists, sgs, shs, cnts):
+                """Fused search over a [Q, Gh, B, 3] stack of children."""
+                if bundled:
+                    hists = jax.vmap(hist_view)(hists)
+                return find_best_split_batched(hists, sgs, shs, cnts,
+                                               feature_mask, meta=meta,
+                                               **find_kwargs)
+
         hist_root_local = hist_fn(payload, jnp.int32(0), n_rows)
         # every row lands in exactly one bin of storage column 0, so the
         # root totals fall out of the histogram — no separate full-data pass
@@ -449,6 +508,8 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             state["slot_of_leaf"] = jnp.full(L, -1, jnp.int32).at[0].set(0)
             state["leaf_of_slot"] = jnp.full(POOL, -1, jnp.int32).at[0].set(0)
             state["slot_use"] = jnp.zeros(POOL, jnp.int32)
+        if frontier_batched:
+            state["rounds"] = jnp.int32(0)
 
         def do_split(s, st, best_leaf):
             """Partition the split leaf and evaluate its children; runs only
@@ -571,6 +632,19 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                                    min_constraint=lmin, max_constraint=lmax)
                 res_r = find_split(new_right, rg, rh, rcnt,
                                    min_constraint=rmin, max_constraint=rmax)
+            elif stacked_find:
+                # the sequential loop must stay bit-comparable with the
+                # frontier-batched grower: evaluate the two children
+                # through the SAME stacked-fori search the batched rounds
+                # use (see find_best_split_batched's exactness note),
+                # then split the [2] rows back out
+                lmin = lmax = rmin = rmax = None
+                res2_ = find_split_batched(
+                    jnp.stack([new_left, new_right]),
+                    jnp.stack([lg, rg]), jnp.stack([lh, rh]),
+                    jnp.stack([lcnt, rcnt]))
+                res_l = jax.tree_util.tree_map(lambda a: a[0], res2_)
+                res_r = jax.tree_util.tree_map(lambda a: a[1], res2_)
             else:
                 lmin = lmax = rmin = rmax = None
                 res_l = find_split(new_left, lg, lh, lcnt)
@@ -684,13 +758,228 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             best_leaf = jnp.argmax(st["bgain"]).astype(jnp.int32)
             return do_split(st["num_leaves"], st, best_leaf)
 
-        st = lax.while_loop(loop_cond, body, state) if L > 1 else state
+        # ---- frontier-batched rounds (see the gate comment above) -------
+        KB = frontier_k
+
+        def round_body(st):
+            # selection: the gain-ordered window.  lax.top_k is stable
+            # (ties prefer the lower index), so slot 0 is exactly the
+            # argmax the sequential grower would pop next — the first
+            # commit of a round always succeeds and rounds always progress.
+            top_gain, cand = lax.top_k(st["bgain"], KB)
+            active = top_gain > 0.0
+            start_c = st["seg_start"][cand]
+            cnt_c = jnp.where(active, st["seg_cnt"][cand], 0)
+            feat_c = st["bfeat"][cand]
+            bbin_c = st["bbin"][cand]
+            bdleft_c = st["bdleft"][cand]
+            bcat_c = st["bcat"][cand]
+            bbitset_c = st["bbitset"][cand]
+            blo_c, bro_c = st["blo"][cand], st["bro"][cand]
+
+            # eval phase A: STAGE every candidate's partition into the aux
+            # scratch (passes A+B; payload is only read, so an evaluated
+            # candidate that never commits leaves its rows — and every
+            # later tree's accumulation order — exactly as the sequential
+            # grower would).  Segments are disjoint; ascending start order
+            # keeps each stage's one-chunk aux overrun inside regions
+            # staged afterwards.  Inactive window slots run with count 0
+            # (zero-trip loops) instead of lax.cond, which would copy aux.
+            order = jnp.argsort(start_c)
+
+            def eval_part(i, carry):
+                aux, nls = carry
+                k = order[i]
+                f = feat_c[k]
+                pred = SplitPredicate(
+                    col=bmap.f_group[f],
+                    threshold=bbin_c[k],
+                    default_left=bdleft_c[k],
+                    is_cat=bcat_c[k],
+                    bitset=bbitset_c[k],
+                    missing_type=meta.missing_type[f],
+                    num_bin=meta.num_bin[f],
+                    default_bin=meta.default_bin[f],
+                    offset=bmap.f_offset[f],
+                    identity=bmap.f_identity[f])
+                aux, nl = seg.partition_segment_stage(
+                    st["payload"], aux, start_c[k], cnt_c[k], pred)
+                return aux, nls.at[k].set(nl)
+
+            aux, nl_c = lax.fori_loop(
+                0, KB, eval_part, (st["aux"], jnp.zeros(KB, jnp.int32)))
+            payload = st["payload"]
+
+            # eval phase B: ONE batched histogram dispatch over the K
+            # smaller children, read from the STAGED aux rows — compacted
+            # at the same offsets pass C will copy them back to, so the
+            # chunk layout (and every f32 accumulation) is bit-identical
+            # to the sequential grower's post-partition build.  Siblings
+            # by batched subtraction, same masked-count smaller-child
+            # choice as the sequential path.
+            lg_c, lh_c, lc_c = (st["blg"][cand], st["blh"][cand],
+                                st["blc"][cand])
+            pg_c, ph_c, pc_c = (st["sum_g"][cand], st["sum_h"][cand],
+                                st["cnt"][cand])
+            rg_c, rh_c, rc_c = pg_c - lg_c, ph_c - lh_c, pc_c - lc_c
+            left_smaller = lc_c <= rc_c
+            h_start = jnp.where(left_smaller, start_c, start_c + nl_c)
+            h_count = jnp.where(left_smaller, nl_c, cnt_c - nl_c)
+            hist_small = hist_batched_fn(aux, h_start, h_count)
+            hist_big = st["hist"][cand] - hist_small
+            ls4 = left_smaller[:, None, None, None]
+            new_left = jnp.where(ls4, hist_small, hist_big)
+            new_right = jnp.where(ls4, hist_big, hist_small)
+
+            # eval phase C: ONE fused split search over the 2K children
+            res2 = find_split_batched(
+                jnp.concatenate([new_left, new_right]),
+                jnp.concatenate([lg_c, rg_c]),
+                jnp.concatenate([lh_c, rh_c]),
+                jnp.concatenate([lc_c, rc_c]))
+            child_depth = st["leaf_depth"][cand] + 1
+            if cfg.max_depth > 0:
+                depth_ok = child_depth < cfg.max_depth
+            else:
+                depth_ok = jnp.ones(KB, jnp.bool_)
+            gain_l = jnp.where(depth_ok, res2.gain[:KB], K_MIN_SCORE)
+            gain_r = jnp.where(depth_ok, res2.gain[KB:], K_MIN_SCORE)
+            lval_c = st["leaf_val"][cand]
+            gain_stored = st["bgain"][cand]
+
+            # commit phase: replay the sequential argmax order against the
+            # evaluated window.  Small-state bookkeeping only (payload and
+            # aux stay out of the carry); a pop outside the window — a
+            # child created this round, an unevaluated leaf, exhausted
+            # gain, or the leaf budget — ends the round.  `used` guards
+            # against a committed candidate's id (now its LEFT child)
+            # being popped again and replayed from the stale evaluation.
+            st2 = {k_: v for k_, v in st.items()
+                   if k_ not in ("payload", "aux")}
+
+            def commit_body(k, carry):
+                st2, used, stopped = carry
+                best = jnp.argmax(st2["bgain"]).astype(jnp.int32)
+                is_c = (cand == best) & active & ~used
+                j = jnp.argmax(is_c).astype(jnp.int32)
+                do = (is_c[j] & ~stopped & (st2["num_leaves"] < L)
+                      & (st2["bgain"][best] > 0.0))
+                stopped = stopped | ~do
+                used = used.at[j].set(used[j] | do)
+                s = st2["num_leaves"]
+                s_c = jnp.minimum(s, L - 1)     # clamp no-op writes
+                node = jnp.maximum(s - 1, 0)
+
+                def set2(arr, vl, vr):
+                    arr = arr.at[best].set(jnp.where(do, vl, arr[best]))
+                    return arr.at[s_c].set(jnp.where(do, vr, arr[s_c]))
+
+                def setn(arr, v):
+                    return arr.at[node].set(jnp.where(do, v, arr[node]))
+
+                start, nl = start_c[j], nl_c[j]
+                st2["seg_start"] = set2(st2["seg_start"], start, start + nl)
+                st2["seg_cnt"] = set2(st2["seg_cnt"], nl, cnt_c[j] - nl)
+                st2["sum_g"] = set2(st2["sum_g"], lg_c[j], rg_c[j])
+                st2["sum_h"] = set2(st2["sum_h"], lh_c[j], rh_c[j])
+                st2["cnt"] = set2(st2["cnt"], lc_c[j], rc_c[j])
+                st2["bgain"] = set2(st2["bgain"], gain_l[j], gain_r[j])
+                st2["bfeat"] = set2(st2["bfeat"], res2.feature[j],
+                                    res2.feature[KB + j])
+                st2["bbin"] = set2(st2["bbin"], res2.threshold_bin[j],
+                                   res2.threshold_bin[KB + j])
+                st2["bdleft"] = set2(st2["bdleft"], res2.default_left[j],
+                                     res2.default_left[KB + j])
+                st2["blg"] = set2(st2["blg"], res2.left_sum_g[j],
+                                  res2.left_sum_g[KB + j])
+                st2["blh"] = set2(st2["blh"], res2.left_sum_h[j],
+                                  res2.left_sum_h[KB + j])
+                st2["blc"] = set2(st2["blc"], res2.left_count[j],
+                                  res2.left_count[KB + j])
+                st2["bcat"] = set2(st2["bcat"], res2.is_cat[j],
+                                   res2.is_cat[KB + j])
+                st2["bbitset"] = set2(st2["bbitset"], res2.cat_bitset[j],
+                                      res2.cat_bitset[KB + j])
+                st2["blo"] = set2(st2["blo"], res2.left_output[j],
+                                  res2.left_output[KB + j])
+                st2["bro"] = set2(st2["bro"], res2.right_output[j],
+                                  res2.right_output[KB + j])
+                st2["leaf_val"] = set2(st2["leaf_val"], blo_c[j], bro_c[j])
+                st2["leaf_depth"] = set2(st2["leaf_depth"], child_depth[j],
+                                         child_depth[j])
+                st2["hist"] = st2["hist"].at[best].set(
+                    jnp.where(do, new_left[j], st2["hist"][best]))
+                st2["hist"] = st2["hist"].at[s_c].set(
+                    jnp.where(do, new_right[j], st2["hist"][s_c]))
+
+                # record the internal node (do_split's bookkeeping, with
+                # the same round-start reads the sequential grower makes)
+                st2["split_feature"] = setn(st2["split_feature"], feat_c[j])
+                st2["split_bin"] = setn(st2["split_bin"], bbin_c[j])
+                st2["split_gain"] = setn(st2["split_gain"], gain_stored[j])
+                st2["default_left"] = setn(st2["default_left"], bdleft_c[j])
+                st2["split_is_cat"] = setn(st2["split_is_cat"], bcat_c[j])
+                st2["split_cat_bitset"] = setn(st2["split_cat_bitset"],
+                                               bbitset_c[j])
+                st2["internal_value"] = setn(st2["internal_value"], lval_c[j])
+                st2["internal_count"] = setn(st2["internal_count"], pc_c[j])
+                left_child = setn(st2["left_child"], ~best)
+                right_child = setn(st2["right_child"], ~s)
+                parent_node = st2["leaf_parent"][best]
+                has_par = parent_node >= 0
+                pn = jnp.maximum(parent_node, 0)
+                was_left = left_child[pn] == ~best
+                left_child = left_child.at[pn].set(
+                    jnp.where(do & has_par & was_left, node, left_child[pn]))
+                right_child = right_child.at[pn].set(
+                    jnp.where(do & has_par & ~was_left, node,
+                              right_child[pn]))
+                st2["left_child"] = left_child
+                st2["right_child"] = right_child
+                st2["leaf_parent"] = set2(st2["leaf_parent"], node, node)
+                st2["num_leaves"] = st2["num_leaves"] + do.astype(jnp.int32)
+                return st2, used, stopped
+
+            st2, committed, _ = lax.fori_loop(
+                0, KB, commit_body,
+                (st2, jnp.zeros(KB, jnp.bool_), jnp.bool_(False)))
+
+            # commit pass C: copy the staged rows back for exactly the
+            # splits that committed (count 0 skips the rest — their
+            # payload rows were never touched).  Disjoint segments, so
+            # slot order is free.
+            def commit_part(j, pay):
+                cnt = jnp.where(committed[j], cnt_c[j], 0)
+                return seg.partition_segment_commit(
+                    pay, aux, start_c[j], cnt, nl_c[j], blo_c[j], bro_c[j],
+                    cols.value)
+
+            payload = lax.fori_loop(0, KB, commit_part, payload)
+
+            st2["rounds"] = st2["rounds"] + 1
+            st2["payload"] = payload
+            st2["aux"] = aux
+            return st2
+
+        if frontier_batched:
+            st = lax.while_loop(loop_cond, round_body, state)
+            split_rounds = st["rounds"]
+        elif L > 1:
+            st = lax.while_loop(loop_cond, body, state)
+            split_rounds = st["num_leaves"] - 1
+        else:
+            st = state
+            split_rounds = jnp.int32(0)
 
         leaf_value = jnp.where(
             (jnp.arange(L) == 0) & (st["num_leaves"] == 1),
             out_fn(st["sum_g"], st["sum_h"]), st["leaf_val"])
         tree = {
             "num_leaves": st["num_leaves"],
+            # sequential device rounds this tree paid (== splits for the
+            # sequential grower; < splits once frontier batching commits
+            # more than one split per round) — bench telemetry
+            "split_rounds": split_rounds.astype(jnp.int32),
             "leaf_value": leaf_value,
             "leaf_count": st["cnt"],
             "leaf_sum_g": st["sum_g"],

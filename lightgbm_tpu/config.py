@@ -94,6 +94,14 @@ class Config:
         # per-phase wall timers (the reference's TIMETAG taxonomy,
         # serial_tree_learner.cpp:14-41); adds a device sync per phase
         self.tpu_profile_phases = False
+        # frontier-batch window for the partitioned grower: > 1 evaluates
+        # up to this many frontier leaves per round (one batched histogram
+        # dispatch + one fused cross-leaf split search) and commits splits
+        # in exact sequential argmax order — byte-identical models, fewer
+        # sequential rounds per tree.  1 keeps the classic one-leaf loop;
+        # the TPU pallas path additionally stages behind
+        # FRONTIER_BATCH_VALIDATED (docs/PERFORMANCE.md)
+        self.tpu_frontier_batch = 1
         self._user_keys: set = set()
         self.raw_params: Dict[str, Any] = {}
         if params:
@@ -142,6 +150,10 @@ class Config:
                 setattr(self, name, str(value).lower() in
                         ("1", "true", "yes", "on")
                         if isinstance(value, str) else bool(value))
+            elif isinstance(getattr(self, name, None), int):
+                # non-registry int knob (tpu_frontier_batch): CLI strings
+                # must reach the engine as integers
+                setattr(self, name, int(value))
             else:
                 setattr(self, name, value)
         self._check_ranges()

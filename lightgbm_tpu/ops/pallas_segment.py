@@ -33,6 +33,20 @@ from jax.experimental.pallas import tpu as pltpu
 from .segment import CHUNK, GUARD
 from .split import MISSING_NAN, MISSING_ZERO
 
+def _side_effect_params():
+    """compiler_params marking a kernel side-effecting (its in-place HBM
+    writes through aliased outputs must never be DCE'd or reordered).
+    jax renamed TPUCompilerParams -> CompilerParams and moved
+    has_side_effects between versions; resolve whatever this jax ships —
+    on versions without the flag the input_output_aliases still order the
+    writes, so default params are the best (and only) available."""
+    import dataclasses
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    if any(f.name == "has_side_effects" for f in dataclasses.fields(cls)):
+        return cls(has_side_effects=True)
+    return cls()
+
 # per-tile one-hot budget: the expand and one-hot intermediates over one
 # FEATURE TILE are each [CHUNK, ~TILE_FB] f32 (2 MB).  Features are tiled
 # so any F streams through the same VMEM window — the role of the
@@ -56,19 +70,28 @@ def _tiling(num_features: int, num_bins: int):
     return ft, n_tiles, _pad128(ft * num_bins)
 
 
-def fits_vmem(num_features: int, num_bins: int) -> bool:
+def fits_vmem(num_features: int, num_bins: int,
+              payload_width: int = None) -> bool:
     """True when the tiled histogram kernel's VMEM plan fits the budget:
     the expand + one-hot tile intermediates, the [8 * n_tiles, W]
     accumulator and the double-buffered payload chunk.  Bins are capped at
     256: the kernel's exactness argument needs every bin value and
     within-window offset to be bf16-representable (the reference OpenCL
-    family has the same 256-bin kernel ceiling, ocl/histogram256.cl)."""
+    family has the same 256-bin kernel ceiling, ocl/histogram256.cl).
+
+    payload_width, when known, sizes the chunk buffers with the REAL lane
+    count the kernel DMAs (the num_features+32 estimate assumed the bin
+    columns dominate the payload — false in feature-parallel mode, where a
+    shard histograms Gloc = G/n leading columns of full-width rows and the
+    estimate under-budgeted VMEM by ~n x)."""
     if num_bins > 256:
         return False
     ft, n_tiles, w = _tiling(num_features, num_bins)
+    chunk_w = (_pad128(payload_width) if payload_width is not None
+               else _pad128(num_features + 32))
     est = (2 * 4 * CHUNK * w                   # expand + one-hot tiles
            + 4 * 8 * n_tiles * w               # accumulator
-           + 2 * 4 * CHUNK * _pad128(num_features + 32)  # chunk x2 (DMA)
+           + 2 * 4 * CHUNK * chunk_w           # chunk x2 (DMA)
            + 4 * ft * w)                       # window expander
     return est <= _VMEM_BUDGET
 
@@ -112,6 +135,17 @@ PARTITION_RING4_VALIDATED = False
 #: yet proven on a chip).  OFF until the smoke's BLOCKS section is green.
 PARTITION_BLOCKS_VALIDATED = False
 
+#: True once the BATCHED segment-histogram kernel (frontier-batched tree
+#: growth: one grid-(K,) dispatch builds K smaller-child histograms) is
+#: hardware-validated.  The kernel is a grid-indexed sibling of
+#: _hist_kernel — per-segment instruction sequence identical, scalars
+#: read at 2*program_id — but the multi-step grid over a scalar-prefetch
+#: spec is the one pattern in this family not yet proven on a chip.
+#: While OFF, a TPU pallas config keeps the SEQUENTIAL grower even when
+#: Config.tpu_frontier_batch > 1 (the CPU/lax path batches regardless —
+#: exactness is proven there by the byte-identical-model tests).
+FRONTIER_BATCH_VALIDATED = False
+
 #: staged-flag registry: verdict/flip name -> module flag.  Shared by
 #: exp/flip_validated.py (human flips), exp/smoke_staged.py (verdict
 #: names) and bench.py (in-process enablement) so the three can never
@@ -121,6 +155,7 @@ STAGED_FLAGS = {
     "colblock": "HIST_COLBLOCK_VALIDATED",
     "ring4": "PARTITION_RING4_VALIDATED",
     "blocks": "PARTITION_BLOCKS_VALIDATED",
+    "frontier": "FRONTIER_BATCH_VALIDATED",
 }
 
 
@@ -403,7 +438,7 @@ def _hist_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
                     preferred_element_type=jnp.float32)          # [8, W]
         return 0
 
-    lax.fori_loop(0, nch, body, 0, unroll=False)
+    lax.fori_loop(0, nch, body, 0)
 
 
 #: widest F*B the repeat expansion is the default for.  The round-4
@@ -488,6 +523,167 @@ def _untile_hist(out, F, B, Ft, n_tiles, W, expand_impl):
     return (ghc[:, :, :Ft * B]
             .reshape(n_tiles, 3, Ft, B).transpose(1, 0, 2, 3)
             .reshape(3, n_tiles * Ft, B)[:, :F].transpose(1, 2, 0))
+
+
+# ---------------------------------------------------------------------------
+# batched histogram (frontier-batched growth: K segments, one dispatch)
+# ---------------------------------------------------------------------------
+
+def _hist_batched_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
+                         F, B, Ft, W, grad_col, hess_col, cnt_col,
+                         expand_impl="matmul"):
+    """Grid-(K,) sibling of _hist_kernel: grid step i builds segment i's
+    histogram from scalars[2i] / scalars[2i+1] into its own out block.
+    A sibling copy, not a parametrization of _hist_kernel, for the same
+    reason as the colblock kernel: _hist_kernel is hardware-validated and
+    must not be restructured blind (test_hist_batched_matches_portable
+    pins this one against the portable engine in interpret mode; the
+    smoke's FRONTIER section must prove the Mosaic lowering — the
+    multi-step grid over scalar prefetch — before the flag flips)."""
+    i = pl.program_id(0)
+    start = scalars[2 * i]
+    count = scalars[2 * i + 1]
+    shift = lax.rem(start, 8)
+    base = start - shift
+    nch = jnp.where(count > 0, (shift + count + CHUNK - 1) // CHUNK, 0)
+    n_tiles = -(-F // Ft)
+    out_ref[0] = jnp.zeros(out_ref.shape[1:], out_ref.dtype)
+    iota_rows = _row_iota()
+
+    def dma_for(k, slot):
+        return pltpu.make_async_copy(
+            payload_hbm.at[pl.ds(pl.multiple_of(base + k * CHUNK, 8),
+                                 CHUNK), :],
+            chunk.at[slot], sem.at[slot])
+
+    @pl.when(nch > 0)
+    def _prefetch_first():
+        dma_for(0, 0).start()
+
+    if expand_impl == "repeat":
+        jdivs = {}
+        for t in range(n_tiles):
+            fw = min(Ft, F - t * Ft)
+            if fw not in jdivs:
+                jdivs[fw] = (lax.broadcasted_iota(jnp.int32, (1, fw * B), 1)
+                             // fw).astype(jnp.float32)
+    if expand_impl == "matmul":
+        iota_fr = lax.broadcasted_iota(jnp.int32, (Ft, W), 0)
+        iota_fc = lax.broadcasted_iota(jnp.int32, (Ft, W), 1)
+        d = iota_fc - iota_fr * B
+        in_win = (d >= 0) & (d < B)
+        E = in_win.astype(jnp.float32)                           # [Ft, W]
+        jmod = jnp.sum(jnp.where(in_win, d, 0), axis=0)          # [W] i32
+        jmod_f = jmod.astype(jnp.float32)
+
+    def body(k, _):
+        slot = lax.rem(k, 2)
+
+        @pl.when(k + 1 < nch)
+        def _prefetch_next():
+            dma_for(k + 1, lax.rem(k + 1, 2)).start()
+
+        dma_for(k, slot).wait()
+        data = chunk[slot]
+        ok = ((iota_rows >= shift - k * CHUNK) &
+              (iota_rows < shift + count - k * CHUNK)).astype(jnp.float32)
+        P = data.shape[1]
+        iota_r8 = lax.broadcasted_iota(jnp.int32, (8, P), 0)
+        iota_pc = lax.broadcasted_iota(jnp.int32, (8, P), 1)
+        sel = (((iota_r8 < 3) & (iota_pc == grad_col)) |
+               ((iota_r8 >= 3) & (iota_r8 < 6) & (iota_pc == hess_col)) |
+               ((iota_r8 == 6) & (iota_pc == cnt_col))).astype(jnp.float32)
+        raw = lax.dot_general(
+            sel, data, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST)                     # [8, C]
+        hi = raw.astype(jnp.bfloat16).astype(jnp.float32)
+        r1 = raw - hi
+        mid = r1.astype(jnp.bfloat16).astype(jnp.float32)
+        lo = r1 - mid
+        rr = lax.broadcasted_iota(jnp.int32, raw.shape, 0)
+        vals = jnp.where((rr == 0) | (rr == 3), hi,
+                         jnp.where((rr == 1) | (rr == 4), mid,
+                                   jnp.where((rr == 2) | (rr == 5), lo,
+                                             raw)))
+        vals = vals * ok[None, :]
+        for t in range(n_tiles):
+            f0 = t * Ft
+            fw = min(Ft, F - f0)
+            binsf = data[:, f0:f0 + fw]                          # [C, fw] f32
+            if expand_impl == "repeat":
+                rep = pltpu.repeat(binsf, B, axis=1)             # [C, fw*B]
+                onehot = (rep == jdivs[fw]).astype(jnp.float32)
+                out_ref[0, 8 * t:8 * t + 8, :fw * B] += lax.dot_general(
+                    vals, onehot,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)          # [8, fw*B]
+            else:
+                expand = lax.dot_general(
+                    binsf, E[:fw, :],
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)          # [C, W]
+                onehot = (expand == jmod_f[None, :]).astype(jnp.float32)
+                out_ref[0, 8 * t:8 * t + 8, :] += lax.dot_general(
+                    vals, onehot,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)          # [8, W]
+        return 0
+
+    lax.fori_loop(0, nch, body, 0)
+
+
+def segment_histogram_batched(payload, starts, counts, *, num_features,
+                              num_bins, grad_col, hess_col, cnt_col,
+                              interpret=False, expand_impl=None):
+    """hist[K, F, B, 3] over K disjoint segments in ONE pallas dispatch —
+    the frontier-batched grower's multi-leaf histogram engine (contract of
+    segment.segment_histogram_batched)."""
+    if expand_impl is None:
+        expand_impl = _default_expand_impl(num_features, num_bins)
+    if expand_impl not in ("matmul", "repeat"):
+        raise ValueError("expand_impl must be matmul|repeat, got %r"
+                         % (expand_impl,))
+    return _segment_histogram_batched(
+        payload, starts, counts, num_features=num_features,
+        num_bins=num_bins, grad_col=grad_col, hess_col=hess_col,
+        cnt_col=cnt_col, num_segments=int(starts.shape[0]),
+        interpret=interpret, expand_impl=expand_impl)
+
+
+@functools.partial(jax.jit, static_argnames=("num_features", "num_bins",
+                                             "grad_col", "hess_col",
+                                             "cnt_col", "num_segments",
+                                             "interpret", "expand_impl"))
+def _segment_histogram_batched(payload, starts, counts, *, num_features,
+                               num_bins, grad_col, hess_col, cnt_col,
+                               num_segments, interpret, expand_impl):
+    F, B, P = num_features, num_bins, payload.shape[1]
+    K = num_segments
+    Ft, n_tiles, W = _tiling(F, B)
+    scalars = jnp.stack([starts, counts], axis=1).reshape(-1).astype(
+        jnp.int32)                                               # [2K]
+    kern = functools.partial(_hist_batched_kernel, F=F, B=B, Ft=Ft, W=W,
+                             grad_col=grad_col, hess_col=hess_col,
+                             cnt_col=cnt_col, expand_impl=expand_impl)
+    out = pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(K,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec((1, 8 * n_tiles, W),
+                                   lambda i, s_ref: (i, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, CHUNK, P), jnp.float32),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((K, 8 * n_tiles, W), jnp.float32),
+        interpret=interpret,
+    )(scalars, payload)
+    return jax.vmap(
+        lambda o: _untile_hist(o, F, B, Ft, n_tiles, W, expand_impl))(out)
 
 
 # ---------------------------------------------------------------------------
@@ -657,7 +853,7 @@ def _hist_colblock_kernel(scalars, payload_hbm, out_ref, chunk_blk,
                     preferred_element_type=jnp.float32)
         return 0
 
-    lax.fori_loop(0, nch, body, 0, unroll=False)
+    lax.fori_loop(0, nch, body, 0)
 
 
 def segment_histogram_colblock(payload, start, count, *, num_features,
@@ -840,7 +1036,7 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
         return (nl + jnp.sum(gl), nr + jnp.sum(keep_r))
 
     num_left, num_right = lax.fori_loop(
-        0, nch, body_a, (jnp.int32(0), jnp.int32(0)), unroll=False)
+        0, nch, body_a, (jnp.int32(0), jnp.int32(0)))
     nl_out[0] = num_left
 
     # pass B: copy the staged rights back behind the lefts (touches only
@@ -860,7 +1056,7 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
                    jnp.maximum(hi - lo, 0), lo)
         return 0
 
-    lax.fori_loop(0, nrch, body_b, 0, unroll=False)
+    lax.fori_loop(0, nrch, body_b, 0)
 
 
 @functools.partial(jax.jit, static_argnames=("value_col", "num_bins",
@@ -903,7 +1099,7 @@ def partition_segment(payload, aux, start, count, pred, left_value,
                    jax.ShapeDtypeStruct(aux.shape, aux.dtype),
                    jax.ShapeDtypeStruct((1,), jnp.int32)),
         input_output_aliases={3: 0, 4: 1},
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_side_effect_params(),
         interpret=interpret,
     )(scalars, fvals, bitset, payload, aux)
     return payload_new, aux_new, nl[0]
@@ -1189,8 +1385,7 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
     (num_left, num_right, lo_, ro_, lfl, rfl, pl_, pr_) = lax.fori_loop(
         0, nch, body_a,
         (jnp.int32(0), jnp.int32(0), shift, shift,
-         jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0)),
-        unroll=False)
+         jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0)))
     nl_out[0] = num_left
 
     # rights not yet flushed go out as one final aux window (junk tails in
@@ -1249,8 +1444,7 @@ def _acc_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
 
         return (lo_ + cnt - fl * CHUNK, lfl + fl, jnp.maximum(pl_, fl))
 
-    lo_, lfl, pl_ = lax.fori_loop(0, nchb, body_b, (lo_, lfl, pl_),
-                                  unroll=False)
+    lo_, lfl, pl_ = lax.fori_loop(0, nchb, body_b, (lo_, lfl, pl_))
 
     # the final RMW below reuses the left staging buffer and the kernel
     # must not exit with a flying DMA — drain the left-flush pipeline
@@ -1333,7 +1527,7 @@ def _partition_segment_acc(payload, aux, start, count, pred, left_value,
                    jax.ShapeDtypeStruct(aux.shape, aux.dtype),
                    jax.ShapeDtypeStruct((1,), jnp.int32)),
         input_output_aliases={3: 0, 4: 1},
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_side_effect_params(),
         interpret=interpret,
     )(scalars, fvals, bitset, payload, aux)
     return payload_new, aux_new, nl[0]
@@ -1418,7 +1612,7 @@ def _partition_segment_hist(payload, aux, start, count, pred, left_value,
                    jax.ShapeDtypeStruct((8 * n_tiles, W), jnp.float32),
                    jax.ShapeDtypeStruct((8 * n_tiles, W), jnp.float32)),
         input_output_aliases={3: 0, 4: 1},
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_side_effect_params(),
         interpret=interpret,
     )(scalars, fvals, bitset, payload, aux)
     hist_l = _untile_hist(hl, F, B, Ft, n_tiles, W, expand_impl)
@@ -1473,7 +1667,7 @@ def _snap_window_kernel(scalars, payload_hbm, snap_out, buf, sem):
         d_out.wait()
         return 0
 
-    lax.fori_loop(0, nch, body, 0, unroll=False)
+    lax.fori_loop(0, nch, body, 0)
 
 
 def _acc_blocks_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
@@ -1640,8 +1834,7 @@ def _acc_blocks_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
     (num_left, num_right, lo_, ro_, lfl, rfl, pl_, pr_) = lax.fori_loop(
         0, nch, body_a,
         (jnp.int32(0), jnp.int32(0), shift, shift,
-         jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0)),
-        unroll=False)
+         jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0)))
     nl_out[0] = num_left
 
     @pl.when(ro_ > 0)
@@ -1700,8 +1893,7 @@ def _acc_blocks_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
 
         return (lo_ + cnt - fl * CHUNK, lfl + fl, jnp.maximum(pl_, fl))
 
-    lo_, lfl, pl_ = lax.fori_loop(0, nchb, body_b, (lo_, lfl, pl_),
-                                  unroll=False)
+    lo_, lfl, pl_ = lax.fori_loop(0, nchb, body_b, (lo_, lfl, pl_))
     drain(payload_out, stage, sem_w, pl_)
 
     @pl.when((count > 0) & (lo_ > 0))
@@ -1776,7 +1968,7 @@ def _partition_segment_acc_blocks(payload, aux, start, count, pred,
         ),
         out_shape=jax.ShapeDtypeStruct((payload.shape[0], 128),
                                        jnp.float32),
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_side_effect_params(),
         interpret=interpret,
     )(scalars, payload)
     nl = None
@@ -1815,7 +2007,7 @@ def _partition_segment_acc_blocks(payload, aux, start, count, pred,
                        jax.ShapeDtypeStruct(aux.shape, aux.dtype),
                        jax.ShapeDtypeStruct((1,), jnp.int32)),
             input_output_aliases={3: 0, 4: 1},
-            compiler_params=pltpu.CompilerParams(has_side_effects=True),
+            compiler_params=_side_effect_params(),
             interpret=interpret,
         )(scalars, fvals, bitset, payload, aux, snap)
         nl = nl_k if nl is None else nl

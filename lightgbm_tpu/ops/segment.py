@@ -68,19 +68,27 @@ if CHUNK % 8 != 0 or not 8 <= CHUNK <= 2048:
 GUARD = CHUNK + 8
 
 
-def resolve_impl(impl: str, num_features: int, num_bins: int) -> str:
+def resolve_impl(impl: str, num_features: int, num_bins: int,
+                 payload_width: int = None) -> str:
     """Pick the segment-engine implementation at trace time.
 
     "auto" (Config.tpu_histogram_impl default) chooses the Pallas kernels on
     a TPU backend when the joint one-hot fits VMEM, otherwise the portable
-    lax path.  "pallas" / "lax" force a choice (tests, debugging)."""
+    lax path.  "pallas" / "lax" force a choice (tests, debugging).
+
+    payload_width: the REAL payload lane count, when the caller knows it —
+    the kernel DMAs full payload rows, so the VMEM plan must budget the
+    actual width.  Feature-parallel shards histogram only their owned
+    leading columns (num_features = Gloc) but still stream full-width rows;
+    the old num_features+32 estimate under-budgeted exactly there."""
     if impl not in ("auto", "pallas", "lax"):
         raise ValueError(
             "tpu_histogram_impl must be one of auto|pallas|lax, got %r" % impl)
     if impl == "auto":
         from . import pallas_segment
         if (jax.default_backend() == "tpu"
-                and pallas_segment.fits_vmem(num_features, num_bins)):
+                and pallas_segment.fits_vmem(num_features, num_bins,
+                                             payload_width)):
             return "pallas"
         return "lax"
     if impl == "pallas" and num_bins > 256:
@@ -158,16 +166,19 @@ def _compact_matmul(chunk: jax.Array, keep: jax.Array) -> jax.Array:
     return jnp.matmul(perm, chunk, precision=jax.lax.Precision.HIGHEST)
 
 
-def partition_segment(payload: jax.Array, aux: jax.Array, start: jax.Array,
-                      count: jax.Array, pred: SplitPredicate,
-                      left_value: jax.Array, right_value: jax.Array,
-                      value_col: int):
-    """Stably partition payload rows [start, start+count) by the predicate:
-    left rows first.  Writes the children's leaf outputs into `value_col`.
-    Returns (payload, aux, num_left) — num_left counts only rows whose
-    count-mask survives in the caller's accounting; here it is the raw
-    routed-row count used for segment offsets.
-    """
+def partition_segment_stage(payload: jax.Array, aux: jax.Array,
+                            start: jax.Array, count: jax.Array,
+                            pred: SplitPredicate):
+    """Passes A+B of the stable partition: compact LEFT rows of
+    [start, start+count) into aux[start..], then RIGHT rows after them.
+    payload is only READ — the frontier-batched grower stages candidate
+    splits here and copies back (`partition_segment_commit`) only for the
+    splits that commit, so an evaluated-but-uncommitted leaf's rows keep
+    their exact sequential-grower order.  Compact writes overrun up to one
+    chunk past the segment end in aux; callers staging several segments
+    must stage them in ASCENDING start order so an overrun only ever
+    clobbers a region that is (re)staged afterwards.
+    Returns (aux, num_left)."""
     C = CHUNK
     nch = (count + C - 1) // C
 
@@ -202,17 +213,30 @@ def partition_segment(payload: jax.Array, aux: jax.Array, start: jax.Array,
 
     _, _, aux = lax.while_loop(lambda c: c[0] < nch, body_b,
                                (jnp.int32(0), jnp.int32(0), aux))
+    return aux, num_left
 
-    # pass C: blended copy-back aux -> payload over [start, start+count),
-    # writing the children's creation values (Tree::Split leaf_value_) into
-    # the value column on the way through
+
+def partition_segment_commit(payload: jax.Array, aux: jax.Array,
+                             start: jax.Array, count: jax.Array,
+                             num_left: jax.Array, left_value: jax.Array,
+                             right_value: jax.Array, value_col: int):
+    """Pass C of the stable partition: blended copy-back aux -> payload
+    over [start, start+count), writing the children's creation values
+    (Tree::Split leaf_value_) into the value column on the way through.
+    count = 0 is a no-op (uncommitted staged candidates)."""
+    C = CHUNK
+    nch = (count + C - 1) // C
     vcol_onehot = (jnp.arange(payload.shape[1]) == value_col)[None, :]
+
+    def read(buf, k):
+        return lax.dynamic_slice(buf, (start + k * C, 0),
+                                 (C, buf.shape[1]))
 
     def body_c(carry):
         k, payload = carry
         src = read(aux, k)
         dst = read(payload, k)
-        ok = valid_rows(k)[:, None]
+        ok = (jnp.arange(C, dtype=jnp.int32) < (count - k * C))[:, None]
         pos = start + k * C + jnp.arange(C, dtype=jnp.int32)
         val = jnp.where(pos < start + num_left, left_value, right_value)
         src = jnp.where(vcol_onehot, val[:, None], src)
@@ -223,6 +247,23 @@ def partition_segment(payload: jax.Array, aux: jax.Array, start: jax.Array,
 
     _, payload = lax.while_loop(lambda c: c[0] < nch, body_c,
                                 (jnp.int32(0), payload))
+    return payload
+
+
+def partition_segment(payload: jax.Array, aux: jax.Array, start: jax.Array,
+                      count: jax.Array, pred: SplitPredicate,
+                      left_value: jax.Array, right_value: jax.Array,
+                      value_col: int):
+    """Stably partition payload rows [start, start+count) by the predicate:
+    left rows first.  Writes the children's leaf outputs into `value_col`.
+    Returns (payload, aux, num_left) — num_left counts only rows whose
+    count-mask survives in the caller's accounting; here it is the raw
+    routed-row count used for segment offsets.  Composed of the stage
+    (A+B) and commit (C) passes the frontier-batched grower runs apart.
+    """
+    aux, num_left = partition_segment_stage(payload, aux, start, count, pred)
+    payload = partition_segment_commit(payload, aux, start, count, num_left,
+                                       left_value, right_value, value_col)
     return payload, aux, num_left
 
 
@@ -274,3 +315,30 @@ def segment_histogram(payload: jax.Array, start: jax.Array, count: jax.Array,
     _, hist = lax.while_loop(lambda c: c[0] < nch, body,
                              (jnp.int32(0), hist0))
     return hist
+
+
+def segment_histogram_batched(payload: jax.Array, starts: jax.Array,
+                              counts: jax.Array, *, num_features: int,
+                              num_bins: int, grad_col: int, hess_col: int,
+                              cnt_col: int) -> jax.Array:
+    """hist[K, F, B, 3] over K disjoint segments — portable batched engine.
+
+    One traced region serves the whole frontier batch of the
+    frontier-batched grower; a zero count yields a zero histogram (padding
+    slots of a short frontier).  Each slice [k] is computed by the SAME
+    per-chunk accumulation as `segment_histogram(payload, starts[k],
+    counts[k])` — bit-identical per segment, which is what lets the batched
+    grower stay byte-identical to the sequential one.  The TPU-native
+    single-dispatch version is `pallas_segment.segment_histogram_batched`
+    (staged behind FRONTIER_BATCH_VALIDATED)."""
+    K = starts.shape[0]
+
+    def body(k, hist):
+        h = segment_histogram(payload, starts[k], counts[k],
+                              num_features=num_features, num_bins=num_bins,
+                              grad_col=grad_col, hess_col=hess_col,
+                              cnt_col=cnt_col)
+        return lax.dynamic_update_slice(hist, h[None], (k, 0, 0, 0))
+
+    hist0 = jnp.zeros((K, num_features, num_bins, 3), jnp.float32)
+    return lax.fori_loop(0, K, body, hist0)

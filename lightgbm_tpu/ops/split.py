@@ -24,6 +24,7 @@ Semantics matched to the reference:
 """
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -447,6 +448,51 @@ def find_best_split(hist, sum_g, sum_h, num_data, feature_mask, *,
         left_sum_g=left_g, left_sum_h=left_h - eps, left_count=left_c,
         is_cat=is_cat, cat_bitset=cat_bitset,
         left_output=lo, right_output=ro)
+
+
+def find_best_split_batched(hist, sum_g, sum_h, num_data, feature_mask, *,
+                            meta: FeatureMeta, **kwargs) -> SplitResult:
+    """`find_best_split` lifted to a LEAVES-LEADING axis.
+
+    hist: [Q, F, B, 3] — one histogram per frontier child; sum_g / sum_h /
+    num_data: [Q] leaf totals.  Returns a SplitResult whose every field
+    carries the leading [Q] axis, so one XLA program replaces Q sequential
+    scan+argmax programs (the frontier-batched grower's fused cross-leaf
+    split search; the cross-leaf argmax itself happens over the per-leaf
+    gains at commit time).
+
+    Exactness contract: a row of the result is bit-identical to the same
+    search run through this function at ANY other Q — which is why the
+    sequential grower also routes its two-children evaluation through
+    here (Q = 2) instead of calling `find_best_split` inline.  XLA
+    compiles the gain arithmetic differently per surrounding program (fma
+    contraction / duplicated-consumer fusion), and the resulting ~1e-5
+    relative gain drift would break the frontier-batched grower's
+    byte-identical-model guarantee; a `vmap` lift drifts the same way.
+    Keeping every grower's search inside this one fori body is the
+    measured fix: the body compiles identically at every Q, so the gains
+    are the same bits everywhere (pinned by the byte-identity tests)."""
+    fn = functools.partial(find_best_split, meta=meta, **kwargs)
+    Q = hist.shape[0]
+    B = hist.shape[2]
+    out0 = SplitResult(
+        gain=jnp.full(Q, K_MIN_SCORE, jnp.float32),
+        feature=jnp.zeros(Q, jnp.int32),
+        threshold_bin=jnp.zeros(Q, jnp.int32),
+        default_left=jnp.zeros(Q, bool),
+        left_sum_g=jnp.zeros(Q, jnp.float32),
+        left_sum_h=jnp.zeros(Q, jnp.float32),
+        left_count=jnp.zeros(Q, jnp.float32),
+        is_cat=jnp.zeros(Q, bool),
+        cat_bitset=jnp.zeros((Q, B), bool),
+        left_output=jnp.zeros(Q, jnp.float32),
+        right_output=jnp.zeros(Q, jnp.float32))
+
+    def body(q, acc):
+        r = fn(hist[q], sum_g[q], sum_h[q], num_data[q], feature_mask)
+        return SplitResult(*[a.at[q].set(v) for a, v in zip(acc, r)])
+
+    return jax.lax.fori_loop(0, Q, body, out0)
 
 
 def evaluate_split_at(hist, sum_g, sum_h, num_data, feature, threshold_bin, *,

@@ -153,6 +153,41 @@ def test_c_train_matches_python(problem):
     _check(lib, lib.LGBM_DatasetFree(ds))
 
 
+def test_c_eval_counts_and_names(problem):
+    """LGBM_BoosterGetEvalCounts / GetEvalNames size and name the
+    LGBM_BoosterGetEval buffers (reference c_api pairing)."""
+    lib = _lib()
+    X, y = problem
+    ds = _c_dataset(lib, X, y)
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(ds, PARAMS.encode(),
+                                       ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    n = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetEvalCounts(bst, ctypes.byref(n)))
+    assert n.value == 1  # metric=auc
+
+    bufs = [ctypes.create_string_buffer(128) for _ in range(n.value)]
+    arr = (ctypes.c_char_p * n.value)(
+        *[ctypes.cast(b, ctypes.c_char_p) for b in bufs])
+    out_n = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetEvalNames(bst, ctypes.byref(out_n), arr))
+    assert out_n.value == n.value
+    assert bufs[0].value.decode() == "auc"
+
+    # the count sizes GetEval's buffer exactly
+    res = (ctypes.c_double * n.value)()
+    out_len = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetEval(bst, 0, ctypes.byref(out_len), res))
+    assert out_len.value == n.value
+
+    # a prediction-only handle is rejected like the other training calls
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds))
+
+
 def test_c_train_rollback_and_valid(problem):
     lib = _lib()
     X, y = problem

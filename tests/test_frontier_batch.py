@@ -1,0 +1,279 @@
+"""Frontier-batched tree growth must be EXACT: byte-identical models.
+
+The batched grower (Config.tpu_frontier_batch > 1) evaluates a gain-ordered
+window of frontier leaves per round — staged partitions, one batched
+histogram dispatch, one fused cross-leaf split search — then commits splits
+by replaying the sequential argmax order.  Its exactness rests on two
+invariants these tests pin:
+
+- cross-leaf independence: splitting one leaf never changes another
+  frontier leaf's rows, histogram, or best split (disjoint contiguous
+  segments + stable partition), so an evaluation computes the same bits
+  whenever it runs;
+- search stability: the stacked-fori split search returns the same bits at
+  every batch size (find_best_split_batched's exactness note).
+
+The standard is the serial-EXACT one used for feature-parallel: identical
+model text, identical payload bytes.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.boosting.grower import GrowerConfig
+from lightgbm_tpu.boosting.grower2 import PayloadCols, make_partitioned_grower
+from lightgbm_tpu.boosting.gbdt import _feature_meta_device
+from lightgbm_tpu.ops import segment as seg
+from lightgbm_tpu.ops.segment import SplitPredicate
+
+
+def _problem(seed, n=3000, f=6, with_nan=False, categorical=()):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, f)).astype(np.float64)
+    for c in categorical:
+        X[:, c] = rng.integers(0, 12, size=n)
+    if with_nan:
+        X[rng.random((n, f)) < 0.1] = np.nan
+    y = (X[:, 0] + 0.5 * np.nan_to_num(X[:, 1]) +
+         rng.standard_normal(n) * 0.1 > 0).astype(np.float32)
+    return X, y
+
+
+def _grow_pair(seed, fb, num_leaves=31, with_nan=False, categorical=()):
+    """(sequential tree+payload, batched tree+payload) on one problem."""
+    X, y = _problem(seed, with_nan=with_nan, categorical=categorical)
+    n = len(y)
+    config = Config({"objective": "binary", "max_bin": 63,
+                     "num_leaves": num_leaves, "min_data_in_leaf": 20})
+    ds = BinnedDataset.from_matrix(X, config,
+                                   categorical_feature=list(categorical),
+                                   row_chunk=1024)
+    meta = _feature_meta_device(ds)
+    n_pad = ds.num_data_padded
+    gcfg = GrowerConfig(num_leaves=num_leaves, max_depth=-1, lambda_l1=0.0,
+                        lambda_l2=0.1, max_delta_step=0.0,
+                        min_data_in_leaf=20, min_sum_hessian_in_leaf=1e-3,
+                        min_gain_to_split=0.0, row_chunk=n_pad,
+                        with_categorical=bool(categorical))
+    grad = np.zeros(n_pad, np.float32)
+    hess = np.zeros(n_pad, np.float32)
+    grad[:n] = 0.5 - y
+    hess[:n] = 0.25
+    mask = np.zeros(n_pad, np.float32)
+    mask[:n] = 1.0
+    F = ds.num_features
+    cols = PayloadCols(grad=F, hess=F + 1, cnt=F + 2, value=F + 3)
+    P = F + 4
+    pay = np.zeros((n_pad + seg.GUARD, P), np.float32)
+    pay[:n_pad, :F] = ds.bins.T
+    pay[:n_pad, cols.grad] = grad * mask
+    pay[:n_pad, cols.hess] = hess * mask
+    pay[:n_pad, cols.cnt] = mask
+
+    def run(cfg):
+        grow = make_partitioned_grower(meta, cfg, ds.max_num_bin, cols, F)
+        t, p2, _ = grow(jnp.asarray(pay),
+                        jnp.zeros((n_pad + seg.GUARD, P), jnp.float32),
+                        jnp.ones(F, bool))
+        return jax.device_get(t), np.asarray(jax.device_get(p2))
+
+    return run(gcfg), run(gcfg._replace(frontier_batch=fb))
+
+
+def _assert_bit_identical(out1, pay1, out2, pay2):
+    for k in out1:
+        if k == "split_rounds":
+            continue
+        np.testing.assert_array_equal(np.asarray(out1[k]),
+                                      np.asarray(out2[k]), err_msg=k)
+    # payload bytes too: row ORDER feeds every later tree's accumulation,
+    # so an uncommitted speculative partition must never leak through
+    np.testing.assert_array_equal(pay1, pay2)
+
+
+@pytest.mark.parametrize("seed,fb,with_nan", [(0, 4, False), (1, 4, False),
+                                              (2, 4, True), (5, 8, False)])
+def test_batched_grower_bit_identical(seed, fb, with_nan):
+    (o1, p1), (o2, p2) = _grow_pair(seed, fb, with_nan=with_nan)
+    assert int(o1["num_leaves"]) > 4
+    _assert_bit_identical(o1, p1, o2, p2)
+    # and the fixed-cost claim: strictly fewer sequential rounds
+    assert int(o2["split_rounds"]) < int(o1["split_rounds"])
+
+
+def test_batched_grower_bit_identical_categorical():
+    (o1, p1), (o2, p2) = _grow_pair(7, 4, categorical=(2, 4))
+    assert int(o1["num_leaves"]) > 4
+    _assert_bit_identical(o1, p1, o2, p2)
+
+
+def test_batched_grower_window_wider_than_frontier():
+    """K = num_leaves - 1 (window always covers the whole frontier)."""
+    (o1, p1), (o2, p2) = _grow_pair(6, 14, num_leaves=15, with_nan=True)
+    _assert_bit_identical(o1, p1, o2, p2)
+
+
+@pytest.mark.parametrize("params,rounds", [
+    ({"objective": "binary", "num_leaves": 31, "min_data_in_leaf": 20}, 10),
+    ({"objective": "regression", "num_leaves": 31, "bagging_freq": 1,
+      "bagging_fraction": 0.7}, 8),
+    ({"objective": "multiclass", "num_class": 3, "num_leaves": 15}, 5),
+])
+def test_model_text_byte_identical(params, rounds):
+    """End to end through the Booster: identical model FILES across many
+    boosting iterations (scores feed gradients, so any payload divergence
+    would compound and surface here)."""
+    rng = np.random.default_rng(11)
+    X = rng.standard_normal((3000, 8)).astype(np.float32)
+    if params["objective"] == "multiclass":
+        y = rng.integers(0, 3, size=3000).astype(np.float32)
+        y[X[:, 0] > 0.5] = 0
+    elif params["objective"] == "regression":
+        y = (X[:, 0] * 2 + np.abs(X[:, 3])).astype(np.float32)
+    else:
+        y = (X[:, 0] + 0.4 * X[:, 1] * X[:, 2] +
+             rng.standard_normal(3000) * 0.3 > 0).astype(np.float32)
+    base = dict(params, verbose=-1)
+    b1 = lgb.train(dict(base), lgb.Dataset(X, label=y),
+                   num_boost_round=rounds)
+    b2 = lgb.train(dict(base, tpu_frontier_batch=4), lgb.Dataset(X, label=y),
+                   num_boost_round=rounds)
+    assert b1.model_to_string() == b2.model_to_string()
+    r1 = b1._engine.split_rounds_per_tree()
+    r2 = b2._engine.split_rounds_per_tree()
+    assert r2 < r1 <= params["num_leaves"] - 1
+
+
+def test_config_knob_coerces_strings():
+    """CLI-style string values must reach the grower as integers."""
+    c = Config({"tpu_frontier_batch": "4"})
+    assert c.tpu_frontier_batch == 4 and isinstance(c.tpu_frontier_batch, int)
+
+
+def test_split_rounds_counter_sequential_default():
+    """With the default window (1) the counter equals splits per tree."""
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((2000, 5)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=3)
+    eng = bst._engine
+    assert eng.trees_finished == 3
+    assert eng.split_rounds_per_tree() <= 14
+
+
+# ---------------------------------------------------------------------------
+# the invariants the exactness argument rests on
+# ---------------------------------------------------------------------------
+
+def _toy_segments():
+    """A payload holding a depth-bucketed frontier of two sibling leaves
+    (disjoint contiguous segments) plus value columns."""
+    F, B = 4, 16
+    cols = PayloadCols(grad=F, hess=F + 1, cnt=F + 2, value=F + 3)
+    P = F + 4
+    rng = np.random.default_rng(0)
+    n = 1024
+    pay = np.zeros((n + seg.GUARD, P), np.float32)
+    pay[:n, :F] = rng.integers(0, B, size=(n, F))
+    pay[:n, cols.grad] = rng.standard_normal(n)
+    pay[:n, cols.hess] = rng.random(n) + 0.1
+    pay[:n, cols.cnt] = 1.0
+    return jnp.asarray(pay), cols, F, B
+
+
+def _pred(col, threshold, B):
+    return SplitPredicate(
+        col=jnp.int32(col), threshold=jnp.int32(threshold),
+        default_left=jnp.bool_(False), is_cat=jnp.bool_(False),
+        bitset=jnp.zeros(B, bool), missing_type=jnp.int32(0),
+        num_bin=jnp.int32(B), default_bin=jnp.int32(0),
+        offset=jnp.int32(0), identity=jnp.bool_(True))
+
+
+def test_depth_bucket_invariant_split_does_not_touch_sibling():
+    """Splitting one leaf of a depth-bucketed frontier leaves every other
+    leaf's rows — and therefore its histogram and best split — bit-for-bit
+    unchanged.  This is the invariant that makes a frontier evaluation
+    valid no matter when it runs (no sibling in a window can invalidate
+    another's cached best split)."""
+    pay, cols, F, B = _toy_segments()
+    hk = dict(num_features=F, num_bins=B, grad_col=cols.grad,
+              hess_col=cols.hess, cnt_col=cols.cnt)
+    aux = jnp.zeros_like(pay)
+    # frontier: leaf A = rows [0, 600), leaf B = rows [600, 1024)
+    hist_b_before = seg.segment_histogram(pay, jnp.int32(600),
+                                          jnp.int32(424), **hk)
+    rows_b_before = np.asarray(pay[600:1024])
+    # split leaf A (full stage + commit, as the sequential grower would)
+    pay2, aux, nl = seg.partition_segment(pay, aux, jnp.int32(0),
+                                          jnp.int32(600), _pred(1, B // 2, B),
+                                          jnp.float32(0.5), jnp.float32(-0.5),
+                                          cols.value)
+    hist_b_after = seg.segment_histogram(pay2, jnp.int32(600),
+                                         jnp.int32(424), **hk)
+    np.testing.assert_array_equal(np.asarray(pay2[600:1024]), rows_b_before)
+    np.testing.assert_array_equal(np.asarray(hist_b_after),
+                                  np.asarray(hist_b_before))
+
+
+def test_staged_partition_composes_to_full_partition():
+    """stage (A+B into aux) followed by commit (C) is the partition the
+    sequential grower runs — bit-for-bit, including the value column."""
+    pay, cols, F, B = _toy_segments()
+    pred = _pred(2, B // 3, B)
+    lv, rv = jnp.float32(1.25), jnp.float32(-2.5)
+    p_ref, _, nl_ref = seg.partition_segment(
+        pay, jnp.zeros_like(pay), jnp.int32(100), jnp.int32(700), pred,
+        lv, rv, cols.value)
+    aux, nl = seg.partition_segment_stage(pay, jnp.zeros_like(pay),
+                                          jnp.int32(100), jnp.int32(700),
+                                          pred)
+    assert int(nl) == int(nl_ref)
+    p_got = seg.partition_segment_commit(pay, aux, jnp.int32(100),
+                                         jnp.int32(700), nl, lv, rv,
+                                         cols.value)
+    np.testing.assert_array_equal(np.asarray(p_got), np.asarray(p_ref))
+
+
+def test_staged_child_histogram_matches_committed():
+    """The smaller-child histogram built from STAGED aux rows equals the
+    one built from payload rows after commit — same compacted offsets,
+    same chunk walk, same bits (the batched grower histograms before it
+    knows whether the split will commit)."""
+    pay, cols, F, B = _toy_segments()
+    hk = dict(num_features=F, num_bins=B, grad_col=cols.grad,
+              hess_col=cols.hess, cnt_col=cols.cnt)
+    pred = _pred(0, B // 2, B)
+    aux, nl = seg.partition_segment_stage(pay, jnp.zeros_like(pay),
+                                          jnp.int32(0), jnp.int32(1024),
+                                          pred)
+    h_staged = seg.segment_histogram(aux, jnp.int32(0), nl, **hk)
+    committed = seg.partition_segment_commit(pay, aux, jnp.int32(0),
+                                             jnp.int32(1024), nl,
+                                             jnp.float32(1.0),
+                                             jnp.float32(-1.0), cols.value)
+    h_committed = seg.segment_histogram(committed, jnp.int32(0), nl, **hk)
+    np.testing.assert_array_equal(np.asarray(h_staged),
+                                  np.asarray(h_committed))
+
+
+def test_batched_histogram_matches_per_segment():
+    """Portable batched engine: slice [k] is bit-identical to the
+    single-segment walk; zero-count slots give zero histograms."""
+    pay, cols, F, B = _toy_segments()
+    hk = dict(num_features=F, num_bins=B, grad_col=cols.grad,
+              hess_col=cols.hess, cnt_col=cols.cnt)
+    starts = jnp.asarray([0, 600, 100, 0], jnp.int32)
+    counts = jnp.asarray([600, 424, 37, 0], jnp.int32)
+    batched = seg.segment_histogram_batched(pay, starts, counts, **hk)
+    for k in range(4):
+        ref = seg.segment_histogram(pay, starts[k], counts[k], **hk)
+        np.testing.assert_array_equal(np.asarray(batched[k]),
+                                      np.asarray(ref), err_msg=str(k))
+    assert float(jnp.sum(jnp.abs(batched[3]))) == 0.0

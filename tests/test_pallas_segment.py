@@ -532,3 +532,70 @@ def test_partition_blocks_narrow_pin():
         VALUE_COL, B, interpret=True)
     assert int(got_nl) == int(ref_nl)
     np.testing.assert_array_equal(np.asarray(got_pay), np.asarray(ref_pay))
+
+
+# ---------------------------------------------------------------------------
+# frontier batching: the batched histogram kernel + its staged flag
+# ---------------------------------------------------------------------------
+
+def test_frontier_flag_staged_off():
+    # pinned OFF until the smoke's FRONTIER section validates the
+    # multi-step scalar-prefetch grid on a chip; flip in the SAME commit
+    # as flip_validated.py frontier
+    assert pseg.FRONTIER_BATCH_VALIDATED is False
+    assert pseg.STAGED_FLAGS["frontier"] == "FRONTIER_BATCH_VALIDATED"
+
+
+@pytest.mark.parametrize("expand", ["matmul"])
+def test_hist_batched_matches_portable(expand):
+    """Grid-(K,) batched kernel vs the portable batched engine, including
+    unaligned starts, a 1-row segment and a zero-count padding slot.
+    (repeat mode is excluded the same way the single-segment grid is on
+    this jax: interpret-mode pltpu.repeat emulation disagrees with the
+    hardware-validated layout — see on_tpu_return.sh.)"""
+    pay = _payload(1024, seed=5)
+    starts = jnp.asarray([0, 256, 100, 513, 7, 0], jnp.int32)
+    counts = jnp.asarray([1000, 700, 37, 256, 1, 0], jnp.int32)
+    cols = dict(num_features=F, num_bins=B, **COLS)
+    ref = seg.segment_histogram_batched(pay, starts, counts, **cols)
+    got = pseg.segment_histogram_batched(pay, starts, counts,
+                                         interpret=True, expand_impl=expand,
+                                         **cols)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_hist_batched_slice_matches_single_segment_kernel():
+    """Each batched-grid slice must agree with the hardware-validated
+    single-segment kernel on the same segment (sibling-pin discipline:
+    the batched kernel is a grid-indexed copy, not a restructure)."""
+    pay = _payload(1024, seed=6)
+    starts = jnp.asarray([9, 300], jnp.int32)
+    counts = jnp.asarray([291, 700], jnp.int32)
+    cols = dict(num_features=F, num_bins=B, **COLS)
+    got = pseg.segment_histogram_batched(pay, starts, counts,
+                                         interpret=True,
+                                         expand_impl="matmul", **cols)
+    for k in range(2):
+        ref = pseg.segment_histogram(pay, starts[k], counts[k],
+                                     interpret=True, expand_impl="matmul",
+                                     **cols)
+        np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(ref))
+
+
+def test_hist_vmem_gate_uses_real_payload_width():
+    """The histogram VMEM gate must budget the REAL payload lane count
+    when the caller knows it: a feature-parallel shard histograms few
+    owned columns (small F) of very wide rows, where the old
+    num_features+32 estimate under-budgeted the chunk buffers."""
+    if seg.CHUNK != 256:
+        pytest.skip("VMEM gate expectations assume the default CHUNK")
+    # same histogram shape, honest width: an ultra-wide payload's chunk
+    # buffers alone exceed the budget even though only 28 columns are
+    # histogrammed (2 x 4 x CHUNK x width of double-buffered DMA)
+    assert pseg.fits_vmem(28, 255)
+    assert pseg.fits_vmem(28, 255, payload_width=128)
+    assert not pseg.fits_vmem(28, 255, payload_width=8192)
+    # resolve_impl threads the width through (TPU-only decision; on CPU
+    # both resolve to lax)
+    assert seg.resolve_impl("auto", 28, 255, 4224) in ("pallas", "lax")
