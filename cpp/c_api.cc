@@ -319,13 +319,30 @@ void PredictRow(const Model& m, const double* row, int predict_type,
 // Resolve a public handle to a native Model*: training boosters (embedded
 // Python, c_train.cc) are re-synced into their native model cache so every
 // shared entry point below runs identical code for both booster kinds.
-Model* AsModel(BoosterHandle h) {
-  if (lgbm_tpu_internal::IsTrainBooster(h)) {
-    h = lgbm_tpu_internal::GetTrainHooks()->booster_native(h);
-    if (h == nullptr) return nullptr;
+// RAII: for a training booster the hook returns with the handle's model
+// lock held SHARED, so a concurrent UpdateOneIter->resync cannot free the
+// model under an in-flight predict/save; the destructor releases it.
+// Loaded boosters need no lock (the caller owns their lifetime).
+struct ModelRef {
+  Model* m = nullptr;
+  void* locked = nullptr;  // the train handle whose shared lock we hold
+  explicit ModelRef(BoosterHandle h) {
+    if (lgbm_tpu_internal::IsTrainBooster(h)) {
+      void* native = lgbm_tpu_internal::GetTrainHooks()->booster_native(h);
+      if (native == nullptr) return;
+      locked = h;
+      m = static_cast<Model*>(native);
+      return;
+    }
+    m = static_cast<Model*>(h);
   }
-  return static_cast<Model*>(h);
-}
+  ~ModelRef() {
+    if (locked != nullptr)
+      lgbm_tpu_internal::GetTrainHooks()->booster_native_release(locked);
+  }
+  ModelRef(const ModelRef&) = delete;
+  ModelRef& operator=(const ModelRef&) = delete;
+};
 
 int LoadModel(const std::string& text, int* out_num_iterations,
               BoosterHandle* out) {
@@ -368,14 +385,16 @@ int LGBM_BoosterFree(BoosterHandle handle) {
 }
 
 int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len) {
-  Model* m = AsModel(handle);
+  ModelRef ref(handle);
+  Model* m = ref.m;
   if (m == nullptr) return -1;
   *out_len = m->num_class;
   return 0;
 }
 
 int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len) {
-  Model* m = AsModel(handle);
+  ModelRef ref(handle);
+  Model* m = ref.m;
   if (m == nullptr) return -1;
   *out_len = m->max_feature_idx + 1;
   return 0;
@@ -385,7 +404,8 @@ int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration) {
   if (lgbm_tpu_internal::IsTrainBooster(handle))
     return lgbm_tpu_internal::GetTrainHooks()->booster_current_iteration(
         handle, out_iteration);
-  Model* m = AsModel(handle);
+  ModelRef ref(handle);
+  Model* m = ref.m;
   if (m == nullptr) return -1;
   *out_iteration = m->NumIterations();
   return 0;
@@ -394,7 +414,8 @@ int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration) {
 int LGBM_BoosterSaveModel(BoosterHandle handle, int num_iteration,
                           const char* filename) {
   int64_t len = 0;
-  Model* m = AsModel(handle);
+  ModelRef ref(handle);
+  Model* m = ref.m;
   if (m == nullptr) return -1;
   (void)num_iteration;  // full stored text; truncation is a Python-side task
   std::ofstream f(filename);
@@ -408,7 +429,8 @@ int LGBM_BoosterSaveModelToString(BoosterHandle handle, int num_iteration,
                                   int64_t buffer_len, int64_t* out_len,
                                   char* out_str) {
   (void)num_iteration;
-  Model* m = AsModel(handle);
+  ModelRef ref(handle);
+  Model* m = ref.m;
   if (m == nullptr) return -1;
   *out_len = static_cast<int64_t>(m->text.size()) + 1;
   if (buffer_len >= *out_len && out_str != nullptr) {
@@ -423,7 +445,8 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
                               int num_iteration, const char* parameter,
                               int64_t* out_len, double* out_result) {
   (void)parameter;
-  Model* m = AsModel(handle);
+  ModelRef ref(handle);
+  Model* m = ref.m;
   if (m == nullptr) return -1;
   int nfeat = m->max_feature_idx + 1;
   if (ncol < nfeat)
@@ -472,7 +495,8 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
                               double* out_result) {
   (void)parameter;
   (void)nelem;
-  Model* m = AsModel(handle);
+  ModelRef ref(handle);
+  Model* m = ref.m;
   if (m == nullptr) return -1;
   if (indptr_type != C_API_DTYPE_INT32 && indptr_type != C_API_DTYPE_INT64)
     return Fail("indptr_type must be C_API_DTYPE_INT32/INT64, got " +

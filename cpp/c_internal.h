@@ -32,7 +32,15 @@ inline uint32_t HandleMagic(const void* h) {
 struct TrainHooks {
   // Current model parsed into a native booster (cached; re-synced after
   // every update/rollback).  Returns nullptr on error (message set).
+  // On success the handle's model lock is held SHARED by the calling
+  // thread: the returned Model* stays alive across the caller's whole
+  // predict/save, even if a concurrent update marks the cache dirty and
+  // another thread resyncs — the resync's free waits for readers.  The
+  // caller MUST pair every successful call with booster_native_release
+  // (c_api.cc's ModelRef does this via RAII).
   void* (*booster_native)(void* h);
+  // Drop the shared model lock taken by a successful booster_native.
+  void (*booster_native_release)(void* h);
   int (*booster_free)(void* h);
   int (*booster_current_iteration)(void* h, int* out);
 };

@@ -22,8 +22,10 @@
 #include <Python.h>
 #include <dlfcn.h>
 
+#include <atomic>
 #include <cstring>
 #include <mutex>
+#include <shared_mutex>
 #include <string>
 
 namespace {
@@ -42,8 +44,16 @@ struct TrainBooster {
   const uint32_t magic = kTrainBoosterMagic;
   PyObject* bst = nullptr;      // lightgbm_tpu.Booster
   void* native = nullptr;       // cached LGBM_BoosterLoadModelFromString
-  bool dirty = true;            // model changed since last native sync
-  std::mutex sync_mu;           // serializes the dirty-check/free/swap
+  std::atomic<bool> dirty{true};  // model changed since last native sync
+  std::mutex sync_mu;           // serializes the parse-and-swap itself
+  // Reader/writer guard on the cached Model*: every predict/save holds it
+  // SHARED for the whole time it dereferences the pointer (taken inside
+  // TrainBoosterNative, released via the booster_native_release hook), and
+  // the resync takes it EXCLUSIVE only around the free/swap — so an
+  // UpdateOneIter racing an in-flight predict can no longer free the
+  // model under the reader, making the header's "any thread" contract
+  // actually true (the reference c_api guards Booster the same way).
+  std::shared_mutex model_mu;
 };
 
 // Helper functions executed inside the embedded interpreter.  Keeping the
@@ -188,14 +198,23 @@ void InitPython() {
   // make the package importable: LIGHTGBM_TPU_ROOT wins, then the repo
   // root next to this shared library (the parent of the cpp/ dir the .so
   // lives in, located via dladdr); a pip install resolves through the
-  // normal sys.path instead
+  // normal sys.path instead.  The candidate paths travel as REAL Python
+  // objects (PyUnicode_DecodeFSDefault + PySys_SetObject), never spliced
+  // into source text — a quote run or trailing backslash in a path must
+  // stay path data, not become code inside the embedded interpreter.
   {
-    std::string boot =
-        "import os, sys\n"
-        "for _cand in (";
+    PyObject* cands = PyList_New(0);
+    auto append_path = [&](const std::string& p) {
+      PyObject* s = PyUnicode_DecodeFSDefault(p.c_str());
+      if (s != nullptr) {
+        PyList_Append(cands, s);
+        Py_DECREF(s);
+      } else {
+        PyErr_Clear();  // undecodable path: skip the candidate
+      }
+    };
     const char* env_root = std::getenv("LIGHTGBM_TPU_ROOT");
-    if (env_root != nullptr)
-      boot += "r'''" + std::string(env_root) + "''', ";
+    if (env_root != nullptr) append_path(env_root);
     Dl_info info;
     if (dladdr(reinterpret_cast<void*>(&InitPython), &info) != 0 &&
         info.dli_fname != nullptr) {
@@ -204,15 +223,17 @@ void InitPython() {
       if (cut != std::string::npos) {
         std::string so_dir = so.substr(0, cut);
         auto cut2 = so_dir.find_last_of('/');
-        if (cut2 != std::string::npos)
-          boot += "r'''" + so_dir.substr(0, cut2) + "''', ";
+        if (cut2 != std::string::npos) append_path(so_dir.substr(0, cut2));
       }
     }
-    boot +=
-        "):\n"
+    PySys_SetObject("_lgbm_tpu_path_candidates", cands);
+    Py_DECREF(cands);
+    PyRun_SimpleString(
+        "import os, sys\n"
+        "for _cand in sys._lgbm_tpu_path_candidates:\n"
         "    if _cand and os.path.isdir(_cand) and _cand not in sys.path:\n"
-        "        sys.path.insert(0, _cand)\n";
-    PyRun_SimpleString(boot.c_str());
+        "        sys.path.insert(0, _cand)\n"
+        "del sys._lgbm_tpu_path_candidates\n");
   }
   PyObject* mod = PyModule_New("_lgbm_tpu_c_helpers");
   PyObject* mdict = PyModule_GetDict(mod);
@@ -280,30 +301,51 @@ TrainDataset* AsDataset(DatasetHandle h) {
   return static_cast<TrainDataset*>(h);
 }
 
+// Returns the current native model with tb->model_mu held SHARED (see
+// TrainHooks::booster_native); nullptr on error (nothing held).
 void* TrainBoosterNative(void* h) {
   TrainBooster* tb = AsTrain(h);
-  // serialize the dirty-check/free/swap: two concurrent first-predicts
-  // must not both parse-and-free (use-after-free / double-free); after
-  // the winner syncs, the loser sees !dirty and reuses the cache
-  std::lock_guard<std::mutex> lock(tb->sync_mu);
-  if (!tb->dirty && tb->native != nullptr) return tb->native;
-  PyScope py;
-  if (!py.ok) return nullptr;
-  PyObject* s = CallHelper("booster_model_string",
-                           Py_BuildValue("(Oi)", tb->bst, -1));
-  if (s == nullptr) return nullptr;
-  const char* text = PyUnicode_AsUTF8(s);
-  void* fresh = nullptr;
-  int num_iter = 0;
-  int rc = text == nullptr
-               ? -1
-               : LGBM_BoosterLoadModelFromString(text, &num_iter, &fresh);
-  Py_DECREF(s);
-  if (rc != 0) return nullptr;
-  if (tb->native != nullptr) LGBM_BoosterFree(tb->native);
-  tb->native = fresh;
-  tb->dirty = false;
-  return tb->native;
+  {
+    // serialize the parse-and-swap: two concurrent first-predicts must
+    // not both parse-and-free (use-after-free / double-free); after the
+    // winner syncs, the loser sees !dirty and reuses the cache
+    std::lock_guard<std::mutex> sync(tb->sync_mu);
+    if (tb->dirty.load() || tb->native == nullptr) {
+      PyScope py;
+      if (!py.ok) return nullptr;
+      PyObject* s = CallHelper("booster_model_string",
+                               Py_BuildValue("(Oi)", tb->bst, -1));
+      if (s == nullptr) return nullptr;
+      const char* text = PyUnicode_AsUTF8(s);
+      void* fresh = nullptr;
+      int num_iter = 0;
+      int rc = text == nullptr
+                   ? -1
+                   : LGBM_BoosterLoadModelFromString(text, &num_iter, &fresh);
+      Py_DECREF(s);
+      if (rc != 0) return nullptr;
+      {
+        // the free waits for every in-flight reader of the OLD model
+        std::unique_lock<std::shared_mutex> w(tb->model_mu);
+        if (tb->native != nullptr) LGBM_BoosterFree(tb->native);
+        tb->native = fresh;
+      }
+      tb->dirty.store(false);
+    }
+  }
+  // reader lock for the caller's whole predict/save; a resync triggered
+  // by a concurrent update blocks at the unique_lock above until released
+  tb->model_mu.lock_shared();
+  void* native = tb->native;
+  if (native == nullptr) {  // raced a failed resync
+    tb->model_mu.unlock_shared();
+    SetLastError("native model cache is empty");
+  }
+  return native;
+}
+
+void TrainBoosterNativeRelease(void* h) {
+  AsTrain(h)->model_mu.unlock_shared();
 }
 
 int TrainBoosterFree(void* h) {
@@ -330,7 +372,8 @@ int TrainBoosterCurrentIteration(void* h, int* out) {
 
 // registered into the base library when this library loads
 const lgbm_tpu_internal::TrainHooks g_hooks = {
-    &TrainBoosterNative, &TrainBoosterFree, &TrainBoosterCurrentIteration};
+    &TrainBoosterNative, &TrainBoosterNativeRelease, &TrainBoosterFree,
+    &TrainBoosterCurrentIteration};
 
 __attribute__((constructor)) void RegisterHooks() {
   lgbm_tpu_internal::RegisterTrainHooks(&g_hooks);

@@ -55,6 +55,13 @@ echo "     pallas path; the A/B only measures the lever once it is flipped."
 echo "     Compare sec_per_iter and split_rounds_per_tree against step 3.)"
 BENCH_FRONTIER_BATCH=8 BENCH_ROWS=2000000 BENCH_TEST_ROWS=200000 BENCH_ITERS=10 \
   timeout 550 python bench.py 2>&1 | grep '"metric"' || echo "frontier A/B failed"
+echo "=== 4d. quantized-gradient A/B (gradient_quantization, ISSUE 2) ==="
+echo "    (the quantized LAX engine runs regardless of staged flags; the"
+echo "     int8 MXU kernel additionally stages behind HIST_QUANT_VALIDATED —"
+echo "     inspect the smoke's QUANT section, then flip_validated.py quant"
+echo "     and re-run.  Compare sec_per_iter_quant / auc_delta_vs_f32.)"
+BENCH_HIST_QUANT=int8 BENCH_ROWS=2000000 BENCH_TEST_ROWS=200000 BENCH_ITERS=10 \
+  timeout 900 python bench.py 2>&1 | grep '"metric"' || echo "quant A/B failed"
 echo "=== 5. in-loop chunk-size A/B (VERDICT r4 #7 lever) ==="
 LIGHTGBM_TPU_CHUNK=512 BENCH_ROWS=2000000 BENCH_TEST_ROWS=200000 BENCH_ITERS=10 \
   timeout 550 python bench.py 2>&1 | grep '"metric"' || echo "chunk=512 A/B failed"

@@ -22,7 +22,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 verdicts = {"merged": False, "colblock": False, "ring4": False,
-            "blocks": False, "frontier": False}
+            "blocks": False, "frontier": False, "quant": False}
 notes = {}
 
 
@@ -148,6 +148,45 @@ def main():
         verdicts["frontier"] = ms_bat <= ms_seq * 1.05
     except Exception as e:
         notes["frontier"] = "%s: %s" % (type(e).__name__, str(e)[:300])
+
+    # ---- quantized histogram: the int8 x one-hot -> int32 MXU kernel
+    # (gradient_quantization, HIST_QUANT_VALIDATED).  Exactness leg is
+    # BIT equality against the portable integer engine (integer
+    # accumulation is order-free, so zero tolerance); the race is against
+    # the validated f32 kernel over the same rows — the lever is dropping
+    # the 7 bf16 part-rows to 3 int8 value rows plus the s8 contraction.
+    # The one unproven Mosaic pattern is the s8xs8->s32 dot_general. ----
+    try:
+        payq = np.array(pay)
+        payq[:N, g] = rng.integers(-127, 128, N)
+        payq[:N, h] = rng.integers(0, 128, N)
+        payq = jnp.asarray(payq)
+        for (s_, c_) in ((0, 8000), (7, 4097), (2048, 1), (0, 0)):
+            hq = pseg.segment_histogram_quant(payq, jnp.int32(s_),
+                                              jnp.int32(c_), num_bins=B,
+                                              **kw)
+            hr = seg.segment_histogram(payq, jnp.int32(s_), jnp.int32(c_),
+                                       num_bins=B, quantized=True, **kw)
+            assert int(jnp.abs(hq - hr).max()) == 0, (s_, c_)
+
+        def quant_fn():
+            np.asarray(pseg.segment_histogram_quant(
+                payq, jnp.int32(0), jnp.int32(N), num_bins=B,
+                **kw))[0, 0, 2]
+
+        def f32_fn():
+            np.asarray(pseg.segment_histogram(
+                payq, jnp.int32(0), jnp.int32(N), num_bins=B,
+                **kw))[0, 0, 2]
+
+        quant_fn(); f32_fn()
+        ms_q = median_ms(quant_fn)
+        ms_f = median_ms(f32_fn)
+        notes["quant_ms"] = {"quant_int8": round(ms_q, 2),
+                             "f32_kernel": round(ms_f, 2)}
+        verdicts["quant"] = ms_q <= ms_f * 1.05
+    except Exception as e:
+        notes["quant"] = "%s: %s" % (type(e).__name__, str(e)[:300])
 
     # ---- colblock ultra-wide hist: exact vs portable, race vs portable
     # (its activation shapes otherwise run the portable lax path) ----

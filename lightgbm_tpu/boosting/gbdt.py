@@ -134,10 +134,11 @@ _PTREE_REPLICATED = (
 def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
                     ds: BinnedDataset, cols: PayloadCols, payload_width: int,
                     bundle_map=None, forced=None, mesh=None, mesh_axis=None,
-                    mode="data", top_k=20):
+                    mode="data", top_k=20, quantized=False, qmax=0):
     from ..ops import pallas_segment as _pseg
     key = (cfg, max_num_bin, ds.bins.shape, cols, payload_width,
            _bundle_key(ds), forced, mesh, mesh_axis, mode, top_k,
+           quantized, qmax,
            # every staged flag that flips grower structure or kernel
            # choice when toggled: an in-process flip (bench probe,
            # exp/flip_validated.py rerun) must always rebuild the grower,
@@ -147,6 +148,7 @@ def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
            _pseg.PARTITION_BLOCKS_VALIDATED,
            _pseg.PARTITION_RING4_VALIDATED,
            _pseg.FRONTIER_BATCH_VALIDATED,
+           _pseg.HIST_QUANT_VALIDATED,
            tuple((m.num_bin, m.missing_type, m.default_bin, m.is_trivial, m.bin_type)
                  for m in ds.bin_mappers),
            ds.monotone_constraints.tobytes(), ds.feature_penalty.tobytes())
@@ -156,7 +158,8 @@ def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
             grower = make_partitioned_grower(
                 meta_dev, cfg, max_num_bin, cols, ds.num_features,
                 bundle_map=bundle_map, num_columns=ds.bins.shape[0],
-                forced=forced, payload_width=payload_width)
+                forced=forced, payload_width=payload_width,
+                quantized=quantized, qmax=qmax)
         else:
             # the mesh fast path: the SAME partitioned engine per shard
             # (local row blocks partition locally), collectives at the
@@ -171,14 +174,21 @@ def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
                 num_columns=ds.bins.shape[0], forced=forced,
                 axis_name=ax, mode=mode,
                 num_machines=int(mesh.shape[ax]), top_k=top_k,
-                payload_width=payload_width)
+                payload_width=payload_width,
+                quantized=quantized, qmax=qmax)
             tree_specs = dict.fromkeys(_PTREE_REPLICATED, P())
             # per-device row segments come back stacked [ndev * L]
             tree_specs["seg_start"] = P(ax)
             tree_specs["seg_cnt"] = P(ax)
+            # quantized growers take the replicated [2] scale pair as a
+            # fourth argument (scales are global maxima, so every shard
+            # holds the same values)
+            in_specs = (P(ax, None), P(ax, None), P(None))
+            if quantized:
+                in_specs = in_specs + (P(),)
             grower = jax.jit(jax.shard_map(
                 grow, mesh=mesh,
-                in_specs=(P(ax, None), P(ax, None), P(None)),
+                in_specs=in_specs,
                 out_specs=(tree_specs, P(ax, None), P(ax, None)),
                 check_vma=False), donate_argnums=(0, 1))
         _PGROWER_CACHE[key] = grower
@@ -354,6 +364,10 @@ class _FastState:
 
         self._build = build
         self.reset(gbdt)
+        # quantized-gradient mode (ops.quantize): integer grad/hess
+        # columns, int32 histograms, dequantize at the split boundary
+        self.quant_on = bool(getattr(gbdt, "_quant_enabled", False))
+        self.qmax = int(getattr(gbdt, "_qmax", 0))
         self.grower = _cached_pgrower(gbdt.meta_dev, gbdt.grower_cfg,
                                       ds.max_num_bin, ds, self.cols, self.P,
                                       bundle_map=gbdt.bundle_map
@@ -362,7 +376,9 @@ class _FastState:
                                       mesh=mesh, mesh_axis=gbdt.mesh_axis,
                                       mode=gbdt.parallel_mode or "data",
                                       top_k=int(getattr(gbdt.config, "top_k",
-                                                        20) or 20))
+                                                        20) or 20),
+                                      quantized=self.quant_on,
+                                      qmax=self.qmax)
 
         obj = gbdt.objective
         snap0, cnt_col = self.snap0, self.cnt_col
@@ -393,19 +409,18 @@ class _FastState:
         label_orig, weight_orig = gbdt.label_dev, gbdt.weight_dev
 
         if rowwise:
-            def _fill_body(payload, k):
-                """Write class k's gradients into the grad/hess columns —
-                shared by the piecewise (profiled) and fused paths."""
+            def _class_grads(payload, k):
+                """Class k's masked (gradient, hessian) vectors in the
+                payload's current row order — shared by the f32 fill and
+                the quantized fill."""
                 snap = payload[:, snap0:snap0 + K].T
                 g, h = obj.get_gradients_multi(snap, payload[:, G],
                                                payload[:, G + 1])
                 valid = payload[:, cnt_col]
-                payload = seg.payload_col_write(
-                    payload, grad_col, jnp.take(g, k, axis=0) * valid)
-                return seg.payload_col_write(
-                    payload, hess_col, jnp.take(h, k, axis=0) * valid)
+                return (jnp.take(g, k, axis=0) * valid,
+                        jnp.take(h, k, axis=0) * valid)
         else:
-            def _fill_body(payload, k):
+            def _class_grads(payload, k):
                 """Non-rowwise objectives (lambdarank/xendcg: gradients
                 couple rows within a query): scatter the snapshot scores
                 back to ORIGINAL row order through the index column,
@@ -422,15 +437,41 @@ class _FastState:
                 gp = jnp.pad(g, ((0, 0), (0, 1)))
                 hp = jnp.pad(h, ((0, 0), (0, 1)))
                 valid = payload[:, cnt_col]
-                payload = seg.payload_col_write(
-                    payload, grad_col, jnp.take(gp, k, axis=0)[idx] * valid)
-                return seg.payload_col_write(
-                    payload, hess_col, jnp.take(hp, k, axis=0)[idx] * valid)
+                return (jnp.take(gp, k, axis=0)[idx] * valid,
+                        jnp.take(hp, k, axis=0)[idx] * valid)
+
+        def _fill_body(payload, k):
+            """Write class k's gradients into the grad/hess columns —
+            shared by the piecewise (profiled) and fused paths."""
+            gk, hk = _class_grads(payload, k)
+            payload = seg.payload_col_write(payload, grad_col, gk)
+            return seg.payload_col_write(payload, hess_col, hk)
 
         @functools.partial(jax.jit, donate_argnums=(0,),
                            static_argnames=("k",))
         def fill_class(payload, k):
             return _fill_body(payload, k)
+
+        if self.quant_on:
+            from ..ops.quantize import quantize_pair
+            qmax_f = float(self.qmax)
+
+            def _fill_body_quant(payload, k, qseed):
+                """Quantized fill: class k's masked gradients are scaled
+                to the integer grid and stochastically rounded; the
+                integer-valued columns feed the int32 histogram engine
+                and the [2] scale pair rides to the grower's dequantize
+                boundary."""
+                gk, hk = _class_grads(payload, k)
+                qg, qh, qscale = quantize_pair(gk, hk, qseed, qmax_f)
+                payload = seg.payload_col_write(payload, grad_col, qg)
+                payload = seg.payload_col_write(payload, hess_col, qh)
+                return payload, qscale
+
+            @functools.partial(jax.jit, donate_argnums=(0,),
+                               static_argnames=("k",))
+            def fill_class_quant(payload, k, qseed):
+                return _fill_body_quant(payload, k, qseed)
 
         @functools.partial(jax.jit, donate_argnums=(0,),
                            static_argnames=("k",))
@@ -443,10 +484,11 @@ class _FastState:
         bvalid_col = self.bvalid_col
         sample_hook = getattr(gbdt, "_fast_sample_hook", None)
 
-        def _grow_and_score(payload, aux, fmask, lr, k):
-            out, payload, aux = grower.__wrapped__(payload, aux, fmask) \
-                if hasattr(grower, "__wrapped__") else grower(payload, aux,
-                                                             fmask)
+        def _grow_and_score(payload, aux, fmask, lr, k, qscale=None):
+            args = (payload, aux, fmask) if qscale is None \
+                else (payload, aux, fmask, qscale)
+            out, payload, aux = grower.__wrapped__(*args) \
+                if hasattr(grower, "__wrapped__") else grower(*args)
             # stumps must not move the scores (gbdt.cpp stops instead)
             upd = jnp.where(out["num_leaves"] > 1,
                             payload[:, value_col] * lr, 0.0)
@@ -462,6 +504,15 @@ class _FastState:
             every class)."""
             payload = _fill_body(payload, k)
             return _grow_and_score(payload, aux, fmask, lr, k)
+
+        if self.quant_on:
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def step_quant(payload, aux, fmask, lr, k, qseed):
+                """Quantized fused tree: the scale pair never leaves the
+                program — quantize, int32-histogram growth and the score
+                add are one dispatch, like the f32 step."""
+                payload, qscale = _fill_body_quant(payload, k, qseed)
+                return _grow_and_score(payload, aux, fmask, lr, k, qscale)
 
         def _all_grads(payload):
             snap = payload[:, snap0:snap0 + K].T
@@ -603,6 +654,8 @@ class _FastState:
         self._fill_class = fill_class
         self._apply_score = apply_score
         self._step = step
+        self._fill_class_quant = fill_class_quant if self.quant_on else None
+        self._step_quant = step_quant if self.quant_on else None
         self._step_sampled = step_sampled if sample_hook is not None else None
         self._apply_sample_masks = apply_sample_masks \
             if sample_hook is not None else None
@@ -791,6 +844,40 @@ class GBDT:
             if self.forced_schedule is not None:
                 Log.info("Loaded forced splits from %s (%d nodes)",
                          fs_path, len(self.forced_schedule.feat))
+
+        # quantized-gradient training (gradient_quantization, ops.quantize):
+        # per-iteration int gradient/hessian columns + int32 histograms on
+        # the partition-ordered fast path.  Plain gbdt boosting only (GOSS
+        # amplifies gradients inside its fused step, DART/RF replay trees
+        # through their own steps) and unforced (the forced override reads
+        # raw f32 hist views); anything else trains f32 with a warning.
+        self._quant_enabled = False
+        self._qmax = 0
+        self.quant_report = None
+        if bool(getattr(config, "gradient_quantization", False)):
+            if type(self) is not GBDT or self.forced_schedule is not None:
+                Log.warning(
+                    "gradient_quantization supports plain gbdt boosting "
+                    "without forced splits; training with f32 gradients")
+            else:
+                from ..ops.quantize import (F32_GH_BYTES, QUANT_GH_BYTES,
+                                            derive_qmax)
+                qdtype = str(getattr(config, "gradient_quant_dtype",
+                                     "int16") or "int16")
+                # trace-time int32 overflow guard: rows-per-leaf x max|q|
+                # must stay below 2^31 (raises when it cannot)
+                self._qmax = derive_qmax(train_set.num_data_padded, qdtype)
+                self._quant_enabled = True
+                gh_bytes = QUANT_GH_BYTES[qdtype]
+                self.quant_report = {
+                    "dtype": qdtype, "qmax": self._qmax,
+                    "hist_gh_bytes_per_row": gh_bytes,
+                    "hist_bytes_reduction_vs_f32": F32_GH_BYTES / gh_bytes,
+                }
+                Log.info(
+                    "gradient quantization on: %s grid (qmax=%d, %.1fx "
+                    "fewer grad/hess bytes per histogram dispatch)",
+                    qdtype, self._qmax, F32_GH_BYTES / gh_bytes)
 
         # EFB bundle decode map (identity when the dataset is unbundled).
         # Bundled + data/voting parallel trains on the MESH FAST PATH
@@ -1127,10 +1214,15 @@ class GBDT:
                 # then renew on host and replay the renewed outputs through
                 # the payload's bin-traversal score add.
                 with self.timer.phase("boosting (gradients)"):
-                    fs.payload = fs._fill_class(fs.payload, k=k)
+                    if fs.quant_on:
+                        fs.payload, qsc = fs._fill_class_quant(
+                            fs.payload, k=k, qseed=self._quant_seed(k))
+                    else:
+                        fs.payload = fs._fill_class(fs.payload, k=k)
                 with self.timer.phase("tree (hist+split+partition)"):
-                    out, fs.payload, fs.aux = fs.grower(fs.payload, fs.aux,
-                                                        fmask)
+                    gargs = (fs.payload, fs.aux, fmask) if not fs.quant_on \
+                        else (fs.payload, fs.aux, fmask, qsc)
+                    out, fs.payload, fs.aux = fs.grower(*gargs)
                     self.timer.sync(fs.payload)
                 with self.timer.phase("leaf renewal (host)"):
                     renewed = self._renew_leaf_values_fast(fs, out, k)
@@ -1174,16 +1266,26 @@ class GBDT:
             elif not self.timer.enabled:
                 # one dispatch for the whole tree (gradients + growth +
                 # score add); profiling uses the piecewise path below
-                out, fs.payload, fs.aux = fs._step(
-                    fs.payload, fs.aux, fmask, jnp.float32(lr),
-                    jnp.int32(k))
+                if fs.quant_on:
+                    out, fs.payload, fs.aux = fs._step_quant(
+                        fs.payload, fs.aux, fmask, jnp.float32(lr),
+                        jnp.int32(k), self._quant_seed(k))
+                else:
+                    out, fs.payload, fs.aux = fs._step(
+                        fs.payload, fs.aux, fmask, jnp.float32(lr),
+                        jnp.int32(k))
             else:
                 with self.timer.phase("boosting (gradients)"):
-                    fs.payload = fs._fill_class(fs.payload, k=k)
+                    if fs.quant_on:
+                        fs.payload, qsc = fs._fill_class_quant(
+                            fs.payload, k=k, qseed=self._quant_seed(k))
+                    else:
+                        fs.payload = fs._fill_class(fs.payload, k=k)
                     self.timer.sync(fs.payload)
                 with self.timer.phase("tree (hist+split+partition)"):
-                    out, fs.payload, fs.aux = fs.grower(fs.payload, fs.aux,
-                                                        fmask)
+                    gargs = (fs.payload, fs.aux, fmask) if not fs.quant_on \
+                        else (fs.payload, fs.aux, fmask, qsc)
+                    out, fs.payload, fs.aux = fs.grower(*gargs)
                     self.timer.sync(fs.payload)
             with self.timer.phase("tree assemble (host)"):
                 tree, tree_dev, leaf_out = self._finish_tree(out, init_score)
@@ -1210,11 +1312,24 @@ class GBDT:
             Log.warning("Stopped training because there are no more leaves that meet the split requirements")
         return not should_continue
 
+    def _quant_seed(self, k: int) -> jax.Array:
+        """Deterministic stochastic-rounding seed per (iteration, class):
+        reruns of the same config quantize identically, and no two trees
+        share a rounding draw."""
+        base = int(getattr(self.config, "seed", 0) or 0)
+        return jnp.int32((base + self.iter * self.num_tree_per_iteration
+                          + k) & 0x7FFFFFFF)
+
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         if grad is None and hess is None and self._fast_eligible():
             return self._train_one_iter_fast()
         self._fast_sync_back()
+        if self._quant_enabled and not getattr(self, "_warned_quant_legacy",
+                                               False):
+            Log.warning("gradient_quantization rides the fast path only; "
+                        "this iteration trains with f32 gradients")
+            self._warned_quant_legacy = True
         if self.forced_schedule is not None and self.parallel_mode is not None \
                 and not getattr(self, "_warned_forced_legacy", False):
             Log.warning("forcedsplits_filename is honored by the serial "

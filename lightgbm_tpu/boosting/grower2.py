@@ -31,9 +31,9 @@ from jax import lax
 
 from ..ops.bundle import BundleMap, expand_histogram, identity_bundle_map
 from ..ops.split import (FeatureMeta, K_MIN_SCORE, SplitResult,
-                         find_best_split, find_best_split_batched,
-                         leaf_output, pad_feature_meta,
-                         per_feature_best_gains)
+                         dequantize_hist, find_best_split,
+                         find_best_split_batched, leaf_output,
+                         pad_feature_meta, per_feature_best_gains)
 from ..ops import segment as seg
 from ..ops.segment import SplitPredicate
 from .forced import PRIORITY_UNIT, ForcedSchedule
@@ -58,8 +58,9 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                             axis_name: str = None, mode: str = "data",
                             num_machines: int = 1, top_k: int = 20,
                             merged_hist: bool = None,
-                            payload_width: int = None):
-    """Returns grow(payload, aux, feature_mask) ->
+                            payload_width: int = None,
+                            quantized: bool = False, qmax: int = 0):
+    """Returns grow(payload, aux, feature_mask[, qscale]) ->
     (tree arrays dict, payload, aux).
 
     payload/aux: [N_pad + GUARD, P] f32 with a GUARD-row tail whose
@@ -99,6 +100,16 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
       FeatureParallelTreeLearner (feature_parallel_tree_learner.cpp:21-69:
       full data per rank, feature-sliced search, no row movement).
       Unbundled/unforced only; the caller builds the permuted payload.
+
+    quantized (gradient_quantization mode, ops.quantize): the payload's
+    grad/hess columns hold integer-valued quantized gradients with grid
+    half-range `qmax`; histograms accumulate int32 (exact — subtraction
+    siblings and cross-shard psum/psum_scatter are bit-exact and every
+    engine agrees to the bit), and `grow` takes a fourth argument, the
+    [2] f32 (gradient, hessian) scale vector, dequantizing with
+    `ops.split.dequantize_hist` exactly at the split-search boundary so
+    the gain arithmetic is the f32 code unchanged.  Serial + mesh modes;
+    forced splits and the merged partition+hist kernel are f32-only.
     """
     L = cfg.num_leaves
     B = num_bins_max
@@ -151,13 +162,32 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
     Ghist = Gloc if feature_mode else G
     hist_kwargs = dict(num_features=Ghist, num_bins=B, grad_col=cols.grad,
                        hess_col=cols.hess, cnt_col=cols.cnt)
+    if quantized:
+        # f32-only machinery stays off the quantized path: forced splits
+        # read raw f32 hist views in their override, and the merged
+        # partition+hist kernel accumulates f32 (gbdt gates eligibility
+        # before building a quantized grower, so these are invariants)
+        assert forced is None, "quantized grower is unforced-only"
+        assert qmax >= 2, "quantized grower needs the derive_qmax grid"
     # the real payload width reaches the VMEM gate: the kernel DMAs full
     # rows even when it histograms only the owned leading columns
     # (feature-parallel), so the num_features-based estimate under-budgeted
     # exactly where Ghist << payload_width
     impl = seg.resolve_impl(cfg.hist_impl, Ghist, B, payload_width)
     hist_engine = impl
-    if impl == "pallas":
+    if quantized:
+        from ..ops import pallas_segment as pseg
+        if (impl == "pallas" and pseg.HIST_QUANT_VALIDATED and qmax <= 127):
+            # staged int8 x one-hot -> int32 MXU kernel; bit-exact with
+            # the portable int engine (integer accumulation never rounds)
+            hist_fn = functools.partial(pseg.segment_histogram_quant,
+                                        **hist_kwargs)
+            hist_engine = "pallas-quant"
+        else:
+            hist_fn = functools.partial(seg.segment_histogram,
+                                        quantized=True, **hist_kwargs)
+            hist_engine = "lax"
+    elif impl == "pallas":
         from ..ops import pallas_segment as pseg
         hist_fn = functools.partial(pseg.segment_histogram, **hist_kwargs)
     else:
@@ -222,11 +252,12 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         # would land on part_hist_fn's portable fallback, which walks BOTH
         # children (strictly worse than smaller-child + subtraction)
         merged_hist = (not meshed and pallas_part and impl == "pallas"
+                       and not quantized
                        and _pseg.PARTITION_HIST_VALIDATED
                        and payload_width is not None
                        and _pseg.partition_hist_fits_vmem(payload_width,
                                                           G, B))
-    merged_hist = bool(merged_hist) and not meshed
+    merged_hist = bool(merged_hist) and not meshed and not quantized
 
     if merged_hist:
         from ..ops import pallas_segment as _pseg
@@ -293,11 +324,21 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         # section, then exp/flip_validated.py frontier)
         from ..ops import pallas_segment as _pseg_fb
         frontier_batched = _pseg_fb.FRONTIER_BATCH_VALIDATED
+    elif frontier_batched and quantized:
+        # quantized engines are bit-exact across dispatch shapes (integer
+        # accumulation never rounds), so the portable quantized batched
+        # engine serves every quantized config — including pallas-quant,
+        # which has no batched sibling (yet) — without an exactness gate
+        pass
     elif frontier_batched and hist_engine != "lax":
         frontier_batched = False   # no batched colblock sibling (yet)
     frontier_k = min(fb_req, L - 1) if frontier_batched else 1
     if frontier_batched:
-        if hist_engine == "pallas":
+        if quantized:
+            hist_batched_fn = functools.partial(
+                seg.segment_histogram_batched, quantized=True,
+                **hist_kwargs)
+        elif hist_engine == "pallas":
             from ..ops import pallas_segment as _pseg_fb2
             hist_batched_fn = functools.partial(
                 _pseg_fb2.segment_histogram_batched, **hist_kwargs)
@@ -311,8 +352,19 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             make_forced_machinery(forced, meta, cfg)
 
     def grow(payload: jax.Array, aux: jax.Array,
-             feature_mask: jax.Array):
+             feature_mask: jax.Array, qscale: jax.Array = None):
         n_rows = jnp.int32(payload.shape[0] - seg.GUARD)
+
+        # dequantize-at-the-boundary: int32 histograms become f32 views
+        # exactly where the split search consumes them; identity in f32
+        # mode so the default path's trace is unchanged
+        if quantized:
+            assert qscale is not None, "quantized grow needs the scale pair"
+            deq = functools.partial(dequantize_hist, gscale=qscale[0],
+                                    hscale=qscale[1])
+        else:
+            def deq(h):
+                return h
 
         # mesh-mode machinery is built at trace time (axis_index exists only
         # inside shard_map); find_split closes over the feature mask so the
@@ -361,7 +413,7 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
 
             def find_split(hist_loc, sg, sh, cnt, **constraints):
                 return bcast_from_winner(
-                    find_local(hist_loc, sg, sh, cnt, fmask_loc,
+                    find_local(deq(hist_loc), sg, sh, cnt, fmask_loc,
                                **constraints))
 
         elif voting_mode:
@@ -379,9 +431,10 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                 # phase 1: vote top_k features by LOCAL split gain with
                 # 1/num_machines-scaled constraints; phase 2: reduce ONLY
                 # the vote winners' histograms and find on them (PV-Tree)
-                local_tot = jnp.sum(hist_local[0], axis=0)
+                hist_local_f = deq(hist_local)
+                local_tot = jnp.sum(hist_local_f[0], axis=0)
                 local_gains = per_feature_best_gains(
-                    hist_local, local_tot[0], local_tot[1], local_tot[2],
+                    hist_local_f, local_tot[0], local_tot[1], local_tot[2],
                     feature_mask, meta=meta, **vote_kwargs)
                 top_vals, top_idx = lax.top_k(local_gains, k_vote)
                 valid_vote = (top_vals > K_MIN_SCORE).astype(jnp.int32)
@@ -390,7 +443,9 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                 votes = jnp.zeros(F, jnp.int32).at[all_top.reshape(-1)].add(
                     all_valid.reshape(-1))
                 _, sel = lax.top_k(votes, S)
-                hsel = lax.psum(hist_local[sel], axis_name)
+                # the vote winners' histograms cross the wire as integers
+                # in quantized mode (exact psum, 0 ulp shard-order drift)
+                hsel = deq(lax.psum(hist_local[sel], axis_name))
                 meta_sel = FeatureMeta(*[a[sel] for a in meta])
                 res = find_best_split(hsel, sg, sh, cnt, feature_mask[sel],
                                       meta=meta_sel, **find_kwargs,
@@ -402,12 +457,13 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                 return lax.psum(h, axis_name) if replicated else h
 
             def find_split(h, sg, sh, cnt, **constraints):
-                return find(hist_view(h), sg, sh, cnt, feature_mask,
+                return find(hist_view(deq(h)), sg, sh, cnt, feature_mask,
                             **constraints)
 
         if stacked_find:
             def find_split_batched(hists, sgs, shs, cnts):
                 """Fused search over a [Q, Gh, B, 3] stack of children."""
+                hists = deq(hists)
                 if bundled:
                     hists = jax.vmap(hist_view)(hists)
                 return find_best_split_batched(hists, sgs, shs, cnts,
@@ -431,6 +487,9 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
             totals = lax.psum(jnp.where(my == 0, totals,
                                         jnp.zeros_like(totals)), axis_name)
         hist_root = reduce_hist(hist_root_local)
+        # quantized mode: totals crossed the wire as exact integers; the
+        # f32 leaf aggregates exist only from this boundary on
+        totals = deq(totals)
         root_g, root_h, root_c = totals[0], totals[1], totals[2]
         if cfg.with_monotone:
             res0 = find_split(hist_root, root_g, root_h, root_c,
@@ -492,9 +551,11 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
         if not merged_hist:
             # per-leaf (or pooled) histogram state exists only for the
             # subtraction trick; merged mode gets both child histograms
-            # from the partition kernel itself
+            # from the partition kernel itself.  int32 in quantized mode
+            # (the narrow-dtype plumbing: LRU slots, subtraction and the
+            # frontier-batch dispatch all carry the integer histograms)
             state["hist"] = jnp.zeros((POOL, Gh, B, 3),
-                                      jnp.float32).at[0].set(hist_root)
+                                      hist_root.dtype).at[0].set(hist_root)
         if forced is not None:
             # pending forced rank per leaf, and the REAL (not priority) gain
             # of each leaf's stored best split, for honest split_gain records

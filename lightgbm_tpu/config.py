@@ -102,6 +102,17 @@ class Config:
         # the TPU pallas path additionally stages behind
         # FRONTIER_BATCH_VALIDATED (docs/PERFORMANCE.md)
         self.tpu_frontier_batch = 1
+        # quantized-gradient training (Shi et al., NeurIPS 2022; ISSUE 2):
+        # per-iteration int8/int16 gradient+hessian quantization with
+        # stochastic rounding, int32 histogram accumulation, and
+        # dequantize-at-the-split-boundary (ops/quantize.py).  Default
+        # off: models stay byte-identical to f32 training.  The effective
+        # grid is additionally capped by the int32 overflow bound
+        # (rows-per-leaf x max|q| < 2^31, checked at trace time); on a
+        # TPU pallas config the int8 MXU kernel stages behind
+        # HIST_QUANT_VALIDATED (docs/PERFORMANCE.md expiry table).
+        self.gradient_quantization = False
+        self.gradient_quant_dtype = "int16"  # int16 | int8
         self._user_keys: set = set()
         self.raw_params: Dict[str, Any] = {}
         if params:

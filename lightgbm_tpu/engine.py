@@ -36,16 +36,8 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         params, num_boost_round, early_stopping_rounds)
     if fobj is not None:
         params["objective"] = "none"
-    init = None
-    if init_model is not None:
-        # continued training: accept a filename, Booster or raw model
-        if isinstance(init_model, str):
-            from .models.gbdt_model import GBDTModel
-            init = GBDTModel.load_model(init_model)
-        elif isinstance(init_model, Booster):
-            init = init_model._model
-        else:
-            init = init_model
+    # continued training: accept a filename, Booster or raw model
+    init = _resolve_init_model(init_model)
 
     booster = Booster(params=params, train_set=train_set, init_model=init)
     is_valid_contain_train = False
@@ -190,9 +182,22 @@ def _group_folds(group_sizes: np.ndarray, nfold: int):
                group_sizes[train_q], group_sizes[test_q])
 
 
+def _resolve_init_model(init_model):
+    """Filename / Booster / raw GBDTModel -> GBDTModel (shared by train()
+    and cv(); the reference engine accepts the same three spellings)."""
+    if init_model is None:
+        return None
+    if isinstance(init_model, str):
+        from .models.gbdt_model import GBDTModel
+        return GBDTModel.load_model(init_model)
+    if isinstance(init_model, Booster):
+        return init_model._model
+    return init_model
+
+
 def _make_n_folds(train_set: Dataset, folds, nfold: int, params: Dict,
                   seed: int, fpreproc, stratified: bool, shuffle: bool,
-                  eval_train_metric: bool) -> CVBooster:
+                  eval_train_metric: bool, init_model=None) -> CVBooster:
     """Build the per-fold Boosters (engine.py _make_n_folds:256-301)."""
     train_set.construct()
     n = train_set.num_data()
@@ -246,7 +251,12 @@ def _make_n_folds(train_set: Dataset, folds, nfold: int, params: Dict,
         fold_params = dict(params)
         if fpreproc is not None:
             tr, te, fold_params = fpreproc(tr, te, fold_params)
-        bst = Booster(params=fold_params, train_set=tr)
+        # continued training per fold (reference cv supports init_model the
+        # same way train does: every fold booster replays the loaded trees
+        # onto its own fold's scores); Booster deep-copies the model, so
+        # the folds never share mutable tree state
+        bst = Booster(params=fold_params, train_set=tr,
+                      init_model=init_model)
         if eval_train_metric:
             bst.add_valid(tr, "train")
         bst.add_valid(te, "valid")
@@ -289,13 +299,10 @@ def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         params["metric"] = metrics
     if fobj is not None:
         params["objective"] = "none"
-    if init_model is not None:
-        raise NotImplementedError(
-            "cv(init_model=...) is not supported; continued training is "
-            "available through train()")
 
     cvfolds = _make_n_folds(train_set, folds, nfold, params, seed, fpreproc,
-                            stratified, shuffle, eval_train_metric)
+                            stratified, shuffle, eval_train_metric,
+                            init_model=_resolve_init_model(init_model))
     results = collections.defaultdict(list)
     best_iter, best_metric_val, best_hib = -1, None, True
 

@@ -146,6 +146,17 @@ PARTITION_BLOCKS_VALIDATED = False
 #: exactness is proven there by the byte-identical-model tests).
 FRONTIER_BATCH_VALIDATED = False
 
+#: True once the QUANTIZED histogram kernel (gradient_quantization mode:
+#: int8 value rows x int8 one-hot -> int32 MXU accumulation, up to 4x the
+#: f32 contraction throughput and no bf16 part decomposition) is
+#: hardware-validated.  The kernel's instruction mix differs from the
+#: validated f32 family in exactly one way — the s8xs8->s32 dot_general —
+#: which is the one pattern not yet proven legal under Mosaic on a real
+#: chip.  While OFF, quantized training on a TPU pallas config builds its
+#: int32 histograms through the portable lax engine instead (bit-exact
+#: with this kernel by construction: integer accumulation never rounds).
+HIST_QUANT_VALIDATED = False
+
 #: staged-flag registry: verdict/flip name -> module flag.  Shared by
 #: exp/flip_validated.py (human flips), exp/smoke_staged.py (verdict
 #: names) and bench.py (in-process enablement) so the three can never
@@ -156,6 +167,7 @@ STAGED_FLAGS = {
     "ring4": "PARTITION_RING4_VALIDATED",
     "blocks": "PARTITION_BLOCKS_VALIDATED",
     "frontier": "FRONTIER_BATCH_VALIDATED",
+    "quant": "HIST_QUANT_VALIDATED",
 }
 
 
@@ -684,6 +696,139 @@ def _segment_histogram_batched(payload, starts, counts, *, num_features,
     )(scalars, payload)
     return jax.vmap(
         lambda o: _untile_hist(o, F, B, Ft, n_tiles, W, expand_impl))(out)
+
+
+# ---------------------------------------------------------------------------
+# quantized histogram (gradient_quantization: int8 x one-hot -> int32 MXU)
+# ---------------------------------------------------------------------------
+
+def _hist_quant_kernel(scalars, payload_hbm, out_ref, chunk, sem, *,
+                       F, B, Ft, W, grad_col, hess_col, cnt_col):
+    """Sibling of _hist_kernel for QUANTIZED payloads (ops.quantize): the
+    grad/hess columns hold integer values in [-127, 127], so the whole
+    bf16 hi/mid/lo decomposition retires — the value rows and the one-hot
+    are both int8-representable and ONE s8xs8->s32 dot_general per tile
+    accumulates the exact int32 histogram at up to 4x the f32 MXU
+    throughput.  A sibling copy, not a parametrization of _hist_kernel,
+    per the family discipline (the validated kernel must not be
+    restructured blind); matmul expand only — the repeat relayout's int8
+    interaction is unproven and buys nothing here (the expand matmul it
+    removes is the f32 family's overhead, already halved by dropping the
+    part rows)."""
+    start = scalars[0]
+    count = scalars[1]
+    shift = lax.rem(start, 8)
+    base = start - shift
+    nch = jnp.where(count > 0, (shift + count + CHUNK - 1) // CHUNK, 0)
+    n_tiles = -(-F // Ft)
+    out_ref[:] = jnp.zeros(out_ref.shape, out_ref.dtype)
+    iota_rows = _row_iota()
+
+    def dma_for(k, slot):
+        return pltpu.make_async_copy(
+            payload_hbm.at[pl.ds(pl.multiple_of(base + k * CHUNK, 8),
+                                 CHUNK), :],
+            chunk.at[slot], sem.at[slot])
+
+    @pl.when(nch > 0)
+    def _prefetch_first():
+        dma_for(0, 0).start()
+
+    iota_fr = lax.broadcasted_iota(jnp.int32, (Ft, W), 0)
+    iota_fc = lax.broadcasted_iota(jnp.int32, (Ft, W), 1)
+    d = iota_fc - iota_fr * B
+    in_win = (d >= 0) & (d < B)
+    E = in_win.astype(jnp.float32)                               # [Ft, W]
+    jmod = jnp.sum(jnp.where(in_win, d, 0), axis=0)              # [W] i32
+    jmod_f = jmod.astype(jnp.float32)
+
+    def body(k, _):
+        slot = lax.rem(k, 2)
+
+        @pl.when(k + 1 < nch)
+        def _prefetch_next():
+            dma_for(k + 1, lax.rem(k + 1, 2)).start()
+
+        dma_for(k, slot).wait()
+        data = chunk[slot]
+        ok = ((iota_rows >= shift - k * CHUNK) &
+              (iota_rows < shift + count - k * CHUNK)).astype(jnp.float32)
+        P = data.shape[1]
+        iota_r8 = lax.broadcasted_iota(jnp.int32, (8, P), 0)
+        iota_pc = lax.broadcasted_iota(jnp.int32, (8, P), 1)
+        sel = (((iota_r8 == 0) & (iota_pc == grad_col)) |
+               ((iota_r8 == 1) & (iota_pc == hess_col)) |
+               ((iota_r8 == 2) & (iota_pc == cnt_col))).astype(jnp.float32)
+        raw = lax.dot_general(
+            sel, data, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST)                     # [8, C]
+        vals_i8 = (raw * ok[None, :]).astype(jnp.int8)           # exact: |q|<=127
+        for t in range(n_tiles):
+            f0 = t * Ft
+            fw = min(Ft, F - f0)
+            binsf = data[:, f0:f0 + fw]                          # [C, fw] f32
+            expand = lax.dot_general(
+                binsf, E[:fw, :],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)              # [C, W]
+            onehot = (expand == jmod_f[None, :]).astype(jnp.int8)
+            out_ref[8 * t:8 * t + 8, :] += lax.dot_general(
+                vals_i8, onehot,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)                # [8, W] i32
+        return 0
+
+    lax.fori_loop(0, nch, body, 0)
+
+
+def segment_histogram_quant(payload, start, count, *, num_features,
+                            num_bins, grad_col, hess_col, cnt_col,
+                            interpret=False):
+    """int32 hist[F, B, 3] over payload rows [start, start+count) whose
+    grad/hess columns carry int8-range quantized values — TPU kernel
+    contract of `segment.segment_histogram(..., quantized=True)` (staged
+    behind HIST_QUANT_VALIDATED; callers must ensure qmax <= 127, the
+    int8 value-row range — grower2 falls back to the portable int engine
+    for wider grids)."""
+    return _segment_histogram_quant(
+        payload, start, count, num_features=num_features, num_bins=num_bins,
+        grad_col=grad_col, hess_col=hess_col, cnt_col=cnt_col,
+        interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("num_features", "num_bins",
+                                             "grad_col", "hess_col",
+                                             "cnt_col", "interpret"))
+def _segment_histogram_quant(payload, start, count, *, num_features,
+                             num_bins, grad_col, hess_col, cnt_col,
+                             interpret):
+    F, B, P = num_features, num_bins, payload.shape[1]
+    Ft, n_tiles, W = _tiling(F, B)
+    scalars = jnp.stack([start, count]).astype(jnp.int32)
+    kern = functools.partial(_hist_quant_kernel, F=F, B=B, Ft=Ft, W=W,
+                             grad_col=grad_col, hess_col=hess_col,
+                             cnt_col=cnt_col)
+    out = pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((2, CHUNK, P), jnp.float32),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((8 * n_tiles, W), jnp.int32),
+        interpret=interpret,
+    )(scalars, payload)
+    # epilogue: rows (0, 1, 2) of each tile are the (g, h, cnt) int32 sums
+    # — no part recombination, just the feature-major untile
+    r = out.reshape(n_tiles, 8, W)[:, :3, :Ft * B]               # [T, 3, Ft*B]
+    return (r.reshape(n_tiles, 3, Ft, B).transpose(1, 0, 2, 3)
+            .reshape(3, n_tiles * Ft, B)[:, :F].transpose(1, 2, 0))
 
 
 # ---------------------------------------------------------------------------

@@ -269,18 +269,30 @@ def partition_segment(payload: jax.Array, aux: jax.Array, start: jax.Array,
 
 def segment_histogram(payload: jax.Array, start: jax.Array, count: jax.Array,
                       *, num_features: int, num_bins: int,
-                      grad_col: int, hess_col: int, cnt_col: int) -> jax.Array:
+                      grad_col: int, hess_col: int, cnt_col: int,
+                      quantized: bool = False) -> jax.Array:
     """hist[F, B, 3] over payload rows [start, start+count).
 
     Only ceil(count / CHUNK) chunks are touched — the O(rows-touched)
     guarantee of the reference's ordered bins, with the scatter-free joint
     (feature, bin) one-hot contraction in place of per-row accumulation.
+
+    quantized=True (gradient_quantization mode, ops.quantize): the
+    grad/hess columns hold integer-VALUED f32 quantized gradients and the
+    histogram accumulates int32.  On the scatter path the integers add
+    directly; on the contraction path each CHUNK's partial histogram is
+    f32-EXACT by construction (<= CHUNK * qmax < 2^23 per cell under the
+    derive_qmax bound, and the bf16 part decomposition keeps products
+    exact), so casting the per-chunk result to int32 before accumulating
+    is exact at ANY total count — integer addition never rounds, which is
+    what makes subtraction-trick siblings and cross-shard psums bit-exact.
     """
     C = CHUNK
     F, B = num_features, num_bins
     P = payload.shape[1]
     nch = (count + C - 1) // C
     iota_b = jnp.arange(B, dtype=jnp.int32)
+    hist_dtype = jnp.int32 if quantized else jnp.float32
     # CPU test meshes scatter quickly but choke on one-hot contractions;
     # TPU is the inverse (and normally runs the Pallas kernels anyway)
     use_scatter = jax.default_backend() != "tpu"
@@ -298,6 +310,8 @@ def segment_histogram(payload: jax.Array, start: jax.Array, count: jax.Array,
             jidx = (binsf + iota_b[0] +
                     jnp.arange(F, dtype=jnp.int32)[None, :] * B)  # [C, F]
             upd = jnp.broadcast_to(vals[:, None, :], (C, F, 3)).reshape(-1, 3)
+            if quantized:
+                upd = upd.astype(jnp.int32)
             hist = hist.reshape(F * B, 3).at[jidx.reshape(-1)].add(
                 upd).reshape(F, B, 3)
         else:
@@ -306,12 +320,15 @@ def segment_histogram(payload: jax.Array, start: jax.Array, count: jax.Array,
                 payload.dtype)                                 # [C, F, B]
             # bf16-exact part columns keep the MXU contraction one-pass
             # AND exact (the default f32 matmul is one bf16 pass)
-            hist = hist + _recombine_hist(
+            chunk_hist = _recombine_hist(
                 jnp.einsum("cfb,cd->fbd", onehot, _decompose_vals(vals),
                            preferred_element_type=jnp.float32))
+            if quantized:
+                chunk_hist = chunk_hist.astype(jnp.int32)
+            hist = hist + chunk_hist
         return k + 1, hist
 
-    hist0 = jnp.zeros((F, B, 3), jnp.float32)
+    hist0 = jnp.zeros((F, B, 3), hist_dtype)
     _, hist = lax.while_loop(lambda c: c[0] < nch, body,
                              (jnp.int32(0), hist0))
     return hist
@@ -320,7 +337,8 @@ def segment_histogram(payload: jax.Array, start: jax.Array, count: jax.Array,
 def segment_histogram_batched(payload: jax.Array, starts: jax.Array,
                               counts: jax.Array, *, num_features: int,
                               num_bins: int, grad_col: int, hess_col: int,
-                              cnt_col: int) -> jax.Array:
+                              cnt_col: int,
+                              quantized: bool = False) -> jax.Array:
     """hist[K, F, B, 3] over K disjoint segments — portable batched engine.
 
     One traced region serves the whole frontier batch of the
@@ -337,8 +355,9 @@ def segment_histogram_batched(payload: jax.Array, starts: jax.Array,
         h = segment_histogram(payload, starts[k], counts[k],
                               num_features=num_features, num_bins=num_bins,
                               grad_col=grad_col, hess_col=hess_col,
-                              cnt_col=cnt_col)
+                              cnt_col=cnt_col, quantized=quantized)
         return lax.dynamic_update_slice(hist, h[None], (k, 0, 0, 0))
 
-    hist0 = jnp.zeros((K, num_features, num_bins, 3), jnp.float32)
+    hist0 = jnp.zeros((K, num_features, num_bins, 3),
+                      jnp.int32 if quantized else jnp.float32)
     return lax.fori_loop(0, K, body, hist0)

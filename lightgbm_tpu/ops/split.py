@@ -86,6 +86,23 @@ def pad_feature_meta(meta: "FeatureMeta", f_padded: int) -> "FeatureMeta":
         monotone=ext(meta.monotone, 0),
     )
 
+def dequantize_hist(hist: jax.Array, gscale, hscale) -> jax.Array:
+    """f32 view of an integer quantized-gradient histogram.
+
+    THE dequantize-at-the-boundary of the quantized training mode
+    (`ops.quantize`): histograms accumulate int32 (exact, order-free —
+    subtraction-trick siblings and cross-shard psums are bit-exact), and
+    the f32 view is taken only here, immediately before the split search,
+    so every gain formula below runs unchanged.  `hist` is [..., 3] with
+    channels (sum_q_grad, sum_q_hess, count); gscale/hscale are the
+    per-iteration per-class scale factors from `quantize.quantize_pair`
+    (counts are never scaled)."""
+    scale = jnp.stack([jnp.asarray(gscale, jnp.float32),
+                       jnp.asarray(hscale, jnp.float32),
+                       jnp.float32(1.0)])
+    return hist.astype(jnp.float32) * scale
+
+
 def threshold_l1(s, l1):
     return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
 

@@ -27,6 +27,13 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 # LGBTPU_TEST_PLATFORM=tpu (or axon) to run the suite on real hardware.
 jax.config.update("jax_platforms", os.environ.get("LGBTPU_TEST_PLATFORM", "cpu"))
 
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; slow covers multi-process launches
+    # and full bench-scale parity runs
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 suite (-m 'not slow')")
+
+
 REFERENCE_DIR = "/root/reference"
 GOLDEN_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                           ".golden")

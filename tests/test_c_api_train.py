@@ -348,3 +348,57 @@ def test_c_program_end_to_end(tmp_path):
                          env=env, timeout=300)
     assert run.returncode == 0, run.stderr[-2000:]
     assert "C-ABI train+predict ok" in run.stdout
+
+
+def test_concurrent_predict_and_update(problem):
+    """Predict-vs-update thread safety (ADVICE r5 medium): the native
+    model cache is resynced after every update; readers must hold the
+    handle's shared lock so the resync's free cannot pull the Model* out
+    from under an in-flight predict.  Hammers predicts from worker
+    threads while the main thread keeps updating — ctypes releases the
+    GIL around the C calls, so the C-side locking is genuinely
+    exercised; a regression shows up as a crash or corrupt output."""
+    import threading
+
+    lib = _lib()
+    X, y = problem
+    ds = _c_dataset(lib, X, y)
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(ds, PARAMS.encode(),
+                                       ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    n = X.shape[0]
+    stop = threading.Event()
+    errors = []
+
+    def predict_loop():
+        out = (ctypes.c_double * n)()
+        olen = ctypes.c_int64()
+        while not stop.is_set():
+            rc = lib.LGBM_BoosterPredictForMat(
+                bst, X.ctypes.data_as(ctypes.c_void_p), F32,
+                ctypes.c_int32(n), ctypes.c_int32(X.shape[1]), 1, 0, -1,
+                b"", ctypes.byref(olen), out)
+            if rc != 0:
+                errors.append(_err(lib))
+                return
+            p = np.frombuffer(out, count=n)
+            if not np.isfinite(p).all() or not ((p >= 0) & (p <= 1)).all():
+                errors.append("non-probability output under race")
+                return
+
+    threads = [threading.Thread(target=predict_loop) for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(8):
+            _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+    assert not errors, errors
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds))

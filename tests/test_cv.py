@@ -95,3 +95,30 @@ def test_cv_ranking_group_aware():
     key = [k for k in res if k.endswith("-mean")][0]
     assert len(res[key]) == 3
     assert np.isfinite(res[key]).all()
+
+
+def test_cv_init_model_continuation(tmp_path):
+    """cv(init_model=) continues every fold booster from the loaded model
+    (reference engine.py cv supports the same filename / Booster /
+    GBDTModel spellings as train)."""
+    X, y = _binary_data()
+    warm = lgb.train(dict(BASE), lgb.Dataset(X, label=y),
+                     num_boost_round=4)
+    path = str(tmp_path / "warm.txt")
+    warm.save_model(path)
+
+    # filename spelling
+    res = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=3, nfold=3,
+                 init_model=path, return_cvbooster=True)
+    assert len(res["auc-mean"]) == 3
+    for bst in res["cvbooster"].boosters:
+        # 4 loaded iterations + 3 cv iterations, all in the model
+        assert bst.current_iteration() == 7
+        assert bst.num_trees() == 7
+
+    # Booster spelling; continued folds must not be worse than a cold
+    # start at the same number of NEW rounds (the warm trees carry signal)
+    cold = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=3, nfold=3)
+    warm_res = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=3,
+                      nfold=3, init_model=warm)
+    assert warm_res["auc-mean"][0] > cold["auc-mean"][0] - 0.02
