@@ -18,6 +18,7 @@
 
 #include <algorithm>
 #include <cmath>
+#include <cstdio>
 #include <cstring>
 #include <fstream>
 #include <memory>
@@ -483,6 +484,190 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
     }
   }
   *out_len = static_cast<int64_t>(nrow) * width;
+  return 0;
+}
+
+int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                               const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int num_iteration, const char* parameter,
+                               const char* result_filename) {
+  ModelRef ref(handle);
+  Model* m = ref.m;
+  if (m == nullptr) return -1;
+  if (predict_type != C_API_PREDICT_NORMAL &&
+      predict_type != C_API_PREDICT_RAW_SCORE &&
+      predict_type != C_API_PREDICT_LEAF_INDEX)
+    return Fail("unsupported predict_type " + std::to_string(predict_type));
+
+  // label_column=<idx> from the parameter string (default 0, like the
+  // Python CLI's predict task)
+  int label_col = 0;
+  if (parameter != nullptr) {
+    const char* p = strstr(parameter, "label_column=");
+    if (p != nullptr) label_col = atoi(p + strlen("label_column="));
+  }
+
+  // sniff separator + column count from the first non-blank lines (the
+  // Python parser's detect_format: tab beats comma, tsv is the default)
+  std::ifstream f(data_filename);
+  if (!f) return Fail(std::string("cannot open data file: ") + data_filename);
+  std::string line, first_body;
+  bool saw_first = false, skipped_header = !data_has_header;
+  char sep = '\t';
+  while (std::getline(f, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    bool blank = line.find_first_not_of(" \t\r\n") == std::string::npos;
+    if (blank) continue;
+    if (!saw_first) {
+      saw_first = true;
+      if (line.find('\t') != std::string::npos) sep = '\t';
+      else if (line.find(',') != std::string::npos) sep = ',';
+    }
+    if (!skipped_header) {  // this non-blank line IS the header
+      skipped_header = true;
+      continue;
+    }
+    first_body = line;
+    break;
+  }
+  f.close();
+  if (first_body.empty()) return Fail("data file is empty or unparseable");
+  int n_cols = 1 + static_cast<int>(
+      std::count(first_body.begin(), first_body.end(), sep));
+  if (n_cols < 2) return Fail("data file needs at least 2 columns");
+  if (label_col >= n_cols)
+    return Fail("label_column " + std::to_string(label_col) +
+                " out of range for " + std::to_string(n_cols) + " columns");
+
+  long long nrow = LGBMT_CountRows(data_filename, data_has_header, sep);
+  if (nrow < 0)
+    return Fail(std::string("cannot read data file: ") + data_filename);
+  if (nrow == 0) return Fail("data file has no rows");
+  int n_parsed = n_cols - 1;
+  std::vector<double> X(static_cast<size_t>(nrow) * n_parsed);
+  std::vector<double> y(nrow);
+  int rc = LGBMT_ParseDense(data_filename, sep, data_has_header, nrow,
+                            n_cols, label_col, X.data(), y.data());
+  if (rc == -4) return Fail("ragged rows in data file");
+  if (rc == -5) return Fail("non-numeric token in data file");
+  if (rc != 0)
+    return Fail("data parse failed (rc " + std::to_string(rc) + ")");
+
+  int nfeat = m->max_feature_idx + 1;
+  int k = m->num_tree_per_iteration;
+  int iters = m->NumIterations();
+  if (num_iteration > 0 && num_iteration < iters) iters = num_iteration;
+  int used_trees = iters * k;
+  bool leaf = predict_type == C_API_PREDICT_LEAF_INDEX;
+  int64_t width = leaf ? used_trees : k;
+  std::vector<double> out(static_cast<size_t>(nrow) * width);
+  // rows parsed narrower than the model pad with NaN, wider truncate —
+  // the Python loader's _fix_width semantics
+#pragma omp parallel
+  {
+    std::vector<double> row(nfeat);
+#pragma omp for schedule(static)
+    for (long long r = 0; r < nrow; ++r) {
+      const double* xrow = X.data() + r * n_parsed;
+      int copy = std::min(n_parsed, nfeat);
+      for (int c = 0; c < copy; ++c) row[c] = xrow[c];
+      for (int c = copy; c < nfeat; ++c) row[c] = NAN;
+      PredictRow(*m, row.data(), predict_type, iters, used_trees,
+                 out.data() + r * width);
+    }
+  }
+
+  // "%.18g" + tab-join + "\n": the exact format application.py's
+  // predict task writes, so outputs compare byte-for-byte
+  std::FILE* rf = std::fopen(result_filename, "w");
+  if (rf == nullptr)
+    return Fail(std::string("cannot open for write: ") + result_filename);
+  char buf[64];
+  for (long long r = 0; r < nrow; ++r) {
+    for (int64_t j = 0; j < width; ++j) {
+      std::snprintf(buf, sizeof(buf), "%.18g", out[r * width + j]);
+      std::fputs(buf, rf);
+      std::fputc(j + 1 < width ? '\t' : '\n', rf);
+    }
+  }
+  std::fclose(rf);
+  return 0;
+}
+
+// Reusable single-row predict state (reference
+// LGBM_BoosterPredictForMatSingleRowFast): schema checks, iteration
+// resolution and the row buffer are paid once in Init; each Fast call
+// is one traversal.  One caller thread at a time per config (the row
+// buffer is shared state — the reference has the same contract).
+struct FastConfig {
+  BoosterHandle handle;
+  int predict_type;
+  int data_type;
+  int32_t ncol;
+  int num_iteration;
+  std::vector<double> row;
+};
+
+int LGBM_BoosterPredictForMatSingleRowFastInit(
+    BoosterHandle handle, int predict_type, int data_type, int32_t ncol,
+    const char* parameter, int num_iteration, FastConfigHandle* out_fast) {
+  (void)parameter;
+  ModelRef ref(handle);
+  Model* m = ref.m;
+  if (m == nullptr) return -1;
+  if (predict_type != C_API_PREDICT_NORMAL &&
+      predict_type != C_API_PREDICT_RAW_SCORE &&
+      predict_type != C_API_PREDICT_LEAF_INDEX)
+    return Fail("unsupported predict_type " + std::to_string(predict_type));
+  if (data_type != C_API_DTYPE_FLOAT32 && data_type != C_API_DTYPE_FLOAT64)
+    return Fail("data_type must be C_API_DTYPE_FLOAT32/FLOAT64, got " +
+                std::to_string(data_type));
+  int nfeat = m->max_feature_idx + 1;
+  if (ncol < nfeat)
+    return Fail("input has " + std::to_string(ncol) + " columns, model needs " +
+                std::to_string(nfeat));
+  auto* fc = new FastConfig();
+  fc->handle = handle;
+  fc->predict_type = predict_type;
+  fc->data_type = data_type;
+  fc->ncol = ncol;
+  fc->num_iteration = num_iteration;
+  fc->row.resize(ncol);
+  *out_fast = fc;
+  return 0;
+}
+
+int LGBM_BoosterPredictForMatSingleRowFast(FastConfigHandle fast_config,
+                                           const void* data,
+                                           int64_t* out_len,
+                                           double* out_result) {
+  auto* fc = static_cast<FastConfig*>(fast_config);
+  if (fc == nullptr) return Fail("fast_config is null");
+  // resolve per call: for a training booster this takes the shared model
+  // lock, so concurrent UpdateOneIter resyncs stay safe
+  ModelRef ref(fc->handle);
+  Model* m = ref.m;
+  if (m == nullptr) return -1;
+  if (fc->data_type == C_API_DTYPE_FLOAT32) {
+    const float* src = static_cast<const float*>(data);
+    for (int32_t c = 0; c < fc->ncol; ++c) fc->row[c] = src[c];
+  } else {
+    std::memcpy(fc->row.data(), data, sizeof(double) * fc->ncol);
+  }
+  int k = m->num_tree_per_iteration;
+  int iters = m->NumIterations();
+  if (fc->num_iteration > 0 && fc->num_iteration < iters)
+    iters = fc->num_iteration;
+  int used_trees = iters * k;
+  PredictRow(*m, fc->row.data(), fc->predict_type, iters, used_trees,
+             out_result);
+  *out_len = fc->predict_type == C_API_PREDICT_LEAF_INDEX ? used_trees : k;
+  return 0;
+}
+
+int LGBM_FastConfigFree(FastConfigHandle fast_config) {
+  delete static_cast<FastConfig*>(fast_config);
   return 0;
 }
 

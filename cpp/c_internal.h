@@ -57,4 +57,17 @@ inline bool IsTrainBooster(const void* h) {
 
 }  // namespace lgbm_tpu_internal
 
+// --- native text ingest (ingest.cc, same base library) ---
+// The mmap + OpenMP delimited parser behind lightgbm_tpu/io/parser.py's
+// fast path; LGBM_BoosterPredictForFile reuses it so the C file-predict
+// parses byte-identically to the Python CLI.
+extern "C" {
+long long LGBMT_CountRows(const char* path, int has_header, char sep);
+// rc 0 ok, -1 I/O error, -2 row-count mismatch, -4 ragged rows,
+// -5 non-numeric token.  X is [n_rows, n_cols-1] (label column removed).
+int LGBMT_ParseDense(const char* path, char sep, int has_header,
+                     long long n_rows, int n_cols, int label_col,
+                     double* X, double* y);
+}
+
 #endif  /* LIGHTGBM_TPU_C_INTERNAL_H_ */

@@ -76,6 +76,37 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
                               int num_iteration, const char* parameter,
                               int64_t* out_len, double* out_result);
 
+/* File-to-file prediction (reference c_api LGBM_BoosterPredictForFile /
+ * src/application predictor.hpp): parse a delimited numeric data file
+ * (CSV or TSV, auto-detected; label column removed — label_column=<idx>
+ * in `parameter` overrides the default 0), predict every row, and write
+ * one line per row to result_filename ("%.18g" values, tab-separated for
+ * multi-output) — byte-identical to the Python CLI's
+ * `task=predict` output for the same model and data. */
+int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                               const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int num_iteration, const char* parameter,
+                               const char* result_filename);
+
+/* Single-row fast path (reference LGBM_BoosterPredictForMat
+ * SingleRowFast): Init resolves the model, validates the schema and
+ * allocates the row buffer ONCE; each subsequent call is one traversal
+ * with zero setup.  The fast config is bound to one caller thread at a
+ * time (the reference's contract).  num_iteration <= 0 means all. */
+typedef void* FastConfigHandle;
+
+int LGBM_BoosterPredictForMatSingleRowFastInit(
+    BoosterHandle handle, int predict_type, int data_type, int32_t ncol,
+    const char* parameter, int num_iteration, FastConfigHandle* out_fast);
+
+int LGBM_BoosterPredictForMatSingleRowFast(FastConfigHandle fast_config,
+                                           const void* data,
+                                           int64_t* out_len,
+                                           double* out_result);
+
+int LGBM_FastConfigFree(FastConfigHandle fast_config);
+
 /* Sparse (CSR) prediction: indptr[nindptr] row offsets (int32 or int64 by
  * indptr_type using the C_API_DTYPE_* int codes below), indices[nelem]
  * column ids, data[nelem] values.  Absent entries are 0.0 (missing-zero

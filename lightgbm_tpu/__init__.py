@@ -6,7 +6,7 @@ from .basic import Booster, Dataset
 from .callback import (early_stopping, log_evaluation, print_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
-from .engine import CVBooster, cv, train
+from .engine import CVBooster, cv, predict, train
 from .plotting import (create_tree_digraph, plot_importance, plot_metric,
                        plot_tree)
 from .parallel.launch import init_distributed
@@ -15,7 +15,8 @@ from .utils.log import LightGBMError
 
 __version__ = "0.1.0"
 
-__all__ = ["Dataset", "Booster", "Config", "train", "cv", "CVBooster",
+__all__ = ["Dataset", "Booster", "Config", "train", "cv", "predict",
+           "CVBooster",
            "LightGBMError",
            "early_stopping", "log_evaluation", "print_evaluation",
            "record_evaluation", "reset_parameter",

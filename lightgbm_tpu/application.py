@@ -161,6 +161,14 @@ class Application:
         data_path = params.pop("data", None)
         input_model = params.pop("input_model", None)
         output_result = params.pop("output_result", "LightGBM_predict_result.txt")
+        # predict_device=true routes file-scale prediction through the
+        # tree-parallel device engine (f32 thresholds, micro-batched
+        # streaming transfer); the default stays the exact f64 host
+        # traversal whose output files are the byte-parity reference for
+        # the C ABI's LGBM_BoosterPredictForFile
+        use_device = params.pop("predict_device",
+                                params.pop("device", "")).lower() \
+            in ("true", "1")
         if not data_path or not input_model:
             Log.fatal("Prediction needs data=<file> and input_model=<file>")
         booster = Booster(params=params, model_file=input_model)
@@ -171,10 +179,14 @@ class Application:
         pred_contrib = params.get("predict_contrib", "").lower() in ("true", "1")
         num_iter = int(params.get("num_iteration_predict", -1))
         early = params.get("pred_early_stop", "").lower() in ("true", "1")
+        if use_device and (pred_leaf or pred_contrib):
+            Log.warning("predict_device supports normal/raw prediction "
+                        "only; using the host predictor")
+            use_device = False
         out = booster.predict(
             X, raw_score=raw_score, pred_leaf=pred_leaf,
             pred_contrib=pred_contrib, num_iteration=num_iter,
-            pred_early_stop=early,
+            pred_early_stop=early, device=use_device,
             pred_early_stop_freq=int(params.get("pred_early_stop_freq", 10)),
             pred_early_stop_margin=float(
                 params.get("pred_early_stop_margin", 10.0)))

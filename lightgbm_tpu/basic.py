@@ -670,50 +670,48 @@ class Booster:
                 pred_leaf: bool = False, pred_contrib: bool = False,
                 pred_early_stop: bool = False, pred_early_stop_freq: int = 10,
                 pred_early_stop_margin: float = 10.0,
-                device: bool = False, **kwargs) -> np.ndarray:
-        """device=True runs the jitted accelerator predictor (f32
-        thresholds, numeric-split models only) instead of the exact f64
-        host traversal — the throughput path for large matrices."""
+                device: bool = False, start_iteration: int = 0,
+                **kwargs) -> np.ndarray:
+        """device=True runs the jitted tree-parallel inference engine
+        (models/device_predictor.py: f32 thresholds, categorical bitsets
+        on device, shape-bucketed program cache, micro-batched transfer)
+        instead of the exact f64 host traversal — the throughput path
+        for large matrices."""
         X = _to_2d_float(data, getattr(self, "pandas_categorical", None))
         if pred_leaf:
             return self._model.predict_leaf_index(X, num_iteration)
         if pred_contrib:
             return self._model.predict_contrib(X, num_iteration)
-        if device and pred_early_stop:
-            Log.warning("device prediction does not implement prediction "
-                        "early stop; using the host predictor")
-        elif device:
-            from .models.device_predictor import DevicePredictor, \
-                packable_model
-            if packable_model(self._model):
-                end = self._model.num_prediction_iterations(0, num_iteration)
-                key = (end, len(self._model.trees),
-                       getattr(self, "_model_version", 0))
-                if getattr(self, "_dev_pred_key", None) != key:
-                    self._dev_predictor = DevicePredictor(
-                        self._model, 0, num_iteration)
-                    self._dev_pred_key = key
-                raw = self._dev_predictor.predict_raw(X)
-                return self._finish_predict(raw, raw_score, num_iteration)
-            Log.warning("device prediction unavailable for models with "
-                        "categorical splits; using the host predictor")
-        early = None
-        # reference gates early stop on NeedAccuratePrediction: only binary /
-        # multiclass / ranking objectives tolerate truncated sums
-        # (predictor.hpp:46-52, objective NeedAccuratePrediction overrides)
-        obj_kind = str(self._model.objective_str).split()[0] \
-            if self._model.objective_str else ""
-        if pred_early_stop and not self._model.average_output and \
-                obj_kind in ("binary", "multiclass", "multiclassova", "lambdarank"):
-            early = "multiclass" if self._model.num_tree_per_iteration > 1 else "binary"
-        raw = self._model.predict_raw(X, num_iteration=num_iteration,
+        # shared NeedAccuratePrediction gating so host and device paths
+        # truncate sums identically (gbdt_model.early_stop_mode)
+        early = self._model.early_stop_mode(pred_early_stop)
+        if device:
+            from .models.device_predictor import DevicePredictor
+            end = self._model.num_prediction_iterations(start_iteration,
+                                                        num_iteration)
+            key = (start_iteration, end, len(self._model.trees),
+                   getattr(self, "_model_version", 0))
+            if getattr(self, "_dev_pred_key", None) != key:
+                self._dev_predictor = DevicePredictor(
+                    self._model, start_iteration, num_iteration)
+                self._dev_pred_key = key
+            raw = self._dev_predictor.predict_raw(
+                X, early_stop=early,
+                early_stop_freq=pred_early_stop_freq,
+                early_stop_margin=pred_early_stop_margin)
+            return self._finish_predict(raw, raw_score, num_iteration,
+                                        start_iteration)
+        raw = self._model.predict_raw(X, start_iteration=start_iteration,
+                                      num_iteration=num_iteration,
                                       early_stop=early,
                                       early_stop_freq=pred_early_stop_freq,
                                       early_stop_margin=pred_early_stop_margin)
-        return self._finish_predict(raw, raw_score, num_iteration)
+        return self._finish_predict(raw, raw_score, num_iteration,
+                                    start_iteration)
 
     def _finish_predict(self, raw: np.ndarray, raw_score: bool,
-                        num_iteration: int = -1) -> np.ndarray:
+                        num_iteration: int = -1,
+                        start_iteration: int = 0) -> np.ndarray:
         if raw.shape[1] == 1:
             raw = raw[:, 0]
         if raw_score:
@@ -721,7 +719,8 @@ class Booster:
         if self._model.average_output:
             # averaged pre-converted outputs; no ConvertOutput on top
             # (gbdt_prediction.cpp Predict, average_output_ branch)
-            return raw / self._model.num_prediction_iterations(0, num_iteration)
+            return raw / self._model.num_prediction_iterations(
+                start_iteration, num_iteration)
         if self._objective is None:
             return raw
         return self._objective.convert_output(raw)

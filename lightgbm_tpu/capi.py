@@ -105,6 +105,25 @@ class NativeBooster:
             self._handle, -1, out_len.value, ctypes.byref(out_len), buf))
         return buf.value.decode()
 
+    def predict_for_file(self, data_path: str, result_path: str,
+                         data_has_header: bool = False,
+                         raw_score: bool = False, pred_leaf: bool = False,
+                         num_iteration: int = -1,
+                         parameter: str = "") -> None:
+        """File-to-file prediction in pure C (LGBM_BoosterPredictForFile):
+        parse, predict and write without any Python in the loop — output
+        files are byte-identical to `application.py task=predict`."""
+        if pred_leaf:
+            ptype = C_API_PREDICT_LEAF_INDEX
+        else:
+            ptype = C_API_PREDICT_RAW_SCORE if raw_score \
+                else C_API_PREDICT_NORMAL
+        _check(load_lib().LGBM_BoosterPredictForFile(
+            self._handle, data_path.encode(),
+            1 if data_has_header else 0, ptype,
+            ctypes.c_int(num_iteration), parameter.encode(),
+            result_path.encode()))
+
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 pred_leaf: bool = False,
                 num_iteration: int = -1) -> np.ndarray:
@@ -131,3 +150,36 @@ class NativeBooster:
         out = out[:out_len.value]
         per_row = out_len.value // nrow
         return out.reshape(nrow, per_row) if per_row > 1 else out
+
+
+class FastSingleRowPredictor:
+    """Reuse handle over LGBM_BoosterPredictForMatSingleRowFast: schema
+    validation and buffers are paid once at construction, each predict()
+    is a single C call — the low-latency point-lookup serving path."""
+
+    def __init__(self, booster: NativeBooster, ncol: int,
+                 raw_score: bool = False, num_iteration: int = -1):
+        lib = load_lib()
+        self._booster = booster          # keep the model handle alive
+        self._fast = ctypes.c_void_p()
+        ptype = C_API_PREDICT_RAW_SCORE if raw_score else C_API_PREDICT_NORMAL
+        _check(lib.LGBM_BoosterPredictForMatSingleRowFastInit(
+            booster._handle, ptype, C_API_DTYPE_FLOAT64,
+            ctypes.c_int32(ncol), b"", ctypes.c_int(num_iteration),
+            ctypes.byref(self._fast)))
+        self._out = np.zeros(max(booster.num_class, 1), np.float64)
+        self._out_ptr = self._out.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double))
+        self._len = ctypes.c_int64(0)
+
+    def __del__(self):
+        if getattr(self, "_fast", None):
+            load_lib().LGBM_FastConfigFree(self._fast)
+            self._fast = None
+
+    def predict(self, row: np.ndarray) -> np.ndarray:
+        row = np.ascontiguousarray(row, dtype=np.float64)
+        _check(load_lib().LGBM_BoosterPredictForMatSingleRowFast(
+            self._fast, row.ctypes.data_as(ctypes.c_void_p),
+            ctypes.byref(self._len), self._out_ptr))
+        return self._out[: self._len.value].copy()

@@ -8,13 +8,14 @@ from __future__ import annotations
 
 import collections
 import copy
+import os
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from .basic import Booster, Dataset, _slice_rows
 from .callback import CallbackEnv, EarlyStopException, log_evaluation
-from .utils.log import Log
+from .utils.log import LightGBMError, Log
 
 
 def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
@@ -369,3 +370,23 @@ def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
 
 def _subset_matrix(ds: Dataset, idx: np.ndarray):
     return _slice_rows(ds.data, idx)
+
+
+def predict(model, data, device: bool = True, **kwargs) -> np.ndarray:
+    """One-shot serving entry: run prediction through the tree-parallel
+    device inference engine (models/device_predictor.py) without the
+    caller managing a Booster — the engine-level sibling of train()/cv()
+    for prediction traffic.  `model` may be a live Booster, a model file
+    path, or a full model string; device=False selects the exact f64
+    host traversal instead.  Extra kwargs flow to Booster.predict
+    (num_iteration, start_iteration, raw_score, pred_early_stop, ...)."""
+    if isinstance(model, Booster):
+        bst = model
+    elif isinstance(model, str) and "\n" in model:
+        bst = Booster(model_str=model)
+    elif isinstance(model, (str, bytes, os.PathLike)):
+        bst = Booster(model_file=os.fspath(model))
+    else:
+        raise LightGBMError("predict() needs a Booster, a model file "
+                            "path, or a model string")
+    return bst.predict(data, device=device, **kwargs)

@@ -1,20 +1,42 @@
-"""Jitted batch prediction on the accelerator (gbdt_prediction.cpp role).
+"""Tree-parallel jitted inference engine (gbdt_prediction.cpp role).
 
 The host predictor (`models/tree.py`) is the exactness reference (f64
 thresholds, byte-parity with the reference CLI).  This one trades f32
-thresholds for device throughput: all trees are packed into stacked SoA
-arrays once, and one jitted program traverses [N] rows x T trees with a
-fixed depth loop (num_leaves-1 bounds any path in a leaf-wise tree).
+thresholds for device throughput.  Design (see docs/PERFORMANCE.md
+"Inference engine"):
 
-Opt-in via `Booster.predict(..., device=True)`.  Models with categorical
-splits fall back to the host path (bitset membership over ragged
-category words does not vectorize cleanly; numeric models are the ones
-with million-row prediction workloads).
+- **Tree-parallel traversal.**  All T trees advance one level per step
+  over a `[N, T]` node frontier: every gather is batched over the tree
+  axis (flat `[T * nodes]` arrays indexed by `node + tree_offset`), so
+  one loop trip touches N x T cells instead of the old per-tree
+  `lax.scan` whose T x (L-1) serialized steps dominated wall clock.
+- **Depth-bounded loop.**  The loop runs `max leaf depth` trips — for
+  leaf-wise 255-leaf trees typically 20-40, not the worst-case
+  `num_leaves - 1 = 254` the scan engine used.  Rows/trees that reach a
+  leaf early park on the encoded `~leaf` node id.
+- **Categorical splits on device.**  Each node's category bitset is
+  packed into fixed-width uint32 words `[T, nodes, W]`; membership is a
+  flat gather + shift/mask, so categorical models no longer fall back
+  to the host path.
+- **Shape-bucketed program cache.**  Row counts are padded up to
+  power-of-two buckets (padding rows are discarded after the fact), so
+  repeated ragged batch sizes reuse at most log2(N) compiled programs.
+- **Row micro-batching + double buffering.**  File-scale matrices are
+  cut into device-sized micro-batches; the next batch's host->device
+  transfer and the previous batch's fetch overlap the current compute.
+- **Device-side prediction early stop.**  Port of the vectorized host
+  logic (models/gbdt_model.py predict_raw): per-leaf values for all
+  trees are computed in one traversal, then a masked per-iteration
+  accumulation stops adding a row's contributions once its margin
+  clears the threshold at a check point — same truncated sums as the
+  host path.
+
+Opt-in via `Booster.predict(..., device=True)`.
 """
 from __future__ import annotations
 
 import functools
-from typing import Dict
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -24,13 +46,47 @@ from jax import lax
 _K_ZERO_THRESHOLD = 1e-35
 MISSING_NONE, MISSING_ZERO, MISSING_NAN = 0, 1, 2
 
+#: bumped once per (re)trace of the tree-parallel program — the shape
+#: bucket policy is pinned by asserting how this moves across calls
+_TRACE_COUNT = 0
+
+
+def trace_count() -> int:
+    return _TRACE_COUNT
+
 
 def packable_model(model) -> bool:
-    return all(t.num_cat == 0 for t in model.trees)
+    """Every model packs now — categorical bitsets ride fixed-width words
+    (kept for API compatibility with the pre-tree-parallel engine)."""
+    return True
 
 
-def pack_trees(trees, num_leaves_cap: int) -> Dict[str, np.ndarray]:
-    """Stack tree SoA arrays to [T, L-1] / [T, L] (inert padding)."""
+def _tree_depth(t) -> int:
+    """Max leaf depth from child pointers.  Node indices are creation
+    order, so an internal child always has a larger index than its
+    parent (tree.h Split; our tree.py split()) and one in-order pass
+    settles every depth."""
+    ni = t.num_leaves - 1
+    if ni <= 0:
+        return 0
+    depth = np.zeros(ni, np.int64)
+    max_leaf = 1
+    for node in range(ni):
+        d = depth[node] + 1
+        for child in (int(t.left_child[node]), int(t.right_child[node])):
+            if child >= 0:
+                if child <= node:   # malformed pointers: keep the safe bound
+                    return ni
+                depth[child] = d
+            else:
+                max_leaf = max(max_leaf, d)
+    return int(max_leaf)
+
+
+def pack_trees(trees, num_leaves_cap: int):
+    """Stack tree SoA arrays to [T, L-1] / [T, L] (inert padding), plus
+    fixed-width categorical bitset words when the slice has categorical
+    splits.  Returns (arrays: Dict[str, np.ndarray], max_depth)."""
     T = len(trees)
     L = max(num_leaves_cap, 2)
     feat = np.zeros((T, L - 1), np.int32)
@@ -40,6 +96,15 @@ def pack_trees(trees, num_leaves_cap: int) -> Dict[str, np.ndarray]:
     left = np.full((T, L - 1), -1, np.int32)
     right = np.full((T, L - 1), -1, np.int32)
     leaf = np.zeros((T, L), np.float32)
+    is_cat = np.zeros((T, L - 1), bool)
+    depth = 0
+    W = 0
+    for t in trees:
+        if t.num_cat > 0:
+            for node in range(t.num_leaves - 1):
+                if t.decision_type[node] & 1:
+                    W = max(W, len(t.cat_words_for_node(node)))
+    catw = np.zeros((T, L - 1, W), np.uint32) if W else None
     for i, t in enumerate(trees):
         ni = max(t.num_leaves - 1, 0)
         if ni:
@@ -50,13 +115,116 @@ def pack_trees(trees, num_leaves_cap: int) -> Dict[str, np.ndarray]:
             dleft[i, :ni] = (dt & 2) != 0
             left[i, :ni] = t.left_child[:ni]
             right[i, :ni] = t.right_child[:ni]
+            if t.num_cat > 0:
+                is_cat[i, :ni] = (dt & 1) != 0
+                for node in np.nonzero(is_cat[i, :ni])[0]:
+                    words = t.cat_words_for_node(int(node))
+                    catw[i, node, :len(words)] = words
         leaf[i, : t.num_leaves] = t.leaf_value[: t.num_leaves]
-    return {"feat": feat, "thr": thr, "miss": miss, "dleft": dleft,
-            "left": left, "right": right, "leaf": leaf}
+        depth = max(depth, _tree_depth(t))
+    out = {"feat": feat, "thr": thr, "miss": miss, "dleft": dleft,
+           "left": left, "right": right, "leaf": leaf}
+    if W:
+        out["is_cat"] = is_cat
+        out["catw"] = catw
+    return out, depth
+
+
+@functools.partial(jax.jit, static_argnames=("num_class", "depth_iters",
+                                             "early_mode", "early_freq"))
+def _predict_tree_parallel(arrs, X, margin, *, num_class: int,
+                           depth_iters: int, early_mode: Optional[str],
+                           early_freq: int):
+    global _TRACE_COUNT
+    _TRACE_COUNT += 1
+    N = X.shape[0]
+    T, NI = arrs["feat"].shape
+    K = num_class
+
+    # flat [T * NI] views: one gather serves every tree at once
+    feat = arrs["feat"].reshape(-1)
+    thr = arrs["thr"].reshape(-1)
+    miss = arrs["miss"].reshape(-1)
+    dleft = arrs["dleft"].reshape(-1)
+    left = arrs["left"].reshape(-1)
+    right = arrs["right"].reshape(-1)
+    has_cat = "catw" in arrs
+    if has_cat:
+        is_cat = arrs["is_cat"].reshape(-1)
+        W = arrs["catw"].shape[-1]
+        catw = arrs["catw"].reshape(-1)          # [T * NI * W]
+    offs = (jnp.arange(T, dtype=jnp.int32) * NI)[None, :]    # [1, T]
+
+    def body(_, node):
+        nd = jnp.maximum(node, 0) + offs                     # [N, T]
+        f = feat[nd]
+        fv = jnp.take_along_axis(X, f, axis=1)               # [N, T]
+        mt = miss[nd]
+        is_nan = jnp.isnan(fv)
+        fv2 = jnp.where(is_nan & (mt != MISSING_NAN), 0.0, fv)
+        missing = ((mt == MISSING_ZERO) &
+                   (jnp.abs(fv2) <= _K_ZERO_THRESHOLD)) | \
+                  ((mt == MISSING_NAN) & is_nan)
+        go_left = jnp.where(missing, dleft[nd], fv2 <= thr[nd])
+        if has_cat:
+            # tree.h CategoricalDecision: NaN -> right (missing NaN) or
+            # category 0; negative / beyond the node's bitset -> right
+            iv = jnp.where(is_nan,
+                           jnp.where(mt == MISSING_NAN, -1.0, 0.0), fv)
+            in_range = jnp.isfinite(iv) & (iv >= 0) & (iv < W * 32.0)
+            v = jnp.clip(iv, 0.0, W * 32.0 - 1.0).astype(jnp.int32)
+            word = catw[nd * W + (v >> 5)]
+            bit = (word >> (v & 31).astype(jnp.uint32)) & jnp.uint32(1)
+            go_left = jnp.where(is_cat[nd],
+                                in_range & (bit == 1), go_left)
+        child = jnp.where(go_left, left[nd], right[nd])
+        return jnp.where(node >= 0, child, node)
+
+    node0 = jnp.zeros((N, T), jnp.int32)
+    node = lax.fori_loop(0, depth_iters, body, node0) \
+        if depth_iters else node0
+    # children encode leaves as ~leaf, so stump/padded trees (whose
+    # children are all -1 = ~0) land on leaf 0 with no special case
+    leaf_idx = ~jnp.minimum(node, -1)
+    L = arrs["leaf"].shape[1]
+    leaf_offs = (jnp.arange(T, dtype=jnp.int32) * L)[None, :]
+    vals = arrs["leaf"].reshape(-1)[leaf_idx + leaf_offs]    # [N, T]
+
+    # per-class reduction: trees are iteration-major, tree t -> class t%K
+    iters = T // K
+    vals_k = vals.reshape(N, iters, K)
+    if early_mode is None:
+        return vals_k.sum(axis=1)
+
+    # prediction early stop (prediction_early_stop.cpp, vectorized): add
+    # per iteration, check the margin every early_freq iterations, and
+    # stop accumulating the rows that cleared it
+    def step(carry, v):                                      # v: [N, K]
+        out, active, since = carry
+        out = out + v * active[:, None]
+        since = since + 1
+        if early_mode == "binary":
+            m = 2.0 * jnp.abs(out[:, 0])
+        else:
+            top2 = lax.top_k(out, 2)[0]
+            m = top2[:, 0] - top2[:, 1]
+        check = since >= early_freq
+        active = jnp.where(check, active & ~(m > margin), active)
+        since = jnp.where(check, 0, since)
+        return (out, active, since), None
+
+    out0 = jnp.zeros((N, K), jnp.float32)
+    active0 = jnp.ones(N, bool)
+    (score, _, _), _ = lax.scan(step, (out0, active0, jnp.int32(0)),
+                                jnp.moveaxis(vals_k, 1, 0))
+    return score
 
 
 @functools.partial(jax.jit, static_argnames=("num_class", "depth_iters"))
-def _predict_packed(arrs, X, *, num_class: int, depth_iters: int):
+def _predict_packed_scan(arrs, X, *, num_class: int, depth_iters: int):
+    """Pre-tree-parallel engine (sequential lax.scan over trees), kept as
+    the A/B reference for BENCH_PREDICT and the equivalence tests.
+    Numeric splits only."""
     N = X.shape[0]
     K = num_class
 
@@ -82,8 +250,6 @@ def _predict_packed(arrs, X, *, num_class: int, depth_iters: int):
         node0 = jnp.zeros(N, jnp.int32)
         node = lax.fori_loop(0, depth_iters, body, node0) \
             if depth_iters else node0
-        # children encode leaves as ~leaf, so stump/padded trees (whose
-        # children are all -1 = ~0) land on leaf 0 with no special case
         leaf_idx = ~jnp.minimum(node, -1)
         vals = tree["leaf"][leaf_idx]                             # [N]
         k = jnp.mod(t_idx, K)
@@ -96,33 +262,107 @@ def _predict_packed(arrs, X, *, num_class: int, depth_iters: int):
     return score
 
 
+def _bucket_rows(n: int) -> int:
+    """Pad a row count up to its power-of-two bucket so ragged batches
+    share compiled programs (min bucket 16)."""
+    return max(16, 1 << (max(n - 1, 1)).bit_length())
+
+
+def _default_batch_rows(num_trees: int) -> int:
+    """Micro-batch so the [N, T] traversal buffers stay device-sized:
+    ~2^24 cells per buffer, power-of-two rows, capped at 2^20."""
+    rows = max((1 << 24) // max(num_trees, 1), 256)
+    return min(1 << (rows.bit_length() - 1), 1 << 20)
+
+
 class DevicePredictor:
     """Packs a model once; predicts [N, F] matrices on the accelerator."""
 
     def __init__(self, model, start_iteration: int = 0,
-                 num_iteration: int = -1):
-        if not packable_model(model):
-            raise ValueError("model has categorical splits; "
-                             "use the host predictor")
+                 num_iteration: int = -1,
+                 batch_rows: Optional[int] = None):
         k = model.num_tree_per_iteration
         end = model.num_prediction_iterations(start_iteration, num_iteration)
         trees = model.trees[start_iteration * k:
                             (start_iteration + end) * k]
         L = max((t.num_leaves for t in trees), default=2)
-        packed = pack_trees(trees, L)
+        packed, depth = pack_trees(trees, L)
         self._arrs = {kk: jnp.asarray(v) for kk, v in packed.items()}
         self.num_class = k
-        self.depth_iters = max(L - 1, 0)
+        self.depth_iters = depth
+        self.num_trees = len(trees)
         self.num_features = model.max_feature_idx + 1
+        self.batch_rows = batch_rows or _default_batch_rows(self.num_trees)
+        # legacy-scan bound: num_leaves-1 covers any path
+        self._scan_depth_iters = max(L - 1, 0)
 
-    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+    # -- internals -----------------------------------------------------------
+    def _check_width(self, X: np.ndarray) -> np.ndarray:
         X = np.asarray(X, np.float32)
         if X.shape[1] < self.num_features:
             # jit gathers clamp out-of-bounds indices — a narrow matrix
             # would yield silently wrong predictions, not an IndexError
             raise ValueError("input has %d features, model needs %d"
                              % (X.shape[1], self.num_features))
-        X = jnp.asarray(X)
-        out = _predict_packed(self._arrs, X, num_class=self.num_class,
-                              depth_iters=self.depth_iters)
+        return X
+
+    def _pad_rows(self, X: np.ndarray) -> np.ndarray:
+        n = X.shape[0]
+        bucket = _bucket_rows(n)
+        if bucket == n:
+            return X
+        pad = np.zeros((bucket - n, X.shape[1]), X.dtype)
+        return np.concatenate([X, pad])
+
+    def _run(self, X_dev, early_mode, early_freq, margin):
+        return _predict_tree_parallel(
+            self._arrs, X_dev, jnp.float32(margin),
+            num_class=self.num_class, depth_iters=self.depth_iters,
+            early_mode=early_mode, early_freq=early_freq)
+
+    # -- public --------------------------------------------------------------
+    def predict_raw(self, X: np.ndarray, early_stop: Optional[str] = None,
+                    early_stop_freq: int = 10,
+                    early_stop_margin: float = 10.0) -> np.ndarray:
+        """Raw margin scores [N, num_class].  early_stop: None, 'binary'
+        or 'multiclass' (same truncated-sum semantics as the host
+        predictor's vectorized early stop)."""
+        X = self._check_width(X)
+        N = X.shape[0]
+        freq = max(int(early_stop_freq), 1)
+        if early_stop not in ("binary", "multiclass"):
+            early_stop = None
+        out = np.empty((N, self.num_class), np.float64)
+
+        bs = self.batch_rows
+        slices = [(s, min(s + bs, N)) for s in range(0, N, bs)] or [(0, 0)]
+        # double buffering: enqueue batch i+1's host->device transfer and
+        # fetch batch i-1's result while batch i computes
+        dev_next = jax.device_put(self._pad_rows(X[slices[0][0]:slices[0][1]]))
+        pending = None
+        for i, (s, e) in enumerate(slices):
+            xb = dev_next
+            if i + 1 < len(slices):
+                ns, ne = slices[i + 1]
+                dev_next = jax.device_put(self._pad_rows(X[ns:ne]))
+            yb = self._run(xb, early_stop, freq, early_stop_margin)
+            if pending is not None:
+                (ps, pe), py = pending
+                out[ps:pe] = np.asarray(py, np.float64)[: pe - ps]
+            pending = ((s, e), yb)
+        (ps, pe), py = pending
+        out[ps:pe] = np.asarray(py, np.float64)[: pe - ps]
+        return out
+
+    def predict_raw_scan(self, X: np.ndarray) -> np.ndarray:
+        """The pre-PR scan engine, for A/B benchmarking only (numeric
+        models; no bucketing, no micro-batching — the old behavior)."""
+        if "catw" in self._arrs:
+            raise ValueError("the legacy scan engine has no categorical "
+                             "support")
+        X = jnp.asarray(self._check_width(X))
+        arrs = {kk: self._arrs[kk] for kk in
+                ("feat", "thr", "miss", "dleft", "left", "right", "leaf")}
+        out = _predict_packed_scan(arrs, X, num_class=self.num_class,
+                                   depth_iters=self._scan_depth_iters)
         return np.asarray(out, np.float64)

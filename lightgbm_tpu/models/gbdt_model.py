@@ -93,6 +93,21 @@ class GBDTModel:
                     all_active = bool(active.all())
         return out
 
+    def early_stop_mode(self, requested: bool) -> Optional[str]:
+        """None / 'binary' / 'multiclass' — the reference gates prediction
+        early stop on NeedAccuratePrediction: only binary / multiclass /
+        ranking objectives tolerate truncated sums (predictor.hpp:46-52,
+        objective NeedAccuratePrediction overrides).  Shared by the host
+        and device predict paths so both truncate identically."""
+        if not requested or self.average_output:
+            return None
+        obj_kind = str(self.objective_str).split()[0] \
+            if self.objective_str else ""
+        if obj_kind not in ("binary", "multiclass", "multiclassova",
+                            "lambdarank"):
+            return None
+        return "multiclass" if self.num_tree_per_iteration > 1 else "binary"
+
     def num_prediction_iterations(self, start_iteration: int = 0,
                                   num_iteration: int = -1) -> int:
         return max(self._resolve_end_iteration(start_iteration, num_iteration)

@@ -200,6 +200,17 @@ class Tree:
             return leaf.astype(np.float64)
         return self.leaf_value[leaf]
 
+    def cat_words_for_node(self, node: int) -> np.ndarray:
+        """The raw-category membership bitset of a categorical split node
+        as uint32 words (word i covers categories 32*i .. 32*i+31) — the
+        export format the device predictor packs into its fixed-width
+        [T, nodes, W] word tensor."""
+        if not (self.decision_type[node] & _K_CATEGORICAL_MASK):
+            return np.zeros(0, np.uint32)
+        ci = int(self.threshold_in_bin[node])
+        lo, hi = self.cat_boundaries[ci], self.cat_boundaries[ci + 1]
+        return np.asarray(self.cat_threshold[lo:hi], np.uint32)
+
     def set_bin_thresholds(self, bin_mappers) -> None:
         """Map double thresholds back to bin thresholds against a training
         dataset's mappers so a loaded model can be replayed on binned data

@@ -174,3 +174,172 @@ def test_csr_prediction_matches_dense(capi, tmp_path):
     assert rc == 0, lib.LGBM_GetLastError()
     assert out_len.value == n
     np.testing.assert_allclose(out, nb.predict(X), atol=1e-15)
+
+
+# -- LGBM_BoosterPredictForFile: the C-ABI serving fast path -----------------
+
+def _file_problem(tmp_path, objective="binary", fmt="tsv", seed=11, n=600):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, 6))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    if objective == "regression":
+        y = X[:, 0] * 2 + 0.3 * X[:, 1]
+    bst = _train({"objective": objective}, X, y)
+    model_f = str(tmp_path / "m.txt")
+    bst.save_model(model_f)
+    sep = "\t" if fmt == "tsv" else ","
+    data_f = str(tmp_path / ("d." + fmt))
+    np.savetxt(data_f, np.column_stack([y, X]), delimiter=sep, fmt="%.10g")
+    return bst, model_f, data_f
+
+
+def _cli_predict(data_f, model_f, out_f, *extra):
+    from lightgbm_tpu.application import Application
+    Application(["task=predict", "data=%s" % data_f,
+                 "input_model=%s" % model_f,
+                 "output_result=%s" % out_f] + list(extra)).run()
+
+
+@pytest.mark.parametrize("objective,raw", [("regression", False),
+                                           ("binary", True),
+                                           ("regression", True)])
+def test_predict_for_file_byte_identical_to_cli(capi, tmp_path, objective,
+                                                raw):
+    """Acceptance gate: the pure-C file predict writes the SAME BYTES as
+    application.py's predict task (same parse, same f64 traversal, same
+    %.18g formatting).  Byte-identity is guaranteed for raw scores and
+    identity-transform objectives; sigmoid/softmax outputs can differ by
+    1 ulp (numpy's SIMD exp vs libm exp) and are pinned at ulp tolerance
+    in test_predict_for_file_sigmoid_within_one_ulp."""
+    _, model_f, data_f = _file_problem(tmp_path, objective, seed=14)
+    py_out = str(tmp_path / "py.txt")
+    extra = ["predict_raw_score=true"] if raw else []
+    _cli_predict(data_f, model_f, py_out, *extra)
+    nb = capi.NativeBooster(model_file=model_f)
+    c_out = str(tmp_path / "c.txt")
+    nb.predict_for_file(data_f, c_out, raw_score=raw)
+    assert open(py_out, "rb").read() == open(c_out, "rb").read()
+
+
+def test_predict_for_file_sigmoid_within_one_ulp(capi, tmp_path):
+    _, model_f, data_f = _file_problem(tmp_path, "binary", seed=14)
+    py_out = str(tmp_path / "py.txt")
+    _cli_predict(data_f, model_f, py_out)
+    nb = capi.NativeBooster(model_file=model_f)
+    c_out = str(tmp_path / "c.txt")
+    nb.predict_for_file(data_f, c_out)
+    a, b = np.loadtxt(py_out), np.loadtxt(c_out)
+    # %.18g round-trips doubles exactly, so any diff here is a true ulp
+    # diff of the exp() implementations, never a formatting artifact
+    assert np.all(np.abs(a - b) <= np.spacing(np.maximum(np.abs(a),
+                                                         np.abs(b))))
+
+
+def test_predict_for_file_raw_and_sliced(capi, tmp_path):
+    bst, model_f, data_f = _file_problem(tmp_path)
+    py_out = str(tmp_path / "py.txt")
+    _cli_predict(data_f, model_f, py_out, "predict_raw_score=true",
+                 "num_iteration_predict=3")
+    nb = capi.NativeBooster(model_file=model_f)
+    c_out = str(tmp_path / "c.txt")
+    nb.predict_for_file(data_f, c_out, raw_score=True, num_iteration=3)
+    assert open(py_out, "rb").read() == open(c_out, "rb").read()
+
+
+def test_predict_for_file_csv_matches_values(capi, tmp_path):
+    bst, model_f, data_f = _file_problem(tmp_path, fmt="csv")
+    nb = capi.NativeBooster(model_file=model_f)
+    c_out = str(tmp_path / "c.txt")
+    nb.predict_for_file(data_f, c_out)
+    from lightgbm_tpu.io.parser import parse_file
+    X, _ = parse_file(data_f)
+    np.testing.assert_allclose(np.loadtxt(c_out), bst.predict(X), atol=1e-15)
+
+
+def test_predict_for_file_errors(capi, tmp_path):
+    _, model_f, _ = _file_problem(tmp_path)
+    nb = capi.NativeBooster(model_file=model_f)
+    with pytest.raises(Exception, match="cannot open"):
+        nb.predict_for_file(str(tmp_path / "missing.tsv"),
+                            str(tmp_path / "o.txt"))
+
+
+# -- single-row fast path ----------------------------------------------------
+
+def test_single_row_fast_matches_batch(capi, tmp_path):
+    rng = np.random.default_rng(12)
+    X = rng.standard_normal((200, 5))
+    y = (X[:, 0] > 0).astype(float)
+    bst = _train({"objective": "binary"}, X, y)
+    nb, _ = _roundtrip(capi, bst, X, tmp_path, "fast")
+    fp = capi.FastSingleRowPredictor(nb, X.shape[1])
+    batch = np.asarray(nb.predict(X)).reshape(-1)
+    single = np.array([fp.predict(X[i])[0] for i in range(len(X))])
+    np.testing.assert_array_equal(single, batch)
+
+
+def test_single_row_fast_multiclass_and_errors(capi, tmp_path):
+    rng = np.random.default_rng(13)
+    X = rng.standard_normal((300, 4))
+    y = ((X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0)).astype(float)
+    bst = _train({"objective": "multiclass", "num_class": 3}, X, y)
+    nb, _ = _roundtrip(capi, bst, X, tmp_path, "fastmc")
+    fp = capi.FastSingleRowPredictor(nb, X.shape[1])
+    batch = np.asarray(nb.predict(X[:7]))
+    for i in range(7):
+        np.testing.assert_array_equal(fp.predict(X[i]), batch[i])
+    with pytest.raises(Exception, match="columns"):
+        capi.FastSingleRowPredictor(nb, 2)     # narrower than the model
+
+
+# -- compiled-C harness: PredictForFile from a real C program ----------------
+
+C_FILE_PROGRAM = r"""
+#include <stdio.h>
+#include "lightgbm_tpu_c_api.h"
+#define CHECK(call) do { if ((call) != 0) { \
+  fprintf(stderr, "FAIL %s: %s\n", #call, LGBM_GetLastError()); return 1; } \
+} while (0)
+
+int main(int argc, char** argv) {
+  if (argc != 4) { fprintf(stderr, "usage: model data out\n"); return 2; }
+  BoosterHandle bst;
+  int iters = 0;
+  CHECK(LGBM_BoosterCreateFromModelfile(argv[1], &iters, &bst));
+  /* raw score (predict_type 1): transform-free sums are byte-exact
+   * against the Python CLI on every libm */
+  CHECK(LGBM_BoosterPredictForFile(bst, argv[2], 0, 1, -1, "", argv[3]));
+  CHECK(LGBM_BoosterFree(bst));
+  printf("C predict-for-file ok (%d iters)\n", iters);
+  return 0;
+}
+"""
+
+
+def test_c_program_predict_for_file(capi, tmp_path):
+    """Acceptance gate, compiled-C form: a real C program linked against
+    the dependency-free base library runs the whole file->file predict
+    and its output is byte-identical to the Python CLI's."""
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cpp = os.path.join(repo, "cpp")
+    _, model_f, data_f = _file_problem(tmp_path, seed=14)
+    py_out = str(tmp_path / "py.txt")
+    _cli_predict(data_f, model_f, py_out, "predict_raw_score=true")
+
+    src = tmp_path / "predict_file.c"
+    src.write_text(C_FILE_PROGRAM)
+    exe = tmp_path / "predict_file"
+    cc = subprocess.run(
+        ["cc", str(src), "-I", cpp,
+         os.path.join(cpp, "lib_lightgbm_tpu.so"),
+         "-Wl,-rpath," + cpp, "-o", str(exe)],
+        capture_output=True, text=True)
+    if cc.returncode != 0:
+        pytest.skip("cc unavailable or link failed: " + cc.stderr[-300:])
+    c_out = str(tmp_path / "c.txt")
+    run = subprocess.run([str(exe), model_f, data_f, c_out],
+                         capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, run.stderr[-1000:]
+    assert "C predict-for-file ok" in run.stdout
+    assert open(py_out, "rb").read() == open(c_out, "rb").read()

@@ -402,3 +402,41 @@ def test_concurrent_predict_and_update(problem):
     assert not errors, errors
     _check(lib, lib.LGBM_BoosterFree(bst))
     _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_predict_for_file_on_training_booster(problem, tmp_path):
+    """LGBM_BoosterPredictForFile through a TRAINING booster handle: the
+    ModelRef seam resolves the train handle to its native model cache
+    under the shared lock, so the file fast path serves both booster
+    kinds.  Output must match PredictForMat on the same handle exactly."""
+    lib = _lib()
+    X, y = problem
+    ds = _c_dataset(lib, X, y)
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(ds, PARAMS.encode(),
+                                       ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(5):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    data_f = str(tmp_path / "d.tsv")
+    np.savetxt(data_f, np.column_stack([y, X]).astype(np.float64),
+               delimiter="\t", fmt="%.10g")
+    out_f = str(tmp_path / "pred.txt")
+    _check(lib, lib.LGBM_BoosterPredictForFile(
+        bst, data_f.encode(), 0, 0, -1, b"", out_f.encode()))
+
+    # reference: dense predict on the same (re-parsed) values
+    from lightgbm_tpu.io.parser import parse_file
+    Xp, _ = parse_file(data_f)
+    n = Xp.shape[0]
+    ref = np.zeros(n, np.float64)
+    olen = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, np.ascontiguousarray(Xp).ctypes.data_as(ctypes.c_void_p),
+        F64, ctypes.c_int32(n), ctypes.c_int32(Xp.shape[1]), 1, 0, -1,
+        b"", ctypes.byref(olen),
+        ref.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_array_equal(np.loadtxt(out_f), ref)
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds))

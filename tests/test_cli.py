@@ -148,3 +148,27 @@ def test_header_after_blank_lines(tmp_path):
     X, y = parse_file(str(f))
     assert X.shape == (2, 1)
     np.testing.assert_array_equal(y, [1, 0])
+
+
+def test_cli_predict_device_engine(tmp_path):
+    """task=predict predict_device=true routes through the tree-parallel
+    device engine; scores agree with the host CLI output at f32
+    tolerance."""
+    rng = np.random.default_rng(23)
+    X = rng.standard_normal((400, 5))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    train_f = tmp_path / "d.tsv"
+    np.savetxt(train_f, np.column_stack([y, X]), delimiter="\t", fmt="%.10g")
+    model_f = tmp_path / "model.txt"
+    Application(["task=train", "data=%s" % train_f, "objective=binary",
+                 "num_trees=5", "output_model=%s" % model_f,
+                 "verbose=-1"]).run()
+    host_f, dev_f = tmp_path / "host.txt", tmp_path / "dev.txt"
+    Application(["task=predict", "data=%s" % train_f,
+                 "input_model=%s" % model_f,
+                 "output_result=%s" % host_f]).run()
+    Application(["task=predict", "data=%s" % train_f,
+                 "input_model=%s" % model_f, "predict_device=true",
+                 "output_result=%s" % dev_f]).run()
+    np.testing.assert_allclose(np.loadtxt(dev_f), np.loadtxt(host_f),
+                               rtol=1e-5, atol=1e-6)

@@ -1,12 +1,23 @@
-"""Jitted accelerator batch prediction (gbdt_prediction.cpp throughput
-path; f32 thresholds, opt-in via Booster.predict(device=True))."""
+"""Tree-parallel jitted inference engine (gbdt_prediction.cpp throughput
+path; f32 thresholds, opt-in via Booster.predict(device=True)).
+
+The host predictor is the exactness reference; every family in the sweep
+pins device == host within f32-appropriate tolerance: |err| is bounded by
+f32 rounding of thresholds/leaf sums (~1e-7 relative per tree, summed
+over trees), so rtol 1e-5 / atol 1e-6 holds for the small models here.
+"""
 import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
+from lightgbm_tpu.models import device_predictor as dpr
+from lightgbm_tpu.models.device_predictor import DevicePredictor
+
+RTOL, ATOL = 1e-5, 1e-6
 
 
-def _train(objective="binary", n=500, num_class=None, nan_rate=0.0, seed=0):
+def _train(objective="binary", n=500, num_class=None, nan_rate=0.0, seed=0,
+           rounds=6, extra=None):
     rng = np.random.default_rng(seed)
     X = rng.standard_normal((n, 6)).astype(np.float64)
     if nan_rate:
@@ -14,26 +25,46 @@ def _train(objective="binary", n=500, num_class=None, nan_rate=0.0, seed=0):
     base = np.nan_to_num(X)
     if objective == "multiclass":
         y = ((base[:, 0] > 0).astype(int) + (base[:, 1] > 0.5)).astype(float)
-    elif objective == "regression":
+    elif objective in ("regression", "poisson"):
         y = base[:, 0] * 2.0 + 0.3 * base[:, 1]
+        if objective == "poisson":
+            y = np.abs(y)
     else:
         y = (base[:, 0] + 0.4 * base[:, 1] > 0).astype(float)
     params = {"objective": objective, "num_leaves": 15, "verbose": -1,
               "min_data_in_leaf": 5}
     if num_class:
         params["num_class"] = num_class
-    return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=6), X
+    if extra:
+        params.update(extra)
+    return lgb.train(params, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds), X
 
 
-@pytest.mark.parametrize("objective", ["binary", "regression"])
+def _train_categorical(n=400, n_cat=6, seed=4, rounds=4):
+    rng = np.random.default_rng(seed)
+    Xc = rng.integers(0, n_cat, n).astype(float)
+    Xn = rng.standard_normal(n)
+    X = np.column_stack([Xc, Xn])
+    y = ((Xc % 2 == 0) ^ (Xn > 0)).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]),
+                    num_boost_round=rounds)
+    return bst, X
+
+
+def _assert_device_matches_host(bst, X, **kw):
+    np.testing.assert_allclose(bst.predict(X, device=True, **kw),
+                               bst.predict(X, **kw), rtol=RTOL, atol=ATOL)
+
+
+# -- objective-family equivalence sweep --------------------------------------
+@pytest.mark.parametrize("objective",
+                         ["binary", "regression", "poisson", "xentropy"])
 def test_device_matches_host(objective):
     bst, X = _train(objective)
-    host = bst.predict(X)
-    dev = bst.predict(X, device=True)
-    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
-    host_raw = bst.predict(X, raw_score=True)
-    dev_raw = bst.predict(X, raw_score=True, device=True)
-    np.testing.assert_allclose(dev_raw, host_raw, rtol=1e-5, atol=1e-6)
+    _assert_device_matches_host(bst, X)
+    _assert_device_matches_host(bst, X, raw_score=True)
 
 
 def test_device_multiclass():
@@ -41,62 +72,179 @@ def test_device_multiclass():
     host = bst.predict(X)
     dev = bst.predict(X, device=True)
     assert dev.shape == host.shape == (500, 3)
-    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(dev, host, rtol=RTOL, atol=ATOL)
     assert (np.argmax(dev, 1) == np.argmax(host, 1)).mean() > 0.999
 
 
+# -- missing-value modes -----------------------------------------------------
 def test_device_with_nans():
     bst, X = _train("binary", nan_rate=0.15, seed=3)
-    np.testing.assert_allclose(bst.predict(X, device=True), bst.predict(X),
-                               rtol=1e-5, atol=1e-6)
+    _assert_device_matches_host(bst, X)
 
 
+def test_device_zero_as_missing():
+    bst, X = _train("binary", seed=5,
+                    extra={"zero_as_missing": True, "use_missing": True})
+    Xz = X.copy()
+    Xz[::7, 0] = 0.0                     # exact zeros hit the missing path
+    _assert_device_matches_host(bst, Xz)
+
+
+def test_device_missing_disabled():
+    bst, X = _train("binary", seed=6, extra={"use_missing": False})
+    _assert_device_matches_host(bst, X)
+
+
+# -- categorical splits on device (no host fallback any more) ----------------
+def test_categorical_model_on_device():
+    bst, X = _train_categorical()
+    assert sum(t.num_cat for t in bst._model.trees) > 0
+    _assert_device_matches_host(bst, X)
+
+
+def test_categorical_many_categories():
+    # categories spanning several uint32 bitset words + out-of-vocabulary
+    # and NaN category values at predict time
+    bst, X = _train_categorical(n=900, n_cat=140, seed=8, rounds=6)
+    assert sum(t.num_cat for t in bst._model.trees) > 0
+    Xq = X.copy()
+    Xq[::11, 0] = 999.0                  # unseen category -> right child
+    Xq[::13, 0] = np.nan
+    Xq[::17, 0] = -3.0                   # negative -> right child
+    _assert_device_matches_host(bst, Xq)
+
+
+# -- iteration slices --------------------------------------------------------
 def test_device_num_iteration():
     bst, X = _train("binary")
-    np.testing.assert_allclose(
-        bst.predict(X, device=True, num_iteration=2),
-        bst.predict(X, num_iteration=2), rtol=1e-5, atol=1e-6)
+    _assert_device_matches_host(bst, X, num_iteration=2)
 
 
-def test_categorical_model_falls_back():
-    rng = np.random.default_rng(4)
-    Xc = rng.integers(0, 6, 400).astype(float)
-    Xn = rng.standard_normal(400)
-    X = np.column_stack([Xc, Xn])
-    y = ((Xc % 2 == 0) ^ (Xn > 0)).astype(float)
-    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
-                    lgb.Dataset(X, label=y, categorical_feature=[0]),
-                    num_boost_round=4)
-    host = bst.predict(X)
-    dev = bst.predict(X, device=True)  # warns, falls back to host
-    np.testing.assert_array_equal(dev, host)
+def test_device_start_iteration():
+    bst, X = _train("binary", rounds=8)
+    for start, num in ((2, 3), (0, -1), (5, -1), (3, 2)):
+        np.testing.assert_allclose(
+            bst.predict(X, device=True, start_iteration=start,
+                        num_iteration=num, raw_score=True),
+            bst.predict(X, start_iteration=start, num_iteration=num,
+                        raw_score=True), rtol=RTOL, atol=ATOL)
+
+
+# -- prediction early stop on device -----------------------------------------
+@pytest.mark.parametrize("freq,margin", [(5, 2.0), (1, 0.5), (10, 10.0)])
+def test_device_early_stop_binary(freq, margin):
+    bst, X = _train("binary", rounds=30)
+    _assert_device_matches_host(bst, X, pred_early_stop=True,
+                                pred_early_stop_freq=freq,
+                                pred_early_stop_margin=margin)
+
+
+def test_device_early_stop_multiclass():
+    bst, X = _train("multiclass", num_class=3, rounds=25)
+    _assert_device_matches_host(bst, X, pred_early_stop=True,
+                                pred_early_stop_freq=5,
+                                pred_early_stop_margin=2.0)
+
+
+def test_device_early_stop_truncates():
+    # early stop must actually change the answer vs the full sum (the
+    # host asserts the same — proves the device path is not ignoring it)
+    bst, X = _train("binary", rounds=30)
+    full = bst.predict(X, device=True, raw_score=True)
+    stopped = bst.predict(X, device=True, raw_score=True,
+                          pred_early_stop=True, pred_early_stop_freq=1,
+                          pred_early_stop_margin=0.5)
+    assert np.abs(full - stopped).max() > 0
+
+
+def test_device_early_stop_ignored_for_regression():
+    # NeedAccuratePrediction objectives never truncate (shared gating)
+    bst, X = _train("regression", rounds=10)
+    a = bst.predict(X, device=True, pred_early_stop=True,
+                    pred_early_stop_freq=1, pred_early_stop_margin=0.1)
+    b = bst.predict(X, device=True)
+    np.testing.assert_array_equal(a, b)
+
+
+# -- engine plumbing ---------------------------------------------------------
+def test_depth_bound_is_packed_max_depth():
+    bst, _ = _train("binary", rounds=8)
+    dp = DevicePredictor(bst._model)
+    # leaf-wise 15-leaf trees are never 14 deep in practice; the bound
+    # must come from the packed trees, not num_leaves - 1
+    assert 0 < dp.depth_iters <= dp._scan_depth_iters
+
+    def ref_depth(t, node=0, d=0):     # recursive walk, no training state
+        if node < 0 or t.num_leaves <= 1:
+            return d
+        return max(ref_depth(t, int(t.left_child[node]), d + 1),
+                   ref_depth(t, int(t.right_child[node]), d + 1))
+
+    assert dp.depth_iters == max(ref_depth(t) for t in bst._model.trees)
+
+
+def test_scan_engine_agrees_with_tree_parallel():
+    bst, X = _train("binary", rounds=6)
+    dp = DevicePredictor(bst._model)
+    np.testing.assert_allclose(dp.predict_raw_scan(X.astype(np.float32)),
+                               dp.predict_raw(X), rtol=1e-6, atol=1e-6)
+
+
+def test_shape_bucket_cache_compiles_once_per_bucket():
+    bst, X = _train("binary", seed=9)
+    dp = DevicePredictor(bst._model)
+    dp.predict_raw(X[:400])              # compile bucket 512
+    base = dpr.trace_count()
+    for n in (257, 300, 389, 500):       # all land in bucket 512
+        dp.predict_raw(X[:n])
+    assert dpr.trace_count() == base, \
+        "ragged batches inside one power-of-two bucket retraced"
+    dp.predict_raw(X[:100])              # bucket 128
+    assert dpr.trace_count() <= base + 1
+
+
+def test_micro_batching_matches_single_shot():
+    bst, X = _train("binary", n=1000, seed=10)
+    dp_one = DevicePredictor(bst._model)
+    dp_micro = DevicePredictor(bst._model, batch_rows=128)
+    np.testing.assert_array_equal(dp_one.predict_raw(X),
+                                  dp_micro.predict_raw(X))
 
 
 def test_num_leaves_2_tree():
     # regression guard: a root whose left child stays leaf 0 encodes
     # left_child[0] = ~0 = -1 and must still traverse
-    bst, X = _train("binary")
     rng = np.random.default_rng(7)
     X2 = rng.standard_normal((300, 6))
     y2 = (X2[:, 0] > 0).astype(float)
     b2 = lgb.train({"objective": "binary", "num_leaves": 2, "verbose": -1},
                    lgb.Dataset(X2, label=y2), num_boost_round=3)
-    np.testing.assert_allclose(b2.predict(X2, device=True), b2.predict(X2),
-                               rtol=1e-5, atol=1e-6)
+    _assert_device_matches_host(b2, X2)
     # and the predictions actually vary (not one collapsed leaf value)
     assert len(np.unique(np.round(b2.predict(X2, device=True), 8))) > 1
 
 
 def test_rollback_invalidates_device_cache():
     bst, X = _train("binary")
-    p1 = bst.predict(X, device=True)
+    bst.predict(X, device=True)
     bst.rollback_one_iter()
     bst.update()
-    p2 = bst.predict(X, device=True)
-    np.testing.assert_allclose(p2, bst.predict(X), rtol=1e-5, atol=1e-6)
+    _assert_device_matches_host(bst, X)
 
 
 def test_narrow_input_raises():
     bst, X = _train("binary")
     with pytest.raises(ValueError):
         bst.predict(X[:, :2], device=True)
+
+
+def test_engine_predict_entry(tmp_path):
+    # lgb.predict: the one-shot serving entry routes through the device
+    # engine from a model file
+    bst, X = _train("binary")
+    f = str(tmp_path / "m.txt")
+    bst.save_model(f)
+    np.testing.assert_allclose(lgb.predict(f, X), bst.predict(X),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_array_equal(lgb.predict(bst, X, device=False),
+                                  bst.predict(X))

@@ -347,3 +347,44 @@ def test_entry_is_hermetic_no_platform_binding():
                        capture_output=True, text=True, timeout=180)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "HERMETIC_OK" in r.stdout
+
+
+def test_hermetic_env_scrubs_plugin_vars(monkeypatch):
+    """The scrub invariant behind the whitelist (MULTICHIP Weak #1): even
+    if a future whitelist edit copies a var, no inherited JAX_PLATFORMS /
+    PJRT-plugin key may survive into the dryrun subprocess environment."""
+    import __graft_entry__ as g
+
+    polluted = {"PATH": "/usr/bin", "HOME": "/root",
+                "JAX_PLATFORMS": "axon",
+                "PJRT_DEVICE": "TPU",
+                "TPU_LIBRARY_PATH": "/x/libtpu.so",
+                "LIBTPU_INIT_ARGS": "--xla",
+                "PALLAS_AXON_POOL_IPS": "10.255.255.1",
+                "SOME_FUTURE_AXON_TUNNEL": "on"}
+    assert g._scrub_plugin_env(dict(polluted)) == \
+        {"PATH": "/usr/bin", "HOME": "/root"}
+    # and the real builder: pollute the parent env, build, assert nothing
+    # plugin-shaped survives and cpu is re-pinned explicitly
+    for k, v in polluted.items():
+        monkeypatch.setenv(k, v)
+    env = g._hermetic_cpu_env(2)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    leaked = [k for k in env if k != "JAX_PLATFORMS" and any(
+        m in k.upper() for m in g._PLUGIN_ENV_MARKERS)]
+    assert not leaked, leaked
+
+
+def test_dryrun_stage_lines_carry_wallclock(capsys):
+    """Every dryrun stage line must carry a wall-clock timestamp so a red
+    MULTICHIP artifact shows where (and for how long) the run stalled."""
+    import re
+
+    import __graft_entry__ as g
+
+    wd = g._StageWatchdog(seconds=30, hard=False)
+    wd("probe stage")
+    wd.done()
+    out = capsys.readouterr().out
+    assert re.search(r"^\[\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\] "
+                     r"dryrun stage: probe stage$", out, re.M), out
