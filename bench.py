@@ -282,16 +282,10 @@ def _staged_kernel_probe():
 def _device_probe() -> bool:
     """True when the accelerator platform initializes promptly.  A dead
     axon tunnel HANGS jax.devices(), which would hang the whole bench —
-    probe in a killable subprocess instead."""
-    import subprocess
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); print('ok')"],
-            timeout=180, capture_output=True)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    the resilience probe runs in a short-deadline subprocess whose child
+    self-dumps its thread tracebacks before the kill lands."""
+    from lightgbm_tpu.runtime import resilience
+    return resilience.probe_platform(deadline=180)["ok"]
 
 
 def main():
@@ -332,8 +326,18 @@ def main():
         # not initialize in the fallback either.
         sys.stderr.write("bench: accelerator platform unreachable; "
                          "falling back to CPU at reduced scale\n")
+        from lightgbm_tpu.runtime import resilience as _res
+        degradation = {
+            "event": "platform_degradation",
+            "from": os.environ.get("JAX_PLATFORMS") or "<default>",
+            "to": "cpu", "reason": "device probe failed or hung",
+            "wallclock": _res.wallclock(),
+        }
         import __graft_entry__ as ge
         env = ge._hermetic_cpu_env(1)
+        # machine-readable degradation record: rides the re-exec into the
+        # CPU bench's result JSON (key "degradation_event")
+        env["LGBM_TPU_DEGRADATION"] = json.dumps(degradation)
         # the whitelist env has no PYTHONPATH; this re-exec runs WITHOUT
         # the -I -S bootstrap, so module reachability must ride PYTHONPATH
         # (covers pip --target provisioning; trigger vars are gone, so a
@@ -346,6 +350,13 @@ def main():
                     "BENCH_LEAVES": str(num_leaves),
                     "BENCH_FEATURES": str(n_feat),
                     "BENCH_BINS": str(max_bin)})
+        # section toggles must survive the re-exec (the hermetic whitelist
+        # dropped them): a caller that opted out of the predict/phase
+        # sections must not get them back at CPU-fallback speed
+        for k in ("BENCH_PREDICT", "BENCH_PREDICT_ROWS", "BENCH_PHASES",
+                  "BENCH_HIST_QUANT", "BENCH_FRONTIER_BATCH"):
+            if k in os.environ:
+                env[k] = os.environ[k]
         os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
                   env)
 
@@ -361,11 +372,14 @@ def main():
                          n_feat, max_bin)
             print(json.dumps(result))
             return
-        except Exception as e:  # RESOURCE_EXHAUSTED etc.
+        except Exception as e:  # RESOURCE_EXHAUSTED, StageTimeout etc.
             # keep only the MESSAGE and leave the handler promptly: while
             # the handler runs, exc_info pins run()'s frame (payload +
             # aux, ~10 GB at full scale); it is the handler EXIT that
             # frees it for the next rung
+            import signal as _signal
+            if hasattr(_signal, "SIGALRM"):
+                _signal.alarm(0)   # run()'s stage watchdog dies with it
             last_msg = "%s: %s" % (type(e).__name__, e)
             sys.stderr.write("bench failed at %d rows: %s\n"
                              % (attempt_rows, last_msg))
@@ -401,11 +415,26 @@ def main():
 def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
     import lightgbm_tpu as lgb
     from lightgbm_tpu.ops import segment as lseg
+    from lightgbm_tpu.runtime import resilience
+
+    # every bench stage runs under a named soft deadline: a hang dies as
+    # a StageTimeout naming its stage (caught by main()'s rung handler,
+    # with faulthandler tracebacks on stderr) instead of eating the whole
+    # wall budget silently.  BENCH_STAGE_TIMEOUT=0 disables.
+    wd = resilience.Watchdog(
+        int(os.environ.get("BENCH_STAGE_TIMEOUT", "1200")),
+        hard=False, label="bench stage", stream=sys.stderr)
 
     def stage(msg):
-        sys.stderr.write("bench stage: %s\n" % msg)
+        # wall-clock-tagged stage marker (stderr: stdout stays the one
+        # JSON result line); each marker re-arms the per-stage deadline,
+        # so a later hang is blamed on the segment "after <marker>"
+        wd("after %r" % msg)
+        sys.stderr.write("[%s] bench stage: %s\n"
+                         % (resilience.wallclock(), msg))
         sys.stderr.flush()
 
+    wd("synth")
     X, y = synth_higgs(n_rows + n_test, n_feat=n_feat)
     Xte, yte = X[n_rows:], y[n_rows:]
     X, y = X[:n_rows], y[:n_rows]
@@ -552,6 +581,11 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
                        "program amortizes; sec_per_iter is the honest "
                        "steady-state number",
     }
+    wd.done()
+    deg = os.environ.get("LGBM_TPU_DEGRADATION")
+    if deg:
+        # the pre-fallback process recorded WHY this run landed on CPU
+        result["degradation_event"] = json.loads(deg)
     if predict_rec is not None:
         result["predict"] = predict_rec
     if hist_quant is not None:

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Watchdogged multichip dryrun wrapper -> MULTICHIP-style artifact JSON.
+
+The driver's own MULTICHIP artifact records only {rc, tail}; five rounds
+of red artifacts (rc=124, hung after "import jax") proved that is not
+enough.  This wrapper runs the SAME check — `dryrun_multichip(n)` over a
+virtual n-device CPU mesh — but leaves a diagnosable artifact whatever
+happens:
+
+* the requested platform is health-probed first in short-deadline
+  subprocesses with jittered-backoff retry; a dead/hung platform is
+  recorded as a machine-readable `degradation_event` (the dryrun itself
+  always runs on the hermetic CPU mesh, so a dead tunnel costs seconds,
+  not the driver's whole budget);
+* every dryrun stage runs under the resilience watchdog with wall-clock
+  timestamps, and the rolling stage trail is embedded in the artifact;
+* on a timeout, the artifact carries the faulthandler tracebacks of all
+  threads and NAMES the culprit stage — no bare rc=124 is reachable from
+  any injected fault (`LGBM_TPU_FAULT=bogus_platform,hang_import:300` is
+  the tier-1 pin, tests/test_resilience.py).
+
+Usage:  python exp/dryrun.py [n_devices] [artifact.json]
+Env:    LGBM_TPU_DRYRUN_BUDGET (s, default 240)
+        LGBM_TPU_PROBE_DEADLINE (s, default 15), LGBM_TPU_PROBE_ATTEMPTS
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from lightgbm_tpu.runtime import resilience  # noqa: E402
+
+
+def main(argv):
+    n_devices = int(argv[1]) if len(argv) > 1 else int(
+        os.environ.get("NDEV", "8"))
+    artifact = argv[2] if len(argv) > 2 else os.path.join(
+        REPO, "MULTICHIP_local.json")
+    budget = float(os.environ.get("LGBM_TPU_DRYRUN_BUDGET", "240"))
+    probe_deadline = float(os.environ.get("LGBM_TPU_PROBE_DEADLINE", "15"))
+    probe_attempts = int(os.environ.get("LGBM_TPU_PROBE_ATTEMPTS", "2"))
+    t0 = time.monotonic()
+    rec = {"n_devices": n_devices, "ok": False, "skipped": False,
+           "rc": None, "wrapper": "exp/dryrun.py", "budget_s": budget,
+           "t_start": resilience.wallclock()}
+
+    # -- 1. platform health probe + degradation chain -----------------------
+    # The dryrun proper always runs on the hermetic virtual-CPU mesh; the
+    # probe records whether the ENVIRONMENT's requested platform (the one
+    # the driver would bind) is actually alive, and degrades the record to
+    # cpu instead of letting a dead tunnel eat the whole budget.
+    backend, degradation, probes = resilience.resolve_backend(
+        requested=None, deadline=probe_deadline, attempts=probe_attempts,
+        n_devices=n_devices)
+    rec["platform"] = backend
+    rec["platform_probes"] = [{k: v for k, v in p.items() if k != "tail"}
+                              for p in probes]
+    rec["degradation_event"] = degradation
+    if degradation is not None:
+        # the hung probe's self-dumped thread tracebacks are the evidence
+        # a post-mortem needs; keep the last probe tail that has one
+        for p in reversed(probes):
+            if p.get("tail"):
+                rec["probe_tracebacks"] = p["tail"]
+                break
+
+    # -- 2. the dryrun itself, stage-watchdogged ----------------------------
+    report_path = os.path.join(tempfile.gettempdir(),
+                               "lgbm_tpu_dryrun_stages_%d.json" % os.getpid())
+    env = dict(os.environ)
+    env["LGBM_TPU_STAGE_REPORT"] = report_path
+    if degradation is not None:
+        # belt-and-braces: never let a child of THIS wrapper bind the
+        # platform the probe just watched die
+        env["JAX_PLATFORMS"] = "cpu"
+    remaining = max(budget - (time.monotonic() - t0), 30.0)
+    code = ("import __graft_entry__ as g; g.dryrun_multichip(%d)"
+            % n_devices)
+    try:
+        r = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                           timeout=remaining, capture_output=True, text=True)
+        rec["rc"] = r.returncode
+        rec["ok"] = r.returncode == 0
+        rec["tail"] = ((r.stdout or "") + (r.stderr or ""))[-4000:]
+    except subprocess.TimeoutExpired as e:
+        rec["rc"] = 124
+        rec["tail"] = (_txt(e.stdout) + _txt(e.stderr))[-4000:]
+        rec["note"] = ("wrapper budget exceeded — the stage trail below "
+                       "names the culprit")
+
+    # the rolling stage report survives any way the subprocess died
+    try:
+        with open(report_path) as fh:
+            stage_rep = json.load(fh)
+        rec["stages"] = stage_rep.get("stages", [])
+        rec["culprit_stage"] = stage_rep.get("culprit")
+        if stage_rep.get("tracebacks"):
+            rec["tracebacks"] = stage_rep["tracebacks"]
+    except (OSError, ValueError):
+        rec["stages"] = []
+        rec["culprit_stage"] = None
+    finally:
+        try:
+            os.unlink(report_path)
+        except OSError:
+            pass
+    rec["elapsed_s"] = round(time.monotonic() - t0, 1)
+    rec["within_budget"] = rec["elapsed_s"] <= budget
+    resilience.atomic_write(artifact, json.dumps(rec, indent=1) + "\n")
+    print("dryrun wrapper: ok=%s rc=%s elapsed=%.1fs degradation=%s "
+          "artifact=%s" % (rec["ok"], rec["rc"], rec["elapsed_s"],
+                           "yes" if degradation else "no", artifact),
+          flush=True)
+    return 0 if rec["ok"] else 1
+
+
+def _txt(v):
+    if v is None:
+        return ""
+    return v.decode("utf-8", "replace") if isinstance(v, bytes) else v
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -3,6 +3,19 @@
 # not be hardware-tested while it was down, then takes a bench reading.
 set -e -o pipefail
 cd "$(dirname "$0")/.."
+echo "=== 0. resilience: watchdogged dryrun + platform health (ISSUE 4) ==="
+echo "   (exp/dryrun.py probes the real platform with a short deadline,"
+echo "    records a degradation_event if the tunnel is dead, and runs the"
+echo "    stage-watchdogged multichip dryrun — the artifact JSON carries"
+echo "    per-stage wall-clock timestamps and, on any timeout, the"
+echo "    faulthandler dump.  docs/RESILIENCE.md has the failure model.)"
+timeout 300 python exp/dryrun.py 8 MULTICHIP_local.json \
+  && echo "   dryrun artifact: MULTICHIP_local.json" \
+  || echo "   dryrun NOT green — read MULTICHIP_local.json (culprit_stage, degradation_event)"
+echo "=== 0b. resilience: snapshot/resume under injected preemption ==="
+timeout 400 python -m pytest tests/test_resilience.py -q -x \
+  -k "sigterm or byte_for_byte" 2>&1 | tail -2 \
+  || echo "   resume byte-identity FAILED on this hardware — investigate before trusting snapshots"
 echo "=== 1. kernels exact vs portable (incl. the 2-pass partition) ==="
 timeout 400 python exp/smoke_tpu_kernels.py 2>&1 | grep -vE "WARN|INFO|libtpu|common_lib|Failed to find|Logging" | tail -8
 echo "=== 1b. IF step 1 was green: flip remaining validated kernel flags ==="

@@ -22,7 +22,15 @@ from .config import Config
 from .engine import train as engine_train
 from .io.parser import load_sidecar, parse_file
 from .models.gbdt_model import GBDTModel
+from .runtime import resilience
 from .utils.log import LightGBMError, Log
+
+#: per-stage deadline for the CLI's ingest/save stages (seconds; 0
+#: disables).  Training itself is legitimately unbounded, so only the
+#: bounded stages are watchdogged by default — a hung parse or a stuck
+#: filesystem dies loudly with a faulthandler dump instead of stalling
+#: the whole task (LGBM_TPU_STAGE_TIMEOUT overrides).
+_INGEST_STAGE_TIMEOUT = int(os.environ.get("LGBM_TPU_STAGE_TIMEOUT", "3600"))
 
 
 def parse_parameters(argv: List[str]) -> Dict[str, str]:
@@ -111,49 +119,109 @@ class Application:
         num_rounds, early_stopping = _rounds_from_params(params, 100, 0)
         num_rounds, early_stopping = int(num_rounds), int(early_stopping or 0)
         snapshot_freq = int(params.pop("snapshot_freq", -1))
+        # keep-last-K snapshot cleanup; <= 0 keeps everything
+        snapshot_retention = int(params.pop("snapshot_retention", -1))
+        resume = str(params.pop("resume", "")).lower() in ("true", "1")
 
+        # resume=true: scan for the newest VALID snapshot (checksummed
+        # footer; corrupt/truncated ones are skipped with a warning) and
+        # continue from it to a model byte-identical to an uninterrupted
+        # run (runtime/resilience.py restores scores, payload row order
+        # and RNG streams past the trees themselves)
+        resume_state = None
+        if resume:
+            snap_path, resume_state = resilience.find_resume_snapshot(
+                output_model, log=Log)
+            if snap_path is None:
+                Log.warning("resume=true but no valid snapshot found for "
+                            "%s; training from scratch", output_model)
+            else:
+                Log.info("Resuming from snapshot %s (iteration %d)",
+                         snap_path, resume_state["total_iter"])
+                input_model = snap_path
+                if resume_state["total_iter"] >= num_rounds:
+                    Log.info("Snapshot already has %d >= %d iterations; "
+                             "saving it as the final model",
+                             resume_state["total_iter"], num_rounds)
+                    GBDTModel.load_model(snap_path).save_model(output_model)
+                    return
+
+        wd = resilience.Watchdog(_INGEST_STAGE_TIMEOUT, hard=False,
+                                 label="cli stage")
         from .io.dataset import BinnedDataset
         resolved = {Config.resolve_alias(k): v for k, v in params.items()}
-        if BinnedDataset.is_binary_file(data_path):
-            train_set = Dataset(data_path, params=params)
-            train_set.construct(Config(params))
-        else:
-            X, y, weight, query = self._load(data_path)
-            group = None
-            if query is not None:
-                group = query.astype(np.int64)
-            train_set = Dataset(X, label=y, weight=weight, group=group,
-                                params=params)
-            if str(resolved.get("save_binary", "")).lower() in ("true", "1"):
+        with wd.stage_scope("ingest train data (%s)" % data_path):
+            if BinnedDataset.is_binary_file(data_path):
+                train_set = Dataset(data_path, params=params)
                 train_set.construct(Config(params))
-                train_set.save_binary(data_path + ".bin")
+            else:
+                X, y, weight, query = self._load(data_path)
+                group = None
+                if query is not None:
+                    group = query.astype(np.int64)
+                train_set = Dataset(X, label=y, weight=weight, group=group,
+                                    params=params)
+                if str(resolved.get("save_binary", "")).lower() in ("true", "1"):
+                    train_set.construct(Config(params))
+                    train_set.save_binary(data_path + ".bin")
         valid_sets = []
         valid_names = []
         num_features = train_set.binned.num_features
         for i, vp in enumerate(valid_paths):
-            vX, vy, vweight, vquery = self._load(vp, num_features=num_features)
-            vgroup = vquery.astype(np.int64) if vquery is not None else None
-            valid_sets.append(train_set.create_valid(vX, label=vy, weight=vweight,
-                                                     group=vgroup))
-            valid_names.append(os.path.basename(vp))
+            with wd.stage_scope("ingest valid data (%s)" % vp):
+                vX, vy, vweight, vquery = self._load(vp,
+                                                     num_features=num_features)
+                vgroup = vquery.astype(np.int64) if vquery is not None else None
+                valid_sets.append(train_set.create_valid(
+                    vX, label=vy, weight=vweight, group=vgroup))
+                valid_names.append(os.path.basename(vp))
+        wd.done()
 
         callbacks = []
+        if resume_state is not None:
+            callbacks.append(resilience.make_resume_callback(resume_state,
+                                                             log=Log))
         if snapshot_freq > 0:
             def snapshot(env):
-                if (env.iteration + 1) % snapshot_freq == 0:
-                    env.model.save_model("%s.snapshot_iter_%d"
-                                         % (output_model, env.iteration + 1))
+                # absolute iteration clock (model.current_iteration), so a
+                # resumed run writes the SAME snapshot schedule and names
+                # as an uninterrupted one
+                total = int(env.model.current_iteration())
+                if total % snapshot_freq == 0:
+                    resilience.write_snapshot(env.model, output_model,
+                                              total_iter=total,
+                                              retention=snapshot_retention,
+                                              log=Log)
             callbacks.append(snapshot)
         evals: Dict = {}
         callbacks.append(record_evaluation(evals))
 
-        booster = engine_train(
-            params, train_set, num_boost_round=num_rounds,
-            valid_sets=valid_sets or None, valid_names=valid_names or None,
-            init_model=input_model, callbacks=callbacks,
-            early_stopping_rounds=early_stopping if early_stopping > 0 else None,
-            verbose_eval=int(params.get("metric_freq", 1)))
-        booster.save_model(output_model)
+        # preemption guard: SIGTERM/SIGINT write a final checksummed
+        # snapshot at the next iteration boundary, then exit cleanly
+        guard = resilience.PreemptionGuard(output_model,
+                                           retention=snapshot_retention,
+                                           log=Log)
+        callbacks.append(guard.callback)
+        remaining = num_rounds - (resume_state["total_iter"]
+                                  if resume_state is not None else 0)
+        try:
+            with guard:
+                booster = engine_train(
+                    params, train_set, num_boost_round=remaining,
+                    valid_sets=valid_sets or None,
+                    valid_names=valid_names or None,
+                    init_model=input_model, callbacks=callbacks,
+                    early_stopping_rounds=early_stopping
+                    if early_stopping > 0 else None,
+                    verbose_eval=int(params.get("metric_freq", 1)))
+        except resilience.TrainingPreempted as e:
+            Log.warning("Training preempted by signal %d at iteration %d; "
+                        "snapshot %s written — rerun with resume=true to "
+                        "continue", e.signum, e.iteration, e.snapshot)
+            return
+        with wd.stage_scope("save model (%s)" % output_model):
+            booster.save_model(output_model)
+        wd.done()
         Log.info("Finished training, model saved to %s", output_model)
 
     def predict(self) -> None:

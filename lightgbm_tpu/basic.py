@@ -478,12 +478,23 @@ class Booster:
     def update(self, train_set=None, fobj=None) -> bool:
         if self._engine is None:
             raise LightGBMError("Cannot update a loaded Booster")
+        from .runtime import resilience
+        # fault-injection seam (LGBM_TPU_FAULT=die_at_iter:K /
+        # sigterm_at_iter:K): the iteration boundary is where an abrupt
+        # death or a preemption notice lands in testing
+        resilience.maybe_die_or_preempt(self)
         self._model_version = getattr(self, "_model_version", 0) + 1
-        if fobj is not None:
-            grad, hess = fobj(self._engine.raw_train_score().reshape(-1),
-                              self.train_set)
-            return self._engine.train_one_iter(grad, hess)
-        return self._engine.train_one_iter()
+        guard = resilience.SentinelGuard(self._engine)
+        try:
+            if fobj is not None:
+                grad, hess = fobj(self._engine.raw_train_score().reshape(-1),
+                                  self.train_set)
+                return self._engine.train_one_iter(grad, hess)
+            return self._engine.train_one_iter()
+        except resilience.NonFiniteDetected as e:
+            # abort re-raises naming the iteration; rollback restores the
+            # pre-iteration scores, drops the trees and reports finished
+            return guard.handle(e, Log)
 
     def rollback_one_iter(self) -> "Booster":
         self._model_version = getattr(self, "_model_version", 0) + 1

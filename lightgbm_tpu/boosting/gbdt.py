@@ -25,6 +25,8 @@ from ..io.dataset import BinnedDataset
 from ..models.gbdt_model import GBDTModel
 from ..models.tree import Tree
 from ..ops.split import FeatureMeta
+from ..runtime import resilience
+from ..utils import compat
 from ..utils.log import Log
 from ..utils.random import Random, partition_seed
 from ..utils.timer import PhaseTimer
@@ -186,7 +188,7 @@ def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
             in_specs = (P(ax, None), P(ax, None), P(None))
             if quantized:
                 in_specs = in_specs + (P(),)
-            grower = jax.jit(jax.shard_map(
+            grower = jax.jit(compat.shard_map(
                 grow, mesh=mesh,
                 in_specs=in_specs,
                 out_specs=(tree_specs, P(ax, None), P(ax, None)),
@@ -343,7 +345,7 @@ class _FastState:
                 return build_block(bins_all[perm], label_f, weight_f,
                                    vmask_f, score_f, jnp.int32(0))
 
-            build = jax.jit(jax.shard_map(
+            build = jax.jit(compat.shard_map(
                 build_local_feat, mesh=mesh,
                 in_specs=(PS(ax, None), PS(), PS(), PS(), PS(None, None)),
                 out_specs=PS(ax, None), check_vma=False))
@@ -356,7 +358,7 @@ class _FastState:
                 return build_block(bins_l, label_l, weight_l, vmask_l,
                                    score_l, my * n_loc)
 
-            build = jax.jit(jax.shard_map(
+            build = jax.jit(compat.shard_map(
                 build_local, mesh=mesh,
                 in_specs=(PS(None, ax), PS(ax), PS(ax), PS(ax),
                           PS(None, ax)),
@@ -602,7 +604,7 @@ class _FastState:
                 return _tree_add_body(payload_l, tree_dev, leaf_scaled, k,
                                       col_of)
 
-            payload_tree_add = jax.jit(jax.shard_map(
+            payload_tree_add = jax.jit(compat.shard_map(
                 _pta_local, mesh=mesh,
                 in_specs=(PS(ax_f, None), PS(), PS(), PS()),
                 out_specs=PS(ax_f, None), check_vma=False),
@@ -972,6 +974,16 @@ class GBDT:
         # validation sets
         self.valid_sets: List[Tuple[str, BinnedDataset, jax.Array, jax.Array, List]] = []
 
+        # non-finite sentinel (runtime/resilience.py): screen every
+        # iteration's fetched tree outputs for NaN/inf under the
+        # configurable abort-vs-rollback policy.  'off' costs nothing.
+        self._sentinel_policy = str(getattr(config, "sentinel_nonfinite",
+                                            "off") or "off").lower()
+        if self._sentinel_policy not in ("off", "abort", "rollback"):
+            Log.warning("sentinel_nonfinite=%s is not off|abort|rollback; "
+                        "using abort", self._sentinel_policy)
+            self._sentinel_policy = "abort"
+
         # deterministic per-subsystem RNG (bagging / feature sampling)
         seed = int(getattr(config, "seed", 0) or 0)
         self.bagging_rng = Random(partition_seed(seed + int(config.bagging_seed), 1))
@@ -1086,7 +1098,7 @@ class GBDT:
         out_specs["leaf_id"] = leaf_id_spec
         # check_vma off: every shard carries the replicated winner through
         # the fori_loop, which the varying-axes tracker cannot prove
-        self.grower = jax.jit(jax.shard_map(
+        self.grower = jax.jit(compat.shard_map(
             grow_core, mesh=self.mesh,
             in_specs=(bins_spec, vals_spec, fmask_spec),
             out_specs=out_specs, check_vma=False))
@@ -1639,6 +1651,10 @@ class GBDT:
         """Fetch grower output, assemble the host Tree (reference numbering),
         apply shrinkage and first-tree bias (gbdt.cpp:450-456)."""
         host = _fetch_packed(out)
+        # the outputs are on host anyway — the non-finite sentinel rides
+        # this fetch for free (raises NonFiniteDetected under
+        # sentinel_nonfinite=abort|rollback; Booster.update arbitrates)
+        resilience.sentinel_check(self, host)
         nl = int(host["num_leaves"])
         # legacy masked grower reports no round counter: its loop is one
         # round per split by construction

@@ -113,6 +113,11 @@ class Config:
         # HIST_QUANT_VALIDATED (docs/PERFORMANCE.md expiry table).
         self.gradient_quantization = False
         self.gradient_quant_dtype = "int16"  # int16 | int8
+        # non-finite sentinel policy (runtime/resilience.py, ISSUE 4):
+        # off | abort | rollback — screen each iteration's tree outputs
+        # for NaN/inf; abort raises naming the iteration, rollback
+        # restores the pre-iteration scores and stops training cleanly.
+        self.sentinel_nonfinite = "off"
         self._user_keys: set = set()
         self.raw_params: Dict[str, Any] = {}
         if params:
@@ -182,7 +187,6 @@ class Config:
         "gpu_platform_id": "no OpenCL on TPU; the visible TPU chips are used",
         "gpu_device_id": "no OpenCL on TPU; the visible TPU chips are used",
         "gpu_use_dp": "histogram accumulation is always f32 on the MXU",
-        "time_out": "XLA's transport owns connection timeouts",
         "is_enable_sparse":
             "EFB-then-densify policy is always used (docs/STORAGE.md)",
         "sparse_threshold":

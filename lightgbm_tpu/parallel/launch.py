@@ -25,8 +25,10 @@ from __future__ import annotations
 
 import os
 import socket
+import time
 from typing import List, Optional, Tuple
 
+from ..runtime import resilience
 from ..utils.log import Log
 
 __all__ = ["parse_machine_list", "resolve_rank", "init_distributed",
@@ -132,10 +134,61 @@ def _already_initialized() -> bool:
         return _dist.global_state.client is not None
 
 
+#: bounded bring-up (reference parity: linkers_socket.cpp retries its
+#: connects under config.time_out rather than blocking forever).  Both
+#: are env-overridable for tests and flaky-fabric tuning.
+_INIT_TIMEOUT_S = int(os.environ.get("LIGHTGBM_TPU_INIT_TIMEOUT", "120"))
+_INIT_ATTEMPTS = int(os.environ.get("LIGHTGBM_TPU_INIT_ATTEMPTS", "3"))
+
+
+def _initialize_with_retry(coord: str, num_processes: int, rank: int,
+                           timeout_s: int, attempts: int) -> None:
+    """`jax.distributed.initialize` under a per-attempt initialization
+    timeout and bounded jittered-backoff retry.  The terminal error NAMES
+    the coordinator address and this process's rank — the two facts a
+    human debugging a dead bring-up needs first — instead of hanging
+    indefinitely on a silent socket."""
+    import inspect
+    import jax
+    kwargs = {}
+    try:
+        sig = inspect.signature(jax.distributed.initialize)
+        if "initialization_timeout" in sig.parameters:
+            kwargs["initialization_timeout"] = max(int(timeout_s), 1)
+    except (TypeError, ValueError):
+        pass
+    delays = resilience.backoff_delays(attempts, base=2.0, cap=15.0,
+                                       seed=rank)
+    last: Optional[BaseException] = None
+    for a in range(max(attempts, 1)):
+        try:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=num_processes,
+                                       process_id=rank, **kwargs)
+            return
+        except Exception as e:   # connect refusals, timeouts, DNS
+            last = e
+            if a < len(delays):
+                Log.warning(
+                    "jax.distributed.initialize attempt %d/%d failed "
+                    "(coordinator %s, rank %d/%d): %s — retrying in %.1fs",
+                    a + 1, attempts, coord, rank, num_processes, e,
+                    delays[a])
+                time.sleep(delays[a])
+    raise RuntimeError(
+        "jax.distributed.initialize failed after %d attempt(s): "
+        "coordinator %s unreachable from rank %d of %d (last error: %s). "
+        "Check that the coordinator host is up, the port is open, and "
+        "every machine-list entry resolves." % (
+            max(attempts, 1), coord, rank, num_processes, last)) from last
+
+
 def init_distributed(machines: str = None,
                      machine_list_filename: str = None,
                      local_listen_port: int = 12400,
-                     node_rank: Optional[int] = None) -> int:
+                     node_rank: Optional[int] = None,
+                     timeout_s: Optional[int] = None,
+                     attempts: Optional[int] = None) -> int:
     """Bring up JAX multi-host from a reference-style cluster config and
     return this process's rank.  The FIRST machine in the list acts as
     the JAX coordinator (any consistent choice works — the reference
@@ -162,9 +215,10 @@ def init_distributed(machines: str = None,
         return 0
     rank = resolve_rank(mlist, node_rank, local_listen_port)
     coord = "%s:%d" % mlist[0]
-    jax.distributed.initialize(coordinator_address=coord,
-                               num_processes=len(mlist),
-                               process_id=rank)
+    _initialize_with_retry(
+        coord, len(mlist), rank,
+        timeout_s=_INIT_TIMEOUT_S if timeout_s is None else timeout_s,
+        attempts=_INIT_ATTEMPTS if attempts is None else attempts)
     Log.info("jax.distributed up: %d processes, rank %d, coordinator %s; "
              "%d devices visible", len(mlist), rank, coord,
              len(jax.devices()))
@@ -208,6 +262,11 @@ def maybe_init_distributed(cfg) -> Optional[int]:
     if num_machines <= 1:
         return None   # reference is_parallel gate: the local path
     port = int(get("local_listen_port", 12400) or 12400)
+    # reference time_out is the socket-connect budget in MINUTES
+    # (config.h); it now bounds jax.distributed bring-up the same way
+    tmin = get("time_out", None)
+    timeout_s = int(float(tmin) * 60) if tmin not in (None, "") else None
     return init_distributed(machines=machines or None,
                             machine_list_filename=mfile or None,
-                            local_listen_port=port)
+                            local_listen_port=port,
+                            timeout_s=timeout_s)

@@ -1,0 +1,10 @@
+"""Execution-runtime services: fault tolerance, watchdogs, snapshot/resume.
+
+This package holds the machinery that keeps long runs alive on flaky
+platforms — it deliberately imports neither jax nor any other heavy
+dependency at module scope, so the hermetic dryrun bootstrap and the CLI
+entry can use it before (or instead of) binding an accelerator platform.
+"""
+from . import resilience  # noqa: F401
+
+__all__ = ["resilience"]

@@ -1,0 +1,45 @@
+"""jax API drift shims.
+
+The training engine targets the modern `jax.shard_map` surface
+(check_vma kwarg); older jax releases (<= 0.4.x) only ship
+`jax.experimental.shard_map.shard_map` with the `check_rep` spelling of
+the same knob.  Running on whatever jax the host provides is part of the
+degrade-don't-break posture (ISSUE 4): resolve the drift once here
+instead of letting every mesh code path die of AttributeError.
+"""
+from __future__ import annotations
+
+import jax
+
+_MODERN = hasattr(jax, "shard_map")
+if _MODERN:
+    _shard_map = jax.shard_map
+else:  # pragma: no cover - exercised on jax <= 0.4.x hosts only
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+              check_vma=None, **kwargs):
+    """`jax.shard_map` with the check_vma/check_rep kwarg translated to
+    whatever this jax release understands.
+
+    On legacy jax the replication checker is additionally DISABLED by
+    default: its scan-carry tracking mis-flags valid programs (jax's own
+    error message prescribes check_rep=False as the workaround), and the
+    checker is purely advisory — it validates replication annotations,
+    it never changes the computed values."""
+    if check_vma is not None:
+        kwargs["check_vma" if _MODERN else "check_rep"] = check_vma
+    elif not _MODERN:
+        kwargs["check_rep"] = False
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)
+
+
+def pvary(x, axis_name):
+    """`lax.pvary` (mark a value device-varying for the modern
+    replication checker); releases without it have no VMA tracking, so
+    identity is exactly right there."""
+    from jax import lax
+    fn = getattr(lax, "pvary", None)
+    return fn(x, axis_name) if fn is not None else x

@@ -141,7 +141,7 @@ def test_inline_machines_without_explicit_count_still_derives():
     called = {}
 
     def fake_init(machines=None, machine_list_filename=None,
-                  local_listen_port=12400):
+                  local_listen_port=12400, **kwargs):
         called["machines"] = machines
         return 0
 

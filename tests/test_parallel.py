@@ -382,9 +382,10 @@ def test_dryrun_stage_lines_carry_wallclock(capsys):
 
     import __graft_entry__ as g
 
-    wd = g._StageWatchdog(seconds=30, hard=False)
+    wd = g._make_watchdog(seconds=30, hard=False)
     wd("probe stage")
     wd.done()
     out = capsys.readouterr().out
     assert re.search(r"^\[\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\] "
-                     r"dryrun stage: probe stage$", out, re.M), out
+                     r"dryrun stage: probe stage \(budget 30s\)$", out,
+                     re.M), out
