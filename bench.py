@@ -100,6 +100,9 @@ def _phase_times_impl(bst, reps, state=None):
     fs = getattr(eng, "_fast", None)
     if fs is None or not getattr(eng, "_fast_active", False):
         return {}
+    # the piecewise stages append trees inline — deferred assemblies from
+    # pipelined update() calls must land first (strict ordering)
+    eng.flush()
     import jax.numpy as jnp
     fmask = eng._feature_sample()
     lr = jnp.float32(eng.shrinkage_rate)
@@ -416,6 +419,7 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
     import lightgbm_tpu as lgb
     from lightgbm_tpu.ops import segment as lseg
     from lightgbm_tpu.runtime import resilience
+    from lightgbm_tpu.runtime import syncs
 
     # every bench stage runs under a named soft deadline: a hang dies as
     # a StageTimeout naming its stage (caught by main()'s rung handler,
@@ -455,20 +459,80 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
     # warm-up: binning + compile + first iterations
     for _ in range(3):
         bst.update()
+    bst._engine.flush()
     stage("warmup done")
+    # blocking-sync audit over the measured window (ISSUE 5): total and
+    # tree->tree-critical-path host fetches per iteration ride the JSON
+    sync0 = syncs.snapshot()
     t0 = time.time()
     for _ in range(measure_iters):
         bst.update()
+    bst._engine.flush()
     dt = time.time() - t0
+    sync_audit = syncs.delta(sync0)
+    host_syncs = {
+        "per_iter_total": round(sync_audit["total"] / measure_iters, 3),
+        "per_iter_critical_path": round(
+            sync_audit["critical_path"] / measure_iters, 3),
+        "by_label": sync_audit["by_label"],
+        "pipeline_depth": bst._engine._pipeline_depth,
+    }
     iters_per_sec = measure_iters / dt
-    stage("measured %.4f s/iter" % (dt / measure_iters))
+    stage("measured %.4f s/iter (%s critical-path syncs/iter)"
+          % (dt / measure_iters, host_syncs["per_iter_critical_path"]))
 
     # predict BEFORE the piecewise phase diagnostics: the phases section
     # re-dispatches the standalone stage programs (extra compiles); if it
     # takes the worker down, the headline result must already be in hand
     pred = bst.predict(Xte, device=True)
     test_auc = float(auc_score(yte, pred))
+    headline_iters = bst.current_iteration()
     stage("predict+auc done")
+
+    # BENCH_PIPELINE A/B (=0 skips): the SAME booster re-measured with the
+    # dispatch pipeline off — compiled programs are shared, so the delta
+    # is pure pipeline effect (per-tree blocking fetch + host assembly on
+    # vs off the critical path).  Guarded: never fatal to the headline.
+    pipeline_rec = None
+    if os.environ.get("BENCH_PIPELINE", "1") != "0":
+        try:
+            eng_ab = bst._engine
+            depth_on = eng_ab._pipeline_depth
+            eng_ab.flush()
+            eng_ab._pipeline_depth = 0
+            sync0 = syncs.snapshot()
+            tp0 = time.time()
+            for _ in range(measure_iters):
+                bst.update()
+            dt_off = time.time() - tp0
+            d_off = syncs.delta(sync0)
+            eng_ab._pipeline_depth = depth_on
+            pipeline_rec = {
+                "pipeline_depth_on": depth_on,
+                "sec_per_iter_on": round(dt / measure_iters, 4),
+                "sec_per_iter_off": round(dt_off / measure_iters, 4),
+                "speedup_on_vs_off": round(dt_off / dt, 4),
+                "host_syncs_per_iter_on": host_syncs["per_iter_total"],
+                "host_syncs_per_iter_off": round(
+                    d_off["total"] / measure_iters, 3),
+                "critical_path_syncs_per_iter_on":
+                    host_syncs["per_iter_critical_path"],
+                "critical_path_syncs_per_iter_off": round(
+                    d_off["critical_path"] / measure_iters, 3),
+                "note": "on an in-process CPU backend the per-tree fetch "
+                        "is a cheap memcpy, so the A/B mostly measures "
+                        "the overlapped host assembly; the ~90 ms/tree "
+                        "round trip the pipeline hides is a "
+                        "tunneled/remote-TPU cost (BENCH_r05)",
+            }
+            stage("pipeline A/B done (%.4f on vs %.4f off s/iter)"
+                  % (dt / measure_iters, dt_off / measure_iters))
+        except Exception as e:
+            pipeline_rec = {"error": "%s: %s" % (type(e).__name__, e),
+                            "note": "pipeline A/B failed; headline result "
+                                    "above is unaffected"}
+            stage("pipeline A/B FAILED (diagnostics only)")
+
     if n_rows > 5_000_000 and os.environ.get("BENCH_PHASES") != "1":
         # the piecewise section compiles the standalone stage programs; a
         # full-scale run crashed the tunneled TPU worker twice at/after
@@ -547,6 +611,12 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
                                    "above is unaffected"}
             stage("predict bench FAILED (diagnostics only)")
 
+    if isinstance(phases, dict):
+        # the sync-audit counters ride the default phases output so every
+        # bench record carries the blocking-fetch split next to the wall
+        # split (ISSUE 5 satellite)
+        phases["host_sync_audit"] = host_syncs
+
     eng = bst._engine
     result = {
         "metric": "boosting iters/sec, Higgs-scale binary (%.1fM x %d, %d leaves, %d bins)"
@@ -559,7 +629,8 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
                         if (n_feat, max_bin) == (28, 255) else None),
         "sec_per_iter": round(dt / measure_iters, 4),
         "n_rows": n_rows,
-        "held_out_auc_at_%d" % bst.current_iteration(): round(test_auc, 6),
+        "host_syncs_per_iter": host_syncs,
+        "held_out_auc_at_%d" % headline_iters: round(test_auc, 6),
         "reference_real_higgs_auc_at_500": REFERENCE_HIGGS_AUC,
         "hist_engine": lseg.resolve_impl("auto", n_feat, max_bin + 1),
         "platform": __import__("jax").default_backend(),
@@ -586,6 +657,8 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
     if deg:
         # the pre-fallback process recorded WHY this run landed on CPU
         result["degradation_event"] = json.loads(deg)
+    if pipeline_rec is not None:
+        result["pipeline"] = pipeline_rec
     if predict_rec is not None:
         result["predict"] = predict_rec
     if hist_quant is not None:

@@ -51,6 +51,12 @@ struct Tree {
   std::vector<double> leaf_value;
   std::vector<int64_t> cat_boundaries;
   std::vector<uint32_t> cat_threshold;
+  // importance/dump extras (empty-tolerant: old model strings without
+  // these lines still load and predict)
+  std::vector<double> split_gain;
+  std::vector<double> internal_value;
+  std::vector<int64_t> internal_count;
+  std::vector<int64_t> leaf_count;
 
   bool CategoricalDecision(double fval, int node) const {
     int mt = (decision_type[node] >> 2) & 3;
@@ -116,6 +122,7 @@ struct Model {
   double sigmoid = 1.0;
   Transform transform = Transform::kNone;
   std::string objective;
+  std::vector<std::string> feature_names;
   std::vector<Tree> trees;
   std::string text;  // original model text, for SaveModel
 
@@ -203,6 +210,10 @@ bool ParseModel(const std::string& text, Model* m, std::string* err) {
         t.cat_boundaries = ParseArray<int64_t>(get("cat_boundaries"));
         t.cat_threshold = ParseArray<uint32_t>(get("cat_threshold"));
       }
+      t.split_gain = ParseArray<double>(get("split_gain"));
+      t.internal_value = ParseArray<double>(get("internal_value"));
+      t.internal_count = ParseArray<int64_t>(get("internal_count"));
+      t.leaf_count = ParseArray<int64_t>(get("leaf_count"));
     }
     m->trees.push_back(std::move(t));
     in_tree = false;
@@ -231,6 +242,7 @@ bool ParseModel(const std::string& text, Model* m, std::string* err) {
       m->num_tree_per_iteration = atoi(val.c_str());
     else if (key == "max_feature_idx") m->max_feature_idx = atoi(val.c_str());
     else if (key == "objective") m->objective = val;
+    else if (key == "feature_names") m->feature_names = SplitWs(val);
     else if (key == "average_output") m->average_output = true;
   }
   if (!finish_tree()) return false;
@@ -436,6 +448,184 @@ int LGBM_BoosterSaveModelToString(BoosterHandle handle, int num_iteration,
   *out_len = static_cast<int64_t>(m->text.size()) + 1;
   if (buffer_len >= *out_len && out_str != nullptr) {
     std::memcpy(out_str, m->text.c_str(), m->text.size() + 1);
+  }
+  return 0;
+}
+
+int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
+                                  int importance_type, double* out_results) {
+  ModelRef ref(handle);
+  Model* m = ref.m;
+  if (m == nullptr) return -1;
+  if (importance_type != C_API_FEATURE_IMPORTANCE_SPLIT &&
+      importance_type != C_API_FEATURE_IMPORTANCE_GAIN)
+    return Fail("unsupported importance_type " +
+                std::to_string(importance_type));
+  int nfeat = m->max_feature_idx + 1;
+  std::fill(out_results, out_results + nfeat, 0.0);
+  int iters = m->NumIterations();
+  if (num_iteration > 0 && num_iteration < iters) iters = num_iteration;
+  int used_trees = iters * m->num_tree_per_iteration;
+  for (int t = 0; t < used_trees; ++t) {
+    const Tree& tr = m->trees[t];
+    int ni = tr.num_leaves - 1;
+    for (int n = 0; n < ni; ++n) {
+      int f = tr.split_feature[n];
+      if (f < 0 || f >= nfeat) continue;
+      if (importance_type == C_API_FEATURE_IMPORTANCE_GAIN) {
+        // gbdt.cpp FeatureImportance: negative recorded gains clamp to 0
+        double g = n < static_cast<int>(tr.split_gain.size())
+                       ? tr.split_gain[n] : 0.0;
+        out_results[f] += std::max(g, 0.0);
+      } else {
+        out_results[f] += 1.0;
+      }
+    }
+  }
+  return 0;
+}
+
+namespace {
+
+void JsonEscape(const std::string& s, std::string* out) {
+  for (char c : s) {
+    if (c == '"' || c == '\\') {
+      out->push_back('\\');
+      out->push_back(c);
+    } else if (static_cast<unsigned char>(c) < 0x20) {
+      char buf[8];
+      std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+      *out += buf;
+    } else {
+      out->push_back(c);
+    }
+  }
+}
+
+void JsonNum(double v, std::string* out) {
+  if (std::isnan(v)) { *out += "null"; return; }
+  char buf[40];
+  std::snprintf(buf, sizeof(buf), "%.17g", v);
+  *out += buf;
+}
+
+// recursive node dump mirroring the Python binding's Tree._node_to_json
+// (models/tree.py) so both dumps share one schema
+void DumpNode(const Tree& t, int index, std::string* out) {
+  if (index < 0) {
+    int leaf = ~index;
+    *out += "{\"leaf_index\":" + std::to_string(leaf) + ",\"leaf_value\":";
+    JsonNum(t.leaf_value[leaf], out);
+    int64_t cnt = leaf < static_cast<int>(t.leaf_count.size())
+                      ? t.leaf_count[leaf] : 0;
+    *out += ",\"leaf_count\":" + std::to_string(cnt) + "}";
+    return;
+  }
+  int dt = t.decision_type[index];
+  bool is_cat = (dt & kCategoricalMask) != 0;
+  static const char* kMissing[] = {"None", "Zero", "NaN", "NaN"};
+  *out += "{\"split_index\":" + std::to_string(index);
+  *out += ",\"split_feature\":" + std::to_string(t.split_feature[index]);
+  *out += ",\"split_gain\":";
+  JsonNum(index < static_cast<int>(t.split_gain.size())
+              ? t.split_gain[index] : 0.0, out);
+  *out += ",\"missing_type\":\"";
+  *out += kMissing[(dt >> 2) & 3];
+  *out += "\",\"default_left\":";
+  *out += (dt & kDefaultLeftMask) ? "true" : "false";
+  *out += ",\"internal_value\":";
+  JsonNum(index < static_cast<int>(t.internal_value.size())
+              ? t.internal_value[index] : 0.0, out);
+  int64_t icnt = index < static_cast<int>(t.internal_count.size())
+                     ? t.internal_count[index] : 0;
+  *out += ",\"internal_count\":" + std::to_string(icnt);
+  if (is_cat) {
+    int ci = static_cast<int>(t.threshold[index]);
+    *out += ",\"decision_type\":\"==\",\"threshold\":\"";
+    bool first = true;
+    if (ci + 1 < static_cast<int>(t.cat_boundaries.size())) {
+      for (int64_t w = t.cat_boundaries[ci]; w < t.cat_boundaries[ci + 1];
+           ++w) {
+        for (int b = 0; b < 32; ++b) {
+          if ((t.cat_threshold[w] >> b) & 1) {
+            if (!first) *out += "||";
+            first = false;
+            *out += std::to_string((w - t.cat_boundaries[ci]) * 32 + b);
+          }
+        }
+      }
+    }
+    *out += "\"";
+  } else {
+    *out += ",\"decision_type\":\"<=\",\"threshold\":";
+    JsonNum(t.threshold[index], out);
+  }
+  *out += ",\"left_child\":";
+  DumpNode(t, t.left_child[index], out);
+  *out += ",\"right_child\":";
+  DumpNode(t, t.right_child[index], out);
+  *out += "}";
+}
+
+}  // namespace
+
+int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int feature_importance_type,
+                          int64_t buffer_len, int64_t* out_len,
+                          char* out_str) {
+  (void)feature_importance_type;  // importances ride the dedicated entry
+  ModelRef ref(handle);
+  Model* m = ref.m;
+  if (m == nullptr) return -1;
+  int total_iter = m->NumIterations();
+  int start = std::max(0, std::min(start_iteration, total_iter));
+  int end = total_iter;
+  if (num_iteration > 0) end = std::min(start + num_iteration, total_iter);
+  int k = m->num_tree_per_iteration;
+
+  std::string js = "{\"name\":\"tree\",\"version\":\"v2\"";
+  js += ",\"num_class\":" + std::to_string(m->num_class);
+  js += ",\"num_tree_per_iteration\":" + std::to_string(k);
+  js += ",\"label_index\":0";
+  js += ",\"max_feature_idx\":" + std::to_string(m->max_feature_idx);
+  js += ",\"objective\":\"";
+  JsonEscape(m->objective, &js);
+  js += "\",\"average_output\":";
+  js += m->average_output ? "true" : "false";
+  js += ",\"feature_names\":[";
+  for (int f = 0; f <= m->max_feature_idx; ++f) {
+    if (f) js += ",";
+    js += "\"";
+    if (f < static_cast<int>(m->feature_names.size()))
+      JsonEscape(m->feature_names[f], &js);
+    else
+      js += "Column_" + std::to_string(f);
+    js += "\"";
+  }
+  js += "],\"tree_info\":[";
+  for (int t = start * k; t < end * k; ++t) {
+    if (t > start * k) js += ",";
+    const Tree& tr = m->trees[t];
+    js += "{\"tree_index\":" + std::to_string(t - start * k);
+    js += ",\"num_leaves\":" + std::to_string(tr.num_leaves);
+    js += ",\"num_cat\":" + std::to_string(tr.num_cat);
+    js += ",\"shrinkage\":";
+    JsonNum(tr.shrinkage, &js);
+    js += ",\"tree_structure\":";
+    if (tr.num_leaves <= 1) {
+      js += "{\"leaf_value\":";
+      JsonNum(tr.leaf_value.empty() ? 0.0 : tr.leaf_value[0], &js);
+      js += "}";
+    } else {
+      DumpNode(tr, 0, &js);
+    }
+    js += "}";
+  }
+  js += "]}";
+
+  *out_len = static_cast<int64_t>(js.size()) + 1;
+  if (buffer_len >= *out_len && out_str != nullptr) {
+    std::memcpy(out_str, js.c_str(), js.size() + 1);
   }
   return 0;
 }

@@ -36,6 +36,9 @@ typedef void* DatasetHandle;
 #define C_API_PREDICT_RAW_SCORE (1)
 #define C_API_PREDICT_LEAF_INDEX (2)
 
+#define C_API_FEATURE_IMPORTANCE_SPLIT (0)
+#define C_API_FEATURE_IMPORTANCE_GAIN (1)
+
 /* All functions return 0 on success, -1 on error (message via
  * LGBM_GetLastError). */
 
@@ -63,6 +66,25 @@ int LGBM_BoosterSaveModel(BoosterHandle handle, int num_iteration,
 int LGBM_BoosterSaveModelToString(BoosterHandle handle, int num_iteration,
                                   int64_t buffer_len, int64_t* out_len,
                                   char* out_str);
+
+/* JSON model dump (reference LGBM_BoosterDumpModel): same recursive
+ * tree_structure schema as the Python binding's dump_model().  Two-call
+ * protocol like SaveModelToString: *out_len is set to the required
+ * buffer size (incl. NUL); the string is written when buffer_len
+ * suffices.  num_iteration <= 0 dumps everything from start_iteration.
+ * feature_importance_type is accepted for signature parity (importances
+ * come from LGBM_BoosterFeatureImportance). */
+int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int feature_importance_type,
+                          int64_t buffer_len, int64_t* out_len,
+                          char* out_str);
+
+/* Per-feature importance (reference LGBM_BoosterFeatureImportance):
+ * importance_type C_API_FEATURE_IMPORTANCE_SPLIT counts splits, _GAIN
+ * sums non-negative split gains; out_results must hold num_feature
+ * doubles.  num_iteration <= 0 uses every iteration. */
+int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
+                                  int importance_type, double* out_results);
 
 /* Dense-matrix prediction.
  * data: nrow*ncol values, row- or column-major; data_type selects

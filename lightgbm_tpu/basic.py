@@ -415,6 +415,7 @@ class Booster:
     # -- pickling (reference basic.py Booster __getstate__/__setstate__:
     # serialize as the model string; the engine/device state is not portable)
     def __getstate__(self) -> Dict:
+        self._drain()
         state = self.__dict__.copy()
         state.pop("_engine", None)
         state.pop("train_set", None)
@@ -501,8 +502,18 @@ class Booster:
         self._engine.rollback_one_iter()
         return self
 
+    def _drain(self) -> None:
+        """Flush the engine's async dispatch pipeline so model reads see
+        every dispatched tree (no-op for loaded boosters and for an empty
+        pipeline).  Every Booster entry point that observes the model
+        object goes through here — `update()` may legitimately return
+        with up to `pipeline_depth` tree assemblies still in flight."""
+        if self._engine is not None:
+            self._engine.flush()
+
     def current_iteration(self) -> int:
         """Number of completed iterations (reference Booster method)."""
+        self._drain()
         return self._model.current_iteration
 
     def phase_timings(self):
@@ -535,6 +546,7 @@ class Booster:
         return self
 
     def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        self._drain()
         return float(self._model.trees[tree_id].leaf_value[leaf_id])
 
     def attr(self, key: str):
@@ -578,6 +590,7 @@ class Booster:
                        end_iteration: int = -1) -> "Booster":
         """Randomly permute tree order in [start, end) iterations
         (reference Booster.shuffle_models)."""
+        self._drain()
         k = self._model.num_tree_per_iteration
         total = self._model.current_iteration
         end = total if end_iteration <= 0 else min(end_iteration, total)
@@ -618,12 +631,14 @@ class Booster:
         return Booster(model_str=self.model_to_string())
 
     def num_trees(self) -> int:
+        self._drain()
         return self._model.num_total_trees
 
     # -- evaluation ----------------------------------------------------------
     def eval(self, data: Dataset, name: str, feval=None) -> List:
         """Evaluate the current model on an arbitrary Dataset
         (reference Booster.eval)."""
+        self._drain()
         data.construct(self.config)
         label = data.get_label()
         if isinstance(data.data, str):
@@ -666,6 +681,24 @@ class Booster:
                 out.append((name, mname, val, hib))
         return out
 
+    def eval_round(self, feval=None, include_train: bool = False):
+        """One evaluation round — (train results, valid results) — off a
+        SINGLE packed device fetch (engine.eval_all), so metric_freq=1
+        doesn't pay one D2H round trip per dataset.  Used by the train()
+        driver; eval_train/eval_valid keep the reference per-surface
+        behavior for direct callers."""
+        tr_res, va_res = self._engine.eval_all(include_train)
+        train_out = self._wrap_eval(tr_res, feval, "training") \
+            if include_train else []
+        valid_out = self._wrap_eval(va_res, None, None)
+        if feval is not None:
+            for i, (name, ds) in enumerate(self._valid_data):
+                raw = self._engine.raw_valid_score(i)
+                preds = raw[0] if raw.shape[0] == 1 else raw.reshape(-1)
+                mname, val, hib = feval(preds, ds)
+                valid_out.append((name, mname, val, hib))
+        return train_out, valid_out
+
     def _wrap_eval(self, results, feval, dataset_name):
         out = [(name, metric, val, hib) for (name, metric, val, hib) in results]
         if feval is not None:
@@ -688,6 +721,7 @@ class Booster:
         on device, shape-bucketed program cache, micro-batched transfer)
         instead of the exact f64 host traversal — the throughput path
         for large matrices."""
+        self._drain()
         X = _to_2d_float(data, getattr(self, "pandas_categorical", None))
         if pred_leaf:
             return self._model.predict_leaf_index(X, num_iteration)
@@ -748,6 +782,7 @@ class Booster:
 
         if self._objective is None:
             raise LightGBMError("Cannot refit with a custom objective")
+        self._drain()
         X = _to_2d_float(data, getattr(self, "pandas_categorical", None))
         label = np.asarray(label, dtype=np.float64).reshape(-1)
         n = X.shape[0]
@@ -771,11 +806,13 @@ class Booster:
         label_dev = jnp.asarray(label.astype(np.float32))
         scores = np.zeros((K, n), dtype=np.float64)
 
+        from .runtime import syncs
         for it in range(num_iters):
             g, h = objective.get_gradients_multi(
                 jnp.asarray(scores.astype(np.float32)), label_dev, w_dev)
-            g = np.asarray(jax.device_get(g), np.float64)
-            h = np.asarray(jax.device_get(h), np.float64)
+            g, h = syncs.device_get((g, h), label="refit_fetch")
+            g = np.asarray(g, np.float64)
+            h = np.asarray(h, np.float64)
             for k in range(K):
                 tree = model.trees[it * K + k]
                 nl = tree.num_leaves
@@ -813,6 +850,7 @@ class Booster:
 
     def save_model(self, filename: str, num_iteration: int = -1,
                    start_iteration: int = 0) -> "Booster":
+        self._drain()
         params = self.config.to_string() if self.config else ""
         self._model.save_model(filename, start_iteration, num_iteration,
                                parameters=params)
@@ -823,15 +861,18 @@ class Booster:
         return self
 
     def model_to_string(self, num_iteration: int = -1, start_iteration: int = 0) -> str:
+        self._drain()
         return self._model.save_model_to_string(start_iteration,
                                                 num_iteration) + \
             self._pandas_categorical_line()
 
     def dump_model(self, num_iteration: int = -1) -> Dict:
+        self._drain()
         return self._model.dump_model(num_iteration)
 
     def feature_importance(self, importance_type: str = "split",
                            iteration: int = -1) -> np.ndarray:
+        self._drain()
         return self._model.feature_importance(iteration, importance_type)
 
     def feature_name(self) -> List[str]:

@@ -13,6 +13,7 @@ to the host, where the reference-format model is assembled.
 from __future__ import annotations
 
 import functools
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -25,7 +26,7 @@ from ..io.dataset import BinnedDataset
 from ..models.gbdt_model import GBDTModel
 from ..models.tree import Tree
 from ..ops.split import FeatureMeta
-from ..runtime import resilience
+from ..runtime import resilience, syncs
 from ..utils import compat
 from ..utils.log import Log
 from ..utils.random import Random, partition_seed
@@ -35,6 +36,7 @@ from ..ops.bundle import (BundleMap, bundle_map_from_info, decode_bin,
                           identity_bundle_map)
 from .grower import GrowerConfig, make_tree_grower
 from .grower2 import PayloadCols, make_partitioned_grower
+from .pipeline import TreeAssembler
 
 K_EPSILON = 1e-15
 
@@ -86,10 +88,21 @@ _IDX_WIDE_THRESHOLD = 1 << 24
 #: radix of the split index
 _IDX_RADIX = 4096.0
 
-_PACK_CACHE: Dict = {}
+#: packed-fetch program cache, bounded so long-lived serving/training
+#: processes cycling through many output specs (different num_leaves,
+#: grower variants, eval-round shapes) cannot grow it without limit;
+#: LRU eviction — steady-state training uses one or two specs
+_PACK_CACHE: "OrderedDict" = OrderedDict()
+_PACK_CACHE_MAX = 64
 
 
-def _fetch_packed(out: Dict) -> Dict[str, np.ndarray]:
+def _pack_cache_put(cache: "OrderedDict", key, entry) -> None:
+    cache[key] = entry
+    while len(cache) > _PACK_CACHE_MAX:
+        cache.popitem(last=False)
+
+
+def _fetch_packed(out: Dict, label: str = "tree_fetch") -> Dict[str, np.ndarray]:
     """device_get of the grower's (small) outputs in ONE transfer.
 
     A tunneled/remote TPU pays a full round trip per fetched array;
@@ -114,14 +127,21 @@ def _fetch_packed(out: Dict) -> Dict[str, np.ndarray]:
                 [o[k].astype(jnp.float32).reshape(-1) for k in keys])
 
         entry = (keys, shapes, dtypes, offs, pack)
-        _PACK_CACHE[spec] = entry
+        _pack_cache_put(_PACK_CACHE, spec, entry)
+    else:
+        _PACK_CACHE.move_to_end(spec)
     keys, shapes, dtypes, offs, pack = entry
-    flat = np.asarray(jax.device_get(pack(out)))
+    flat = np.asarray(syncs.device_get(pack(out), label=label))
     host = {}
     for i, k in enumerate(keys):
         a = flat[offs[i]:offs[i + 1]].reshape(shapes[k])
         host[k] = a if dtypes[k] == "float32" else a.astype(dtypes[k])
     return host
+
+
+#: eval-round pack cache (same pattern/bound as _PACK_CACHE): one jitted
+#: flatten+concat program per tuple-of-shapes of the round's score arrays
+_EVAL_PACK_CACHE: "OrderedDict" = OrderedDict()
 
 
 #: grower2 tree-dict fields that are replicated in value across a mesh
@@ -676,25 +696,46 @@ class _FastState:
 
     def host_idx(self) -> np.ndarray:
         """Integer original-row indices of every payload row (host)."""
-        idx = np.asarray(jax.device_get(
-            self.payload[:, self.idx_col])).astype(np.int64)
+        idx = np.asarray(syncs.device_get(
+            self.payload[:, self.idx_col], label="score_fetch")) \
+            .astype(np.int64)
         if self.wide_idx:
-            hi = np.asarray(jax.device_get(
-                self.payload[:, self.idxhi_col])).astype(np.int64)
+            hi = np.asarray(syncs.device_get(
+                self.payload[:, self.idxhi_col],
+                label="score_fetch")).astype(np.int64)
             idx = idx + hi * int(_IDX_RADIX)
         return idx
 
-    def raw_scores(self) -> np.ndarray:
-        """[K, n_pad] scores in ORIGINAL row order (host).  Guard rows
-        carry the dead-slot index and are dropped."""
-        h = np.asarray(jax.device_get(
-            self.payload[:, self.idx_col:self.score0 + self.K]))
-        idx = (self.host_idx() if self.wide_idx
-               else h[:, 0].astype(np.int64))
+    def score_cols_device(self) -> List[jax.Array]:
+        """Device views whose host fetch reconstructs the original-order
+        scores: the contiguous [idx | score_0..score_{K-1}] column block,
+        plus the radix-hi index column on the wide layout.  Exposed so an
+        eval round can fold them into ONE packed transfer."""
+        cols = [self.payload[:, self.idx_col:self.score0 + self.K]]
+        if self.wide_idx:
+            cols.append(self.payload[:, self.idxhi_col])
+        return cols
+
+    def scores_from_host(self, h: np.ndarray,
+                         hi: Optional[np.ndarray] = None) -> np.ndarray:
+        """[K, n_pad] ORIGINAL-order scores from the fetched column block
+        (and radix-hi column on the wide layout).  Guard rows carry the
+        dead-slot index and are dropped."""
+        idx = h[:, 0].astype(np.int64)
+        if self.wide_idx:
+            idx = idx + hi.astype(np.int64) * int(_IDX_RADIX)
         keep = idx < self.n_pad
         out = np.zeros((self.K, self.n_pad), np.float32)
         out[:, idx[keep]] = h[keep, 1:1 + self.K].T
         return out
+
+    def raw_scores(self) -> np.ndarray:
+        """[K, n_pad] scores in ORIGINAL row order (host)."""
+        host = syncs.device_get(self.score_cols_device(),
+                                label="score_fetch")
+        h = np.asarray(host[0])
+        hi = np.asarray(host[1]) if self.wide_idx else None
+        return self.scores_from_host(h, hi)
 
 
 def _feature_meta_device(ds: BinnedDataset) -> FeatureMeta:
@@ -751,6 +792,17 @@ def _make_decision_body(tree_dev, meta: FeatureMeta, bmap: BundleMap,
         return jnp.where(is_leaf, nd, child)
 
     return body
+
+
+def _mark_critical_path(fn):
+    """Run `fn` under the sync-audit's tree->tree critical-path marker:
+    any blocking host fetch inside it is a pipeline stall and counts
+    against the `host_syncs_per_iter.critical_path` pin."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with syncs.critical_path():
+            return fn(*args, **kwargs)
+    return wrapped
 
 
 @functools.partial(jax.jit, static_argnames=("depth_iters", "k"))
@@ -984,6 +1036,31 @@ class GBDT:
                         "using abort", self._sentinel_policy)
             self._sentinel_policy = "abort"
 
+        # async boosting pipeline (pipeline_depth, ISSUE 5): how many trees
+        # the device may run ahead of host Tree assembly on the fused fast
+        # path.  0 = synchronous classic loop; 1 (default) overlaps tree
+        # t's packed D2H fetch + host assembly with tree t+1's device
+        # compute; 2 runs two trees ahead.  The legacy/profiled/renew/RF
+        # paths always run synchronously (honest fallback), and an armed
+        # non-finite sentinel disables the pipeline — its abort/rollback
+        # contract screens every iteration's outputs before the next one
+        # is dispatched.
+        self._pipeline_depth = max(0, min(
+            int(getattr(config, "pipeline_depth", 1) or 0), 8))
+        if self._sentinel_policy != "off" and self._pipeline_depth > 0:
+            Log.info("sentinel_nonfinite=%s: the dispatch pipeline is "
+                     "disabled so each iteration's tree outputs are "
+                     "screened before the next dispatch",
+                     self._sentinel_policy)
+        self._assembler: Optional[TreeAssembler] = None
+        #: engine-run iteration whose trees ALL failed to split, observed
+        #: by the assembler thread after later iterations were already
+        #: dispatched; flush() rolls the over-dispatch back
+        self._pipe_stop_iter: Optional[int] = None
+        self._pipe_k_seen = 0
+        self._pipe_any_split = False
+        self._in_flush = False
+
         # deterministic per-subsystem RNG (bagging / feature sampling)
         seed = int(getattr(config, "seed", 0) or 0)
         self.bagging_rng = Random(partition_seed(seed + int(config.bagging_seed), 1))
@@ -1164,9 +1241,110 @@ class GBDT:
                 # payload's index column switches to the radix-split layout
                 and self.train_set.num_data_padded < (1 << 31))
 
+    # -- async pipeline drain ------------------------------------------------
+    def flush(self) -> None:
+        """Drain the dispatch pipeline: after this returns, model.trees
+        holds every dispatched tree in dispatch order and any deferred
+        assembly error has been re-raised.  Every point that observes the
+        model or host scores calls this — metric eval, early-stop
+        callbacks, snapshot writes / PreemptionGuard, rollback_one_iter,
+        save_model, _fast_sync_back, and the train() exit path.
+
+        If a drained iteration turned out to have no splittable leaves,
+        the iterations dispatched past it are rolled back here — the
+        synchronous loop would have stopped before training them."""
+        if self._assembler is not None:
+            self._assembler.flush()
+        if self._in_flush:
+            return
+        stop = self._pipe_stop_iter
+        if stop is not None and self.iter > stop + 1:
+            self._in_flush = True
+            try:
+                # rollback IN PLACE (payload score replay on the fast
+                # path) rather than via rollback_one_iter, which would
+                # sync the engine off the fast path — a state change the
+                # synchronous loop never makes on a no-split stop
+                K = self.num_tree_per_iteration
+                for _ in range(self.iter - (stop + 1)):
+                    for k in reversed(range(K)):
+                        tree = self.model.trees.pop()
+                        if tree.num_leaves <= 1:
+                            continue
+                        self._add_tree_to_train_score(tree, k, -1.0)
+                        self._add_tree_to_valid_scores(tree, k, -1.0)
+                    self.iter -= 1
+            finally:
+                self._in_flush = False
+
+    def _note_tree_drained(self, num_leaves: int, it: int) -> None:
+        """Assembler-thread bookkeeping, strictly in tree order: when a
+        full iteration's trees have drained and none found a split, the
+        run should have stopped at that iteration."""
+        self._pipe_k_seen += 1
+        if num_leaves > 1:
+            self._pipe_any_split = True
+        if self._pipe_k_seen >= self.num_tree_per_iteration:
+            if not self._pipe_any_split and self._pipe_stop_iter is None:
+                self._pipe_stop_iter = it
+                Log.warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+            self._pipe_k_seen = 0
+            self._pipe_any_split = False
+
+    def _tree_device_half(self, out: Dict, lr: float, masked: bool = False):
+        """The half of _finish_tree the NEXT device step may depend on,
+        derived from the grower output without any host fetch: the
+        traversal arrays plus the shrunk leaf outputs.  With masked=True
+        a stump's outputs are zeroed so deferred consumers (valid-set
+        _traverse_update, DART/RF replay) add +0.0 instead of needing the
+        host-side num_leaves gate."""
+        tree_dev = {
+            "split_feature": out["split_feature"],
+            "split_bin": out["split_bin"],
+            "default_left": out["default_left"],
+            "split_is_cat": out["split_is_cat"],
+            "split_cat_bitset": out["split_cat_bitset"],
+            "left_child": out["left_child"],
+            "right_child": out["right_child"],
+        }
+        leaf_out = out["leaf_value"] * jnp.float32(lr)
+        if masked:
+            leaf_out = jnp.where(out["num_leaves"] > 1, leaf_out,
+                                 jnp.float32(0.0))
+        return tree_dev, leaf_out
+
+    def _defer_finish(self, out: Dict, init_score: float, lr: float,
+                      k: int) -> None:
+        """Pipeline one tree's host half: the packed fetch + Tree assembly
+        + model append run on the assembler thread (bounded at
+        pipeline_depth in flight, strict dispatch order), while this
+        thread goes on to dispatch the next tree.  The valid-set score
+        replay runs NOW from the device half, so it never waits on the
+        fetch either."""
+        if self.valid_sets:
+            tree_dev, leaf_out = self._tree_device_half(out, lr, masked=True)
+            depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
+            for vs in self.valid_sets:
+                vs[3] = _traverse_update(vs[2], vs[3], leaf_out, tree_dev,
+                                         self.meta_dev, self.bundle_map,
+                                         depth_iters, k)
+        if self._assembler is None:
+            self._assembler = TreeAssembler(self._pipeline_depth)
+        it = self.iter
+
+        def host_half():
+            host = _fetch_packed(out, label="pipeline_drain")
+            tree = self._finish_tree_host(host, init_score, lr)
+            self.model.trees.append(tree)
+            self._note_tree_drained(tree.num_leaves, it)
+
+        self._assembler.submit(host_half)
+
     def _fast_sync_back(self) -> None:
         """Leave the fast path: restore original-order scores into the
         legacy score matrix.  The state object is kept for cheap re-entry."""
+        self.flush()
         if not self._fast_active:
             return
         self.score = jnp.asarray(self._fast.raw_scores())
@@ -1202,6 +1380,16 @@ class GBDT:
             self.timer.sync(fs.payload)
 
     def _train_one_iter_fast(self) -> bool:
+        if self._pipe_stop_iter is not None:
+            # a drained host half found an iteration with no splittable
+            # leaves; flush() rolls back anything dispatched past it and
+            # this update reports finished (one-to-two updates later than
+            # the synchronous loop, with an identical final model).  The
+            # flag clears once reported so a caller that keeps driving
+            # update() manually trains again, like the synchronous loop.
+            self.flush()
+            self._pipe_stop_iter = None
+            return True
         init_score = self._boost_from_average()
         fs = self._fast_enter()
         fmask = self._feature_sample()
@@ -1218,6 +1406,23 @@ class GBDT:
         should_continue = False
         renew = (self.objective is not None
                  and self.objective.renew_tree_output_required())
+        # pipelined iterations cover exactly the fused steps (_step /
+        # _step_quant / _step_sampled / _step_masked); the piecewise
+        # profiled path and leaf renewal observe per-tree host state by
+        # construction and stay synchronous
+        use_pipe = (self._pipeline_depth > 0 and not renew
+                    and not self.timer.enabled
+                    and self._sentinel_policy == "off")
+        if not use_pipe:
+            # deferred appends from earlier pipelined iterations must land
+            # before this iteration's inline appends
+            self.flush()
+        return self._run_iter_trees(fs, fmask, init_score, lr, renew,
+                                    use_pipe, should_continue)
+
+    @_mark_critical_path
+    def _run_iter_trees(self, fs, fmask, init_score, lr, renew, use_pipe,
+                        should_continue) -> bool:
         for k in range(self.num_tree_per_iteration):
             if renew:
                 # leaf-output renewal (RenewTreeOutput, serial_tree_learner
@@ -1299,6 +1504,16 @@ class GBDT:
                         else (fs.payload, fs.aux, fmask, qsc)
                     out, fs.payload, fs.aux = fs.grower(*gargs)
                     self.timer.sync(fs.payload)
+            if use_pipe:
+                # the host half (packed fetch -> Tree assembly -> append)
+                # drains off-path; the device already applied the masked
+                # score add inside the fused step, and _defer_finish
+                # replays the valid sets from the device half.  The
+                # no-split stop is signaled by the drain (see
+                # _note_tree_drained) — report continue optimistically.
+                self._defer_finish(out, init_score, lr, k)
+                should_continue = True
+                continue
             with self.timer.phase("tree assemble (host)"):
                 tree, tree_dev, leaf_out = self._finish_tree(out, init_score)
             if tree.num_leaves > 1:
@@ -1603,17 +1818,21 @@ class GBDT:
         partition-ordered scores/bag back to original row order so the
         objective's renewal code runs UNCHANGED — bit-identical to the
         legacy path."""
-        nl = int(jax.device_get(out["num_leaves"]))
+        nl = int(syncs.device_get(out["num_leaves"], label="renew_fetch"))
         if nl <= 1:
             return None
-        # one contiguous column fetch: cnt (bag), idx, per-class scores
-        h = np.asarray(jax.device_get(
-            fs.payload[:, fs.cnt_col:fs.score0 + fs.K]))
+        # one round of transfers: the contiguous column block (cnt/bag,
+        # idx, per-class scores) plus the segment tables and leaf values
+        h, ss, sc, lv = syncs.device_get(
+            (fs.payload[:, fs.cnt_col:fs.score0 + fs.K],
+             out["seg_start"], out["seg_cnt"], out["leaf_value"]),
+            label="renew_fetch")
+        h = np.asarray(h)
         cnt = h[:, 0]
         idx = fs.host_idx() if fs.wide_idx else h[:, 1].astype(np.int64)
         score_k = h[:, 2 + k].astype(np.float64)
-        ss = np.asarray(jax.device_get(out["seg_start"])).astype(np.int64)
-        sc = np.asarray(jax.device_get(out["seg_cnt"])).astype(np.int64)
+        ss = np.asarray(ss).astype(np.int64)
+        sc = np.asarray(sc).astype(np.int64)
         L = ss.size // fs.ndev
         R = fs.n_rows // fs.ndev
         lid_part = np.full(fs.n_rows, nl, np.int64)
@@ -1629,7 +1848,7 @@ class GBDT:
         pred[idx[keep]] = score_k[keep]
         in_bag = np.zeros(fs.n_pad, bool)
         in_bag[idx[keep]] = cnt[keep] > 0
-        lv = np.asarray(jax.device_get(out["leaf_value"]), dtype=np.float64)
+        lv = np.asarray(lv, dtype=np.float64)
         return self.objective.renew_leaf_values(lv[:nl], lid, pred, in_bag)
 
     def _renew_leaf_values(self, out: Dict, k: int) -> Optional[np.ndarray]:
@@ -1637,24 +1856,47 @@ class GBDT:
         serial_tree_learner.cpp:780-818): replace leaf outputs with the
         objective's robust statistic (e.g. L1 median of residuals) computed
         over the bagged rows of each leaf, before shrinkage."""
-        nl = int(jax.device_get(out["num_leaves"]))
+        nl = int(syncs.device_get(out["num_leaves"], label="renew_fetch"))
         if nl <= 1:
             return None
-        leaf_id = np.asarray(jax.device_get(out["leaf_id"]))
-        pred_k = np.asarray(jax.device_get(self.score[k]), dtype=np.float64)
-        lv = np.asarray(jax.device_get(out["leaf_value"]), dtype=np.float64)
-        in_bag = np.asarray(jax.device_get(self._bag_cmask)) > 0
+        leaf_id, pred_k, lv, in_bag = syncs.device_get(
+            (out["leaf_id"], self.score[k], out["leaf_value"],
+             self._bag_cmask), label="renew_fetch")
+        leaf_id = np.asarray(leaf_id)
+        pred_k = np.asarray(pred_k, dtype=np.float64)
+        lv = np.asarray(lv, dtype=np.float64)
+        in_bag = np.asarray(in_bag) > 0
         return self.objective.renew_leaf_values(lv[:nl], leaf_id, pred_k, in_bag)
 
     def _finish_tree(self, out: Dict, init_score: float,
                      renewed: Optional[np.ndarray] = None):
         """Fetch grower output, assemble the host Tree (reference numbering),
-        apply shrinkage and first-tree bias (gbdt.cpp:450-456)."""
+        apply shrinkage and first-tree bias (gbdt.cpp:450-456) — the
+        synchronous form; the pipelined fast path defers the host half
+        through _defer_finish instead."""
         host = _fetch_packed(out)
         # the outputs are on host anyway — the non-finite sentinel rides
         # this fetch for free (raises NonFiniteDetected under
         # sentinel_nonfinite=abort|rollback; Booster.update arbitrates)
         resilience.sentinel_check(self, host)
+        lr = self.shrinkage_rate
+        tree = self._finish_tree_host(host, init_score, lr, renewed)
+        if renewed is not None or self._leaf_transform is not None:
+            leaf_value_dev_f = jnp.asarray(
+                (host["leaf_value"] * lr).astype(np.float32))
+            tree_dev, _ = self._tree_device_half(out, lr)
+        else:
+            tree_dev, leaf_value_dev_f = self._tree_device_half(out, lr)
+        return tree, tree_dev, leaf_value_dev_f
+
+    def _finish_tree_host(self, host: Dict[str, np.ndarray],
+                          init_score: float, lr: float,
+                          renewed: Optional[np.ndarray] = None) -> Tree:
+        """The pure-host half of _finish_tree: fetched outputs -> reference
+        Tree.  Runs inline (classic loop) or on the assembler thread
+        (pipelined loop); `lr` is the shrinkage captured AT DISPATCH —
+        DART and reset_parameter may have moved self.shrinkage_rate by
+        drain time."""
         nl = int(host["num_leaves"])
         # legacy masked grower reports no round counter: its loop is one
         # round per split by construction
@@ -1664,7 +1906,6 @@ class GBDT:
         L = self.grower_cfg.num_leaves
         tree = Tree(max(L, 2))
         tree.num_leaves = nl
-        lr = self.shrinkage_rate
         host_lv = host["leaf_value"]
         if renewed is not None:
             host_lv = host_lv.copy()
@@ -1675,9 +1916,6 @@ class GBDT:
             host_lv = self._leaf_transform(np.asarray(host_lv, np.float64))
         if renewed is not None or self._leaf_transform is not None:
             host["leaf_value"] = host_lv
-            leaf_value_dev_f = jnp.asarray((host_lv * lr).astype(np.float32))
-        else:
-            leaf_value_dev_f = out["leaf_value"] * lr  # device outputs, shrunk, no bias
 
         if nl > 1:
             ni = nl - 1
@@ -1734,17 +1972,7 @@ class GBDT:
         else:
             tree.leaf_value[0] = float(host["leaf_value"][0]) * lr + init_score
             tree.shrinkage = 1.0
-
-        tree_dev = {
-            "split_feature": out["split_feature"],
-            "split_bin": out["split_bin"],
-            "default_left": out["default_left"],
-            "split_is_cat": out["split_is_cat"],
-            "split_cat_bitset": out["split_cat_bitset"],
-            "left_child": out["left_child"],
-            "right_child": out["right_child"],
-        }
-        return tree, tree_dev, leaf_value_dev_f
+        return tree
 
     def split_rounds_per_tree(self) -> Optional[float]:
         """Mean sequential grower rounds per finished tree (telemetry for
@@ -1756,13 +1984,83 @@ class GBDT:
 
     # -- evaluation ----------------------------------------------------------
     def raw_train_score(self) -> np.ndarray:
+        self.flush()
         if self._fast_active:
             return self._fast.raw_scores()[:, : self.train_set.num_data]
-        return jax.device_get(self.score)[:, : self.train_set.num_data]
+        return syncs.device_get(
+            self.score, label="score_fetch")[:, : self.train_set.num_data]
 
     def raw_valid_score(self, i: int) -> np.ndarray:
         name, valid, _, score_v, _ = self.valid_sets[i]
-        return jax.device_get(score_v)[:, : valid.num_data]
+        return syncs.device_get(score_v,
+                                label="score_fetch")[:, : valid.num_data]
+
+    def _packed_eval_fetch(self, arrays: List[jax.Array]) -> List[np.ndarray]:
+        """ONE blocking D2H for a whole eval round (the _fetch_packed
+        pattern on the f32 score arrays): flatten+concat on device, fetch
+        once, split on host — metric_freq=1 must not serialize one
+        round trip per dataset.  Mesh runs fetch the list as one pytree
+        device_get instead (a cross-sharding concat would insert
+        collectives); jax still overlaps every leaf's transfer."""
+        if not arrays:
+            return []
+        if self.mesh is not None or len(arrays) == 1:
+            return [np.asarray(a) for a in
+                    syncs.device_get(arrays, label="eval_fetch")]
+        spec = tuple(tuple(a.shape) for a in arrays)
+        entry = _EVAL_PACK_CACHE.get(spec)
+        if entry is None:
+            sizes = [int(np.prod(s, dtype=np.int64)) for s in spec]
+            offs = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+
+            @jax.jit
+            def pack(xs):
+                return jnp.concatenate([x.reshape(-1) for x in xs])
+
+            entry = (offs, pack)
+            _pack_cache_put(_EVAL_PACK_CACHE, spec, entry)
+        else:
+            _EVAL_PACK_CACHE.move_to_end(spec)
+        offs, pack = entry
+        flat = np.asarray(syncs.device_get(pack(arrays), label="eval_fetch"))
+        return [flat[offs[i]:offs[i + 1]].reshape(s)
+                for i, s in enumerate(spec)]
+
+    def _eval_raws(self, want_train: bool, want_valid: bool):
+        """(train raw, [valid raws]) for an eval round, off one packed
+        transfer.  Flushing here makes every eval a pipeline barrier —
+        callbacks that observe the model (early stopping bookkeeping,
+        snapshot schedules) run against a fully-assembled tree list."""
+        self.flush()
+        fs = self._fast if self._fast_active else None
+        arrays: List[jax.Array] = []
+        if want_train:
+            arrays.extend(fs.score_cols_device() if fs is not None
+                          else [self.score])
+        if want_valid:
+            arrays.extend(vs[3] for vs in self.valid_sets)
+        host = self._packed_eval_fetch(arrays)
+        i = 0
+        train_raw = None
+        if want_train:
+            if fs is not None:
+                cols = host[i]
+                i += 1
+                hi = None
+                if fs.wide_idx:
+                    hi = host[i]
+                    i += 1
+                train_raw = fs.scores_from_host(cols, hi)
+            else:
+                train_raw = host[i]
+                i += 1
+            train_raw = train_raw[:, : self.train_set.num_data]
+        valid_raws = []
+        if want_valid:
+            for (_name, valid, _b, _s, _m) in self.valid_sets:
+                valid_raws.append(host[i][:, : valid.num_data])
+                i += 1
+        return train_raw, valid_raws
 
     @staticmethod
     def _metric_input(raw: np.ndarray, m) -> np.ndarray:
@@ -1770,19 +2068,34 @@ class GBDT:
         consume the full [K, N] matrix (multiclass_metric.hpp Eval)."""
         return raw if getattr(m, "multiclass", False) else raw[0]
 
-    def eval_train(self) -> List[Tuple[str, str, float, bool]]:
-        raw = self.raw_train_score()
+    def _eval_train_results(self, raw) -> List[Tuple[str, str, float, bool]]:
         return [("training", m.name,
                  m.eval(self._metric_input(raw, m), self._metric_objective),
                  m.is_higher_better)
                 for m in self.train_metrics]
 
-    def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
+    def _eval_valid_results(self, raws) -> List[Tuple[str, str, float, bool]]:
         out = []
-        for i, (name, valid, _, _, metrics) in enumerate(self.valid_sets):
-            raw = self.raw_valid_score(i)
+        for (name, _valid, _b, _s, metrics), raw in zip(self.valid_sets,
+                                                        raws):
             for m in metrics:
                 out.append((name, m.name,
-                            m.eval(self._metric_input(raw, m), self._metric_objective),
+                            m.eval(self._metric_input(raw, m),
+                                   self._metric_objective),
                             m.is_higher_better))
         return out
+
+    def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        raw, _ = self._eval_raws(True, False)
+        return self._eval_train_results(raw)
+
+    def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
+        _, raws = self._eval_raws(False, True)
+        return self._eval_valid_results(raws)
+
+    def eval_all(self, include_train: bool):
+        """One eval round — train metrics (optional) plus every valid set
+        — off a single packed device_get (see _packed_eval_fetch)."""
+        raw, raws = self._eval_raws(include_train, True)
+        train_res = self._eval_train_results(raw) if include_train else []
+        return train_res, self._eval_valid_results(raws)

@@ -1,0 +1,105 @@
+"""Deferred host-half assembler for the async boosting pipeline (ISSUE 5).
+
+The fused fast path's device step for tree t+1 does not depend on tree
+t's host `Tree` object — `_step` consumes only `(payload, aux)`, which
+never leave the device.  The only reason the classic loop stalled once
+per tree was the synchronous packed fetch inside `_finish_tree`.  This
+module provides the bounded FIFO that takes that fetch (and the ~2 ms of
+host assembly behind it) off the dispatch path:
+
+* `submit(fn)` enqueues one tree's host half (packed fetch -> `Tree`
+  assembly -> `model.trees.append`) and applies backpressure: at most
+  `depth` host halves are pending-or-running, so the device can run at
+  most `depth` trees ahead of the host model.
+* the halves run on ONE worker thread in strict submission order —
+  `model.trees` grows in exactly the order the trees were dispatched,
+  which is what byte-identical model files require.
+* `flush()` drains everything, joins the worker, and re-raises the first
+  deferred exception.  After `flush()` returns no thread is alive — a
+  process with a thousand short-lived boosters never accumulates parked
+  workers.
+
+jax is thread-safe for this use: the host half only runs jitted *reads*
+of committed output arrays (the packed fetch); nothing in it donates or
+mutates device buffers the dispatch thread still owns.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable, Deque, Optional
+
+
+class TreeAssembler:
+    """Bounded, strictly-ordered, single-worker deferred queue."""
+
+    def __init__(self, depth: int):
+        self.depth = max(1, int(depth))
+        self._cv = threading.Condition()
+        self._fifo: Deque[Callable[[], None]] = collections.deque()
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._stopping = False
+
+    @property
+    def pending(self) -> int:
+        """Host halves submitted but not yet finished."""
+        with self._cv:
+            return len(self._fifo)
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        """Enqueue one host half; blocks while `depth` are already
+        pending (the in-flight one counts), bounding how far the device
+        runs ahead.  A deferred error from an earlier half re-raises
+        here rather than silently dropping trees."""
+        with self._cv:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            while len(self._fifo) >= self.depth:
+                self._cv.wait()
+                if self._error is not None:
+                    err, self._error = self._error, None
+                    raise err
+            self._fifo.append(fn)
+            if self._thread is None:
+                self._stopping = False
+                self._thread = threading.Thread(
+                    target=self._run, name="lgbm-tpu-assembler", daemon=True)
+                self._thread.start()
+            self._cv.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._fifo and not self._stopping:
+                    self._cv.wait()
+                if not self._fifo:
+                    return
+                fn = self._fifo[0]      # keep queued: in-flight counts
+                                        # against the depth bound
+            try:
+                fn()
+            except BaseException as e:  # deferred to submit()/flush()
+                with self._cv:
+                    if self._error is None:
+                        self._error = e
+            with self._cv:
+                self._fifo.popleft()
+                self._cv.notify_all()
+
+    def flush(self) -> None:
+        """Drain every pending half, stop the worker, and re-raise the
+        first deferred error.  Idempotent; cheap when already empty."""
+        with self._cv:
+            while self._fifo:
+                self._cv.wait()
+            self._stopping = True
+            self._cv.notify_all()
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join()
+        with self._cv:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err

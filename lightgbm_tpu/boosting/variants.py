@@ -120,6 +120,12 @@ class DART(GBDT):
         return self.model.trees[(self.num_init_iteration + i) * K + k]
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
+        if self._pipe_stop_iter is not None:
+            # a pipelined earlier iteration turned out to stop training;
+            # settle it BEFORE drawing drop RNG / touching scores
+            self.flush()
+            self._pipe_stop_iter = None
+            return True
         self._dropping_trees()
         stopped = super().train_one_iter(grad, hess)
         if stopped:
@@ -134,6 +140,14 @@ class DART(GBDT):
         cfg = self.config
         K = self.num_tree_per_iteration
         self.drop_index = []
+        # drop candidates and the drop/normalize replay read HOST trees of
+        # every earlier iteration, so DART is an every-iteration pipeline
+        # barrier: drain deferred assemblies (and settle any pending
+        # no-split stop) before the candidate window is fixed.  The
+        # pipeline still overlaps the host half of each tree with the
+        # remainder of its own iteration.
+        if self.iter > 0:
+            self.flush()
         is_skip = self.random_for_drop.next_float() < float(cfg.skip_drop)
         n_iter = self.iter
         if not is_skip and n_iter > 0:

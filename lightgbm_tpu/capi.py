@@ -25,6 +25,8 @@ C_API_DTYPE_FLOAT64 = 1
 C_API_PREDICT_NORMAL = 0
 C_API_PREDICT_RAW_SCORE = 1
 C_API_PREDICT_LEAF_INDEX = 2
+C_API_FEATURE_IMPORTANCE_SPLIT = 0
+C_API_FEATURE_IMPORTANCE_GAIN = 1
 
 _lib: Optional[ctypes.CDLL] = None
 
@@ -104,6 +106,34 @@ class NativeBooster:
         _check(lib.LGBM_BoosterSaveModelToString(
             self._handle, -1, out_len.value, ctypes.byref(out_len), buf))
         return buf.value.decode()
+
+    def dump_model(self, start_iteration: int = 0,
+                   num_iteration: int = -1) -> dict:
+        """JSON model dump through LGBM_BoosterDumpModel (same recursive
+        tree_structure schema as Booster.dump_model), parsed to a dict."""
+        import json
+        lib = load_lib()
+        out_len = ctypes.c_int64(0)
+        _check(lib.LGBM_BoosterDumpModel(
+            self._handle, start_iteration, num_iteration, 0, 0,
+            ctypes.byref(out_len), None))
+        buf = ctypes.create_string_buffer(out_len.value)
+        _check(lib.LGBM_BoosterDumpModel(
+            self._handle, start_iteration, num_iteration, 0, out_len.value,
+            ctypes.byref(out_len), buf))
+        return json.loads(buf.value.decode())
+
+    def feature_importance(self, importance_type: str = "split",
+                           num_iteration: int = -1) -> np.ndarray:
+        """Per-feature importance through LGBM_BoosterFeatureImportance
+        ('split' counts, 'gain' sums non-negative split gains)."""
+        itype = C_API_FEATURE_IMPORTANCE_GAIN if importance_type == "gain" \
+            else C_API_FEATURE_IMPORTANCE_SPLIT
+        out = np.zeros(self.num_feature, dtype=np.float64)
+        _check(load_lib().LGBM_BoosterFeatureImportance(
+            self._handle, ctypes.c_int(num_iteration), itype,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        return out
 
     def predict_for_file(self, data_path: str, result_path: str,
                          data_has_header: bool = False,

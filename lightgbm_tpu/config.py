@@ -118,6 +118,15 @@ class Config:
         # for NaN/inf; abort raises naming the iteration, rollback
         # restores the pre-iteration scores and stops training cleanly.
         self.sentinel_nonfinite = "off"
+        # async boosting pipeline depth (ISSUE 5): on the fused fast path
+        # the device may run this many trees ahead of host Tree assembly
+        # (the packed D2H fetch + assembly drain on a bounded worker, in
+        # strict dispatch order — models stay byte-identical to
+        # pipeline_depth=0).  0 = synchronous classic loop, 1 = default
+        # dispatch-ahead, 2 = two trees ahead.  Honest fallbacks: the
+        # legacy/profiled/renew paths and an armed sentinel_nonfinite run
+        # synchronously (docs/PERFORMANCE.md "Dispatch pipeline").
+        self.pipeline_depth = 1
         self._user_keys: set = set()
         self.raw_params: Dict[str, Any] = {}
         if params:

@@ -79,12 +79,16 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
             cb(env)
         is_finished = booster.update(fobj=fobj)
 
+        # one packed device fetch per eval round (Booster.eval_round):
+        # train metrics + every valid set come off a single device_get,
+        # and the round doubles as the async pipeline's flush barrier
         evaluation_result_list = []
-        if is_valid_contain_train:
+        if is_valid_contain_train or booster._engine.valid_sets:
+            train_res, valid_res = booster.eval_round(
+                feval, include_train=is_valid_contain_train)
             evaluation_result_list.extend(
-                [(train_data_name, m, v, h) for (_, m, v, h) in booster.eval_train(feval)])
-        if booster._engine.valid_sets:
-            evaluation_result_list.extend(booster.eval_valid(feval))
+                [(train_data_name, m, v, h) for (_, m, v, h) in train_res])
+            evaluation_result_list.extend(valid_res)
         env = CallbackEnv(model=booster, params=params, iteration=i,
                           begin_iteration=0, end_iteration=num_boost_round,
                           evaluation_result_list=evaluation_result_list)
@@ -103,6 +107,10 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     for name, metric, value, _ in evaluation_result_list:
         booster.best_score[name][metric] = value
     if booster._engine is not None:
+        # drain the dispatch pipeline: the returned booster's model must
+        # hold every dispatched tree (runs without eval rounds never hit
+        # another flush barrier)
+        booster._engine.flush()
         booster._engine.timer.report()
     return booster
 

@@ -151,6 +151,10 @@ def maybe_die_or_preempt(booster) -> None:
     eng = getattr(booster, "_engine", None)
     if eng is None:
         return
+    # an armed fault counts COMPLETED iterations: drain the dispatch
+    # pipeline so the count (and the state a die/preempt leaves behind)
+    # is the synchronous loop's
+    eng.flush()
     done = int(eng.model.current_iteration)
     if "die_at_iter" in spec and done >= int(spec["die_at_iter"] or 0):
         sys.stderr.write("[%s] FAULT die_at_iter: abrupt exit after %d "
@@ -657,17 +661,21 @@ def capture_training_state(booster) -> Dict[str, Any]:
     tree weights).  Mesh runs skip the row order (rows are reordered per
     shard) — resume still works, but exactness is only guaranteed for
     serial training; the state records which case it captured."""
-    import jax
     import numpy as np
+    from . import syncs
     eng = booster._engine
     if eng is None:
         raise RuntimeError("capture_training_state needs a training Booster")
+    # snapshots observe the model AND the scores: drain the dispatch
+    # pipeline first (flush barrier contract, ISSUE 5)
+    eng.flush()
     if eng._fast_active:
         score = eng._fast.raw_scores()                      # [K, n_pad] f32
         perm = (eng._fast.host_idx().astype(np.int32)
                 if eng.mesh is None else None)
     else:
-        score = np.asarray(jax.device_get(eng.score), np.float32)
+        score = np.asarray(syncs.device_get(eng.score, label="snapshot"),
+                           np.float32)
         perm = None
     state: Dict[str, Any] = {
         "version": 1,
@@ -934,7 +942,7 @@ class SentinelGuard:
     poison every later tree)."""
 
     def __init__(self, engine):
-        import jax
+        from . import syncs
         self.engine = engine
         self.policy = getattr(engine, "_sentinel_policy", "off")
         self.pre_trees = len(engine.model.trees)
@@ -944,7 +952,8 @@ class SentinelGuard:
             if engine._fast_active:
                 self.score = engine._fast.raw_scores()
             else:
-                self.score = jax.device_get(engine.score)
+                self.score = syncs.device_get(engine.score,
+                                              label="sentinel")
 
     def handle(self, err: NonFiniteDetected, log) -> bool:
         """Returns True (= training finished) after a rollback; raises

@@ -43,10 +43,12 @@ class PhaseTimer:
             self.calls[name] = self.calls.get(name, 0) + 1
 
     def sync(self, outputs) -> None:
-        """Block on a phase's outputs (no-op when timing is off)."""
+        """Block on a phase's outputs (no-op when timing is off).  Routed
+        through the sync-audit seam: profiled runs honestly report their
+        per-phase barriers as critical-path syncs."""
         if self.enabled:
-            import jax
-            jax.block_until_ready(outputs)
+            from ..runtime import syncs
+            syncs.block_until_ready(outputs, label="profile_sync")
 
     def observe(self, name: str, seconds: float) -> None:
         if self.enabled:

@@ -343,3 +343,55 @@ def test_c_program_predict_for_file(capi, tmp_path):
     assert run.returncode == 0, run.stderr[-1000:]
     assert "C predict-for-file ok" in run.stdout
     assert open(py_out, "rb").read() == open(c_out, "rb").read()
+
+
+def test_feature_importance_matches_python(capi, tmp_path):
+    """LGBM_BoosterFeatureImportance: split counts are exact vs the
+    Python binding; gain sums agree to text-serialization precision
+    (the native model re-parses %g-printed gains)."""
+    rng = np.random.default_rng(21)
+    X = rng.standard_normal((600, 7)).astype(np.float64)
+    y = X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.1 * rng.standard_normal(600)
+    bst = _train({"objective": "regression"}, X, y, rounds=10)
+    nb, _ = _roundtrip(capi, bst, X, tmp_path, "imp")
+    np.testing.assert_array_equal(nb.feature_importance("split"),
+                                  bst.feature_importance("split"))
+    np.testing.assert_allclose(nb.feature_importance("gain"),
+                               bst.feature_importance("gain"),
+                               rtol=1e-5, atol=1e-6)
+    # num_iteration slicing mirrors the Python binding
+    np.testing.assert_array_equal(
+        nb.feature_importance("split", num_iteration=3),
+        bst.feature_importance("split", iteration=3))
+
+
+def test_dump_model_schema_matches_python(capi, tmp_path):
+    """LGBM_BoosterDumpModel: parseable JSON sharing the Python
+    dump_model schema — header fields, tree count, and the recursive
+    tree_structure down to identical leaf values."""
+    rng = np.random.default_rng(22)
+    X = rng.standard_normal((500, 6)).astype(np.float64)
+    X[:, 2] = rng.integers(0, 6, 500)
+    y = (X[:, 0] + (X[:, 2] == 3) > 0.5).astype(float)
+    bst = _train({"objective": "binary", "categorical_feature": [2]},
+                 X, y, rounds=6)
+    nb, _ = _roundtrip(capi, bst, X, tmp_path, "dump")
+    d = nb.dump_model()
+    pd = bst.dump_model()
+    for key in ("name", "num_class", "num_tree_per_iteration",
+                "max_feature_idx", "average_output"):
+        assert d[key] == pd[key], key
+    assert len(d["tree_info"]) == len(pd["tree_info"])
+
+    def leaves(node):
+        if "split_index" not in node:
+            return [node["leaf_value"]]
+        return leaves(node["left_child"]) + leaves(node["right_child"])
+
+    for tc, tp in zip(d["tree_info"], pd["tree_info"]):
+        assert tc["num_leaves"] == tp["num_leaves"]
+        np.testing.assert_allclose(leaves(tc["tree_structure"]),
+                                   leaves(tp["tree_structure"]),
+                                   rtol=0, atol=0)
+    # iteration slicing
+    assert len(nb.dump_model(num_iteration=2)["tree_info"]) == 2

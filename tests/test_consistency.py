@@ -80,13 +80,16 @@ def _auc(y, p):
 
 
 def test_binary_example(tmp_path):
+    """Tightened from the original +-0.02 @ 30 rounds (VERDICT Weak #5c):
+    at 100 rounds the per-tree near-tie noise between the engines has
+    averaged out, so held-out AUC must agree within +-0.005 two-sided."""
     d = os.path.join(REFERENCE, "binary_classification")
-    ours = _run_ours(d, "train.conf", tmp_path)
-    ref = _run_ref(d, "train.conf", tmp_path)
+    ours = _run_ours(d, "train.conf", tmp_path, extra=("num_trees=100",))
+    ref = _run_ref(d, "train.conf", tmp_path, extra=("num_trees=100",))
     y = _labels(d)
     auc_ours, auc_ref = _auc(y, ours), _auc(y, ref)
-    assert abs(auc_ours - auc_ref) < 0.02, (auc_ours, auc_ref)
-    assert auc_ours > 0.75
+    assert abs(auc_ours - auc_ref) < 0.005, (auc_ours, auc_ref)
+    assert auc_ours > 0.78
 
 
 def test_regression_example(tmp_path):
