@@ -246,6 +246,76 @@ def bench_predict():
     }
 
 
+def bench_online():
+    """BENCH_ONLINE: the continuous-training service (ISSUE 6) at reduced
+    scale, schedule-free (`online_interval=0`) so the numbers measure the
+    pipeline, not the clock: cycles/sec, per-cycle publish latency (from
+    the service's own stage trail), and subscriber staleness (age of the
+    newest resolvable generation, sampled by a 20 Hz poller for the whole
+    run).  BENCH_ONLINE_{ROWS,CYCLES,ROUNDS} reshape it."""
+    import tempfile
+    import threading
+
+    from lightgbm_tpu.runtime import publish as pubmod
+    from lightgbm_tpu.runtime.continuous import ContinuousTrainer
+
+    rows = int(os.environ.get("BENCH_ONLINE_ROWS", 8_000))
+    cycles = int(os.environ.get("BENCH_ONLINE_CYCLES", 3))
+    rounds = int(os.environ.get("BENCH_ONLINE_ROUNDS", 2))
+    X, y = synth_higgs(rows)
+    with tempfile.TemporaryDirectory(prefix="bench_online_") as d:
+        data = os.path.join(d, "train.tsv")
+        np.savetxt(data, np.column_stack([y, X]), delimiter="\t",
+                   fmt="%.7g")
+        out = os.path.join(d, "m.txt")
+        staleness = []
+        stop = threading.Event()
+
+        def poll():
+            sub = pubmod.ModelSubscriber(out + ".pub", attempts=1)
+            while not stop.is_set():
+                rec = sub.resolve_once()
+                if rec is not None:
+                    try:
+                        staleness.append(
+                            time.time() - os.path.getmtime(rec.path))
+                    except OSError:
+                        pass
+                stop.wait(0.05)
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        trainer = ContinuousTrainer({
+            "data": data, "output_model": out, "objective": "binary",
+            "num_leaves": 31, "verbose": -1, "seed": 7,
+            "online_cycles": cycles, "online_rounds": rounds,
+            "online_interval": 0})
+        # stage markers go to stderr: bench stdout is ONE json line
+        trainer.wd.stream = sys.stderr
+        t0 = time.perf_counter()
+        rc = trainer.run()
+        dt = time.perf_counter() - t0
+        stop.set()
+        poller.join(timeout=5)
+        if rc != 0:
+            raise RuntimeError("online service rc=%d" % rc)
+        lat = [s["publish_latency_s"] for s in trainer.wd.stages
+               if "publish_latency_s" in s]
+        st = np.asarray(staleness) if staleness else np.asarray([0.0])
+        return {
+            "rows": rows, "cycles": cycles, "rounds_per_cycle": rounds,
+            "cycles_per_sec": round(cycles / dt, 3),
+            "sec_per_cycle": round(dt / cycles, 3),
+            "publish_latency_s": {"mean": round(float(np.mean(lat)), 4),
+                                  "max": round(float(np.max(lat)), 4)},
+            "staleness_s": {"p50": round(float(np.percentile(st, 50)), 3),
+                            "max": round(float(st.max()), 3),
+                            "samples": int(st.size)},
+            "note": "interval=0: staleness == pipeline lag; a scheduled "
+                    "deployment adds its online_interval on top",
+        }
+
+
 #: per-flag verdicts from the staged-kernel probe (None = probe not run);
 #: recorded in the bench JSON so an unattended hardware window leaves
 #: evidence for the human flip (exp/flip_validated.py)
@@ -357,7 +427,9 @@ def main():
         # dropped them): a caller that opted out of the predict/phase
         # sections must not get them back at CPU-fallback speed
         for k in ("BENCH_PREDICT", "BENCH_PREDICT_ROWS", "BENCH_PHASES",
-                  "BENCH_HIST_QUANT", "BENCH_FRONTIER_BATCH"):
+                  "BENCH_HIST_QUANT", "BENCH_FRONTIER_BATCH",
+                  "BENCH_ONLINE", "BENCH_ONLINE_ROWS",
+                  "BENCH_ONLINE_CYCLES", "BENCH_ONLINE_ROUNDS"):
             if k in os.environ:
                 env[k] = os.environ[k]
         os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
@@ -611,6 +683,22 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
                                    "above is unaffected"}
             stage("predict bench FAILED (diagnostics only)")
 
+    # continuous-training bench (BENCH_ONLINE=0 skips): cycles/sec,
+    # publish latency, subscriber staleness at reduced scale.  Guarded —
+    # a failure is recorded, never fatal to the headline result.
+    online_rec = None
+    if os.environ.get("BENCH_ONLINE", "1") != "0":
+        try:
+            online_rec = bench_online()
+            stage("online bench done (%.2f cycles/s, staleness p50 %.2fs)"
+                  % (online_rec["cycles_per_sec"],
+                     online_rec["staleness_s"]["p50"]))
+        except Exception as e:
+            online_rec = {"error": "%s: %s" % (type(e).__name__, e),
+                          "note": "online bench failed; headline result "
+                                  "above is unaffected"}
+            stage("online bench FAILED (diagnostics only)")
+
     if isinstance(phases, dict):
         # the sync-audit counters ride the default phases output so every
         # bench record carries the blocking-fetch split next to the wall
@@ -661,6 +749,8 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
         result["pipeline"] = pipeline_rec
     if predict_rec is not None:
         result["predict"] = predict_rec
+    if online_rec is not None:
+        result["online"] = online_rec
     if hist_quant is not None:
         result["hist_quant"] = hist_quant
     if STAGED_REPORT is not None:

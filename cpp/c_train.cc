@@ -123,6 +123,16 @@ def booster_rollback(bst):
     bst.rollback_one_iter()
 
 
+def booster_reset_parameter(bst, params):
+    bst.reset_parameter(_params(params))
+
+
+def booster_refit(bst, mv, lmv, nrow, ncol):
+    X = np.frombuffer(mv, dtype=np.float64).reshape(nrow, ncol)
+    y = np.frombuffer(lmv, dtype=np.float32, count=nrow).astype(np.float64)
+    return bst.refit(np.array(X, copy=True), y)
+
+
 def booster_current_iteration(bst):
     return int(bst.current_iteration())
 
@@ -597,6 +607,64 @@ int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
   PyObject* r = CallHelper("booster_rollback", Py_BuildValue("(O)", tb->bst));
   if (r == nullptr) return -1;
   Py_DECREF(r);
+  tb->dirty = true;
+  return 0;
+}
+
+int LGBM_BoosterResetParameter(BoosterHandle handle, const char* parameters) {
+  PyScope py;
+  if (!py.ok) return -1;
+  if (!lgbm_tpu_internal::IsTrainBooster(handle)) {
+    SetLastError("not a training booster");
+    return -1;
+  }
+  TrainBooster* tb = AsTrain(handle);
+  PyObject* r = CallHelper(
+      "booster_reset_parameter",
+      Py_BuildValue("(Os)", tb->bst, parameters ? parameters : ""));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  // a parameter change (learning_rate, shrinkage) alters FUTURE trees,
+  // not the saved model text, but resync anyway: the parameters block of
+  // the model text records the live config
+  tb->dirty = true;
+  return 0;
+}
+
+int LGBM_BoosterRefit(BoosterHandle handle, const double* data,
+                      const float* label, int32_t nrow, int32_t ncol) {
+  PyScope py;
+  if (!py.ok) return -1;
+  if (!lgbm_tpu_internal::IsTrainBooster(handle)) {
+    SetLastError("not a training booster");
+    return -1;
+  }
+  if (data == nullptr || label == nullptr || nrow <= 0 || ncol <= 0) {
+    SetLastError("LGBM_BoosterRefit needs data, label and positive shape");
+    return -1;
+  }
+  TrainBooster* tb = AsTrain(handle);
+  PyObject* mv = PyMemoryView_FromMemory(
+      const_cast<char*>(reinterpret_cast<const char*>(data)),
+      static_cast<Py_ssize_t>(nrow) * ncol * 8, PyBUF_READ);
+  PyObject* lmv = PyMemoryView_FromMemory(
+      const_cast<char*>(reinterpret_cast<const char*>(label)),
+      static_cast<Py_ssize_t>(nrow) * 4, PyBUF_READ);
+  if (mv == nullptr || lmv == nullptr) {
+    Py_XDECREF(mv);
+    Py_XDECREF(lmv);
+    return FailPy("LGBM_BoosterRefit");
+  }
+  PyObject* r = CallHelper("booster_refit",
+                           Py_BuildValue("(ONNii)", tb->bst, mv, lmv,
+                                         nrow, ncol));
+  if (r == nullptr) return -1;
+  // swap the handle's python booster to the refit result (under the GIL:
+  // every other entry point touches tb->bst inside its own PyScope); the
+  // native Model* cache resyncs lazily from the new model text
+  PyObject* old = tb->bst;
+  tb->bst = r;
+  Py_DECREF(old);
   tb->dirty = true;
   return 0;
 }

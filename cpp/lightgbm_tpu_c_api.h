@@ -183,6 +183,28 @@ int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle, const float* grad,
 
 int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
 
+/* Reset booster parameters mid-training (reference
+ * LGBM_BoosterResetParameter -> Booster::ResetConfig): "key=value ..."
+ * string; e.g. a learning_rate change takes effect on the next
+ * UpdateOneIter.  Training boosters only. */
+int LGBM_BoosterResetParameter(BoosterHandle handle, const char* parameters);
+
+/* Refit the model's tree structures to new data (reference Booster.refit
+ * / gbdt.cpp RefitTree + FitByExistingTree): every split is kept, leaf
+ * values are recomputed from the new data's gradients as
+ * leaf = decay*old + (1-decay)*new*shrinkage, iterating so later trees
+ * see the refit scores of earlier ones.  ADAPTATION of the reference
+ * signature: the reference passes pre-computed leaf assignments
+ * (leaf_preds) against a separately merged booster; here the new window
+ * travels directly (data: nrow*ncol row-major float64, label: nrow
+ * float32) and leaf assignments are computed internally — the embedded
+ * engine owns both halves, which is also the path the online trainer's
+ * refit mode uses.  Training boosters only; the handle's model is
+ * REPLACED in place (subsequent predict/save/dump see the refit model;
+ * to continue boosting, create a fresh training booster from it). */
+int LGBM_BoosterRefit(BoosterHandle handle, const double* data,
+                      const float* label, int32_t nrow, int32_t ncol);
+
 /* Metric values for data_idx (0 = training, i > 0 = i-th valid set). */
 int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
                         double* out_results);

@@ -70,6 +70,8 @@ class Application:
             self._maybe_init_network()
         if self.task == "train":
             self.train()
+        elif self.task == "train_online":
+            self.train_online()
         elif self.task in ("predict", "prediction", "test"):
             self.predict()
         elif self.task == "convert_model":
@@ -223,6 +225,22 @@ class Application:
             booster.save_model(output_model)
         wd.done()
         Log.info("Finished training, model saved to %s", output_model)
+
+    def train_online(self) -> None:
+        """Continuous-training service (runtime/continuous.py): a
+        rolling-window trainer that boosts or refits on an absolute-clock
+        schedule, survives preemption mid-cycle, and publishes every
+        cycle atomically to `publish_dir` for subscribers (the serving
+        layer's contract).  Key params: `online_interval` (seconds
+        between cycles), `online_cycles` (total generations; 0 = run
+        forever), `online_rounds`, `online_mode=boost|refit`,
+        `online_window_rows`, `publish_retention`/`publish_grace`,
+        `snapshot_retention`/`snapshot_grace`.  See docs/RESILIENCE.md
+        for the runbook."""
+        from .runtime.continuous import ContinuousTrainer
+        rc = ContinuousTrainer(dict(self.raw_params), log=Log).run()
+        if rc != 0:
+            sys.exit(rc)
 
     def predict(self) -> None:
         params = dict(self.raw_params)

@@ -19,6 +19,7 @@ from .utils.log import LightGBMError
 _CPP_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                         "cpp")
 _LIB_PATH = os.path.join(_CPP_DIR, "lib_lightgbm_tpu.so")
+_TRAIN_LIB_PATH = os.path.join(_CPP_DIR, "lib_lightgbm_tpu_train.so")
 
 C_API_DTYPE_FLOAT32 = 0
 C_API_DTYPE_FLOAT64 = 1
@@ -52,6 +53,51 @@ def load_lib() -> ctypes.CDLL:
 def _check(rc: int) -> None:
     if rc != 0:
         raise LightGBMError(load_lib().LGBM_GetLastError().decode())
+
+
+_train_lib: Optional[ctypes.CDLL] = None
+
+
+def load_train_lib() -> ctypes.CDLL:
+    """The TRAINING-side library (embedded-CPython ABI).  Its dlopen pulls
+    the base prediction lib via $ORIGIN rpath and registers the dispatch
+    hooks, so symbols from BOTH surfaces resolve through this handle."""
+    global _train_lib
+    if _train_lib is None:
+        ensure_built()
+        lib = ctypes.CDLL(_TRAIN_LIB_PATH)
+        lib.LGBM_GetLastError.restype = ctypes.c_char_p
+        _train_lib = lib
+    return _train_lib
+
+
+def _check_train(rc: int) -> None:
+    if rc != 0:
+        raise LightGBMError(load_train_lib().LGBM_GetLastError().decode())
+
+
+def booster_reset_parameter(handle, parameters: str) -> None:
+    """LGBM_BoosterResetParameter over a raw training BoosterHandle:
+    live-apply "key=value ..." parameters (e.g. learning_rate) so they
+    take effect on the next LGBM_BoosterUpdateOneIter."""
+    _check_train(load_train_lib().LGBM_BoosterResetParameter(
+        handle, parameters.encode()))
+
+
+def booster_refit(handle, X: np.ndarray, y: np.ndarray) -> None:
+    """LGBM_BoosterRefit over a raw training BoosterHandle: keep every
+    split, refit leaf values to (X, y) — the handle's model is replaced
+    in place (reference Booster.refit semantics, adapted signature: the
+    data travels directly instead of pre-computed leaf assignments)."""
+    X = np.ascontiguousarray(X, dtype=np.float64)
+    y = np.ascontiguousarray(y, dtype=np.float32).reshape(-1)
+    nrow, ncol = X.shape
+    if y.size != nrow:
+        raise LightGBMError("label length %d != nrow %d" % (y.size, nrow))
+    _check_train(load_train_lib().LGBM_BoosterRefit(
+        handle, X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int32(nrow), ctypes.c_int32(ncol)))
 
 
 class NativeBooster:

@@ -1,10 +1,14 @@
-"""Execution-runtime services: fault tolerance, watchdogs, snapshot/resume.
+"""Execution-runtime services: fault tolerance, watchdogs, snapshot/resume,
+atomic model publish/subscribe, and the continuous-training service loop.
 
 This package holds the machinery that keeps long runs alive on flaky
 platforms — it deliberately imports neither jax nor any other heavy
 dependency at module scope, so the hermetic dryrun bootstrap and the CLI
 entry can use it before (or instead of) binding an accelerator platform.
+(`continuous` is not imported here: it pulls the training stack; import
+it explicitly where a service loop is actually being run.)
 """
+from . import publish  # noqa: F401
 from . import resilience  # noqa: F401
 
-__all__ = ["resilience"]
+__all__ = ["resilience", "publish"]

@@ -1,0 +1,489 @@
+"""Continuous-training service: rolling-window trainer + atomic publish.
+
+The reference's Boosting drivers are strictly batch-only (SURVEY §2.4);
+this module is the scenario it never had — a long-running service that
+keeps a model fresh against a moving data window and publishes every
+cycle through the atomic publish/subscribe seam (runtime/publish.py),
+composed entirely from runtime features PRs 4–5 already proved out:
+
+* **ingest** — a background producer thread re-parses the data file
+  through the existing parse pipeline (io/parser.py's chunked
+  producer/consumer path) whenever the file changes, keeping the newest
+  `online_window_rows` rows staged for the next cycle; an optional
+  binary cache (`online_save_binary=true`) makes relaunch ingest a
+  single binary load.
+* **train** — each cycle boosts `online_rounds` iterations (continued
+  training on the live engine) or `refit`s the current model to the new
+  window, on an **absolute-clock schedule**: cycle slots are
+  ``t0 + k*interval`` with ``t0`` persisted in the service state file,
+  so a relaunch (after preemption or an injected death) rejoins the
+  same schedule instead of drifting.
+* **recover** — warm start from the newest VALID snapshot (scanning past
+  corrupt ones), finish a mid-cycle preemption's partial cycle to the
+  exact iteration target, and REPUBLISH a cycle whose publish was torn
+  or never landed — from the snapshot's own model text, so the
+  republished generation is byte-identical to what an uninterrupted run
+  would have published.
+* **observe** — every cycle stage runs under the PR 4 stage watchdog
+  (named deadlines, persisted JSON stage trail) and the train stage's
+  blocking-sync profile is recorded through the PR 5 sync-audit seam
+  into the same trail.
+
+Correctness under churn is adversarial: `exp/chaos.py` runs this loop
+under randomized `LGBM_TPU_FAULT` kill/tear/stall churn with a
+high-frequency subscriber polling throughout; the pins are **zero
+corrupt observations ever** and **byte-identical published generations**
+vs an uninterrupted run (tests/test_continuous.py).
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from . import publish, resilience, syncs
+from ..utils.log import LightGBMError, Log
+
+__all__ = ["ContinuousTrainer", "OnlineParams"]
+
+
+class OnlineParams:
+    """Config surface of `task=train_online` (all `k=v` CLI params).
+
+    Everything not consumed here flows through as ordinary training
+    parameters (objective, num_leaves, bagging, pipeline_depth, ...).
+    """
+
+    def __init__(self, params: Dict[str, Any]):
+        p = dict(params)
+        self.data = p.pop("data", p.pop("train_data", None))
+        self.output_model = p.pop("output_model", "LightGBM_online.txt")
+        self.input_model = p.pop("input_model", None)
+        self.publish_dir = p.pop("publish_dir",
+                                 self.output_model + ".pub")
+        self.interval_s = float(p.pop("online_interval", 10.0))
+        self.cycles = int(p.pop("online_cycles", 0))          # 0 = forever
+        self.rounds = max(int(p.pop("online_rounds", 5)), 1)
+        self.mode = str(p.pop("online_mode", "boost")).lower()
+        self.window_rows = int(p.pop("online_window_rows", 0))
+        self.save_binary = str(p.pop("online_save_binary", "")
+                               ).lower() in ("true", "1")
+        self.publish_retention = int(p.pop("publish_retention", 8))
+        self.publish_grace_s = float(p.pop("publish_grace", 30.0))
+        self.snapshot_retention = int(p.pop("snapshot_retention", 4))
+        self.snapshot_grace_s = float(p.pop("snapshot_grace", 30.0))
+        self.stage_timeout = int(p.pop("online_stage_timeout", 600))
+        self.label_column = int(p.pop("label_column", p.pop("label", 0) or 0))
+        self.has_header = str(p.pop("has_header", p.pop("header", ""))
+                              ).lower() in ("true", "1") or None
+        self.train_params = p
+        if not self.data:
+            raise LightGBMError("train_online needs data=<file>")
+        if self.mode not in ("boost", "refit"):
+            raise LightGBMError("online_mode must be boost or refit, got %r"
+                                % self.mode)
+
+
+class _IngestProducer(threading.Thread):
+    """Background ingest: re-parses `path` through io/parser.py whenever
+    its (mtime, size) stamp changes, staging the newest `window_rows`
+    rows.  The training loop never blocks on parsing an unchanged file —
+    it picks up whatever window is staged (the parse of a GROWING file
+    overlaps the previous cycle's training)."""
+
+    def __init__(self, cfg: OnlineParams, log=Log):
+        super().__init__(name="online-ingest", daemon=True)
+        self.cfg = cfg
+        self.log = log
+        self._ready = threading.Event()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._latest: Optional[Tuple[Tuple, np.ndarray, np.ndarray]] = None
+        self._error: Optional[BaseException] = None
+        self._stamp: Optional[Tuple] = None
+
+    def _file_stamp(self) -> Optional[Tuple]:
+        try:
+            st = os.stat(self.cfg.data)
+            return (st.st_mtime_ns, st.st_size)
+        except OSError:
+            return None
+
+    def _parse_once(self) -> None:
+        from ..io.parser import parse_file
+        X, y = parse_file(self.cfg.data,
+                          label_column=self.cfg.label_column,
+                          has_header=self.cfg.has_header)
+        w = self.cfg.window_rows
+        if w > 0 and X.shape[0] > w:
+            X, y = X[-w:], y[-w:]
+        with self._lock:
+            self._latest = (self._stamp, X, y)
+        self._ready.set()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            stamp = self._file_stamp()
+            if stamp is not None and stamp != self._stamp:
+                self._stamp = stamp
+                try:
+                    self._parse_once()
+                except BaseException as e:   # surfaced at the next ingest
+                    if self._latest is None:
+                        self._error = e
+                        self._ready.set()
+                    else:
+                        self.log.warning("online ingest: re-parse of %s "
+                                         "failed (%s); keeping the previous "
+                                         "window", self.cfg.data, e)
+            self._stop.wait(0.2)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def current(self, timeout: float) -> Tuple[Tuple, np.ndarray, np.ndarray]:
+        if not self._ready.wait(timeout):
+            raise LightGBMError("online ingest: no parsed window of %s "
+                                "within %.0fs" % (self.cfg.data, timeout))
+        if self._error is not None:
+            raise LightGBMError("online ingest: cannot parse %s: %s"
+                                % (self.cfg.data, self._error))
+        with self._lock:
+            return self._latest  # type: ignore[return-value]
+
+
+class ContinuousTrainer:
+    """The service loop.  `run()` returns a process exit code: 0 when the
+    target cycle count is reached or the run is preempted cleanly."""
+
+    def __init__(self, params: Dict[str, Any], log=Log):
+        self.cfg = OnlineParams(params)
+        self.log = log
+        self.publisher = publish.ModelPublisher(
+            self.cfg.publish_dir, keep_last=self.cfg.publish_retention,
+            grace_s=self.cfg.publish_grace_s)
+        self.wd = resilience.Watchdog(
+            self.cfg.stage_timeout, hard=False, label="online stage",
+            report_path=os.environ.get("LGBM_TPU_STAGE_REPORT",
+                                       self.cfg.output_model
+                                       + ".stage_trail.json"))
+        self._booster = None
+        self._window_stamp: Optional[Tuple] = None
+        self._base_iter = 0              # iterations in the pre-service model
+        self.timeouts = 0
+
+    # -- service state file (the schedule clock) ----------------------------
+    @property
+    def _state_path(self) -> str:
+        return self.cfg.output_model + ".service.json"
+
+    def _load_or_create_state(self) -> Dict[str, Any]:
+        try:
+            with open(self._state_path) as fh:
+                st = json.load(fh)
+            if float(st.get("interval", -1)) != self.cfg.interval_s:
+                self.log.warning(
+                    "online_interval changed (%.3fs -> %.3fs); the schedule "
+                    "clock keeps its original t0", st.get("interval"),
+                    self.cfg.interval_s)
+            return st
+        except (OSError, ValueError):
+            st = {"t0": time.time(), "interval": self.cfg.interval_s,
+                  "base_iter": self._base_iter, "mode": self.cfg.mode,
+                  "created": resilience.wallclock()}
+            resilience.atomic_write(self._state_path, json.dumps(st, indent=1))
+            return st
+
+    # -- stage plumbing ------------------------------------------------------
+    def _stage(self, cycle: int, name: str,
+               seconds: Optional[int] = None) -> None:
+        label = "cycle %d: %s" % (cycle, name)
+        self.wd(label, seconds)
+        stalled = resilience.maybe_slow_stage(label, defer=True)
+        if stalled:
+            # annotate BEFORE sleeping: the watchdog alarm lands mid-sleep
+            # and the trail must already name the injected stall
+            self.wd.annotate("injected_stall_s", stalled)
+            time.sleep(stalled)
+
+    # -- data / booster construction ----------------------------------------
+    def _binary_cache_path(self) -> str:
+        return self.cfg.output_model + ".window.bin"
+
+    def _cache_fresh(self) -> bool:
+        cache = self._binary_cache_path()
+        try:
+            return os.path.getmtime(cache) >= os.path.getmtime(self.cfg.data)
+        except OSError:
+            return False
+
+    def _make_dataset(self, X, y):
+        from ..basic import Dataset
+        from ..config import Config
+        from ..io.dataset import BinnedDataset
+        params = dict(self.cfg.train_params)
+        if BinnedDataset.is_binary_file(self.cfg.data):
+            ds = Dataset(self.cfg.data, params=params)
+            ds.construct(Config(params))
+            return ds
+        if self.cfg.save_binary and self._cache_fresh():
+            ds = Dataset(self._binary_cache_path(), params=params)
+            ds.construct(Config(params))
+            return ds
+        ds = Dataset(X, label=y, params=params)
+        if self.cfg.save_binary:
+            ds.construct(Config(params))
+            ds.save_binary(self._binary_cache_path())
+        return ds
+
+    def _build_booster(self, X, y, init_model=None, snap_state=None):
+        from ..basic import Booster
+        ds = self._make_dataset(X, y)
+        bst = Booster(params=dict(self.cfg.train_params), train_set=ds,
+                      init_model=init_model)
+        if snap_state is not None:
+            resilience.restore_training_state(bst, snap_state, log=self.log)
+        return bst
+
+    def _model_text(self, booster) -> str:
+        booster._drain()
+        return booster._model.save_model_to_string()
+
+    def _total_iter(self) -> int:
+        return int(self._booster.current_iteration())
+
+    # -- schedule ------------------------------------------------------------
+    def _wait_for_slot(self, t0: float, guard) -> None:
+        """Sleep until the next absolute slot boundary ``t0 + m*interval``
+        strictly in the future, waking early on a preemption signal.  A
+        relaunch lands in whatever slot is next on the SAME clock — the
+        schedule does not drift with downtime."""
+        if self.cfg.interval_s <= 0:
+            return
+        now = time.time()
+        m = max(int(math.ceil((now - t0) / self.cfg.interval_s)), 0)
+        deadline = t0 + m * self.cfg.interval_s
+        if deadline - now < 1e-4:        # exactly on the boundary: take it
+            return
+        while True:
+            if guard.signum is not None:
+                return
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                return
+            time.sleep(min(remaining, 0.05))
+
+    # -- recovery ------------------------------------------------------------
+    def _recover_boost(self, X, y) -> int:
+        """Boost-mode recovery: warm start from the newest valid snapshot
+        and reconcile snapshots against published generations.  Returns
+        the number of COMPLETED cycles."""
+        from ..models.gbdt_model import GBDTModel
+        snap_path, snap_state = resilience.find_resume_snapshot(
+            self.cfg.output_model, log=self.log)
+        init = None
+        if self.cfg.input_model:
+            init = GBDTModel.load_model(self.cfg.input_model)
+            self._base_iter = int(init.current_iteration)
+        if snap_path is None:
+            self._booster = self._build_booster(X, y, init_model=init)
+            return 0
+        svc = snap_state.get("service", {})
+        self._base_iter = int(svc.get("base_iter", self._base_iter))
+        total = int(snap_state["total_iter"])
+        done_cycles = (total - self._base_iter) // self.cfg.rounds
+        self.log.info("online: warm start from %s (iteration %d, "
+                      "%d completed cycles)", snap_path, total, done_cycles)
+        self.wd("recover: warm start")
+        self._booster = self._build_booster(
+            X, y, init_model=GBDTModel.load_model(snap_path),
+            snap_state=snap_state)
+        # republish a cycle whose publish was torn away with the dead
+        # process: the snapshot's own model text IS what that publish
+        # would have carried
+        latest = self.publisher.latest_valid()
+        latest_gen = latest.generation if latest else 0
+        mid = (total - self._base_iter) % self.cfg.rounds
+        if mid == 0 and done_cycles > latest_gen:
+            self.wd("recover: republish generation %d" % done_cycles)
+            text = resilience.snapshot_model_text(snap_path)
+            if text is not None:
+                self.publisher.publish(text, meta=self._gen_meta(
+                    done_cycles, total), generation=done_cycles)
+                self.log.info("online: republished generation %d from the "
+                              "snapshot", done_cycles)
+        return done_cycles
+
+    def _recover_refit(self) -> int:
+        """Refit-mode recovery: the published lineage IS the state."""
+        from ..basic import Booster
+        latest = self.publisher.latest_valid()
+        if latest is None:
+            return 0
+        self._booster = Booster(params=dict(self.cfg.train_params),
+                                model_str=latest.model_text)
+        self.log.info("online: refit mode resumed from published "
+                      "generation %d", latest.generation)
+        return int(latest.meta.get("cycle", latest.generation))
+
+    def _gen_meta(self, cycle: int, total_iter: int) -> Dict[str, Any]:
+        return {"cycle": cycle, "total_iter": int(total_iter),
+                "mode": self.cfg.mode, "rounds_per_cycle": self.cfg.rounds,
+                "window_rows": self.cfg.window_rows}
+
+    # -- the loop ------------------------------------------------------------
+    def run(self) -> int:
+        cfg = self.cfg
+        guard = resilience.PreemptionGuard(cfg.output_model,
+                                           retention=cfg.snapshot_retention,
+                                           log=self.log)
+        producer = _IngestProducer(cfg, log=self.log)
+        producer.start()
+        try:
+            with guard:
+                return self._run_inner(guard, producer)
+        finally:
+            producer.stop()
+            self.wd.done()
+
+    def _run_inner(self, guard, producer) -> int:
+        cfg = self.cfg
+        state = self._load_or_create_state()
+        t0 = float(state["t0"])
+
+        self.wd("ingest: first window")
+        stamp, X, y = producer.current(timeout=max(cfg.stage_timeout, 60))
+        self._window_stamp = stamp
+
+        if cfg.mode == "boost":
+            done = self._recover_boost(X, y)
+        else:
+            done = self._recover_refit()
+        if self._booster is None:
+            self.wd("bootstrap: initial booster")
+            from ..models.gbdt_model import GBDTModel
+            init = GBDTModel.load_model(cfg.input_model) \
+                if cfg.input_model else None
+            if init is not None:
+                self._base_iter = int(init.current_iteration)
+            self._booster = self._build_booster(X, y, init_model=init)
+        # keep base_iter on disk so every relaunch derives the same cycle
+        # arithmetic even before its first snapshot
+        if int(state.get("base_iter", -1)) != self._base_iter:
+            state["base_iter"] = self._base_iter
+            resilience.atomic_write(self._state_path,
+                                    json.dumps(state, indent=1))
+
+        cycle = done + 1
+        while cfg.cycles <= 0 or cycle <= cfg.cycles:
+            self._stage(cycle, "wait for slot", seconds=0)
+            self._wait_for_slot(t0, guard)
+            if guard.signum is not None:
+                return self._preempt(guard, cycle)
+            try:
+                self._run_cycle(cycle, producer, guard)
+            except resilience.StageTimeout as e:
+                self.timeouts += 1
+                self.log.warning("online: %s — cycle %d will be retried at "
+                                 "the next slot", e, cycle)
+                self.wd.annotate("retry", True)
+                continue
+            except resilience.TrainingPreempted:
+                return self._preempt(guard, cycle, snapshot_written=True)
+            if guard.signum is not None:
+                return self._preempt(guard, cycle + 1)
+            cycle += 1
+
+        self.wd("save final model (%s)" % cfg.output_model)
+        self._booster._drain()
+        self._booster.save_model(cfg.output_model)
+        self.wd.done(final=False)
+        self.log.info("online: target of %d cycles reached; final model "
+                      "saved to %s", cfg.cycles, cfg.output_model)
+        return 0
+
+    def _run_cycle(self, cycle: int, producer, guard) -> None:
+        cfg = self.cfg
+
+        # -- ingest: adopt a fresh window if the producer staged one ---------
+        self._stage(cycle, "ingest")
+        stamp, X, y = producer.current(timeout=max(cfg.stage_timeout, 60))
+        if stamp != self._window_stamp and cfg.mode == "boost":
+            # continued training onto the new window: the live engine's
+            # trees carry over as the init model (scores are replayed onto
+            # the new data — reference continued-training semantics)
+            self.log.info("online: data window changed; rebuilding the "
+                          "engine on %d rows", X.shape[0])
+            self._booster = self._build_booster(
+                X, y, init_model=self._booster._model)
+            self._window_stamp = stamp
+        elif stamp != self._window_stamp:
+            self._window_stamp = stamp
+        self._refit_window = (X, y)
+
+        # -- train: to the cycle's absolute iteration target -----------------
+        self._stage(cycle, "train")
+        s0 = syncs.snapshot()
+        refitting = (cfg.mode == "refit"
+                     and self._booster._model.current_iteration > 0)
+        if not refitting:
+            # boost mode every cycle; refit mode's FIRST cycle bootstraps
+            # an initial model the later refit cycles keep re-fitting
+            target = self._base_iter + cfg.rounds * (
+                cycle if cfg.mode == "boost" else 1)
+            while self._total_iter() < target:
+                self._booster.update()
+                if guard.signum is not None:
+                    raise resilience.TrainingPreempted(
+                        guard.signum, self._total_iter(),
+                        self._snapshot(cycle, mid_cycle=True))
+        else:
+            X, y = self._refit_window
+            self._booster = self._booster.refit(X, y)
+        self.wd.annotate("syncs", syncs.delta(s0)["by_label"])
+
+        # -- snapshot (boost mode: full resume state at the boundary) --------
+        if self._booster._engine is not None:
+            self._stage(cycle, "snapshot")
+            self._snapshot(cycle)
+
+        # -- publish ---------------------------------------------------------
+        self._stage(cycle, "publish")
+        t_pub = time.monotonic()
+        rec = self.publisher.publish(
+            self._model_text(self._booster),
+            meta=self._gen_meta(cycle, self._total_iter()),
+            generation=cycle)
+        self.wd.annotate("publish_latency_s",
+                         round(time.monotonic() - t_pub, 4))
+        self.log.info("online: cycle %d published generation %d (%s)",
+                      cycle, rec.generation, os.path.basename(rec.path))
+
+    def _snapshot(self, cycle: int, mid_cycle: bool = False) -> Optional[str]:
+        extra = {"cycle": cycle - 1 if mid_cycle else cycle,
+                 "base_iter": self._base_iter,
+                 "mid_cycle": bool(mid_cycle)}
+        return resilience.write_snapshot(
+            self._booster, self.cfg.output_model,
+            retention=self.cfg.snapshot_retention, log=self.log,
+            extra_state=extra,
+            retention_grace_s=self.cfg.snapshot_grace_s)
+
+    def _preempt(self, guard, cycle: int,
+                 snapshot_written: bool = False) -> int:
+        """Clean preemption exit: the snapshot (written at the iteration
+        boundary) plus the service state file carry everything the next
+        launch needs to finish this cycle and rejoin the slot schedule."""
+        if not snapshot_written and self._booster is not None \
+                and self._booster._engine is not None:
+            self.wd("preempt: final snapshot")
+            self._snapshot(cycle, mid_cycle=True)
+        self.log.warning("online: preempted by signal %s during cycle %d; "
+                         "relaunch with the same parameters to continue the "
+                         "schedule", guard.signum, cycle)
+        return 0

@@ -1,0 +1,306 @@
+"""Atomic model publish/subscribe seam (the serving layer's contract).
+
+The continuous trainer (runtime/continuous.py) must hand freshly trained
+models to consumers — the future serving service (ROADMAP item 3), a
+`NativeBooster`, a plain file watcher — such that a consumer can NEVER
+observe a torn, partial, or checksum-invalid model, no matter when the
+publisher process dies.  The seam is a directory of immutable generation
+files plus a manifest pointer:
+
+* ``gen_<N>.txt`` — the FULL model text (a loadable model file) followed
+  by two footer lines past ``end of trees``: ``!publish_meta=`` (b64 of
+  zlib of JSON: generation, wallclock, training provenance) and
+  ``!publish_checksum=sha256:`` over everything above it.  Written
+  atomically (tmp + fsync + rename) — a generation file either does not
+  exist or is complete and self-validating.
+* ``MANIFEST.json`` — atomic pointer to the newest generation with its
+  full-file sha256.  The manifest is a CACHE: subscribers that find it
+  stale, torn, or missing fall back to a directory scan, so the
+  publisher dying between the generation rename and the manifest write
+  (`LGBM_TPU_FAULT=die_at_publish`) costs freshness, never correctness.
+
+Retention is keep-last-K **plus a grace window**: a generation beyond the
+K newest is only unlinked once it is also older than `grace_s`, so a
+subscriber that just resolved a path cannot have the file deleted out
+from under it between resolve and read (`ModelSubscriber.resolve`
+additionally reads-then-validates in one pass, so even a lost race
+surfaces as "skip and fall back", never as a corrupt observation).
+
+No jax / numpy at module scope — subscribers (serving hosts, test
+pollers) must be able to use this without binding a platform.
+"""
+from __future__ import annotations
+
+import base64
+import contextlib
+import hashlib
+import json
+import os
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import resilience
+
+__all__ = ["ModelPublisher", "ModelSubscriber", "PublishedModel",
+           "NoValidGeneration", "generation_paths", "validate_generation"]
+
+_META_PREFIX = "!publish_meta="
+_CHECKSUM_PREFIX = "!publish_checksum=sha256:"
+_GEN_PREFIX = "gen_"
+_GEN_SUFFIX = ".txt"
+MANIFEST = "MANIFEST.json"
+
+
+class NoValidGeneration(RuntimeError):
+    """No valid published generation could be resolved (after retries)."""
+
+
+def _gen_name(generation: int) -> str:
+    return "%s%08d%s" % (_GEN_PREFIX, generation, _GEN_SUFFIX)
+
+
+def generation_paths(pub_dir: str) -> List[Tuple[int, str]]:
+    """Existing generation files, newest first (by generation number —
+    publication order, not mtime, which a relaunch's republish rewrites)."""
+    out: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(pub_dir)
+    except OSError:
+        return []
+    for name in names:
+        if name.startswith(_GEN_PREFIX) and name.endswith(_GEN_SUFFIX):
+            digits = name[len(_GEN_PREFIX):-len(_GEN_SUFFIX)]
+            if digits.isdigit():
+                out.append((int(digits), os.path.join(pub_dir, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def _with_publish_footer(model_text: str, meta: Dict[str, Any]) -> str:
+    body = model_text
+    if not body.endswith("\n"):
+        body += "\n"
+    blob = base64.b64encode(zlib.compress(json.dumps(meta).encode())).decode()
+    body += _META_PREFIX + blob + "\n"
+    digest = hashlib.sha256(body.encode()).hexdigest()
+    return body + _CHECKSUM_PREFIX + digest + "\n"
+
+
+def _split_validate(text: str) -> Optional[Tuple[str, Dict[str, Any]]]:
+    """(model_text, meta) from a generation file's full text, or None if
+    the file is torn/corrupt/not-a-publication.  Validation runs on the
+    bytes ALREADY READ — there is no second open, so a file pruned or
+    rewritten mid-observation can only ever look invalid, never torn."""
+    if not text.endswith("\n"):
+        return None                      # a complete publish ends in \n
+    lines = text.rstrip("\n").split("\n")
+    if len(lines) < 2 or not lines[-1].startswith(_CHECKSUM_PREFIX):
+        return None
+    digest = lines[-1][len(_CHECKSUM_PREFIX):].strip()
+    body = text[: text.rfind(_CHECKSUM_PREFIX)]
+    if hashlib.sha256(body.encode()).hexdigest() != digest:
+        return None
+    if not lines[-2].startswith(_META_PREFIX):
+        return None
+    try:
+        meta = json.loads(zlib.decompress(
+            base64.b64decode(lines[-2][len(_META_PREFIX):])).decode())
+    except (ValueError, zlib.error):
+        return None
+    model_text = text[: text.rfind(_META_PREFIX)]
+    return model_text, meta
+
+
+def validate_generation(path: str) -> Tuple[bool, str]:
+    """(ok, reason) for a generation file on disk."""
+    try:
+        with open(path, "rb") as fh:
+            text = fh.read().decode("utf-8", "replace")
+    except OSError as e:
+        return False, "unreadable: %s" % e
+    if _split_validate(text) is None:
+        return False, "torn or checksum-invalid"
+    return True, "ok"
+
+
+class PublishedModel:
+    """One resolved generation: the validated bytes travel WITH the
+    resolution (no re-open between validate and use)."""
+
+    __slots__ = ("generation", "path", "model_text", "meta")
+
+    def __init__(self, generation: int, path: str, model_text: str,
+                 meta: Dict[str, Any]):
+        self.generation = generation
+        self.path = path
+        self.model_text = model_text
+        self.meta = meta
+
+
+class ModelPublisher:
+    """Single-writer publication endpoint for one model lineage.
+
+    ``publish(model_text, meta)`` assigns the next generation number
+    (resuming past whatever a dead predecessor left on disk), writes the
+    generation file atomically, updates the manifest, and prunes old
+    generations under the keep-last-K + grace-window rule.
+    """
+
+    def __init__(self, pub_dir: str, keep_last: int = 8,
+                 grace_s: float = 30.0):
+        self.pub_dir = pub_dir
+        self.keep_last = int(keep_last)
+        self.grace_s = float(grace_s)
+        os.makedirs(pub_dir, exist_ok=True)
+        self._publish_count = 0          # this process, 1-based after ++
+
+    # -- state on disk -------------------------------------------------------
+    def latest_valid(self) -> Optional[PublishedModel]:
+        """Newest VALID generation by directory scan (the truth a
+        relaunch reconciles against; the manifest may be stale)."""
+        for gen, path in generation_paths(self.pub_dir):
+            try:
+                with open(path, "rb") as fh:
+                    text = fh.read().decode("utf-8", "replace")
+            except OSError:
+                continue
+            split = _split_validate(text)
+            if split is not None:
+                return PublishedModel(gen, path, split[0], split[1])
+        return None
+
+    def next_generation(self) -> int:
+        gens = generation_paths(self.pub_dir)
+        return (gens[0][0] + 1) if gens else 1
+
+    # -- publication ---------------------------------------------------------
+    def publish(self, model_text: str, meta: Optional[Dict[str, Any]] = None,
+                generation: Optional[int] = None) -> PublishedModel:
+        """Atomically publish one generation; returns the record.
+
+        `generation` overrides the auto-assigned number — the continuous
+        trainer uses this to REPUBLISH a cycle whose original publish was
+        torn or never landed (overwriting a torn file of the same number
+        is safe: the replacement rename is atomic and carries the same
+        bytes an uninterrupted run would have published).
+        """
+        gen = int(generation) if generation is not None \
+            else self.next_generation()
+        full_meta = dict(meta or {})
+        full_meta.setdefault("generation", gen)
+        full_meta.setdefault("published_at", resilience.wallclock())
+        body = _with_publish_footer(model_text, full_meta)
+        path = os.path.join(self.pub_dir, _gen_name(gen))
+        self._publish_count += 1
+        # fault seam: a torn non-atomic write + abrupt death (the write
+        # discipline this publisher exists to make impossible) …
+        resilience.maybe_torn_publish(path, body, self._publish_count)
+        resilience.atomic_write(path, body)
+        # … and an abrupt death in the rename→manifest window
+        resilience.maybe_die_at_publish(self._publish_count)
+        self._write_manifest(gen, path, body)
+        self._prune()
+        return PublishedModel(gen, path, model_text, full_meta)
+
+    def _write_manifest(self, gen: int, path: str, body: str) -> None:
+        manifest = {
+            "latest": gen,
+            "file": os.path.basename(path),
+            "sha256": hashlib.sha256(body.encode()).hexdigest(),
+            "published_at": resilience.wallclock(),
+            "keep_last": self.keep_last,
+            "grace_s": self.grace_s,
+        }
+        resilience.atomic_write(os.path.join(self.pub_dir, MANIFEST),
+                                json.dumps(manifest, indent=1))
+
+    def _prune(self) -> None:
+        """keep-last-K AND older-than-grace: both conditions must hold
+        before a generation is unlinked (satellite pin: a subscriber that
+        just resolved a path must get to read it)."""
+        if self.keep_last <= 0:
+            return
+        cutoff = time.time() - max(self.grace_s, 0.0)
+        for gen, old in generation_paths(self.pub_dir)[self.keep_last:]:
+            with contextlib.suppress(OSError):
+                if self.grace_s <= 0 or os.path.getmtime(old) < cutoff:
+                    os.unlink(old)
+
+
+class ModelSubscriber:
+    """Read-side resolution of the newest valid generation.
+
+    ``resolve()`` returns a `PublishedModel` whose bytes were validated
+    in the same pass that read them; torn/corrupt/vanished generations
+    are skipped (and counted in ``skipped_invalid`` — the chaos soak's
+    corruption ledger is exactly this counter staying at the number of
+    faults injected, with ``corrupt_observed`` at zero).  When NOTHING
+    valid exists yet (subscriber raced the very first publish), it
+    retries with bounded jittered backoff before raising
+    `NoValidGeneration`.
+    """
+
+    def __init__(self, pub_dir: str, attempts: int = 4,
+                 backoff_base: float = 0.05, backoff_cap: float = 0.5,
+                 seed: int = 0):
+        self.pub_dir = pub_dir
+        self.attempts = max(int(attempts), 1)
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.seed = seed
+        self.skipped_invalid = 0         # torn/corrupt files stepped past
+        self.resolved_count = 0
+
+    def _candidates(self) -> List[Tuple[int, str]]:
+        """Generation candidates newest-first: the manifest pointer is
+        tried first (one read instead of a directory scan in the common
+        case), then the scan — a stale or torn manifest only costs the
+        fallback, and a manifest pointing at a BETTER generation than the
+        scan can see cannot happen (the generation file is renamed into
+        place before the manifest names it)."""
+        cands: List[Tuple[int, str]] = []
+        try:
+            with open(os.path.join(self.pub_dir, MANIFEST)) as fh:
+                m = json.load(fh)
+            cands.append((int(m["latest"]),
+                          os.path.join(self.pub_dir, str(m["file"]))))
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
+        seen = {c[0] for c in cands}
+        cands.extend((g, p) for g, p in generation_paths(self.pub_dir)
+                     if g not in seen)
+        cands.sort(reverse=True)
+        return cands
+
+    def resolve_once(self) -> Optional[PublishedModel]:
+        """One resolution attempt (no retry).  Never raises on torn or
+        vanishing files — those are skipped."""
+        for gen, path in self._candidates():
+            try:
+                with open(path, "rb") as fh:
+                    text = fh.read().decode("utf-8", "replace")
+            except OSError:
+                continue                 # pruned between listing and open
+            split = _split_validate(text)
+            if split is None:
+                self.skipped_invalid += 1
+                continue
+            self.resolved_count += 1
+            return PublishedModel(gen, path, split[0], split[1])
+        return None
+
+    def resolve(self) -> PublishedModel:
+        delays = resilience.backoff_delays(self.attempts,
+                                           base=self.backoff_base,
+                                           cap=self.backoff_cap,
+                                           seed=self.seed)
+        for a in range(self.attempts):
+            rec = self.resolve_once()
+            if rec is not None:
+                return rec
+            if a < len(delays):
+                time.sleep(delays[a])
+        raise NoValidGeneration(
+            "no valid published generation in %r after %d attempts"
+            % (self.pub_dir, self.attempts))

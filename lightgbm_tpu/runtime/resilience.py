@@ -70,7 +70,8 @@ __all__ = [
     "NonFiniteDetected", "SentinelGuard",
     "fault_arg", "fault_active", "maybe_die_or_preempt",
     "maybe_probe_hang_seconds", "maybe_corrupt_snapshot",
-    "maybe_inject_nan",
+    "maybe_inject_nan", "maybe_slow_stage", "maybe_torn_publish",
+    "maybe_die_at_publish", "snapshot_model_text",
 ]
 
 
@@ -89,7 +90,8 @@ def wallclock() -> str:
 #: loudly — a typoed fault name silently injecting nothing would make a
 #: "green under fault" test meaningless.
 FAULT_NAMES = ("hang_import", "die_at_iter", "sigterm_at_iter",
-               "corrupt_snapshot", "nan_grad", "bogus_platform")
+               "corrupt_snapshot", "nan_grad", "bogus_platform",
+               "torn_write", "slow_stage", "die_at_publish")
 
 
 def _fault_spec() -> Dict[str, Optional[str]]:
@@ -199,6 +201,75 @@ def maybe_inject_nan(engine, host: Dict) -> None:
     host["leaf_value"][:] = float("nan")
 
 
+#: stages already stalled by `slow_stage` this process — the injection is
+#: one-shot per process (it models a transient stall, e.g. a filesystem
+#: hiccup; a permanent stall would just crash-loop the service and prove
+#: nothing about recovery).
+_SLOW_STAGES_FIRED: set = set()
+
+
+def maybe_slow_stage(stage_name: str, defer: bool = False) -> float:
+    """`slow_stage:NAME:SECS` stalls the first stage whose name contains
+    NAME for SECS seconds — long enough to blow the stage's watchdog
+    deadline, which is the point: the service must surface the timeout in
+    the stage trail and carry on with the next cycle.  Returns the
+    injected stall (0.0 when nothing fired); `defer=True` skips the sleep
+    so the caller can record the injection in its stage trail FIRST (the
+    watchdog alarm lands mid-sleep, after which nothing else runs)."""
+    if not fault_active("slow_stage"):
+        return 0.0
+    arg = fault_arg("slow_stage", "")
+    name, _, secs = (arg or "").partition(":")
+    if not name or name not in stage_name or name in _SLOW_STAGES_FIRED:
+        return 0.0
+    _SLOW_STAGES_FIRED.add(name)
+    stall = float(secs or "5")
+    sys.stderr.write("[%s] FAULT slow_stage: stalling stage %r for %.1fs\n"
+                     % (wallclock(), stage_name, stall))
+    sys.stderr.flush()
+    if not defer:
+        time.sleep(stall)
+    return stall
+
+
+def maybe_torn_publish(path: str, body: str, publish_count: int) -> None:
+    """`torn_write[:K]` models a publisher whose K-th publish (1-based;
+    every publish when K is omitted) lands TORN on disk and whose process
+    dies before it can repair anything: half the body is written straight
+    to the FINAL path (no tmp, no fsync, no rename — exactly the
+    non-atomic write the real publisher never performs) and the process
+    exits abruptly.  Subscribers must reject the torn generation via its
+    checksum; the relaunched publisher must republish it."""
+    if not fault_active("torn_write"):
+        return
+    arg = fault_arg("torn_write")
+    if arg is not None and int(arg) != int(publish_count):
+        return
+    with open(path, "w") as fh:
+        fh.write(body[: max(len(body) // 2, 1)])
+    sys.stderr.write("[%s] FAULT torn_write: tore publish #%d at %s and "
+                     "dying\n" % (wallclock(), publish_count, path))
+    sys.stderr.flush()
+    os._exit(137)
+
+
+def maybe_die_at_publish(publish_count: int) -> None:
+    """`die_at_publish:K` kills the process BETWEEN the generation file's
+    atomic rename and the manifest update of the K-th publish (1-based) —
+    the window where the newest valid generation on disk is ahead of the
+    manifest pointer.  Subscribers must still resolve a valid model and
+    the relaunched publisher must reconcile."""
+    if not fault_active("die_at_publish"):
+        return
+    if int(fault_arg("die_at_publish", "1")) != int(publish_count):
+        return
+    sys.stderr.write("[%s] FAULT die_at_publish: abrupt exit mid-publish "
+                     "#%d (generation renamed, manifest stale)\n"
+                     % (wallclock(), publish_count))
+    sys.stderr.flush()
+    os._exit(137)
+
+
 # ---------------------------------------------------------------------------
 # stage watchdog
 # ---------------------------------------------------------------------------
@@ -299,9 +370,24 @@ class Watchdog:
                   % (wallclock(), self.label, stage, budget))
         out.flush()
         self._persist()
-        if hasattr(signal, "SIGALRM") and budget > 0:
-            signal.signal(signal.SIGALRM, self._fire)
-            signal.alarm(budget)
+        if hasattr(signal, "SIGALRM"):
+            if budget > 0:
+                signal.signal(signal.SIGALRM, self._fire)
+                signal.alarm(budget)
+            else:
+                # an UNBOUNDED stage must disarm the previous stage's
+                # alarm — otherwise it fires minutes later and blames
+                # this stage for the last one's deadline
+                signal.alarm(0)
+
+    def annotate(self, key: str, value: Any) -> None:
+        """Attach structured evidence (sync-audit deltas, injected-fault
+        notes, publish latencies) to the CURRENT stage's trail entry and
+        re-persist — the stage trail is the service's flight recorder, so
+        per-stage measurements belong in it, not in a side channel."""
+        if self.stages:
+            self.stages[-1][key] = value
+            self._persist()
 
     @contextlib.contextmanager
     def stage_scope(self, stage: str, seconds: Optional[int] = None):
@@ -565,6 +651,24 @@ def load_snapshot_state(path: str, _prevalidated_text: Optional[str] = None
     return None
 
 
+def snapshot_model_text(path: str) -> Optional[str]:
+    """The model-text portion of a snapshot file (everything before the
+    state footer) — what `save_model_to_string()` produced at capture
+    time, byte-for-byte.  The continuous trainer republishes from this
+    after a death between snapshot and publish, so the republished
+    generation is byte-identical to what the dead process would have
+    published.  None when the file has no footer (not a snapshot)."""
+    try:
+        with open(path) as fh:
+            text = fh.read()
+    except OSError:
+        return None
+    cut = text.find(_STATE_PREFIX)
+    if cut < 0:
+        return None
+    return text[:cut]
+
+
 def snapshot_paths(output_model: str) -> List[Tuple[int, str]]:
     """Existing ``<output_model>.snapshot_iter_<N>`` files, newest first."""
     d = os.path.dirname(os.path.abspath(output_model)) or "."
@@ -800,13 +904,29 @@ def make_resume_callback(state: Dict[str, Any], log=None):
 
 
 def write_snapshot(booster, output_model: str, total_iter: Optional[int] = None,
-                   retention: int = -1, log=None) -> Optional[str]:
+                   retention: int = -1, log=None,
+                   extra_state: Optional[Dict[str, Any]] = None,
+                   retention_grace_s: float = 0.0) -> Optional[str]:
     """Atomic snapshot ``<output_model>.snapshot_iter_<N>`` carrying the
     model plus the resume state footer, with keep-last-`retention`
     cleanup (``<= 0`` keeps everything).  Refuses to snapshot non-finite
-    scores (a poisoned snapshot would just re-poison the resume)."""
+    scores (a poisoned snapshot would just re-poison the resume).
+
+    `extra_state` is merged under the footer's ``"service"`` key — the
+    continuous trainer records its schedule clock there; resume ignores
+    unknown keys, so plain `task=train` snapshots are unaffected.
+
+    `retention_grace_s > 0` hardens keep-last-K against concurrent
+    readers: a snapshot beyond the K newest is only unlinked once it is
+    also OLDER than the grace window, so a reader that just resolved a
+    path (a resume scan racing the trainer, a debugging copy) cannot
+    have the file deleted out from under it mid-read.  The default 0
+    keeps the historical behavior for batch training, where pruning only
+    runs in the single writer process."""
     import numpy as np
     state = capture_training_state(booster)
+    if extra_state:
+        state["service"] = dict(extra_state)
     if total_iter is None:
         total_iter = state["total_iter"]
     score = _np_b64(state["score"], np.float32,
@@ -821,9 +941,11 @@ def write_snapshot(booster, output_model: str, total_iter: Optional[int] = None,
         booster._model.save_model_to_string(), state))
     maybe_corrupt_snapshot(path, total_iter)
     if retention > 0:
+        cutoff = time.time() - max(retention_grace_s, 0.0)
         for it, old in snapshot_paths(output_model)[retention:]:
             with contextlib.suppress(OSError):
-                os.unlink(old)
+                if retention_grace_s <= 0 or os.path.getmtime(old) < cutoff:
+                    os.unlink(old)
     return path
 
 

@@ -440,3 +440,103 @@ def test_predict_for_file_on_training_booster(problem, tmp_path):
     np.testing.assert_array_equal(np.loadtxt(out_f), ref)
     _check(lib, lib.LGBM_BoosterFree(bst))
     _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_c_reset_parameter_matches_python(problem):
+    """LGBM_BoosterResetParameter (ISSUE 6 satellite): a mid-training
+    learning_rate change through the C ABI lands on the next
+    UpdateOneIter, producing a model identical to the Python engine
+    doing the same reset_parameter at the same iteration."""
+    lib = _lib()
+    X, y = problem
+    ds = _c_dataset(lib, X, y)
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(ds, PARAMS.encode(),
+                                       ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(4):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    _check(lib, lib.LGBM_BoosterResetParameter(bst, b"learning_rate=0.37"))
+    for _ in range(4):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    slen = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterSaveModelToString(
+        bst, -1, ctypes.c_int64(0), ctypes.byref(slen), None))
+    buf = ctypes.create_string_buffer(slen.value)
+    _check(lib, lib.LGBM_BoosterSaveModelToString(
+        bst, -1, slen, ctypes.byref(slen), buf))
+
+    pybst = lgb.Booster(dict(PY_PARAMS), lgb.Dataset(X, label=y))
+    for _ in range(4):
+        pybst.update()
+    pybst.reset_parameter({"learning_rate": 0.37})
+    for _ in range(4):
+        pybst.update()
+    pybst._drain()                      # the async pipeline may still hold
+    assert buf.value.decode().strip() == \
+        pybst._model.save_model_to_string().strip()
+
+    # a prediction-only (loaded) booster must refuse the training call
+    h2 = ctypes.c_void_p()
+    it = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterLoadModelFromString(
+        buf.value, ctypes.byref(it), ctypes.byref(h2)))
+    assert lib.LGBM_BoosterResetParameter(h2, b"learning_rate=0.5") != 0
+    assert b"training booster" in lib.LGBM_GetLastError()
+    _check(lib, lib.LGBM_BoosterFree(h2))
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_c_refit_matches_python(problem):
+    """LGBM_BoosterRefit (ISSUE 6 satellite): refit to a new window
+    through the C ABI keeps every split, replaces the handle's model in
+    place, and matches Booster.refit on the same data byte-for-byte —
+    the same engine path the online trainer's refit mode drives."""
+    lib = _lib()
+    X, y = problem
+    rng = np.random.default_rng(23)
+    X2 = X + 0.05 * rng.standard_normal(X.shape).astype(np.float32)
+    y2 = (X2[:, 0] + 0.4 * X2[:, 1] > 0.1).astype(np.float32)
+
+    ds = _c_dataset(lib, X, y)
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(ds, PARAMS.encode(),
+                                       ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(6):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    # python reference: the same training run, then refit
+    pybst = lgb.Booster(dict(PY_PARAMS), lgb.Dataset(X, label=y))
+    for _ in range(6):
+        pybst.update()
+    py_refit = pybst.refit(np.asarray(X2, np.float64), y2.astype(np.float64))
+
+    from lightgbm_tpu import capi
+    capi.booster_refit(bst, np.asarray(X2, np.float64), y2)
+
+    slen = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterSaveModelToString(
+        bst, -1, ctypes.c_int64(0), ctypes.byref(slen), None))
+    buf = ctypes.create_string_buffer(slen.value)
+    _check(lib, lib.LGBM_BoosterSaveModelToString(
+        bst, -1, slen, ctypes.byref(slen), buf))
+    assert buf.value.decode().strip() == \
+        py_refit._model.save_model_to_string().strip()
+
+    # the refit model serves predictions through the SAME handle
+    n = X2.shape[0]
+    out = np.zeros(n, np.float64)
+    olen = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, np.ascontiguousarray(X2, np.float64).ctypes.data_as(
+            ctypes.c_void_p),
+        F64, ctypes.c_int32(n), ctypes.c_int32(X2.shape[1]), 1, 0, -1,
+        b"", ctypes.byref(olen),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_allclose(out, py_refit.predict(X2),
+                               rtol=0, atol=1e-12)
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds))

@@ -436,3 +436,59 @@ def test_init_distributed_succeeds_after_transient_failure(monkeypatch):
     rank = launch.init_distributed(machines="10.255.0.1:1,10.255.0.2:1",
                                    node_rank=0, attempts=3, timeout_s=1)
     assert rank == 0 and len(calls) == 2
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6 satellites: deeper resume-scan and pipeline-drain preemption
+# ---------------------------------------------------------------------------
+
+def test_resume_scan_past_three_mixed_corrupt_snapshots(tmp_path):
+    """One resume scan must step past >=3 differently broken snapshots
+    (truncated, bit-flipped, footer stripped) to the newest VALID one."""
+    X, y = _data(seed=9)
+    bst = lgb.Booster({"objective": "binary", "verbose": -1},
+                      lgb.Dataset(X, label=y))
+    out = str(tmp_path / "m.txt")
+    for i in range(5):
+        bst.update()
+        resilience.write_snapshot(bst, out)
+    paths = {it: p for it, p in resilience.snapshot_paths(out)}
+    raw5 = open(paths[5], "rb").read()
+    open(paths[5], "wb").write(raw5[: len(raw5) // 3])          # truncated
+    raw4 = open(paths[4], "rb").read()
+    open(paths[4], "wb").write(raw4.replace(b"leaf_value", b"leaf_valXe"))
+    raw3 = open(paths[3]).read()                                # footerless
+    open(paths[3], "w").write(raw3.split(
+        resilience._STATE_PREFIX)[0])
+    snap, state = resilience.find_resume_snapshot(out)
+    assert snap == paths[2]
+    assert state["total_iter"] == 2
+    # and all three invalid ones have distinct failure reasons
+    reasons = {it: resilience.validate_snapshot(paths[it])[1]
+               for it in (3, 4, 5)}
+    assert all(not resilience.validate_snapshot(paths[it])[0]
+               for it in (3, 4, 5)), reasons
+
+
+def test_sigterm_during_pipeline_drain_depth2(tmp_path):
+    """SIGTERM landing while the async dispatch pipeline is in flight at
+    pipeline_depth=2 still produces rc=0 and a VALID final snapshot (the
+    preemption callback drains before capturing state), and the resumed
+    model is byte-identical to an uninterrupted depth-2 run."""
+    X, y = _data()
+    np.savetxt(tmp_path / "train.tsv", np.column_stack([y, X]),
+               delimiter="\t", fmt="%.8g")
+    common = _TRAIN_ARGS + ["data=train.tsv", "pipeline_depth=2"]
+    _cli(tmp_path, common + ["output_model=a.txt"])
+    r = _cli(tmp_path, common + ["output_model=b.txt"],
+             fault="sigterm_at_iter:5")
+    assert r.returncode == 0
+    assert "preempt" in (r.stdout + r.stderr).lower()
+    assert not (tmp_path / "b.txt").exists()
+    snaps = resilience.snapshot_paths(str(tmp_path / "b.txt"))
+    assert len(snaps) == 1
+    ok, reason = resilience.validate_snapshot(snaps[0][1])
+    assert ok, reason
+    _cli(tmp_path, common + ["output_model=b.txt", "resume=true"])
+    assert (tmp_path / "b.txt").read_bytes() == \
+        (tmp_path / "a.txt").read_bytes()
