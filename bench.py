@@ -31,6 +31,21 @@ import numpy as np
 BASELINE_ITERS_PER_SEC = 500.0 / 238.51  # reference CPU Higgs
 REFERENCE_HIGGS_AUC = 0.845154           # @500 iters, real Higgs
 
+#: section toggles that must SURVIVE the CPU-fallback re-exec (the
+#: hermetic whitelist drops the environment): a caller that opted a
+#: section out — or reshaped it — must get the same sections back at
+#: CPU-fallback speed.  Every BENCH_<SECTION> env knob belongs here;
+#: tests/test_bench_phases.py pins membership so a new section cannot
+#: silently lose its toggles across the fallback.
+FALLBACK_SECTION_ENV = (
+    "BENCH_PREDICT", "BENCH_PREDICT_ROWS", "BENCH_PHASES",
+    "BENCH_HIST_QUANT", "BENCH_FRONTIER_BATCH",
+    "BENCH_ONLINE", "BENCH_ONLINE_ROWS",
+    "BENCH_ONLINE_CYCLES", "BENCH_ONLINE_ROUNDS",
+    "BENCH_SERVE", "BENCH_SERVE_CLIENTS", "BENCH_SERVE_SECONDS",
+    "BENCH_SERVE_TREES", "BENCH_SERVE_LEAVES", "BENCH_SERVE_BATCH",
+)
+
 #: most recent bench measured on REAL TPU hardware (updated by hand after
 #: every hardware session).  Included in the CPU-fallback JSON so a
 #: dead-tunnel round still surfaces the verified on-chip state; the
@@ -316,6 +331,95 @@ def bench_online():
         }
 
 
+def bench_serve():
+    """BENCH_SERVE: the fault-tolerant serving runtime (ISSUE 7) under
+    concurrent client load — request p50/p99 latency, served rows/sec,
+    and hot-swap latency (publish of generation 2 -> first response that
+    reports it), with zero drops asserted.  The model is the synthetic
+    serving-shape ensemble (no training run needed);
+    BENCH_SERVE_{CLIENTS,SECONDS,TREES,LEAVES,BATCH} reshape it."""
+    import tempfile
+    import threading
+
+    from lightgbm_tpu.runtime import publish as pubmod
+    from lightgbm_tpu.runtime.serving import ServeRejected, ServingRuntime
+
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
+    seconds = float(os.environ.get("BENCH_SERVE_SECONDS", 6))
+    n_trees = int(os.environ.get("BENCH_SERVE_TREES", 100))
+    num_leaves = int(os.environ.get("BENCH_SERVE_LEAVES", 63))
+    req_rows = int(os.environ.get("BENCH_SERVE_BATCH", 8))
+    n_feat = 28
+    rng = np.random.default_rng(23)
+    rows = rng.standard_normal((4096, n_feat))
+    with tempfile.TemporaryDirectory(prefix="bench_serve_") as d:
+        pub = pubmod.ModelPublisher(os.path.join(d, "pub"), keep_last=0)
+        pub.publish(synth_serving_model(n_trees, num_leaves, n_feat,
+                                        seed=3).save_model_to_string(),
+                    meta={"cycle": 1})
+        latencies, shed, errors = [], [0], []
+        swap = {"published": None, "seen": None}
+        stop = threading.Event()
+        with ServingRuntime(publish_dir=os.path.join(d, "pub"),
+                            poll_interval_s=0.05,
+                            batch_window_s=0.001) as rt:
+            def client(seed):
+                crng = np.random.default_rng(seed)
+                while not stop.is_set():
+                    idx = crng.integers(0, len(rows), size=req_rows)
+                    t0 = time.perf_counter()
+                    try:
+                        rec = rt.predict(rows[idx], attempts=1)
+                    except ServeRejected:
+                        shed[0] += 1
+                        continue
+                    except Exception as e:   # noqa: BLE001 — ledger
+                        errors.append(str(e))
+                        continue
+                    latencies.append(time.perf_counter() - t0)
+                    if rec.generation == 2 and swap["seen"] is None:
+                        swap["seen"] = time.monotonic()
+
+            threads = [threading.Thread(target=client, args=(i,),
+                                        daemon=True)
+                       for i in range(clients)]
+            t_start = time.perf_counter()
+            for t in threads:
+                t.start()
+            time.sleep(seconds / 2)
+            swap["published"] = time.monotonic()
+            pub.publish(synth_serving_model(n_trees, num_leaves, n_feat,
+                                            seed=4).save_model_to_string(),
+                        meta={"cycle": 2})
+            time.sleep(seconds / 2)
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            dt = time.perf_counter() - t_start
+            st = rt.stats()
+        if errors:
+            raise RuntimeError("serve bench saw %d hard errors; first: %s"
+                               % (len(errors), errors[0]))
+        lat = np.asarray(latencies) if latencies else np.asarray([0.0])
+        return {
+            "clients": clients, "request_rows": req_rows,
+            "n_trees": n_trees, "num_leaves": num_leaves,
+            "requests": len(latencies), "shed": shed[0],
+            "rows_per_sec": round(st["rows_served"] / dt, 1),
+            "latency_ms": {
+                "p50": round(float(np.percentile(lat, 50)) * 1e3, 3),
+                "p99": round(float(np.percentile(lat, 99)) * 1e3, 3),
+                "max": round(float(lat.max()) * 1e3, 3)},
+            "swap_latency_s": (round(swap["seen"] - swap["published"], 3)
+                               if swap["seen"] else None),
+            "batches_device": st["batches_device"],
+            "batches_host": st["batches_host"],
+            "degradations": st["degradations"],
+            "note": "zero-drop asserted: every request completed or was "
+                    "shed with an explicit retryable rejection",
+        }
+
+
 #: per-flag verdicts from the staged-kernel probe (None = probe not run);
 #: recorded in the bench JSON so an unattended hardware window leaves
 #: evidence for the human flip (exp/flip_validated.py)
@@ -426,10 +530,7 @@ def main():
         # section toggles must survive the re-exec (the hermetic whitelist
         # dropped them): a caller that opted out of the predict/phase
         # sections must not get them back at CPU-fallback speed
-        for k in ("BENCH_PREDICT", "BENCH_PREDICT_ROWS", "BENCH_PHASES",
-                  "BENCH_HIST_QUANT", "BENCH_FRONTIER_BATCH",
-                  "BENCH_ONLINE", "BENCH_ONLINE_ROWS",
-                  "BENCH_ONLINE_CYCLES", "BENCH_ONLINE_ROUNDS"):
+        for k in FALLBACK_SECTION_ENV:
             if k in os.environ:
                 env[k] = os.environ[k]
         os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
@@ -699,6 +800,22 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
                                   "above is unaffected"}
             stage("online bench FAILED (diagnostics only)")
 
+    # serving-runtime bench (BENCH_SERVE=0 skips): p50/p99 request
+    # latency, rows/sec and hot-swap latency under concurrent clients.
+    # Guarded — a failure is recorded, never fatal to the headline.
+    serve_rec = None
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        try:
+            serve_rec = bench_serve()
+            stage("serve bench done (%.0f rows/s, p99 %.1f ms)"
+                  % (serve_rec["rows_per_sec"],
+                     serve_rec["latency_ms"]["p99"]))
+        except Exception as e:
+            serve_rec = {"error": "%s: %s" % (type(e).__name__, e),
+                         "note": "serve bench failed; headline result "
+                                 "above is unaffected"}
+            stage("serve bench FAILED (diagnostics only)")
+
     if isinstance(phases, dict):
         # the sync-audit counters ride the default phases output so every
         # bench record carries the blocking-fetch split next to the wall
@@ -751,6 +868,8 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
         result["predict"] = predict_rec
     if online_rec is not None:
         result["online"] = online_rec
+    if serve_rec is not None:
+        result["serve"] = serve_rec
     if hist_quant is not None:
         result["hist_quant"] = hist_quant
     if STAGED_REPORT is not None:

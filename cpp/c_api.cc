@@ -424,6 +424,103 @@ int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration) {
   return 0;
 }
 
+int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
+                                     int* out_tree_per_iteration) {
+  ModelRef ref(handle);
+  Model* m = ref.m;
+  if (m == nullptr) return -1;
+  *out_tree_per_iteration = m->num_tree_per_iteration;
+  return 0;
+}
+
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double* out_val) {
+  ModelRef ref(handle);
+  Model* m = ref.m;
+  if (m == nullptr) return -1;
+  if (tree_idx < 0 || tree_idx >= static_cast<int>(m->trees.size()))
+    return Fail("tree_idx " + std::to_string(tree_idx) +
+                " out of range for " + std::to_string(m->trees.size()) +
+                " trees");
+  const Tree& t = m->trees[tree_idx];
+  if (leaf_idx < 0 || leaf_idx >= static_cast<int>(t.leaf_value.size()))
+    return Fail("leaf_idx " + std::to_string(leaf_idx) +
+                " out of range for " + std::to_string(t.leaf_value.size()) +
+                " leaves");
+  *out_val = t.leaf_value[leaf_idx];
+  return 0;
+}
+
+namespace {
+
+// Rewrite one leaf_value token of one tree block in the stored model
+// text, so SaveModel/SaveModelToString round-trips carry the patch.
+// Only the patched token is reformatted (%.17g round-trips doubles);
+// every other byte of the text is preserved.
+bool PatchLeafValueInText(std::string* text, int tree_idx, int leaf_idx,
+                          double val) {
+  size_t pos = 0;
+  for (int seen = 0;; ++seen) {
+    pos = text->find("Tree=", pos);
+    if (pos == std::string::npos) return false;
+    if (pos != 0 && (*text)[pos - 1] != '\n') {  // mid-line match
+      pos += 5;
+      --seen;
+      continue;
+    }
+    if (seen == tree_idx) break;
+    pos += 5;
+  }
+  size_t next_tree = text->find("\nTree=", pos);
+  size_t lv = text->find("\nleaf_value=", pos);
+  if (lv == std::string::npos || (next_tree != std::string::npos &&
+                                  lv > next_tree))
+    return false;
+  size_t start = lv + strlen("\nleaf_value=");
+  size_t end = text->find('\n', start);
+  if (end == std::string::npos) end = text->size();
+  std::vector<std::string> toks = SplitWs(text->substr(start, end - start));
+  if (leaf_idx < 0 || leaf_idx >= static_cast<int>(toks.size()))
+    return false;
+  char buf[40];
+  std::snprintf(buf, sizeof(buf), "%.17g", val);
+  toks[leaf_idx] = buf;
+  std::string joined;
+  for (size_t i = 0; i < toks.size(); ++i) {
+    if (i) joined += ' ';
+    joined += toks[i];
+  }
+  text->replace(start, end - start, joined);
+  return true;
+}
+
+}  // namespace
+
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double val) {
+  if (lgbm_tpu_internal::IsTrainBooster(handle))
+    return Fail("LGBM_BoosterSetLeafValue: training boosters are read-only "
+                "through the C model surface (their native model is "
+                "resynced from the engine); patch leaves on the Python "
+                "Booster instead");
+  Model* m = static_cast<Model*>(handle);
+  if (m == nullptr) return -1;
+  if (tree_idx < 0 || tree_idx >= static_cast<int>(m->trees.size()))
+    return Fail("tree_idx " + std::to_string(tree_idx) +
+                " out of range for " + std::to_string(m->trees.size()) +
+                " trees");
+  Tree& t = m->trees[tree_idx];
+  if (leaf_idx < 0 || leaf_idx >= static_cast<int>(t.leaf_value.size()))
+    return Fail("leaf_idx " + std::to_string(leaf_idx) +
+                " out of range for " + std::to_string(t.leaf_value.size()) +
+                " leaves");
+  if (!PatchLeafValueInText(&m->text, tree_idx, leaf_idx, val))
+    return Fail("could not locate tree " + std::to_string(tree_idx) +
+                "'s leaf_value line in the stored model text");
+  t.leaf_value[leaf_idx] = val;
+  return 0;
+}
+
 int LGBM_BoosterSaveModel(BoosterHandle handle, int num_iteration,
                           const char* filename) {
   int64_t len = 0;

@@ -60,6 +60,25 @@ int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len);
 
 int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration);
 
+/* Trees per iteration (reference LGBM_BoosterNumModelPerIteration):
+ * 1 for binary/regression, num_class for multiclass — callers size
+ * per-iteration tree arithmetic with this. */
+int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
+                                     int* out_tree_per_iteration);
+
+/* Leaf-level access (reference LGBM_BoosterGetLeafValue/SetLeafValue).
+ * SetLeafValue is the serving-side patch primitive: it updates BOTH the
+ * in-memory tree used by every predict entry point and the stored model
+ * text (so SaveModel/SaveModelToString round-trips carry the patch).
+ * Training boosters are read-only through this surface (their model is
+ * resynced from the Python engine; patch via the Python Booster) —
+ * SetLeafValue on one fails with an explanatory error. */
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double* out_val);
+
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double val);
+
 int LGBM_BoosterSaveModel(BoosterHandle handle, int num_iteration,
                           const char* filename);
 

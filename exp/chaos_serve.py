@@ -1,0 +1,371 @@
+#!/usr/bin/env python
+"""Chaos soak for the serving runtime (ISSUE 7 acceptance).
+
+Hammers a live `ServingRuntime` with concurrent clients while THREE
+fault families churn underneath it:
+
+* **device kill/stall** — `LGBM_TPU_FAULT=die_at_predict:1` (every
+  device batch raises) and `slow_predict:S` (every device batch stalls
+  past the predict deadline) are armed and cleared in randomized
+  windows: the server must degrade to the host predictor, keep
+  answering, and recover to the device path when the window closes;
+* **publish churn** — every generation is published by a SUBPROCESS
+  publisher that may die torn (`torn_write:1`) or die between the
+  generation rename and the manifest write (`die_at_publish:1`); the
+  relaunch republishes, and the serving poller must never swap in a
+  torn model;
+* **overload** — the bounded queue sheds under the stall windows; every
+  shed request must carry an explicit machine-readable RETRYABLE
+  rejection.
+
+The pins, asserted here and (tier-1-sized) in tests/test_serving.py:
+
+* **zero torn or wrong-generation responses** — every completed
+  response names a generation that was actually published, and its
+  values are byte-identical to offline `Booster.predict` for that
+  generation (host-served responses against the exact f64 host path,
+  device-served against the device path — per-row device outputs are
+  batch-composition invariant, pinned in tests/test_serving.py);
+* **zero drops** — every admitted request completes or is explicitly
+  rejected; nothing hangs, nothing vanishes.
+
+Usage:  python exp/chaos_serve.py [generations] [artifact.json]
+        (defaults: 16 generations, CHAOS_SERVE_r07.json at the repo root)
+        python exp/chaos_serve.py --publish <pub_dir> <gen> <text_file>
+        (internal: one subprocess publish, faults via LGBM_TPU_FAULT)
+Env:    CHAOS_SERVE_SEED, CHAOS_SERVE_CLIENTS
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from lightgbm_tpu.runtime import publish, resilience  # noqa: E402
+
+#: serving fault windows one churn step draws from (None = quiet step).
+#: die_at_predict kills every device batch while armed; slow_predict
+#: stalls every device batch past the runtime's predict deadline.
+SERVE_FAULT_POOL = [None, "die_at_predict:1", "slow_predict:0.6"]
+
+#: publisher-side faults (the subprocess publisher dies mid-publish and
+#: the parent relaunches it — PR 6's churn, now observed from the
+#: consuming side).
+PUBLISH_FAULT_POOL = [None, None, "torn_write:1", "die_at_publish:1"]
+
+
+def _train_generations(n_gens: int, rounds: int, seed: int = 7):
+    """One continued-training lineage: generation g = g*rounds
+    iterations.  Returns (texts, probe, ref_host, ref_dev) — the model
+    text per generation plus offline Booster.predict references for the
+    probe rows through BOTH serving paths (computed before any fault is
+    armed)."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.basic import Booster
+
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((500, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.4 * X[:, 1]
+         + 0.3 * rng.standard_normal(500) > 0).astype(np.float64)
+    bst = lgb.Booster({"objective": "binary", "num_leaves": 15,
+                       "verbose": -1, "seed": 7},
+                      lgb.Dataset(X, label=y))
+    texts: Dict[int, str] = {}
+    for g in range(1, n_gens + 1):
+        for _ in range(rounds):
+            bst.update()
+        texts[g] = bst.model_to_string()
+    probe = rng.standard_normal((64, 6))
+    ref_host, ref_dev = {}, {}
+    for g, text in texts.items():
+        b = Booster(model_str=text)
+        ref_host[g] = b.predict(probe)
+        ref_dev[g] = b.predict(probe, device=True)
+    return texts, probe, ref_host, ref_dev
+
+
+def _publish_subprocess(pub_dir: str, gen: int, text_path: str,
+                        fault: Optional[str], timeout: float = 60.0
+                        ) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env.pop("LGBM_TPU_FAULT", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if fault:
+        env["LGBM_TPU_FAULT"] = fault
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--publish",
+         pub_dir, str(gen), text_path],
+        env=env, timeout=timeout, capture_output=True, text=True)
+
+
+class _Client(threading.Thread):
+    """One concurrent client: random probe subsets, bounded retry on
+    retryable rejections, bitwise verification of every response against
+    the offline reference for the generation it reports."""
+
+    def __init__(self, idx: int, rt, probe, ref_host, ref_dev,
+                 published: set, stop_evt: threading.Event):
+        super().__init__(name="chaos-client-%d" % idx, daemon=True)
+        self.rng = np.random.default_rng(1000 + idx)
+        self.rt = rt
+        self.probe = probe
+        self.ref_host = ref_host
+        self.ref_dev = ref_dev
+        self.published = published
+        self.stop_evt = stop_evt
+        self.completed = 0
+        self.shed = 0
+        self.rejection_reasons: Dict[str, int] = {}
+        self.bad_rejections = 0          # non-machine-readable sheds
+        self.wrong_generation: List[int] = []
+        self.mismatched: List[str] = []
+        self.hard_errors: List[str] = []
+        self.served_by = {"device": 0, "host": 0}
+        self.latencies: List[float] = []
+
+    def _record_rejection(self, e) -> None:
+        self.shed += 1
+        self.rejection_reasons[e.reason] = \
+            self.rejection_reasons.get(e.reason, 0) + 1
+        d = e.to_dict()
+        if not (e.retryable is True and d.get("retryable") is True
+                and d.get("error") == "rejected" and d.get("reason")):
+            self.bad_rejections += 1
+
+    def _verify(self, rec, idx) -> None:
+        self.completed += 1
+        self.served_by[rec.served_by] = \
+            self.served_by.get(rec.served_by, 0) + 1
+        if rec.generation not in self.published:
+            self.wrong_generation.append(rec.generation)
+            return
+        ref = (self.ref_dev if rec.served_by == "device"
+               else self.ref_host)[rec.generation]
+        if not np.array_equal(np.asarray(rec.values), ref[idx]):
+            self.mismatched.append(
+                "gen %d via %s" % (rec.generation, rec.served_by))
+
+    def run(self) -> None:
+        from lightgbm_tpu.runtime.serving import ServeRejected
+        while not self.stop_evt.is_set():
+            burst = self.rng.random() < 0.12
+            if burst:
+                # load spike: a volley of raw submits with no retry —
+                # exactly what the bounded queue must shed explicitly
+                pending = []
+                for _ in range(12):
+                    idx = self.rng.integers(0, len(self.probe), size=4)
+                    try:
+                        pending.append(
+                            (idx, self.rt.submit(self.probe[idx],
+                                                 deadline_s=5.0)))
+                    except ServeRejected as e:
+                        self._record_rejection(e)
+                for idx, req in pending:
+                    try:
+                        self._verify(req.wait(timeout=30), idx)
+                    except ServeRejected as e:
+                        self._record_rejection(e)
+                    except BaseException as e:   # noqa: BLE001 — ledger
+                        self.hard_errors.append(
+                            "%s: %s" % (type(e).__name__, e))
+                continue
+            idx = self.rng.integers(0, len(self.probe),
+                                    size=int(self.rng.integers(1, 9)))
+            t0 = time.perf_counter()
+            try:
+                rec = self.rt.predict(self.probe[idx], deadline_s=5.0,
+                                      attempts=2, seed=self.completed)
+            except ServeRejected as e:
+                self._record_rejection(e)
+                continue
+            except BaseException as e:       # noqa: BLE001 — ledger
+                self.hard_errors.append("%s: %s" % (type(e).__name__, e))
+                continue
+            self.latencies.append(time.perf_counter() - t0)
+            self._verify(rec, idx)
+
+
+def run_soak(workdir: str, generations: int = 16, rounds: int = 2,
+             clients: int = 6, seed: int = 11,
+             serve_fault_pool: Optional[List[Optional[str]]] = None,
+             publish_fault_pool: Optional[List[Optional[str]]] = None,
+             step_s: float = 0.5) -> Dict:
+    """One full soak; returns the machine-readable record (also the
+    CHAOS_SERVE_r07.json artifact schema)."""
+    from lightgbm_tpu.runtime.serving import ServingRuntime
+
+    t0 = time.monotonic()
+    rng = random.Random(seed)
+    spool = list(SERVE_FAULT_POOL if serve_fault_pool is None
+                 else serve_fault_pool)
+    ppool = list(PUBLISH_FAULT_POOL if publish_fault_pool is None
+                 else publish_fault_pool)
+    pub_dir = os.path.join(workdir, "pub")
+    texts, probe, ref_host, ref_dev = _train_generations(generations, rounds)
+    text_paths = {}
+    for g, text in texts.items():
+        text_paths[g] = os.path.join(workdir, "gen_%d_src.txt" % g)
+        with open(text_paths[g], "w") as fh:
+            fh.write(text)
+
+    published: set = set()
+    faults_injected: List[str] = []
+    publisher = {"launches": 0, "deaths": 0}
+    stop_evt = threading.Event()
+    rt = ServingRuntime(publish_dir=pub_dir, params={"verbose": -1},
+                        max_queue=16, batch_window_s=0.002,
+                        predict_deadline_s=0.25, breaker_cooldown_s=0.2,
+                        poll_interval_s=0.03)
+    rt.start()
+    workers = [_Client(i, rt, probe, ref_host, ref_dev, published,
+                       stop_evt) for i in range(clients)]
+    try:
+        # publish generation 1 cleanly so clients have something to hit
+        publisher["launches"] += 1
+        r = _publish_subprocess(pub_dir, 1, text_paths[1], None)
+        assert r.returncode == 0, r.stderr[-2000:]
+        published.add(1)
+        for w in workers:
+            w.start()
+
+        for gen in range(2, generations + 1):
+            serve_fault = rng.choice(spool)
+            if serve_fault:
+                faults_injected.append(serve_fault)
+                os.environ["LGBM_TPU_FAULT"] = serve_fault
+            pub_fault = rng.choice(ppool)
+            publisher["launches"] += 1
+            # the generation is legitimate the instant its file can land
+            # (die_at_publish kills the child AFTER the atomic rename, so
+            # the poller may swap it in before the subprocess even
+            # reports back) — record it before the attempt; the ledger's
+            # invariant is that every reported generation's VALUES match
+            # that generation's offline reference, torn publishes can
+            # never resolve at all
+            published.add(gen)
+            r = _publish_subprocess(pub_dir, gen, text_paths[gen],
+                                    pub_fault)
+            if pub_fault:
+                faults_injected.append("publish:" + pub_fault)
+            if r.returncode != 0:
+                # the injected death: a torn/stale publish is on disk;
+                # the relaunch republishes the SAME bytes (the trainer's
+                # recover-and-republish contract, PR 6)
+                publisher["deaths"] += 1
+                publisher["launches"] += 1
+                r = _publish_subprocess(pub_dir, gen, text_paths[gen],
+                                        None)
+                assert r.returncode == 0, r.stderr[-2000:]
+            # let the poller swap and the clients hammer through the
+            # fault window, then clear it and give the breaker a chance
+            # to run its recovery probe
+            time.sleep(step_s)
+            if serve_fault:
+                os.environ.pop("LGBM_TPU_FAULT", None)
+                time.sleep(step_s / 2)
+        # wait for the last swap so post-churn responses prove recovery
+        deadline = time.monotonic() + 15
+        while (rt.generation() != generations
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        time.sleep(step_s)
+    finally:
+        os.environ.pop("LGBM_TPU_FAULT", None)
+        stop_evt.set()
+        for w in workers:
+            w.join(timeout=30)
+        stats = rt.stats()
+        rt.stop()
+
+    lat = np.asarray(sum((w.latencies for w in workers), [])) \
+        if any(w.latencies for w in workers) else np.asarray([0.0])
+    rec = {
+        "artifact": "CHAOS_SERVE_r07",
+        "t_start": resilience.wallclock(),
+        "generations_target": generations,
+        "final_generation": rt.generation(),
+        "clients": clients,
+        "requests_completed": sum(w.completed for w in workers),
+        "requests_shed": sum(w.shed for w in workers),
+        "rejection_reasons": {
+            k: sum(w.rejection_reasons.get(k, 0) for w in workers)
+            for w in workers for k in w.rejection_reasons},
+        "non_machine_readable_rejections": sum(w.bad_rejections
+                                               for w in workers),
+        "wrong_generation_responses": sum(len(w.wrong_generation)
+                                          for w in workers),
+        "mismatched_responses": sum((w.mismatched for w in workers), []),
+        "hard_errors": sum((w.hard_errors for w in workers), [])[:10],
+        "served_by": {
+            "device": sum(w.served_by.get("device", 0) for w in workers),
+            "host": sum(w.served_by.get("host", 0) for w in workers)},
+        "latency_ms": {
+            "p50": round(float(np.percentile(lat, 50)) * 1e3, 3),
+            "p99": round(float(np.percentile(lat, 99)) * 1e3, 3),
+            "max": round(float(lat.max()) * 1e3, 3)},
+        "faults_injected": faults_injected,
+        "publisher": publisher,
+        "subscriber_skipped_invalid": sum(
+            s.skipped_invalid for s in rt._subs.values()),
+        "swaps": stats["swaps"],
+        "degradations": stats["degradations"],
+        "recoveries": stats["recoveries"],
+        "queue_rejections": stats["rejected"],
+        "elapsed_s": round(time.monotonic() - t0, 1),
+    }
+    rec["ok"] = bool(
+        rec["final_generation"] == generations
+        and rec["wrong_generation_responses"] == 0
+        and not rec["mismatched_responses"]
+        and not rec["hard_errors"]
+        and rec["non_machine_readable_rejections"] == 0
+        and rec["requests_completed"] > 0
+        # churn must actually have exercised both paths when faults ran
+        and (not faults_injected or rec["served_by"]["host"] > 0))
+    return rec
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) > 1 and argv[1] == "--publish":
+        # subprocess mode: ONE publish with whatever LGBM_TPU_FAULT the
+        # parent armed (torn_write/die_at_publish make this process die)
+        pub_dir, gen, text_path = argv[2], int(argv[3]), argv[4]
+        with open(text_path) as fh:
+            text = fh.read()
+        publish.ModelPublisher(pub_dir, keep_last=0).publish(
+            text, meta={"cycle": gen}, generation=gen)
+        return 0
+    import tempfile
+    generations = int(argv[1]) if len(argv) > 1 else 16
+    artifact = argv[2] if len(argv) > 2 \
+        else os.path.join(REPO, "CHAOS_SERVE_r07.json")
+    seed = int(os.environ.get("CHAOS_SERVE_SEED", "11"))
+    clients = int(os.environ.get("CHAOS_SERVE_CLIENTS", "6"))
+    with tempfile.TemporaryDirectory(prefix="lgbm_chaos_serve_") as wd:
+        rec = run_soak(wd, generations=generations, clients=clients,
+                       seed=seed)
+    resilience.atomic_write(artifact, json.dumps(rec, indent=1) + "\n")
+    print("chaos serve soak: ok=%s generations=%s/%d completed=%d shed=%d "
+          "wrong_gen=%d mismatched=%d degradations=%d recoveries=%d "
+          "artifact=%s"
+          % (rec["ok"], rec["final_generation"],
+             rec["generations_target"], rec["requests_completed"],
+             rec["requests_shed"], rec["wrong_generation_responses"],
+             len(rec["mismatched_responses"]), rec["degradations"],
+             rec["recoveries"], artifact), flush=True)
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -91,3 +91,16 @@ bst = lgb.train({"objective": "binary", "num_leaves": 63, "verbose": -1,
 assert bst._engine._fast_active, "feature-parallel fell off the fast path"
 print("tree_learner=feature on the real chip: 3 iters ok, fast path active")
 PYEOF2
+echo "=== 7. serving runtime on the real chip (ISSUE 7) ==="
+echo "    (micro-batched device serving + degradation + hot swap;"
+echo "     BENCH_SERVE rides the full bench too — this is the quick"
+echo "     standalone reading at the serving shape)"
+timeout 400 python - <<'PYEOF3' 2>&1 | tail -4
+import json, os
+os.environ.setdefault("BENCH_SERVE_SECONDS", "8")
+import bench
+print(json.dumps(bench.bench_serve(), indent=1))
+PYEOF3
+echo "=== 7b. chaos-serve soak (device path under fault churn) ==="
+timeout 400 python exp/chaos_serve.py 8 /tmp/chaos_serve_tpu.json \
+  || echo "chaos-serve soak FAILED on hardware — inspect /tmp/chaos_serve_tpu.json"

@@ -10,6 +10,7 @@ C++ if-else code (gbdt_model_text.cpp ModelToIfElse).
 """
 from __future__ import annotations
 
+import json
 import os
 import sys
 from typing import Dict, List, Optional
@@ -72,6 +73,8 @@ class Application:
             self.train()
         elif self.task == "train_online":
             self.train_online()
+        elif self.task == "serve":
+            self.serve()
         elif self.task in ("predict", "prediction", "test"):
             self.predict()
         elif self.task == "convert_model":
@@ -242,6 +245,71 @@ class Application:
         if rc != 0:
             sys.exit(rc)
 
+    def serve(self) -> None:
+        """Fault-tolerant serving service (runtime/serving.py): a
+        long-lived JSON-lines TCP server that micro-batches concurrent
+        predict requests into the tree-parallel device engine, sheds
+        overload with explicit retryable rejections, degrades to the
+        host predictor when the device path fails or hangs, and
+        hot-swaps models from a `publish_dir` (the task=train_online
+        publish directory) without dropping a request.  Key params:
+        `publish_dir=` or `input_model=`, `serve_port` (0 = ephemeral,
+        printed on stdout), `serve_host`, `serve_queue`,
+        `serve_batch_rows`, `serve_batch_window`, `serve_deadline`,
+        `predict_deadline`, `serve_poll_interval`, `breaker_cooldown`,
+        `serve_raw_score`.  SIGTERM/SIGINT stop cleanly with the final
+        stats on stderr.  See docs/SERVING.md for the runbook."""
+        import signal as _signal
+        import threading as _threading
+
+        from .runtime.serving import ServingRuntime, ServingServer
+        params = dict(self.raw_params)
+        publish_dir = params.pop("publish_dir", None)
+        input_model = params.pop("input_model", None)
+        host = params.pop("serve_host", "127.0.0.1")
+        port = int(params.pop("serve_port", 0) or 0)
+        runtime = ServingRuntime(
+            publish_dir=publish_dir, model_file=input_model,
+            params=params,
+            raw_score=str(params.pop("serve_raw_score", "")).lower()
+            in ("true", "1"),
+            max_queue=int(params.pop("serve_queue", 256)),
+            max_batch_rows=int(params.pop("serve_batch_rows", 4096)),
+            batch_window_s=float(params.pop("serve_batch_window", 0.002)),
+            default_deadline_s=float(params.pop("serve_deadline", 10.0)),
+            predict_deadline_s=float(params.pop("predict_deadline", 30.0)),
+            poll_interval_s=float(params.pop("serve_poll_interval", 0.2)),
+            breaker_cooldown_s=float(params.pop("breaker_cooldown", 2.0)),
+            probe_platform_on_start=True, log=Log)
+        runtime.start()
+        server = ServingServer(runtime, host=host, port=port)
+        stop_evt = _threading.Event()
+
+        def _stop(signum, frame):
+            Log.warning("serve: signal %d received; draining and "
+                        "shutting down", signum)
+            if stop_evt.is_set():
+                return
+            stop_evt.set()
+            # shutdown() blocks until serve_forever exits — and this
+            # handler RUNS on the serve_forever thread, so it must be
+            # issued from a helper thread or it deadlocks
+            _threading.Thread(target=server.shutdown, daemon=True).start()
+
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            _signal.signal(sig, _stop)
+        # the port on stdout is the machine-readable contract for
+        # supervisors that asked for an ephemeral port
+        print("serving %s on %s:%d" % (publish_dir or input_model,
+                                       host, server.port), flush=True)
+        try:
+            server.serve_forever(poll_interval=0.2)
+        finally:
+            server.server_close()
+            runtime.stop()
+            sys.stderr.write("serve: final stats: %s\n"
+                             % json.dumps(runtime.stats()))
+
     def predict(self) -> None:
         params = dict(self.raw_params)
         data_path = params.pop("data", None)
@@ -381,7 +449,7 @@ def model_to_ifelse(model: GBDTModel) -> str:
 def main(argv: Optional[List[str]] = None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     if not argv:
-        print("usage: python -m lightgbm_tpu task=<train|predict|convert_model|refit> "
-              "[config=<file>] [key=value ...]")
+        print("usage: python -m lightgbm_tpu task=<train|train_online|serve|"
+              "predict|convert_model|refit> [config=<file>] [key=value ...]")
         return
     Application(argv).run()

@@ -139,6 +139,34 @@ class NativeBooster:
                                                     ctypes.byref(out)))
         return out.value
 
+    @property
+    def num_model_per_iteration(self) -> int:
+        """Trees per iteration (LGBM_BoosterNumModelPerIteration): 1 for
+        binary/regression, num_class for multiclass."""
+        out = ctypes.c_int(0)
+        _check(load_lib().LGBM_BoosterNumModelPerIteration(
+            self._handle, ctypes.byref(out)))
+        return out.value
+
+    def get_leaf_value(self, tree_idx: int, leaf_idx: int) -> float:
+        """One leaf's output value (LGBM_BoosterGetLeafValue — the
+        Python Booster.get_leaf_output mirror)."""
+        out = ctypes.c_double(0.0)
+        _check(load_lib().LGBM_BoosterGetLeafValue(
+            self._handle, ctypes.c_int(tree_idx), ctypes.c_int(leaf_idx),
+            ctypes.byref(out)))
+        return out.value
+
+    def set_leaf_value(self, tree_idx: int, leaf_idx: int,
+                       value: float) -> None:
+        """Patch one leaf in place (LGBM_BoosterSetLeafValue): the
+        serving-side patch primitive.  Takes effect on every predict
+        entry point AND on SaveModel/model_to_string round-trips (the
+        stored model text is patched too)."""
+        _check(load_lib().LGBM_BoosterSetLeafValue(
+            self._handle, ctypes.c_int(tree_idx), ctypes.c_int(leaf_idx),
+            ctypes.c_double(value)))
+
     def save_model(self, filename: str) -> None:
         _check(load_lib().LGBM_BoosterSaveModel(self._handle, -1,
                                                 filename.encode()))

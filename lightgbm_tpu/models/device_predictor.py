@@ -36,12 +36,14 @@ Opt-in via `Booster.predict(..., device=True)`.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+from ..runtime import resilience
 
 _K_ZERO_THRESHOLD = 1e-35
 MISSING_NONE, MISSING_ZERO, MISSING_NAN = 0, 1, 2
@@ -323,10 +325,22 @@ class DevicePredictor:
     # -- public --------------------------------------------------------------
     def predict_raw(self, X: np.ndarray, early_stop: Optional[str] = None,
                     early_stop_freq: int = 10,
-                    early_stop_margin: float = 10.0) -> np.ndarray:
+                    early_stop_margin: float = 10.0,
+                    batch_hook: Optional[Callable[[int, int], None]] = None,
+                    ) -> np.ndarray:
         """Raw margin scores [N, num_class].  early_stop: None, 'binary'
         or 'multiclass' (same truncated-sum semantics as the host
-        predictor's vectorized early stop)."""
+        predictor's vectorized early stop).
+
+        `batch_hook(i, n_batches)` fires before each micro-batch dispatch
+        — the batch-boundary seam the serving runtime builds on: faults
+        (`LGBM_TPU_FAULT=die_at_predict|slow_predict`) land HERE, between
+        micro-batches, never mid-dispatch, and a model swap observed at
+        this boundary still finishes the in-flight call on the predictor
+        it started with (the packed arrays are immutable per instance).
+        Per-row outputs are batch-composition invariant (pinned), so
+        micro-batching and serving batch assembly never change results.
+        """
         X = self._check_width(X)
         N = X.shape[0]
         freq = max(int(early_stop_freq), 1)
@@ -341,6 +355,9 @@ class DevicePredictor:
         dev_next = jax.device_put(self._pad_rows(X[slices[0][0]:slices[0][1]]))
         pending = None
         for i, (s, e) in enumerate(slices):
+            if batch_hook is not None:
+                batch_hook(i, len(slices))
+            resilience.maybe_fail_predict()   # serving fault seam
             xb = dev_next
             if i + 1 < len(slices):
                 ns, ne = slices[i + 1]

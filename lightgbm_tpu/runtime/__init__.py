@@ -5,8 +5,9 @@ This package holds the machinery that keeps long runs alive on flaky
 platforms — it deliberately imports neither jax nor any other heavy
 dependency at module scope, so the hermetic dryrun bootstrap and the CLI
 entry can use it before (or instead of) binding an accelerator platform.
-(`continuous` is not imported here: it pulls the training stack; import
-it explicitly where a service loop is actually being run.)
+(`continuous` and `serving` are not imported here: they pull numpy and,
+lazily, the model stack; import them explicitly where a service loop or
+a serving runtime is actually being run.)
 """
 from . import publish  # noqa: F401
 from . import resilience  # noqa: F401

@@ -56,6 +56,7 @@ import signal
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
@@ -71,7 +72,8 @@ __all__ = [
     "fault_arg", "fault_active", "maybe_die_or_preempt",
     "maybe_probe_hang_seconds", "maybe_corrupt_snapshot",
     "maybe_inject_nan", "maybe_slow_stage", "maybe_torn_publish",
-    "maybe_die_at_publish", "snapshot_model_text",
+    "maybe_die_at_publish", "maybe_fail_predict", "DevicePredictFault",
+    "snapshot_model_text", "FAULT_TABLE", "FAULT_NAMES",
 ]
 
 
@@ -86,12 +88,55 @@ def wallclock() -> str:
 # fault injection (LGBM_TPU_FAULT=name[:arg],name[:arg],...)
 # ---------------------------------------------------------------------------
 
-#: the recognized fault points.  Anything else in the spec is rejected
-#: loudly — a typoed fault name silently injecting nothing would make a
-#: "green under fault" test meaningless.
-FAULT_NAMES = ("hang_import", "die_at_iter", "sigterm_at_iter",
-               "corrupt_snapshot", "nan_grad", "bogus_platform",
-               "torn_write", "slow_stage", "die_at_publish")
+#: THE fault registry: every recognized fault point, with its argument
+#: spelling and injection point.  This table is the single source of
+#: truth shared by the parser below and the docs/RESILIENCE.md injection
+#: matrix (test-pinned against each other, so the table and the parser
+#: cannot drift).  Anything else in the spec is rejected loudly — a
+#: typoed fault name silently injecting nothing would make a "green
+#: under fault" test meaningless.
+FAULT_TABLE: Dict[str, Dict[str, str]] = {
+    "hang_import": {
+        "arg": "SECS",
+        "injects_at": "platform probe child (probe_platform), "
+                      "non-cpu binds only"},
+    "die_at_iter": {
+        "arg": "K",
+        "injects_at": "Booster.update entry (maybe_die_or_preempt)"},
+    "sigterm_at_iter": {
+        "arg": "K",
+        "injects_at": "Booster.update entry (SIGTERM to self)"},
+    "corrupt_snapshot": {
+        "arg": "[K]",
+        "injects_at": "write_snapshot, after the atomic rename"},
+    "nan_grad": {
+        "arg": "K",
+        "injects_at": "the _finish_tree host fetch (sentinel_check)"},
+    "bogus_platform": {
+        "arg": "",
+        "injects_at": "probe_platform / resolve_backend request rewrite"},
+    "torn_write": {
+        "arg": "[K]",
+        "injects_at": "ModelPublisher.publish, before the atomic path"},
+    "slow_stage": {
+        "arg": "NAME:SECS",
+        "injects_at": "stage open in the continuous trainer "
+                      "(maybe_slow_stage; one-shot per process)"},
+    "die_at_publish": {
+        "arg": "K",
+        "injects_at": "ModelPublisher.publish, between generation rename "
+                      "and manifest write"},
+    "die_at_predict": {
+        "arg": "K",
+        "injects_at": "device-predict micro-batch boundary "
+                      "(maybe_fail_predict in DevicePredictor.predict_raw)"},
+    "slow_predict": {
+        "arg": "SECS",
+        "injects_at": "device-predict micro-batch boundary "
+                      "(maybe_fail_predict; every batch while armed)"},
+}
+
+FAULT_NAMES = tuple(FAULT_TABLE)
 
 
 def _fault_spec() -> Dict[str, Optional[str]]:
@@ -270,6 +315,52 @@ def maybe_die_at_publish(publish_count: int) -> None:
     os._exit(137)
 
 
+#: device-predict fault bookkeeping: batches seen while die_at_predict is
+#: armed (the victim is the predict CALL, never the process — a serving
+#: runtime must survive device loss, which is the point of the injection)
+_PREDICT_FAULT = {"batches": 0}
+
+
+class DevicePredictFault(RuntimeError):
+    """The injected stand-in for an XLA device failure mid-predict
+    (`LGBM_TPU_FAULT=die_at_predict`): the serving runtime must catch it,
+    trip its circuit breaker, and answer from the host predictor."""
+
+
+def maybe_fail_predict() -> None:
+    """Serving fault seam, consulted at every device-predict micro-batch
+    boundary (models/device_predictor.py predict_raw):
+
+    * ``slow_predict:SECS`` — stalls EVERY device batch by SECS while
+      armed (a degraded device, cleared by clearing the env var); long
+      enough to blow the serving runtime's predict deadline, which is
+      the point: the batch must be re-served from the host path and the
+      timeout must land in the serving stage trail.
+    * ``die_at_predict:K`` — the K-th device batch (1-based, counted
+      while armed) and every later one raise `DevicePredictFault`; the
+      serving runtime must degrade to the host predictor and recover to
+      the device path once the fault clears.
+    """
+    spec = _fault_spec()
+    if "slow_predict" in spec:
+        stall = float(spec["slow_predict"] or "5")
+        sys.stderr.write("[%s] FAULT slow_predict: stalling device batch "
+                         "for %.1fs\n" % (wallclock(), stall))
+        sys.stderr.flush()
+        time.sleep(stall)
+    if "die_at_predict" in spec:
+        _PREDICT_FAULT["batches"] += 1
+        if _PREDICT_FAULT["batches"] >= int(spec["die_at_predict"] or "1"):
+            sys.stderr.write("[%s] FAULT die_at_predict: failing device "
+                             "batch #%d\n"
+                             % (wallclock(), _PREDICT_FAULT["batches"]))
+            sys.stderr.flush()
+            raise DevicePredictFault(
+                "injected device predict failure "
+                "(LGBM_TPU_FAULT=die_at_predict, batch #%d)"
+                % _PREDICT_FAULT["batches"])
+
+
 # ---------------------------------------------------------------------------
 # stage watchdog
 # ---------------------------------------------------------------------------
@@ -312,12 +403,25 @@ class Watchdog:
 
     The report is rewritten at every stage TRANSITION too, so even a
     SIGKILL'd process leaves a trail naming the stage it died in.
+
+    **Thread mode** (`use_alarm=False`, auto-selected off the main
+    thread): SIGALRM cannot be armed outside the main thread, so the
+    watchdog keeps only the trail bookkeeping and the OWNER enforces
+    deadlines itself (e.g. a bounded queue wait), reporting expiries via
+    `record_timeout()` — same trail semantics as a fired alarm (stage
+    closed as timeout, all-thread tracebacks captured, report persisted)
+    but it never raises or exits.  `keep_last=N` bounds the trail for
+    long-lived owners (a serving runtime opening one stage per batch
+    must not grow its flight recorder without bound); dropped entries
+    are counted in the report.
     """
 
     def __init__(self, seconds: int, hard: bool = False,
                  report_path: Optional[str] = None,
                  kill_process_group: bool = False,
-                 label: str = "stage", stream=None):
+                 label: str = "stage", stream=None,
+                 use_alarm: Optional[bool] = None,
+                 keep_last: Optional[int] = None):
         self.seconds = int(seconds)
         self.hard = hard
         self.report_path = report_path or os.environ.get(
@@ -325,6 +429,12 @@ class Watchdog:
         self.kill_process_group = kill_process_group
         self.label = label
         self.stream = stream  # None -> sys.stdout at emit time
+        if use_alarm is None:
+            use_alarm = (hasattr(signal, "SIGALRM") and threading
+                         .current_thread() is threading.main_thread())
+        self.use_alarm = bool(use_alarm)
+        self.keep_last = keep_last
+        self.dropped_stages = 0
         self.stage = "<init>"
         self.stages: List[Dict[str, Any]] = []
         self.tracebacks: Optional[str] = None
@@ -342,6 +452,8 @@ class Watchdog:
         for st in self.stages:
             if st.get("status") in ("timeout", "running", "error"):
                 rep["culprit"] = st["name"]
+        if self.dropped_stages:
+            rep["dropped_stages"] = self.dropped_stages
         if self.tracebacks is not None:
             rep["tracebacks"] = self.tracebacks
         return rep
@@ -364,13 +476,17 @@ class Watchdog:
         self.stage = stage
         self.stages.append({"name": stage, "t_start": wallclock(),
                             "budget_s": budget, "status": "running"})
+        if self.keep_last and len(self.stages) > self.keep_last:
+            drop = len(self.stages) - self.keep_last
+            del self.stages[:drop]
+            self.dropped_stages += drop
         self._t0 = time.monotonic()
         out = self.stream if self.stream is not None else sys.stdout
         out.write("[%s] %s: %s (budget %ds)\n"
                   % (wallclock(), self.label, stage, budget))
         out.flush()
         self._persist()
-        if hasattr(signal, "SIGALRM"):
+        if self.use_alarm:
             if budget > 0:
                 signal.signal(signal.SIGALRM, self._fire)
                 signal.alarm(budget)
@@ -400,7 +516,7 @@ class Watchdog:
         except StageTimeout:
             raise
         except BaseException:
-            if hasattr(signal, "SIGALRM"):
+            if self.use_alarm:
                 signal.alarm(0)
             self._close_current("error")
             self._persist()
@@ -429,10 +545,28 @@ class Watchdog:
         raise StageTimeout(self.stage, self.stages[-1]["budget_s"]
                            if self.stages else self.seconds)
 
+    def record_timeout(self, note: Optional[str] = None) -> None:
+        """Thread-mode deadline expiry: the owner enforced the deadline
+        itself (a bounded wait on the batch's completion event, say) and
+        reports it here — the CURRENT stage closes as ``timeout`` with
+        all-thread tracebacks captured and the report persisted, exactly
+        like a fired alarm, but nothing raises and nothing exits (the
+        owner is a long-lived server that must carry on)."""
+        self._close_current("timeout")
+        if note and self.stages:
+            self.stages[-1]["note"] = note
+        self.tracebacks = _dump_all_threads()
+        sys.stderr.write("[%s] WATCHDOG: %s %r exceeded its deadline "
+                         "(thread mode)%s\n"
+                         % (wallclock(), self.label, self.stage,
+                            ": " + note if note else ""))
+        sys.stderr.flush()
+        self._persist()
+
     def done(self, final: bool = True) -> None:
         """Disarm the alarm (MUST run before the watchdog owner returns:
         an orphaned SIGALRM would hard-kill the host minutes later)."""
-        if hasattr(signal, "SIGALRM"):
+        if self.use_alarm:
             signal.alarm(0)
             if final:
                 signal.signal(signal.SIGALRM, signal.SIG_DFL)

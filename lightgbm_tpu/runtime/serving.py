@@ -1,0 +1,756 @@
+"""Fault-tolerant model-serving runtime (`task=serve` / `ServingRuntime`).
+
+ROADMAP item 3: the reference's serving story (`Predictor`/`c_api`,
+SURVEY §2.5/§2.9) is strictly request-per-call — no lifecycle, no
+backpressure, no model lifecycle.  This module is the long-lived server
+those layers never had, built on seams earlier PRs proved out: PR 3's
+tree-parallel device predictor (shape-bucketed program cache,
+micro-batched streaming), PR 4's stage watchdog + degradation chain,
+and PR 6's atomic publish/subscribe contract.  Robustness is the
+headline, not an afterthought:
+
+* **Admission control + backpressure.**  A bounded request queue with
+  per-request deadlines.  Overload sheds with an explicit
+  machine-readable retryable rejection (`ServeRejected.to_dict()`), at
+  admission time — never an unbounded queue, never a silent hang.  A
+  request whose deadline expires before its batch forms is shed the
+  same way.
+* **Micro-batching.**  Concurrent requests are coalesced (bounded rows,
+  bounded gathering window) into ONE device predict through the
+  shape-bucketed program cache, so p99 latency buys throughput instead
+  of a compile per ragged batch.
+* **Device-failure degradation.**  Every batch runs under the PR 4
+  watchdog in thread mode (serving stage trail, bounded flight
+  recorder).  A failed or hung device batch — `LGBM_TPU_FAULT=
+  die_at_predict|slow_predict` are the injected stand-ins — trips a
+  circuit breaker: the batch is RE-SERVED from the exact f64 host
+  predictor (a `serving_degradation` event lands in the trail), later
+  batches stay on the host path until a probe-based recovery predict
+  succeeds after a cooldown.  The server answers; it does not error out.
+* **Zero-drop hot swap.**  A background `ModelSubscriber` poller picks
+  up new generations from the PR 6 publish directory and swaps the
+  active model atomically BETWEEN batches: in-flight batches finish on
+  the generation they started with, no request is ever dropped or
+  served a torn/mixed model, and every response names the generation
+  that produced it.  Multiple models (multi-tenancy) ride the same
+  queue; compiled programs are shared across generations through the
+  jit cache's shape bucketing.
+
+Adversarial proof: `exp/chaos_serve.py` (CHAOS_SERVE_r07.json) hammers
+this runtime with concurrent clients under randomized kill/stall/
+publish-churn faults — zero torn or wrong-generation responses, every
+completed response byte-identical to offline `Booster.predict` for the
+generation it reports.  Quick pins live in tests/test_serving.py.
+
+`Booster` (and therefore jax) is imported lazily — constructing a
+runtime binds no platform until a model actually loads.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import socketserver
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import publish, resilience
+from ..utils.log import Log
+
+__all__ = ["ServingRuntime", "ServingServer", "ServeRejected",
+           "ServeResult"]
+
+
+class ServeRejected(RuntimeError):
+    """A request the server explicitly refused (admission control,
+    deadline, shutdown).  Machine-readable via `to_dict()`; `retryable`
+    tells the client whether backing off and retrying can succeed."""
+
+    def __init__(self, reason: str, retryable: bool = True,
+                 detail: str = "", queue_depth: Optional[int] = None):
+        super().__init__("request rejected (%s%s)%s"
+                         % (reason, ", retryable" if retryable else "",
+                            ": " + detail if detail else ""))
+        self.reason = reason
+        self.retryable = bool(retryable)
+        self.detail = detail
+        self.queue_depth = queue_depth
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"error": "rejected", "reason": self.reason,
+                             "retryable": self.retryable,
+                             "wallclock": resilience.wallclock()}
+        if self.detail:
+            d["detail"] = self.detail
+        if self.queue_depth is not None:
+            d["queue_depth"] = self.queue_depth
+        return d
+
+
+class ServeResult:
+    """One completed prediction: the values, the generation that
+    produced them, and how they were served."""
+
+    __slots__ = ("values", "generation", "model_id", "served_by",
+                 "latency_s")
+
+    def __init__(self, values: np.ndarray, generation: int, model_id: str,
+                 served_by: str, latency_s: float):
+        self.values = values
+        self.generation = generation
+        self.model_id = model_id
+        self.served_by = served_by          # "device" | "host"
+        self.latency_s = latency_s
+
+
+class _Request:
+    """Queued unit of work; doubles as the caller's future."""
+
+    __slots__ = ("model_id", "X", "n_rows", "deadline", "enqueued",
+                 "done", "result", "rejection", "error")
+
+    def __init__(self, model_id: str, X: np.ndarray, deadline: float):
+        self.model_id = model_id
+        self.X = X
+        self.n_rows = int(X.shape[0])
+        self.deadline = deadline            # absolute time.monotonic()
+        self.enqueued = time.monotonic()
+        self.done = threading.Event()
+        self.result: Optional[ServeResult] = None
+        self.rejection: Optional[ServeRejected] = None
+        self.error: Optional[BaseException] = None
+
+    def wait(self, timeout: Optional[float] = None) -> ServeResult:
+        """Block for the outcome.  Raises the rejection/error the server
+        recorded; a wait past `timeout` raises a retryable rejection
+        (the server itself bounds every path, so this is belt-and-
+        braces for a stopped runtime)."""
+        if not self.done.wait(timeout):
+            raise ServeRejected("result_timeout", retryable=True,
+                                detail="no outcome within %.1fs"
+                                % (timeout or -1.0))
+        if self.rejection is not None:
+            raise self.rejection
+        if self.error is not None:
+            raise self.error
+        assert self.result is not None
+        return self.result
+
+
+class _ModelEntry:
+    """One loaded generation of one model lineage.  Immutable after
+    construction — the swap replaces the whole entry, so an in-flight
+    batch holding the old reference finishes on a consistent model."""
+
+    __slots__ = ("model_id", "generation", "booster", "meta", "loaded_at")
+
+    def __init__(self, model_id: str, generation: int, booster, meta):
+        self.model_id = model_id
+        self.generation = generation
+        self.booster = booster
+        self.meta = dict(meta or {})
+        self.loaded_at = time.monotonic()
+
+    @property
+    def num_features(self) -> int:
+        return self.booster.num_feature()
+
+
+class _Job:
+    """One device-predict dispatch handed to the executor thread."""
+
+    __slots__ = ("fn", "done", "values", "error", "abandoned")
+
+    def __init__(self, fn: Callable[[], np.ndarray]):
+        self.fn = fn
+        self.done = threading.Event()
+        self.values: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.abandoned = False
+
+
+class _DeviceExecutor(threading.Thread):
+    """Single dedicated thread that owns device predict dispatches.  The
+    batcher waits on each job with a deadline; a job that blows it is
+    marked abandoned and a FRESH executor takes over — this thread may
+    be wedged inside a hung dispatch, and a wedged thread can only be
+    left behind, never joined."""
+
+    def __init__(self, index: int):
+        super().__init__(name="serve-device-%d" % index, daemon=True)
+        self.jobs: "collections.deque[Optional[_Job]]" = collections.deque()
+        self._ready = threading.Event()
+        self._stop = False
+
+    def submit(self, job: Optional[_Job]) -> None:
+        self.jobs.append(job)
+        self._ready.set()
+
+    def retire(self) -> None:
+        """Ask the thread to exit after its current job (it may be
+        wedged inside that job forever — that is fine, it is daemon)."""
+        self._stop = True
+        self._ready.set()
+
+    def run(self) -> None:
+        while True:
+            if not self.jobs:
+                if self._stop:
+                    return
+                self._ready.wait(0.1)
+                self._ready.clear()
+                continue
+            job = self.jobs.popleft()
+            if job is None:
+                return
+            try:
+                job.values = job.fn()
+            except BaseException as e:      # noqa: BLE001 — ferried out
+                job.error = e
+            job.done.set()
+            if self._stop:
+                return
+
+
+class ServingRuntime:
+    """The long-lived serving loop.  Use as a context manager or call
+    `start()` / `stop()` explicitly; `submit()` / `predict()` are the
+    request surface (thread-safe, any number of client threads)."""
+
+    def __init__(self,
+                 publish_dir: Optional[str] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None,
+                 models: Optional[Dict[str, str]] = None,
+                 params: Optional[Dict[str, Any]] = None,
+                 raw_score: bool = False,
+                 max_queue: int = 256,
+                 max_batch_rows: int = 4096,
+                 batch_window_s: float = 0.002,
+                 default_deadline_s: float = 10.0,
+                 predict_deadline_s: float = 30.0,
+                 poll_interval_s: float = 0.2,
+                 breaker_cooldown_s: float = 2.0,
+                 probe_platform_on_start: bool = False,
+                 report_path: Optional[str] = None,
+                 log=Log):
+        """`publish_dir` subscribes the default model to a PR 6 publish
+        directory; `models` maps model_id -> publish_dir for
+        multi-tenancy; `model_file`/`model_str` pin a static default
+        model (no poller).  At least one source is required."""
+        self.log = log
+        self._params = dict(params or {})
+        self._raw_score = bool(raw_score)
+        self.max_queue = int(max_queue)
+        self.max_batch_rows = int(max_batch_rows)
+        self.batch_window_s = float(batch_window_s)
+        self.default_deadline_s = float(default_deadline_s)
+        self.predict_deadline_s = float(predict_deadline_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.probe_platform_on_start = bool(probe_platform_on_start)
+
+        self._dirs: Dict[str, str] = dict(models or {})
+        if publish_dir:
+            self._dirs.setdefault("default", publish_dir)
+        self._static: Optional[str] = None
+        if model_str is not None:
+            self._static = model_str
+        elif model_file is not None:
+            with open(model_file) as fh:
+                self._static = fh.read()
+        if not self._dirs and self._static is None:
+            raise ValueError("ServingRuntime needs publish_dir=, models= "
+                             "or a model_file/model_str")
+
+        self._subs = {mid: publish.ModelSubscriber(d, attempts=1)
+                      for mid, d in self._dirs.items()}
+        self._entries: Dict[str, _ModelEntry] = {}
+        self._entries_lock = threading.Lock()
+
+        self._queue: "collections.deque[_Request]" = collections.deque()
+        self._cond = threading.Condition()
+        self._stopped = False
+        self._started = False
+
+        # serving stage trail: PR 4 watchdog in thread mode with a
+        # bounded flight recorder (one stage per batch — unbounded
+        # growth would be its own reliability bug)
+        self.wd = resilience.Watchdog(
+            0, hard=False, label="serve stage", use_alarm=False,
+            keep_last=256, stream=sys.stderr,
+            report_path=report_path
+            or os.environ.get("LGBM_TPU_SERVE_REPORT"))
+        self._wd_lock = threading.Lock()
+
+        self._breaker = {"state": "closed", "open_until": 0.0}
+        self.degradation_events: List[Dict[str, Any]] = []
+        self.recovery_events: List[Dict[str, Any]] = []
+        self.start_degradation: Optional[Dict[str, Any]] = None
+
+        self._stats_lock = threading.Lock()
+        self._stats: Dict[str, Any] = {
+            "admitted": 0, "completed": 0,
+            "rejected": collections.Counter(),
+            "rows_served": 0, "batches_device": 0, "batches_host": 0,
+            "swaps": 0, "degradations": 0, "recoveries": 0,
+        }
+
+        self._executor_idx = 0
+        self._executor: Optional[_DeviceExecutor] = None
+        self._batcher: Optional[threading.Thread] = None
+        self._poller: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def __enter__(self) -> "ServingRuntime":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def start(self) -> "ServingRuntime":
+        if self._started:
+            return self
+        self._started = True
+        with self._wd_lock:
+            self.wd("start")
+        if self.probe_platform_on_start:
+            # PR 4 degradation chain at bring-up: a dead accelerator
+            # tunnel degrades the PROCESS to cpu loudly instead of
+            # hanging the first batch (the device path then runs the
+            # jitted engine on the cpu backend — still the batched path)
+            backend, event, _ = resilience.resolve_backend()
+            if event is not None:
+                self.start_degradation = event
+                with self._wd_lock:
+                    self.wd.annotate("degradation_event", event)
+                self.log.warning("serve: platform degraded at start: %s",
+                                 event["reason"])
+            os.environ.setdefault("JAX_PLATFORMS", backend)
+        if self._static is not None:
+            self._swap_in("default", self._static, generation=0, meta={})
+        for mid in self._dirs:
+            self._poll_model(mid)       # best effort; poller keeps trying
+        self._executor = self._spawn_executor()
+        self._batcher = threading.Thread(target=self._batcher_loop,
+                                         name="serve-batcher", daemon=True)
+        self._batcher.start()
+        if self._subs:
+            self._poller = threading.Thread(target=self._poller_loop,
+                                            name="serve-poller", daemon=True)
+            self._poller.start()
+        with self._wd_lock:
+            self.wd("serving", seconds=0)
+        return self
+
+    def stop(self) -> None:
+        """Clean shutdown: queued requests are rejected explicitly
+        (reason `shutdown`, non-retryable against THIS endpoint), never
+        silently dropped."""
+        with self._cond:
+            if self._stopped:
+                return
+            self._stopped = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+        for req in pending:
+            req.rejection = ServeRejected("shutdown", retryable=False)
+            req.done.set()
+            self._count_rejection("shutdown")
+        if self._executor is not None:
+            self._executor.submit(None)
+        for t in (self._batcher, self._poller):
+            if t is not None:
+                t.join(timeout=5)
+        with self._wd_lock:
+            self.wd.done()
+
+    # -- model lifecycle -----------------------------------------------------
+    def _swap_in(self, model_id: str, model_text: str, generation: int,
+                 meta: Dict[str, Any]) -> None:
+        """Load + prewarm a generation, then swap it in atomically.  The
+        swap is a dict assignment under a lock taken only for the
+        assignment: batches capture their entry BEFORE predicting, so an
+        in-flight batch finishes on the generation it started with."""
+        from ..basic import Booster
+        t0 = time.monotonic()
+        bst = Booster(params=dict(self._params), model_str=model_text)
+        entry = _ModelEntry(model_id, generation, bst, meta)
+        try:
+            # prewarm the device program for the smallest shape bucket so
+            # the first live batch does not pay the compile; an injected
+            # device fault here must not block the swap (the host path
+            # still serves)
+            bst.predict(np.zeros((1, entry.num_features)),
+                        raw_score=self._raw_score, device=True)
+        except BaseException as e:          # noqa: BLE001 — degraded path
+            self.log.warning("serve: prewarm of %s gen %d failed (%s); "
+                             "swapping anyway (host path serves)",
+                             model_id, generation, e)
+        with self._entries_lock:
+            self._entries[model_id] = entry
+        with self._stats_lock:
+            self._stats["swaps"] += 1
+        with self._wd_lock:
+            self.wd.annotate("last_swap", {
+                "model": model_id, "generation": generation,
+                "load_s": round(time.monotonic() - t0, 4),
+                "wallclock": resilience.wallclock()})
+        self.log.info("serve: %s now at generation %d (loaded in %.3fs)",
+                      model_id, generation, time.monotonic() - t0)
+
+    def _poll_model(self, model_id: str) -> None:
+        sub = self._subs.get(model_id)
+        if sub is None:
+            return
+        rec = sub.resolve_once()
+        if rec is None:
+            return
+        cur = self._entries.get(model_id)
+        if cur is not None and cur.generation == rec.generation:
+            return
+        self._swap_in(model_id, rec.model_text, rec.generation, rec.meta)
+
+    def _poller_loop(self) -> None:
+        while not self._stopped:
+            for mid in list(self._subs):
+                try:
+                    self._poll_model(mid)
+                except BaseException as e:   # noqa: BLE001 — keep polling
+                    self.log.warning("serve: poll of %s failed: %s", mid, e)
+            time.sleep(self.poll_interval_s)
+
+    def generation(self, model_id: str = "default") -> Optional[int]:
+        entry = self._entries.get(model_id)
+        return entry.generation if entry is not None else None
+
+    # -- request surface -----------------------------------------------------
+    def submit(self, data, deadline_s: Optional[float] = None,
+               model_id: str = "default") -> _Request:
+        """Admit one request (a feature row [F] or small matrix [B, F]).
+        Raises `ServeRejected` IMMEDIATELY when the queue is full or the
+        server is stopped — shedding at admission is the backpressure
+        contract; blocking the caller would just move the unbounded
+        queue into the clients."""
+        X = np.atleast_2d(np.asarray(data, dtype=np.float64))
+        deadline = time.monotonic() + (self.default_deadline_s
+                                       if deadline_s is None
+                                       else float(deadline_s))
+        req = _Request(model_id, X, deadline)
+        with self._cond:
+            if self._stopped or not self._started:
+                raise ServeRejected("shutdown", retryable=False,
+                                    detail="runtime not serving")
+            if len(self._queue) >= self.max_queue:
+                self._count_rejection("queue_full")
+                raise ServeRejected("queue_full", retryable=True,
+                                    queue_depth=len(self._queue))
+            self._queue.append(req)
+            self._cond.notify()
+        with self._stats_lock:
+            self._stats["admitted"] += 1
+        return req
+
+    def predict(self, data, deadline_s: Optional[float] = None,
+                model_id: str = "default", attempts: int = 3,
+                seed: int = 0) -> ServeResult:
+        """Blocking client helper: submit + wait, with bounded jittered
+        retry on RETRYABLE rejections (queue_full under a load spike,
+        no_model while the first generation lands)."""
+        delays = resilience.backoff_delays(max(attempts, 1), base=0.05,
+                                           cap=0.5, seed=seed)
+        deadline = (self.default_deadline_s if deadline_s is None
+                    else float(deadline_s))
+        last: Optional[ServeRejected] = None
+        for a in range(max(attempts, 1)):
+            try:
+                req = self.submit(data, deadline_s=deadline,
+                                  model_id=model_id)
+                return req.wait(timeout=deadline
+                                + self.predict_deadline_s + 10.0)
+            except ServeRejected as e:
+                last = e
+                if not e.retryable:
+                    raise
+                if a < len(delays):
+                    time.sleep(delays[a])
+        assert last is not None
+        raise last
+
+    # -- the batcher ---------------------------------------------------------
+    def _reject(self, req: _Request, reason: str, retryable: bool = True,
+                detail: str = "") -> None:
+        req.rejection = ServeRejected(reason, retryable=retryable,
+                                      detail=detail)
+        req.done.set()
+        self._count_rejection(reason)
+
+    def _count_rejection(self, reason: str) -> None:
+        with self._stats_lock:
+            self._stats["rejected"][reason] += 1
+
+    def _next_batch(self) -> Optional[List[_Request]]:
+        """Pop a batch of same-model requests: head-of-line model wins,
+        up to `max_batch_rows`, gathering follow-ups for at most
+        `batch_window_s`.  Expired requests are shed here (deadline
+        rejection) — work is never spent on an answer nobody is waiting
+        for."""
+        while True:
+            with self._cond:
+                while not self._queue and not self._stopped:
+                    self._cond.wait(0.1)
+                if self._stopped:
+                    return None
+                batch: List[_Request] = []
+                rows = 0
+                window_end = time.monotonic() + self.batch_window_s
+
+                def take() -> None:
+                    nonlocal rows
+                    keep: List[_Request] = []
+                    now = time.monotonic()
+                    while self._queue and rows < self.max_batch_rows:
+                        req = self._queue.popleft()
+                        if req.deadline < now:
+                            self._reject(req, "deadline_exceeded",
+                                         detail="expired before batching")
+                            continue
+                        if batch and req.model_id != batch[0].model_id:
+                            keep.append(req)
+                            continue
+                        batch.append(req)
+                        rows += req.n_rows
+                    self._queue.extendleft(reversed(keep))
+
+                take()
+                while (batch and rows < self.max_batch_rows
+                       and not self._stopped):
+                    remaining = window_end - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                    take()
+                if batch:
+                    return batch
+                # everything popped this round was shed as expired:
+                # go back to waiting for live work
+
+    def _batcher_loop(self) -> None:
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            try:
+                self._serve_batch(batch)
+            except BaseException as e:       # noqa: BLE001 — must not die
+                for req in batch:
+                    if not req.done.is_set():
+                        req.error = e
+                        req.done.set()
+                self.log.warning("serve: batch failed terminally: %s", e)
+
+    def _serve_batch(self, batch: List[_Request]) -> None:
+        model_id = batch[0].model_id
+        entry = self._entries.get(model_id)
+        if entry is None:
+            for req in batch:
+                self._reject(req, "no_model", retryable=True,
+                             detail="no generation loaded for %r"
+                             % model_id)
+            return
+        X = (batch[0].X if len(batch) == 1
+             else np.concatenate([r.X for r in batch], axis=0))
+        with self._wd_lock:
+            self.wd("batch model=%s gen=%d rows=%d"
+                    % (model_id, entry.generation, X.shape[0]),
+                    seconds=0)
+        values, served_by = self._serve_path(entry, X)
+        now = time.monotonic()
+        with self._stats_lock:
+            self._stats["rows_served"] += int(X.shape[0])
+            self._stats["completed"] += len(batch)
+            self._stats["batches_device" if served_by == "device"
+                        else "batches_host"] += 1
+        s = 0
+        for req in batch:
+            e = s + req.n_rows
+            req.result = ServeResult(values[s:e], entry.generation,
+                                     model_id, served_by,
+                                     round(now - req.enqueued, 6))
+            req.done.set()
+            s = e
+
+    # -- device path + circuit breaker ---------------------------------------
+    def _spawn_executor(self) -> _DeviceExecutor:
+        self._executor_idx += 1
+        ex = _DeviceExecutor(self._executor_idx)
+        ex.start()
+        return ex
+
+    def _device_predict(self, entry: _ModelEntry, X: np.ndarray
+                        ) -> np.ndarray:
+        """One device dispatch under a deadline.  A dispatch that blows
+        it is abandoned (the executor thread may be wedged; a fresh one
+        takes over) and surfaces as `StageTimeout` for the breaker."""
+        job = _Job(lambda: entry.booster.predict(
+            X, raw_score=self._raw_score, device=True))
+        self._executor.submit(job)
+        if not job.done.wait(self.predict_deadline_s):
+            job.abandoned = True
+            self._executor.retire()
+            self._executor = self._spawn_executor()
+            raise resilience.StageTimeout("device predict",
+                                          self.predict_deadline_s)
+        if job.error is not None:
+            raise job.error
+        assert job.values is not None
+        return np.asarray(job.values)
+
+    def _serve_path(self, entry: _ModelEntry, X: np.ndarray):
+        """(values, served_by): device when the breaker allows it, with
+        host fallback — degraded, the server still answers."""
+        if self._device_allowed(entry):
+            try:
+                return self._device_predict(entry, X), "device"
+            except BaseException as e:       # noqa: BLE001 — degrade
+                self._trip_breaker(entry, e)
+        return entry.booster.predict(X, raw_score=self._raw_score,
+                                     device=False), "host"
+
+    def _device_allowed(self, entry: _ModelEntry) -> bool:
+        b = self._breaker
+        if b["state"] == "closed":
+            return True
+        now = time.monotonic()
+        if now < b["open_until"]:
+            return False
+        # cooldown elapsed: PROBE-based recovery (a tiny dispatch pays
+        # the gamble, not a client batch)
+        try:
+            self._device_predict(
+                entry, np.zeros((1, entry.num_features), np.float64))
+        except BaseException as e:           # noqa: BLE001 — stay open
+            b["open_until"] = time.monotonic() + self.breaker_cooldown_s
+            with self._wd_lock:
+                self.wd.annotate("recovery_probe_failed",
+                                 "%s: %s" % (type(e).__name__, e))
+            return False
+        b["state"] = "closed"
+        event = {"event": "serving_recovery", "from": "host",
+                 "to": "device", "model": entry.model_id,
+                 "generation": entry.generation,
+                 "wallclock": resilience.wallclock()}
+        self.recovery_events.append(event)
+        with self._stats_lock:
+            self._stats["recoveries"] += 1
+        with self._wd_lock:
+            self.wd.annotate("recovery_event", event)
+        self.log.warning("serve: device path recovered (probe ok); "
+                         "circuit closed")
+        return True
+
+    def _trip_breaker(self, entry: _ModelEntry, err: BaseException) -> None:
+        timed_out = isinstance(err, resilience.StageTimeout)
+        reason = "%s: %s" % (type(err).__name__, err)
+        self._breaker["state"] = "open"
+        self._breaker["open_until"] = (time.monotonic()
+                                       + self.breaker_cooldown_s)
+        event = {"event": "serving_degradation", "from": "device",
+                 "to": "host", "reason": reason,
+                 "model": entry.model_id, "generation": entry.generation,
+                 "cooldown_s": self.breaker_cooldown_s,
+                 "wallclock": resilience.wallclock()}
+        self.degradation_events.append(event)
+        with self._stats_lock:
+            self._stats["degradations"] += 1
+        with self._wd_lock:
+            if timed_out:
+                # hung dispatch: the trail gets the timeout status AND
+                # all-thread tracebacks naming the wedged executor
+                self.wd.record_timeout(note=reason)
+            self.wd.annotate("degradation_event", event)
+        self.log.warning("serve: device batch failed (%s); circuit OPEN "
+                         "for %.1fs, serving from the host predictor",
+                         reason, self.breaker_cooldown_s)
+
+    # -- observability -------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._stats_lock:
+            st = {k: (dict(v) if isinstance(v, collections.Counter) else v)
+                  for k, v in self._stats.items()}
+        st["queue_depth"] = len(self._queue)
+        st["breaker"] = dict(self._breaker)
+        st["generations"] = {mid: e.generation
+                             for mid, e in self._entries.items()}
+        st["degradation_events"] = list(self.degradation_events)
+        st["recovery_events"] = list(self.recovery_events)
+        if self.start_degradation is not None:
+            st["start_degradation"] = self.start_degradation
+        return st
+
+
+# ---------------------------------------------------------------------------
+# TCP front end (task=serve)
+# ---------------------------------------------------------------------------
+
+class _Handler(socketserver.StreamRequestHandler):
+    """JSON-lines protocol: one request object per line, one response
+    object per line.  Requests: ``{"features": [...], "model": "id",
+    "deadline_s": 2.0, "raw": false}`` or ``{"cmd": "stats"}``.
+    Responses: ``{"values": [...], "generation": N, "served_by": ...,
+    "latency_s": ...}`` or a `ServeRejected.to_dict()` rejection."""
+
+    def handle(self) -> None:
+        rt: ServingRuntime = self.server.runtime    # type: ignore[attr-defined]
+        for raw in self.rfile:
+            line = raw.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line.decode("utf-8"))
+                if msg.get("cmd") == "stats":
+                    out = rt.stats()
+                else:
+                    rec = rt.submit(
+                        np.asarray(msg["features"], np.float64),
+                        deadline_s=msg.get("deadline_s"),
+                        model_id=msg.get("model", "default"),
+                    ).wait(timeout=rt.default_deadline_s
+                           + rt.predict_deadline_s + 10.0)
+                    out = {"values": np.asarray(rec.values).tolist(),
+                           "generation": rec.generation,
+                           "served_by": rec.served_by,
+                           "latency_s": rec.latency_s}
+            except ServeRejected as e:
+                out = e.to_dict()
+            except Exception as e:           # noqa: BLE001 — wire error
+                out = {"error": "bad_request",
+                       "detail": "%s: %s" % (type(e).__name__, e)}
+            try:
+                self.wfile.write((json.dumps(out) + "\n").encode("utf-8"))
+                self.wfile.flush()
+            except OSError:
+                return                       # client went away
+
+
+class ServingServer(socketserver.ThreadingTCPServer):
+    """Thin TCP wrapper over a `ServingRuntime` (the CLI `task=serve`
+    front end).  One thread per connection; all connections share the
+    runtime's bounded queue, so admission control is global."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, runtime: ServingRuntime, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.runtime = runtime
+        super().__init__((host, port), _Handler)
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]

@@ -85,3 +85,44 @@ def test_predict_bench_record_shape():
         assert key in rec
     assert rec["depth_iters"] < rec["scan_depth_iters"]
     assert np.isfinite(rec["max_abs_diff_vs_host_raw"])
+
+
+def test_serve_bench_record_shape():
+    """BENCH_SERVE at toy scale: the record must carry the latency
+    percentiles, rows/sec, swap latency and the zero-drop evidence the
+    acceptance gate reads."""
+    env = {"BENCH_SERVE_CLIENTS": "3", "BENCH_SERVE_SECONDS": "1.6",
+           "BENCH_SERVE_TREES": "12", "BENCH_SERVE_LEAVES": "15",
+           "BENCH_SERVE_BATCH": "4"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        rec = bench.bench_serve()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.update({k: v})
+    for key in ("rows_per_sec", "latency_ms", "swap_latency_s", "shed",
+                "batches_device", "batches_host", "requests"):
+        assert key in rec
+    assert rec["requests"] > 0
+    assert rec["latency_ms"]["p99"] >= rec["latency_ms"]["p50"]
+    # the mid-run hot swap must have been observed by a client
+    assert rec["swap_latency_s"] is not None
+
+
+def test_fallback_reexec_preserves_every_section_toggle():
+    """The CPU-fallback re-exec env pin (ISSUE 7 satellite): every
+    BENCH_<SECTION> toggle — serve included — must ride
+    FALLBACK_SECTION_ENV through the hermetic re-exec, and the re-exec
+    loop must consume the constant (not a drifted copy)."""
+    for key in ("BENCH_SERVE", "BENCH_SERVE_CLIENTS",
+                "BENCH_SERVE_SECONDS", "BENCH_SERVE_TREES",
+                "BENCH_SERVE_LEAVES", "BENCH_SERVE_BATCH",
+                "BENCH_ONLINE", "BENCH_PREDICT", "BENCH_PHASES",
+                "BENCH_HIST_QUANT", "BENCH_FRONTIER_BATCH"):
+        assert key in bench.FALLBACK_SECTION_ENV, key
+    import inspect
+    src = inspect.getsource(bench.main)
+    assert "for k in FALLBACK_SECTION_ENV" in src, (
+        "bench.main's fallback re-exec no longer iterates "
+        "FALLBACK_SECTION_ENV; section toggles would be dropped")

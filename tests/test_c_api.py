@@ -395,3 +395,52 @@ def test_dump_model_schema_matches_python(capi, tmp_path):
                                    rtol=0, atol=0)
     # iteration slicing
     assert len(nb.dump_model(num_iteration=2)["tree_info"]) == 2
+
+
+def test_leaf_value_get_set_and_num_model_per_iteration(capi, tmp_path):
+    """LGBM_BoosterGetLeafValue / SetLeafValue / NumModelPerIteration:
+    get agrees with the Python Booster, set takes effect on prediction
+    AND survives a save round-trip (the stored model text is patched),
+    and K is reported for both binary and multiclass models."""
+    rng = np.random.default_rng(31)
+    X = rng.standard_normal((400, 6))
+    y = (X[:, 0] + 0.6 * X[:, 1] - 0.4 * X[:, 2]
+         + 0.5 * rng.standard_normal(400) > 0).astype(float)
+    bst = _train({"objective": "binary"}, X, y, rounds=4)
+    nb, _ = _roundtrip(capi, bst, X, tmp_path, "leaf")
+    assert nb.num_model_per_iteration == 1
+    for t in range(4):
+        for lf in range(bst._model.trees[t].num_leaves):
+            assert nb.get_leaf_value(t, lf) == bst.get_leaf_output(t, lf)
+
+    # patch one leaf: prediction must shift by exactly the delta on the
+    # rows that land in it (raw score is a plain sum of leaf outputs)
+    patch_leaf = bst._model.trees[1].num_leaves - 1
+    before = nb.predict(X, raw_score=True)
+    leaf_of = bst.predict(X, pred_leaf=True)[:, 1]
+    old = nb.get_leaf_value(1, patch_leaf)
+    nb.set_leaf_value(1, patch_leaf, old + 0.25)
+    assert nb.get_leaf_value(1, patch_leaf) == old + 0.25
+    after = nb.predict(X, raw_score=True)
+    expect = before + np.where(leaf_of == patch_leaf, 0.25, 0.0)
+    np.testing.assert_allclose(after, expect, rtol=0, atol=1e-15)
+
+    # the patch survives text round-trips through BOTH loaders
+    nb2 = capi.NativeBooster(model_str=nb.model_to_string())
+    assert nb2.get_leaf_value(1, patch_leaf) == old + 0.25
+    pb = lgb.Booster(model_str=nb.model_to_string())
+    assert pb.get_leaf_output(1, patch_leaf) == old + 0.25
+
+    # out-of-range indices fail loudly, not silently
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        nb.get_leaf_value(99, 0)
+    with pytest.raises(LightGBMError):
+        nb.set_leaf_value(0, 99, 1.0)
+
+    # multiclass K
+    ym = rng.integers(0, 3, 400)
+    bm = _train({"objective": "multiclass", "num_class": 3}, X, ym,
+                rounds=3)
+    nbm, _ = _roundtrip(capi, bm, X, tmp_path, "leafk")
+    assert nbm.num_model_per_iteration == 3

@@ -246,9 +246,13 @@ def test_online_slow_stage_times_out_and_cycle_retries(tmp_path):
     # completed — no hang, no crash
     assert "WATCHDOG" in r.stderr
     trail = json.load(open(tmp_path / "m.txt.stage_trail.json"))
-    timed_out = [s for s in trail["stages"] if s["status"] == "timeout"]
+    # pin the INJECTED stall's timeout specifically: under a loaded
+    # full-suite run another stage can legitimately graze the tight 2 s
+    # test budget too (observed: cycle-1 train at 2.001 s) — that extra
+    # timeout also retries and completes, so it must not fail this pin
+    timed_out = [s for s in trail["stages"] if s["status"] == "timeout"
+                 and "snapshot" in s["name"]]
     assert len(timed_out) == 1
-    assert "snapshot" in timed_out[0]["name"]
     assert timed_out[0].get("injected_stall_s") == 4.0
     # both cycles still published
     gens = [g for g, _ in

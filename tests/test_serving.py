@@ -1,0 +1,503 @@
+"""Fault-tolerant serving runtime (ISSUE 7).
+
+Layers under test:
+
+* runtime/serving.py — admission control + backpressure (bounded queue,
+  explicit machine-readable retryable rejections, per-request
+  deadlines), micro-batching, the device->host circuit breaker with
+  probe-based recovery, zero-drop hot model swap from the PR 6 publish
+  seam, multi-model tenancy, and the TCP front end;
+* models/device_predictor.py — the micro-batch boundary seam (fault
+  injection point + batch-composition invariance, which the chaos
+  soak's byte-identity ledger builds on);
+* runtime/resilience.py — the serving faults (die_at_predict /
+  slow_predict), the thread-mode watchdog, and the FAULT_TABLE <->
+  docs/RESILIENCE.md drift pin;
+* the ADVERSARIAL pin (exp/chaos_serve.py, shared implementation): the
+  tier-1 quick soak plus the slow full soak (the CHAOS_SERVE_r07.json
+  acceptance artifact).
+"""
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.runtime import publish, resilience
+from lightgbm_tpu.runtime.serving import (ServeRejected, ServingRuntime,
+                                          ServingServer)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "exp"))
+
+import chaos_serve  # noqa: E402
+
+
+def _synth_model(n_trees=16, num_leaves=15, n_feat=6, seed=1):
+    """Serving-shape ensemble built directly (no training run)."""
+    from bench import synth_serving_model
+    return synth_serving_model(n_trees, num_leaves, n_feat,
+                               seed=seed).save_model_to_string()
+
+
+def _booster(text):
+    from lightgbm_tpu.basic import Booster
+    return Booster(model_str=text)
+
+
+@pytest.fixture()
+def clean_fault_env():
+    old = os.environ.pop("LGBM_TPU_FAULT", None)
+    yield
+    if old is None:
+        os.environ.pop("LGBM_TPU_FAULT", None)
+    else:
+        os.environ["LGBM_TPU_FAULT"] = old
+
+
+# ---------------------------------------------------------------------------
+# the quick serve smoke (tier-1 acceptance): concurrent clients, one hot
+# swap, zero drops
+# ---------------------------------------------------------------------------
+
+def test_serve_smoke_concurrent_clients_hot_swap_zero_drops(tmp_path):
+    """N concurrent clients against a live runtime; generation 2 is
+    published mid-load.  Every request must complete or be explicitly
+    rejected (zero drops), every response must be byte-identical to
+    offline Booster.predict for the generation it reports, and
+    post-swap responses must match the NEW generation exactly."""
+    pub = publish.ModelPublisher(str(tmp_path / "pub"), keep_last=0)
+    t1, t2 = _synth_model(seed=1), _synth_model(seed=2)
+    pub.publish(t1, meta={"cycle": 1})
+    rng = np.random.default_rng(0)
+    probe = rng.standard_normal((48, 6))
+    refs = {1: _booster(t1).predict(probe, device=True),
+            2: _booster(t2).predict(probe, device=True)}
+
+    outcomes = {"completed": 0, "rejected": 0}
+    mismatches, errors, gens = [], [], []
+    lock = threading.Lock()
+    with ServingRuntime(publish_dir=str(tmp_path / "pub"),
+                        poll_interval_s=0.03,
+                        batch_window_s=0.002) as rt:
+        swap_evt = threading.Event()
+
+        def client(seed):
+            crng = np.random.default_rng(seed)
+            for k in range(30):
+                idx = crng.integers(0, len(probe), size=3)
+                try:
+                    rec = rt.predict(probe[idx])
+                except ServeRejected:
+                    with lock:
+                        outcomes["rejected"] += 1
+                    continue
+                except BaseException as e:   # noqa: BLE001 — ledger
+                    errors.append(str(e))
+                    continue
+                with lock:
+                    outcomes["completed"] += 1
+                    gens.append(rec.generation)
+                if not np.array_equal(rec.values,
+                                      refs[rec.generation][idx]):
+                    mismatches.append(rec.generation)
+                if k == 10 and seed == 100:
+                    pub.publish(t2, meta={"cycle": 2})
+                    swap_evt.set()
+                if k > 10:
+                    swap_evt.wait(5)
+
+        threads = [threading.Thread(target=client, args=(100 + i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads)
+
+        # post-swap: responses must report generation 2 and match it
+        deadline = time.monotonic() + 10
+        while rt.generation() != 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        rec = rt.predict(probe[:5])
+        assert rec.generation == 2
+        assert np.array_equal(rec.values, refs[2][:5])
+        st = rt.stats()
+
+    assert errors == []
+    assert mismatches == []
+    # zero drops: every admitted request is accounted for
+    assert outcomes["completed"] == 4 * 30 - outcomes["rejected"]
+    assert st["admitted"] == st["completed"] \
+        + sum(st["rejected"].values()) - st["rejected"].get("shutdown", 0)
+    assert set(gens) <= {1, 2}
+    assert st["swaps"] >= 2          # initial load + the hot swap
+
+
+def test_multi_model_tenancy(tmp_path):
+    """Two lineages served from one runtime: requests carry model_id,
+    responses carry the right generation and the right values."""
+    pa = publish.ModelPublisher(str(tmp_path / "a"), keep_last=0)
+    pb = publish.ModelPublisher(str(tmp_path / "b"), keep_last=0)
+    ta, tb = _synth_model(seed=5), _synth_model(seed=6, n_trees=20)
+    pa.publish(ta, meta={})
+    pb.publish(tb, meta={})
+    probe = np.random.default_rng(2).standard_normal((16, 6))
+    ra = _booster(ta).predict(probe, device=True)
+    rb = _booster(tb).predict(probe, device=True)
+    with ServingRuntime(models={"a": str(tmp_path / "a"),
+                                "b": str(tmp_path / "b")},
+                        poll_interval_s=0.05) as rt:
+        got_a = rt.predict(probe, model_id="a")
+        got_b = rt.predict(probe, model_id="b")
+        assert np.array_equal(got_a.values, ra)
+        assert np.array_equal(got_b.values, rb)
+        with pytest.raises(ServeRejected) as ei:
+            rt.predict(probe, model_id="nope", attempts=1)
+        assert ei.value.reason == "no_model" and ei.value.retryable
+
+
+# ---------------------------------------------------------------------------
+# degradation chain
+# ---------------------------------------------------------------------------
+
+def test_die_at_predict_degrades_to_host_and_recovers(tmp_path,
+                                                      clean_fault_env):
+    """Acceptance pin: with die_at_predict armed the server answers
+    from the host-predictor fallback (degradation_event in the stage
+    trail) instead of erroring out, and recovers to the device path
+    when the fault clears."""
+    text = _synth_model(seed=3)
+    probe = np.random.default_rng(1).standard_normal((8, 6))
+    ref_host = _booster(text).predict(probe)
+    ref_dev = _booster(text).predict(probe, device=True)
+    report = str(tmp_path / "trail.json")
+    with ServingRuntime(model_str=text, breaker_cooldown_s=0.2,
+                        predict_deadline_s=5.0, batch_window_s=0.0,
+                        report_path=report) as rt:
+        assert rt.predict(probe).served_by == "device"
+        os.environ["LGBM_TPU_FAULT"] = "die_at_predict:1"
+        rec = rt.predict(probe)
+        assert rec.served_by == "host"
+        assert np.array_equal(rec.values, ref_host)
+        assert rt.degradation_events \
+            and rt.degradation_events[0]["event"] == "serving_degradation"
+        # breaker open: no device attempt, still answering
+        assert rt.predict(probe).served_by == "host"
+        # fault clears -> probe-based recovery after the cooldown
+        del os.environ["LGBM_TPU_FAULT"]
+        time.sleep(0.3)
+        rec = rt.predict(probe)
+        assert rec.served_by == "device"
+        assert np.array_equal(rec.values, ref_dev)
+        assert rt.recovery_events \
+            and rt.recovery_events[0]["event"] == "serving_recovery"
+    # the degradation event is in the persisted serving stage trail
+    trail = json.load(open(report))
+    assert any("degradation_event" in st for st in trail["stages"])
+
+
+def test_slow_predict_times_out_into_trail_and_host_serves(
+        clean_fault_env):
+    """A HUNG device batch (slow_predict past the predict deadline) is
+    abandoned: the stage trail records the timeout with all-thread
+    tracebacks, the batch is re-served from the host path, and the
+    caller never waits for the stall to finish."""
+    text = _synth_model(seed=4)
+    probe = np.random.default_rng(3).standard_normal((6, 6))
+    ref_host = _booster(text).predict(probe)
+    with ServingRuntime(model_str=text, breaker_cooldown_s=10.0,
+                        predict_deadline_s=0.3,
+                        batch_window_s=0.0) as rt:
+        assert rt.predict(probe).served_by == "device"
+        os.environ["LGBM_TPU_FAULT"] = "slow_predict:2.5"
+        t0 = time.monotonic()
+        rec = rt.predict(probe)
+        dt = time.monotonic() - t0
+        assert rec.served_by == "host"
+        assert np.array_equal(rec.values, ref_host)
+        assert dt < 2.0, "caller waited for the stalled dispatch (%.2fs)" % dt
+        assert any(st.get("status") == "timeout" for st in rt.wd.stages)
+        assert rt.wd.tracebacks is not None
+        assert isinstance(rt.degradation_events[0]["reason"], str)
+        del os.environ["LGBM_TPU_FAULT"]
+
+
+# ---------------------------------------------------------------------------
+# admission control + backpressure
+# ---------------------------------------------------------------------------
+
+def test_queue_full_sheds_with_machine_readable_retryable_rejection(
+        clean_fault_env):
+    """Overload sheds AT ADMISSION with an explicit retryable rejection
+    — and the queued requests still complete (zero drops)."""
+    text = _synth_model(seed=7)
+    probe = np.random.default_rng(4).standard_normal((4, 6))
+    os.environ["LGBM_TPU_FAULT"] = "slow_predict:0.8"
+    with ServingRuntime(model_str=text, max_queue=2,
+                        predict_deadline_s=0.3, breaker_cooldown_s=30.0,
+                        batch_window_s=0.0) as rt:
+        reqs, rejected = [], []
+        for _ in range(8):
+            try:
+                reqs.append(rt.submit(probe, deadline_s=20.0))
+            except ServeRejected as e:
+                rejected.append(e)
+        assert rejected, "bounded queue never shed"
+        for e in rejected:
+            assert e.retryable is True
+            d = e.to_dict()
+            assert d["error"] == "rejected" and d["reason"] == "queue_full"
+            assert isinstance(d["queue_depth"], int) and "wallclock" in d
+        del os.environ["LGBM_TPU_FAULT"]
+        # every ADMITTED request completes — host fallback serves them
+        for r in reqs:
+            rec = r.wait(timeout=30)
+            assert rec.values.shape[0] == probe.shape[0]
+
+
+def test_expired_requests_are_shed_not_served(clean_fault_env):
+    """A request whose deadline passes before its batch forms is shed
+    with a deadline rejection — no work is spent on an answer nobody is
+    waiting for."""
+    text = _synth_model(seed=8)
+    probe = np.random.default_rng(5).standard_normal((4, 6))
+    os.environ["LGBM_TPU_FAULT"] = "slow_predict:0.6"
+    with ServingRuntime(model_str=text, predict_deadline_s=0.25,
+                        breaker_cooldown_s=30.0,
+                        batch_window_s=0.0) as rt:
+        blocker = rt.submit(probe, deadline_s=20.0)   # occupies the batcher
+        time.sleep(0.1)       # the blocker's batch is now in flight
+        doomed = rt.submit(probe, deadline_s=0.01)
+        with pytest.raises(ServeRejected) as ei:
+            doomed.wait(timeout=10)
+        assert ei.value.reason == "deadline_exceeded"
+        assert ei.value.retryable is True
+        del os.environ["LGBM_TPU_FAULT"]
+        blocker.wait(timeout=30)                      # zero drops
+
+
+def test_stopped_runtime_rejects_nonretryably(tmp_path):
+    text = _synth_model(seed=9)
+    rt = ServingRuntime(model_str=text).start()
+    rt.stop()
+    with pytest.raises(ServeRejected) as ei:
+        rt.submit(np.zeros(6))
+    assert ei.value.reason == "shutdown" and ei.value.retryable is False
+
+
+# ---------------------------------------------------------------------------
+# device_predictor batch-boundary seam
+# ---------------------------------------------------------------------------
+
+def test_device_predictor_batch_hook_fires_per_microbatch():
+    from lightgbm_tpu.models.device_predictor import DevicePredictor
+    bst = _booster(_synth_model(seed=10))
+    dp = DevicePredictor(bst._model, batch_rows=64)
+    X = np.random.default_rng(6).standard_normal((200, 6)).astype(np.float32)
+    calls = []
+    dp.predict_raw(X, batch_hook=lambda i, n: calls.append((i, n)))
+    assert calls == [(0, 4), (1, 4), (2, 4), (3, 4)]
+
+
+def test_device_predict_is_batch_composition_invariant():
+    """Per-row device outputs must not depend on which batch a row rides
+    in — the invariance the serving runtime's micro-batching and the
+    chaos soak's byte-identity ledger are built on."""
+    bst = _booster(_synth_model(seed=11, n_trees=24))
+    X = np.random.default_rng(7).standard_normal((120, 6))
+    full = bst.predict(X, device=True)
+    assert np.array_equal(full[:37], bst.predict(X[:37], device=True))
+    one = np.concatenate([np.atleast_1d(bst.predict(X[i:i + 1],
+                                                    device=True))
+                          for i in range(9)])
+    assert np.array_equal(full[:9], one)
+
+
+# ---------------------------------------------------------------------------
+# subscriber under concurrent swap + pruning (PR 6 pins, consumer side)
+# ---------------------------------------------------------------------------
+
+def test_subscriber_concurrent_publish_prune_never_torn(tmp_path):
+    """A reader resolving generation N while keep-last-K pruning and a
+    publisher land N+1/N+2 must never observe a torn read: every
+    resolution is valid, deep-parses with the real model loader, and
+    generations never move backwards."""
+    from lightgbm_tpu.models.gbdt_model import GBDTModel
+    d = str(tmp_path / "pub")
+    texts = {g: _synth_model(seed=g, n_trees=4 + g) for g in range(1, 13)}
+    pub = publish.ModelPublisher(d, keep_last=1, grace_s=0.0)
+    pub.publish(texts[1], meta={})
+    stop = threading.Event()
+    seen, problems = [], []
+
+    def reader():
+        sub = publish.ModelSubscriber(d, attempts=1)
+        last = 0
+        while not stop.is_set():
+            rec = sub.resolve_once()
+            if rec is None:
+                continue
+            if rec.generation < last:
+                problems.append("generation went backwards: %d -> %d"
+                                % (last, rec.generation))
+            last = rec.generation
+            if rec.model_text != texts.get(rec.generation):
+                problems.append("gen %d bytes differ" % rec.generation)
+            try:
+                m = GBDTModel.load_model_from_string(rec.model_text)
+                assert m.current_iteration > 0
+            except Exception as e:       # noqa: BLE001 — ledger
+                problems.append("gen %d torn: %s" % (rec.generation, e))
+            seen.append(rec.generation)
+
+    threads = [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    # keep_last=1 + grace 0: every publish prunes the PREVIOUS newest
+    # while readers hammer it — the read-then-validate-in-one-pass
+    # contract is what keeps this safe
+    for g in range(2, 13):
+        pub.publish(texts[g], meta={})
+        time.sleep(0.02)
+    time.sleep(0.1)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert problems == []
+    assert seen and max(seen) == 12
+
+
+# ---------------------------------------------------------------------------
+# fault table <-> docs <-> parser drift pin (satellite)
+# ---------------------------------------------------------------------------
+
+def test_fault_table_is_the_single_registry():
+    """The parser accepts exactly FAULT_TABLE's names (serving faults
+    included), and the docs/RESILIENCE.md injection matrix has exactly
+    one row per table entry — the three surfaces cannot drift."""
+    assert resilience.FAULT_NAMES == tuple(resilience.FAULT_TABLE)
+    for name in ("die_at_predict", "slow_predict"):
+        assert name in resilience.FAULT_TABLE
+    # parser side: every registered name parses; unknown names raise
+    old = os.environ.get("LGBM_TPU_FAULT")
+    try:
+        for name in resilience.FAULT_TABLE:
+            os.environ["LGBM_TPU_FAULT"] = name
+            assert resilience.fault_active(name)
+        os.environ["LGBM_TPU_FAULT"] = "definitely_not_a_fault"
+        with pytest.raises(ValueError):
+            resilience.fault_active("hang_import")
+    finally:
+        if old is None:
+            os.environ.pop("LGBM_TPU_FAULT", None)
+        else:
+            os.environ["LGBM_TPU_FAULT"] = old
+    # docs side: one matrix row per fault, no undocumented faults, no
+    # documented-but-unregistered faults
+    doc = open(os.path.join(REPO, "docs", "RESILIENCE.md")).read()
+    table_rows = [ln for ln in doc.splitlines()
+                  if ln.startswith("| `") and "`" in ln[3:]]
+    documented = {ln[3:].split("`", 1)[0].split(":")[0].split("[")[0]
+                  for ln in table_rows}
+    assert documented == set(resilience.FAULT_TABLE), (
+        "docs/RESILIENCE.md injection matrix drifted from "
+        "resilience.FAULT_TABLE: docs-only %r, table-only %r"
+        % (documented - set(resilience.FAULT_TABLE),
+           set(resilience.FAULT_TABLE) - documented))
+
+
+# ---------------------------------------------------------------------------
+# thread-mode watchdog (the serving flight recorder)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_thread_mode_keep_last_and_record_timeout(tmp_path):
+    report = str(tmp_path / "wd.json")
+    wd = resilience.Watchdog(5, use_alarm=False, keep_last=3,
+                             report_path=report, stream=sys.stderr)
+    out = []
+
+    def worker():
+        for i in range(5):
+            wd("stage %d" % i)
+        wd.record_timeout(note="owner-enforced deadline")
+        out.append(wd.report())
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(timeout=10)
+    rep = out[0]
+    assert len(rep["stages"]) == 3 and rep["dropped_stages"] == 2
+    assert rep["stages"][-1]["status"] == "timeout"
+    assert rep["stages"][-1]["note"] == "owner-enforced deadline"
+    assert rep["culprit"] == "stage 4"
+    assert "tracebacks" in rep
+    assert json.load(open(report))["culprit"] == "stage 4"
+
+
+# ---------------------------------------------------------------------------
+# TCP front end (task=serve)
+# ---------------------------------------------------------------------------
+
+def test_serving_server_tcp_roundtrip():
+    text = _synth_model(seed=12)
+    probe = np.random.default_rng(8).standard_normal((3, 6))
+    with ServingRuntime(model_str=text, batch_window_s=0.0) as rt:
+        srv = ServingServer(rt)      # port 0 -> ephemeral
+        th = threading.Thread(target=srv.serve_forever, daemon=True)
+        th.start()
+        try:
+            with socket.create_connection(("127.0.0.1", srv.port),
+                                          timeout=10) as s:
+                f = s.makefile("rw")
+                f.write(json.dumps({"features": probe.tolist()}) + "\n")
+                f.flush()
+                resp = json.loads(f.readline())
+                assert resp["generation"] == 0
+                assert resp["served_by"] in ("device", "host")
+                ref = _booster(text).predict(
+                    probe, device=resp["served_by"] == "device")
+                assert np.allclose(resp["values"], ref, rtol=0, atol=0)
+                f.write(json.dumps({"cmd": "stats"}) + "\n")
+                f.flush()
+                st = json.loads(f.readline())
+                assert st["completed"] >= 1 and "breaker" in st
+                f.write("not json\n")
+                f.flush()
+                err = json.loads(f.readline())
+                assert err["error"] == "bad_request"
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# chaos soaks (shared implementation with exp/chaos_serve.py)
+# ---------------------------------------------------------------------------
+
+def test_quick_chaos_serve_soak(tmp_path, clean_fault_env):
+    """Tier-1-sized slice of the acceptance soak: randomized device
+    kill/stall + publish churn under concurrent clients -> zero torn or
+    wrong-generation responses, every completed response byte-identical
+    to offline Booster.predict for its generation."""
+    rec = chaos_serve.run_soak(str(tmp_path), generations=4, rounds=2,
+                               clients=3, seed=5, step_s=0.25)
+    assert rec["ok"], rec
+    assert rec["wrong_generation_responses"] == 0
+    assert rec["mismatched_responses"] == []
+    assert rec["non_machine_readable_rejections"] == 0
+    assert rec["requests_completed"] > 0
+
+
+@pytest.mark.slow
+def test_full_chaos_serve_soak(tmp_path, clean_fault_env):
+    """The full acceptance soak (the CHAOS_SERVE_r07.json schema)."""
+    rec = chaos_serve.run_soak(str(tmp_path), generations=12, clients=6,
+                               seed=11)
+    assert rec["ok"], rec
+    assert rec["degradations"] > 0 and rec["recoveries"] > 0
+    assert rec["served_by"]["host"] > 0 and rec["served_by"]["device"] > 0
