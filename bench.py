@@ -44,6 +44,7 @@ FALLBACK_SECTION_ENV = (
     "BENCH_ONLINE_CYCLES", "BENCH_ONLINE_ROUNDS",
     "BENCH_SERVE", "BENCH_SERVE_CLIENTS", "BENCH_SERVE_SECONDS",
     "BENCH_SERVE_TREES", "BENCH_SERVE_LEAVES", "BENCH_SERVE_BATCH",
+    "BENCH_INGEST", "BENCH_INGEST_ROWS",
 )
 
 #: most recent bench measured on REAL TPU hardware (updated by hand after
@@ -417,6 +418,87 @@ def bench_serve():
             "degradations": st["degradations"],
             "note": "zero-drop asserted: every request completed or was "
                     "shed with an explicit retryable rejection",
+        }
+
+
+def bench_ingest():
+    """BENCH_INGEST: dataset-ingest rows/sec (ISSUE 8) — the file-parse
+    path vs the zero-copy streaming pushes (dense chunks, CSR chunks)
+    vs a binary-cache hit, all producing the SAME binned dataset
+    (asserted bit-identical).  BENCH_INGEST_ROWS reshapes it."""
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.stream import StreamingDatasetBuilder
+
+    rows = int(os.environ.get("BENCH_INGEST_ROWS", 120_000))
+    n_feat = 28
+    X, y = synth_higgs(rows, n_feat)
+    X64 = X.astype(np.float64)
+    params = {"max_bin": 255, "verbose": -1}
+    chunk = max(rows // 8, 1)
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        out = fn()
+        return out, time.perf_counter() - t0
+
+    def construct(data):
+        ds = lgb.Dataset(data, params=dict(params))
+        ds.construct(Config(dict(params)))
+        return ds
+
+    with tempfile.TemporaryDirectory(prefix="bench_ingest_") as d:
+        path = os.path.join(d, "train.tsv")
+        # %.17g so the text round-trip reproduces the exact doubles the
+        # push paths see — the bins_identical assertion depends on it
+        np.savetxt(path, np.column_stack([y, X64]), delimiter="\t",
+                   fmt="%.17g")
+        ds_file, t_file = timed(lambda: construct(path))
+
+        def dense_push():
+            b = StreamingDatasetBuilder(params=dict(params))
+            for s in range(0, rows, chunk):
+                b.push_dense(X64[s:s + chunk], label=y[s:s + chunk])
+            return construct(b)
+        ds_push, t_push = timed(dense_push)
+
+        def csr_push():
+            b = StreamingDatasetBuilder(params=dict(params))
+            for s in range(0, rows, chunk):
+                Xc = X64[s:s + chunk]
+                m = Xc.shape[0]
+                # fully-dense CSR: the honest upper bound on marshalling
+                indptr = np.arange(m + 1, dtype=np.int64) * n_feat
+                indices = np.tile(np.arange(n_feat, dtype=np.int32), m)
+                b.push_csr(indptr, indices, Xc.ravel(), n_feat,
+                           label=y[s:s + chunk])
+            return construct(b)
+        ds_csr, t_csr = timed(csr_push)
+
+        bin_path = os.path.join(d, "train.bin")
+        ds_file.binned.metadata.set_label(y)
+        ds_file.save_binary(bin_path)
+        ds_bin, t_bin = timed(lambda: construct(bin_path))
+
+        same = (np.array_equal(ds_file.binned.bins, ds_push.binned.bins)
+                and np.array_equal(ds_file.binned.bins, ds_csr.binned.bins)
+                and np.array_equal(ds_file.binned.bins, ds_bin.binned.bins))
+        if not same:
+            raise RuntimeError("ingest paths produced different bins — "
+                               "the streaming builder broke parser parity")
+        return {
+            "rows": rows, "n_features": n_feat,
+            "file_parse_rows_per_sec": round(rows / t_file, 1),
+            "dense_push_rows_per_sec": round(rows / t_push, 1),
+            "csr_push_rows_per_sec": round(rows / t_csr, 1),
+            "binary_cache_rows_per_sec": round(rows / t_bin, 1),
+            "push_speedup_vs_file_parse": round(t_file / t_push, 2),
+            "cache_speedup_vs_file_parse": round(t_file / t_bin, 2),
+            "bins_identical_across_paths": True,
+            "note": "push paths skip parse entirely; file-parse includes "
+                    "the native mmap parser + find-bin + encode",
         }
 
 
@@ -816,6 +898,23 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
                                  "above is unaffected"}
             stage("serve bench FAILED (diagnostics only)")
 
+    # streaming-ingest bench (BENCH_INGEST=0 skips): file parse vs dense
+    # push vs CSR push vs binary-cache hit rows/sec, bins pinned
+    # identical.  Guarded — a failure is recorded, never fatal.
+    ingest_rec = None
+    if os.environ.get("BENCH_INGEST", "1") != "0":
+        try:
+            ingest_rec = bench_ingest()
+            stage("ingest bench done (%.0f rows/s dense push vs %.0f "
+                  "file parse)"
+                  % (ingest_rec["dense_push_rows_per_sec"],
+                     ingest_rec["file_parse_rows_per_sec"]))
+        except Exception as e:
+            ingest_rec = {"error": "%s: %s" % (type(e).__name__, e),
+                          "note": "ingest bench failed; headline result "
+                                  "above is unaffected"}
+            stage("ingest bench FAILED (diagnostics only)")
+
     if isinstance(phases, dict):
         # the sync-audit counters ride the default phases output so every
         # bench record carries the blocking-fetch split next to the wall
@@ -870,6 +969,8 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
         result["online"] = online_rec
     if serve_rec is not None:
         result["serve"] = serve_rec
+    if ingest_rec is not None:
+        result["ingest"] = ingest_rec
     if hist_quant is not None:
         result["hist_quant"] = hist_quant
     if STAGED_REPORT is not None:

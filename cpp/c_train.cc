@@ -91,6 +91,85 @@ def dataset_set_field(ds, name, mv, dtype_code):
     ds.set_field(name, np.frombuffer(mv, dtype=dt).copy())
 
 
+def _as_np(mv, dtype_code, count):
+    # copy: the C caller's buffer lifetime ends when the entry point
+    # returns, but the chunk lives in the stream builder until finalize
+    dt = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}[dtype_code]
+    return np.frombuffer(mv, dtype=dt, count=count).copy()
+
+
+def _stream_builder(params, num_features=None, reference=None,
+                    num_total_rows=None):
+    from lightgbm_tpu.io.stream import StreamingDatasetBuilder
+    return StreamingDatasetBuilder(params=params, num_features=num_features,
+                                   reference=reference,
+                                   num_total_rows=num_total_rows)
+
+
+def dataset_from_csr(ipmv, ipcode, idxmv, dmv, dcode, nindptr, nelem,
+                     num_col, params, ref):
+    p = _params(params)
+    indptr = _as_np(ipmv, ipcode, nindptr).astype(np.int64)
+    indices = _as_np(idxmv, 2, nelem)
+    values = _as_np(dmv, dcode, nelem).astype(np.float64)
+    b = _stream_builder(p, num_features=int(num_col))
+    b.push_csr(indptr, indices, values, int(num_col))
+    return lgb.Dataset(b, reference=ref, params=p, free_raw_data=False)
+
+
+def dataset_from_csc(cpmv, cpcode, idxmv, dmv, dcode, ncol_ptr, nelem,
+                     num_row, params, ref):
+    p = _params(params)
+    col_ptr = _as_np(cpmv, cpcode, ncol_ptr).astype(np.int64)
+    indices = _as_np(idxmv, 2, nelem)
+    values = _as_np(dmv, dcode, nelem).astype(np.float64)
+    b = _stream_builder(p, num_features=len(col_ptr) - 1)
+    b.push_csc(col_ptr, indices, values, int(num_row))
+    return lgb.Dataset(b, reference=ref, params=p, free_raw_data=False)
+
+
+def dataset_by_reference(ref, num_total_row):
+    ref.construct()
+    p = dict(ref.params)
+    b = _stream_builder(p, reference=ref, num_total_rows=int(num_total_row))
+    return lgb.Dataset(b, reference=ref, params=p, free_raw_data=False)
+
+
+def dataset_push_rows(ds, mv, dcode, nrow, ncol, start_row):
+    a = _as_np(mv, dcode, nrow * ncol).astype(np.float64)
+    ds.push_rows(a.reshape(nrow, ncol), start_row=int(start_row))
+
+
+def dataset_push_rows_csr(ds, ipmv, ipcode, idxmv, dmv, dcode, nindptr,
+                          nelem, num_col, start_row):
+    indptr = _as_np(ipmv, ipcode, nindptr).astype(np.int64)
+    indices = _as_np(idxmv, 2, nelem)
+    values = _as_np(dmv, dcode, nelem).astype(np.float64)
+    ds.push_rows_csr(indptr, indices, values, int(num_col),
+                     start_row=int(start_row))
+
+
+def dataset_get_subset(ds, idxmv, n, params):
+    idx = np.frombuffer(idxmv, dtype=np.int32, count=n).astype(np.int64)
+    ds.construct()
+    return lgb.Dataset._from_binned(ds.binned.subset(idx),
+                                    params=_params(params) or dict(ds.params))
+
+
+def dataset_save_binary(ds, fname):
+    ds.construct()
+    ds.save_binary(fname)
+
+
+def dataset_set_feature_names(ds, names):
+    ds.set_feature_name([str(s) for s in names])
+
+
+def dataset_feature_names(ds):
+    ds.construct()
+    return [str(s) for s in ds.binned.feature_names]
+
+
 def dataset_num_data(ds):
     ds.construct()
     return int(ds.num_data())
@@ -433,6 +512,268 @@ int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
   TrainDataset* d = new TrainDataset;
   d->ds = r;
   *out = d;
+  return 0;
+}
+
+namespace {
+
+// read-only memoryview over a C buffer; nullptr on failure
+PyObject* MemView(const void* p, Py_ssize_t bytes) {
+  return PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(p)), bytes, PyBUF_READ);
+}
+
+Py_ssize_t DTypeSize(int code) {
+  return (code == C_API_DTYPE_FLOAT64 || code == C_API_DTYPE_INT64) ? 8 : 4;
+}
+
+bool CheckIntCode(int code, const char* what) {
+  if (code != C_API_DTYPE_INT32 && code != C_API_DTYPE_INT64) {
+    SetLastError(std::string(what) + " must be C_API_DTYPE_INT32/INT64");
+    return false;
+  }
+  return true;
+}
+
+bool CheckFloatCode(int code, const char* what) {
+  if (code != C_API_DTYPE_FLOAT32 && code != C_API_DTYPE_FLOAT64) {
+    SetLastError(std::string(what) + " must be float32/float64");
+    return false;
+  }
+  return true;
+}
+
+// shared CSR marshalling for CreateFromCSR / PushRowsByCSR: builds the
+// three memoryviews or records an error and returns false
+bool CsrViews(const void* indptr, int indptr_type, const int32_t* indices,
+              const void* data, int data_type, int64_t nindptr,
+              int64_t nelem, PyObject** ipmv, PyObject** idxmv,
+              PyObject** dmv, const char* what) {
+  if (!CheckIntCode(indptr_type, "indptr_type") ||
+      !CheckFloatCode(data_type, "data_type"))
+    return false;
+  *ipmv = MemView(indptr, nindptr * DTypeSize(indptr_type));
+  *idxmv = MemView(indices, nelem * 4);
+  *dmv = MemView(data, nelem * DTypeSize(data_type));
+  if (*ipmv == nullptr || *idxmv == nullptr || *dmv == nullptr) {
+    Py_XDECREF(*ipmv);
+    Py_XDECREF(*idxmv);
+    Py_XDECREF(*dmv);
+    SetLastError(std::string(what) + ": cannot wrap input buffers");
+    PyErr_Clear();
+    return false;
+  }
+  return true;
+}
+
+int WrapNewDataset(PyObject* r, DatasetHandle* out) {
+  if (r == nullptr) return -1;
+  TrainDataset* d = new TrainDataset;
+  d->ds = r;
+  *out = d;
+  return 0;
+}
+
+}  // namespace
+
+int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr, int64_t nelem,
+                              int64_t num_col, const char* parameters,
+                              DatasetHandle reference, DatasetHandle* out) {
+  PyScope py;
+  if (!py.ok) return -1;
+  PyObject *ipmv, *idxmv, *dmv;
+  if (!CsrViews(indptr, indptr_type, indices, data, data_type, nindptr,
+                nelem, &ipmv, &idxmv, &dmv, "LGBM_DatasetCreateFromCSR"))
+    return -1;
+  TrainDataset* ref = AsDataset(reference);
+  PyObject* r = CallHelper(
+      "dataset_from_csr",
+      Py_BuildValue("(NiNNiLLLsO)", ipmv, indptr_type, idxmv, dmv, data_type,
+                    static_cast<long long>(nindptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_col),
+                    parameters ? parameters : "",
+                    ref ? ref->ds : Py_None));
+  return WrapNewDataset(r, out);
+}
+
+int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, const char* parameters,
+                              DatasetHandle reference, DatasetHandle* out) {
+  PyScope py;
+  if (!py.ok) return -1;
+  PyObject *cpmv, *idxmv, *dmv;
+  if (!CsrViews(col_ptr, col_ptr_type, indices, data, data_type, ncol_ptr,
+                nelem, &cpmv, &idxmv, &dmv, "LGBM_DatasetCreateFromCSC"))
+    return -1;
+  TrainDataset* ref = AsDataset(reference);
+  PyObject* r = CallHelper(
+      "dataset_from_csc",
+      Py_BuildValue("(NiNNiLLLsO)", cpmv, col_ptr_type, idxmv, dmv, data_type,
+                    static_cast<long long>(ncol_ptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_row),
+                    parameters ? parameters : "",
+                    ref ? ref->ds : Py_None));
+  return WrapNewDataset(r, out);
+}
+
+int LGBM_DatasetCreateByReference(DatasetHandle reference,
+                                  int64_t num_total_row,
+                                  DatasetHandle* out) {
+  PyScope py;
+  if (!py.ok) return -1;
+  TrainDataset* ref = AsDataset(reference);
+  if (ref == nullptr) {
+    SetLastError("LGBM_DatasetCreateByReference needs a dataset handle "
+                 "as reference");
+    return -1;
+  }
+  PyObject* r = CallHelper(
+      "dataset_by_reference",
+      Py_BuildValue("(OL)", ref->ds, static_cast<long long>(num_total_row)));
+  return WrapNewDataset(r, out);
+}
+
+int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                         int data_type, int32_t nrow, int32_t ncol,
+                         int32_t start_row) {
+  PyScope py;
+  if (!py.ok) return -1;
+  TrainDataset* d = AsDataset(dataset);
+  if (d == nullptr) {
+    SetLastError("not a dataset handle");
+    return -1;
+  }
+  if (!CheckFloatCode(data_type, "data_type")) return -1;
+  PyObject* mv = MemView(data, static_cast<Py_ssize_t>(nrow) * ncol *
+                                   DTypeSize(data_type));
+  if (mv == nullptr) return FailPy("LGBM_DatasetPushRows");
+  PyObject* r = CallHelper(
+      "dataset_push_rows",
+      Py_BuildValue("(ONiiii)", d->ds, mv, data_type, nrow, ncol, start_row));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetPushRowsByCSR(DatasetHandle dataset, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int64_t start_row) {
+  PyScope py;
+  if (!py.ok) return -1;
+  TrainDataset* d = AsDataset(dataset);
+  if (d == nullptr) {
+    SetLastError("not a dataset handle");
+    return -1;
+  }
+  PyObject *ipmv, *idxmv, *dmv;
+  if (!CsrViews(indptr, indptr_type, indices, data, data_type, nindptr,
+                nelem, &ipmv, &idxmv, &dmv, "LGBM_DatasetPushRowsByCSR"))
+    return -1;
+  PyObject* r = CallHelper(
+      "dataset_push_rows_csr",
+      Py_BuildValue("(ONiNNiLLLL)", d->ds, ipmv, indptr_type, idxmv, dmv,
+                    data_type, static_cast<long long>(nindptr),
+                    static_cast<long long>(nelem),
+                    static_cast<long long>(num_col),
+                    static_cast<long long>(start_row)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetSubset(DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters, DatasetHandle* out) {
+  PyScope py;
+  if (!py.ok) return -1;
+  TrainDataset* d = AsDataset(handle);
+  if (d == nullptr) {
+    SetLastError("not a dataset handle");
+    return -1;
+  }
+  PyObject* mv = MemView(used_row_indices,
+                         static_cast<Py_ssize_t>(num_used_row_indices) * 4);
+  if (mv == nullptr) return FailPy("LGBM_DatasetGetSubset");
+  PyObject* r = CallHelper(
+      "dataset_get_subset",
+      Py_BuildValue("(ONis)", d->ds, mv, num_used_row_indices,
+                    parameters ? parameters : ""));
+  return WrapNewDataset(r, out);
+}
+
+int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename) {
+  PyScope py;
+  if (!py.ok) return -1;
+  TrainDataset* d = AsDataset(handle);
+  if (d == nullptr) {
+    SetLastError("not a dataset handle");
+    return -1;
+  }
+  PyObject* r = CallHelper("dataset_save_binary",
+                           Py_BuildValue("(Os)", d->ds, filename));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                const char** feature_names,
+                                int num_feature_names) {
+  PyScope py;
+  if (!py.ok) return -1;
+  TrainDataset* d = AsDataset(handle);
+  if (d == nullptr) {
+    SetLastError("not a dataset handle");
+    return -1;
+  }
+  PyObject* names = PyList_New(0);
+  for (int i = 0; i < num_feature_names; ++i) {
+    PyObject* s = PyUnicode_DecodeFSDefault(
+        feature_names[i] != nullptr ? feature_names[i] : "");
+    if (s == nullptr) {
+      Py_DECREF(names);
+      return FailPy("LGBM_DatasetSetFeatureNames");
+    }
+    PyList_Append(names, s);
+    Py_DECREF(s);
+  }
+  PyObject* r = CallHelper("dataset_set_feature_names",
+                           Py_BuildValue("(ON)", d->ds, names));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle, char** feature_names,
+                                int* num_feature_names) {
+  PyScope py;
+  if (!py.ok) return -1;
+  TrainDataset* d = AsDataset(handle);
+  if (d == nullptr) {
+    SetLastError("not a dataset handle");
+    return -1;
+  }
+  PyObject* r = CallHelper("dataset_feature_names",
+                           Py_BuildValue("(O)", d->ds));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  *num_feature_names = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* name = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    // 128-byte caller buffers (the GetEvalNames contract)
+    std::strncpy(feature_names[i], name != nullptr ? name : "", 127);
+    feature_names[i][127] = '\0';
+  }
+  Py_DECREF(r);
   return 0;
 }
 

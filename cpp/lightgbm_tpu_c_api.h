@@ -177,6 +177,72 @@ int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
                               const char* parameters, DatasetHandle reference,
                               DatasetHandle* out);
 
+/* ---- zero-copy streaming ingest (reference c_api.h:48-232 dataset-
+ * from-memory block; lightgbm_tpu/io/stream.py is the engine).  CSR/CSC
+ * creation takes the standard compressed-sparse triplets; absent entries
+ * are 0.0 (so zero_as_missing applies to them exactly like a parsed
+ * file's explicit zeros).  indptr/col_ptr use C_API_DTYPE_INT32/INT64;
+ * data uses FLOAT32/FLOAT64.  `reference` aligns the new dataset to an
+ * existing dataset's bin mappers (validation semantics). */
+
+int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr, int64_t nelem,
+                              int64_t num_col, const char* parameters,
+                              DatasetHandle reference, DatasetHandle* out);
+
+int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, const char* parameters,
+                              DatasetHandle reference, DatasetHandle* out);
+
+/* Streaming creation: declare the total row count up front against a
+ * constructed reference dataset, then push row chunks (dense or CSR) at
+ * arbitrary start_row offsets.  The reference's bin mappers are FIXED at
+ * creation and every pushed chunk is binned immediately into packed
+ * integer storage and dropped — memory is bounded by the uint8/uint16
+ * bin matrix, not the raw float stream.  The dataset finalizes lazily
+ * when first used (BoosterCreate etc.); an incomplete stream fails then
+ * with the missing row range named. */
+int LGBM_DatasetCreateByReference(DatasetHandle reference,
+                                  int64_t num_total_row, DatasetHandle* out);
+
+int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                         int data_type, int32_t nrow, int32_t ncol,
+                         int32_t start_row);
+
+int LGBM_DatasetPushRowsByCSR(DatasetHandle dataset, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int64_t start_row);
+
+/* Row subset sharing the parent's bin mappers/bundles (reference
+ * LGBM_DatasetGetSubset): used_row_indices must be sorted ascending and
+ * unique.  Works on any dataset handle, including ones whose raw chunks
+ * were dropped by the streaming path (the gather runs on binned
+ * storage). */
+int LGBM_DatasetGetSubset(DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters, DatasetHandle* out);
+
+/* Persist the constructed dataset to the binary cache format
+ * (version-stamped; LGBM_DatasetCreateFromFile loads it back directly,
+ * skipping parse + find-bin + bundling). */
+int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename);
+
+/* Feature names (reference Set/GetFeatureNames).  Get follows the
+ * GetEvalNames contract: out_strs must hold num_feature pointers to
+ * buffers of at least 128 bytes each. */
+int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                const char** feature_names,
+                                int num_feature_names);
+
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle, char** feature_names,
+                                int* num_feature_names);
+
 /* field_name: label / weight / init_score / group (reference SetField). */
 int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
                          const void* field_data, int num_element, int type);

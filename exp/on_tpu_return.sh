@@ -104,3 +104,12 @@ PYEOF3
 echo "=== 7b. chaos-serve soak (device path under fault churn) ==="
 timeout 400 python exp/chaos_serve.py 8 /tmp/chaos_serve_tpu.json \
   || echo "chaos-serve soak FAILED on hardware — inspect /tmp/chaos_serve_tpu.json"
+echo "=== 8. streaming-ingest bench (ISSUE 8) ==="
+echo "    (file parse vs zero-copy dense/CSR push vs binary-cache hit;"
+echo "     bins asserted identical across every path — rides the full"
+echo "     bench too, this is the standalone full-scale reading)"
+BENCH_INGEST_ROWS=1000000 timeout 500 python - <<'PYEOF5' 2>&1 | tail -14
+import json
+import bench
+print(json.dumps(bench.bench_ingest(), indent=1))
+PYEOF5

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""C-ABI drift lint (ISSUE 8 satellite).
+
+Pins three invariants so the C-API surface cannot silently rot:
+
+1. every ``LGBM_*`` entry point declared in ``cpp/lightgbm_tpu_c_api.h``
+   appears in ``lightgbm_tpu/capi.py`` (a ctypes wrapper or an explicit
+   mention — an exported symbol with no Python-side binding is drift);
+2. every declared entry point that exists in the reference C API is
+   accounted for in the canonical ``REFERENCE_C_API`` list below (a new
+   export must be classified: reference-parity or an extension);
+3. the parity fraction in ``PARITY.md`` equals the derived count
+   ``|header ∩ REFERENCE_C_API| / |REFERENCE_C_API|`` — the number in the
+   docs is computed, never hand-waved.
+
+Run standalone (``python helper/check_abi.py``; exit code 1 on drift) or
+through the tier-1 pin in ``tests/test_check_abi.py``.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HEADER = os.path.join(REPO, "cpp", "lightgbm_tpu_c_api.h")
+CAPI = os.path.join(REPO, "lightgbm_tpu", "capi.py")
+PARITY = os.path.join(REPO, "PARITY.md")
+
+#: The reference's L9 entry-point list (c_api.h:48-808, SURVEY §L9: the
+#: ABI every binding rides).  This is the denominator of the PARITY
+#: fraction; names our header exports beyond it (e.g. the single-row
+#: fast-path trio from newer reference versions) are extensions and do
+#: not count toward it.
+REFERENCE_C_API = (
+    "LGBM_GetLastError",
+    # dataset block
+    "LGBM_DatasetCreateFromFile",
+    "LGBM_DatasetCreateFromSampledColumn",
+    "LGBM_DatasetCreateByReference",
+    "LGBM_DatasetPushRows",
+    "LGBM_DatasetPushRowsByCSR",
+    "LGBM_DatasetCreateFromCSR",
+    "LGBM_DatasetCreateFromCSC",
+    "LGBM_DatasetCreateFromMat",
+    "LGBM_DatasetCreateFromMats",
+    "LGBM_DatasetGetSubset",
+    "LGBM_DatasetSetFeatureNames",
+    "LGBM_DatasetGetFeatureNames",
+    "LGBM_DatasetFree",
+    "LGBM_DatasetSaveBinary",
+    "LGBM_DatasetDumpText",
+    "LGBM_DatasetSetField",
+    "LGBM_DatasetGetField",
+    "LGBM_DatasetGetNumData",
+    "LGBM_DatasetGetNumFeature",
+    # booster block
+    "LGBM_BoosterCreate",
+    "LGBM_BoosterCreateFromModelfile",
+    "LGBM_BoosterLoadModelFromString",
+    "LGBM_BoosterFree",
+    "LGBM_BoosterMerge",
+    "LGBM_BoosterAddValidData",
+    "LGBM_BoosterResetTrainingData",
+    "LGBM_BoosterResetParameter",
+    "LGBM_BoosterGetNumClasses",
+    "LGBM_BoosterUpdateOneIter",
+    "LGBM_BoosterRefit",
+    "LGBM_BoosterUpdateOneIterCustom",
+    "LGBM_BoosterRollbackOneIter",
+    "LGBM_BoosterGetCurrentIteration",
+    "LGBM_BoosterNumModelPerIteration",
+    "LGBM_BoosterNumberOfTotalModel",
+    "LGBM_BoosterGetEvalCounts",
+    "LGBM_BoosterGetEvalNames",
+    "LGBM_BoosterGetFeatureNames",
+    "LGBM_BoosterGetNumFeature",
+    "LGBM_BoosterGetEval",
+    "LGBM_BoosterGetNumPredict",
+    "LGBM_BoosterGetPredict",
+    "LGBM_BoosterPredictForFile",
+    "LGBM_BoosterCalcNumPredict",
+    "LGBM_BoosterPredictForCSR",
+    "LGBM_BoosterPredictForCSRSingleRow",
+    "LGBM_BoosterPredictForCSC",
+    "LGBM_BoosterPredictForMat",
+    "LGBM_BoosterPredictForMatSingleRow",
+    "LGBM_BoosterSaveModel",
+    "LGBM_BoosterSaveModelToString",
+    "LGBM_BoosterDumpModel",
+    "LGBM_BoosterGetLeafValue",
+    "LGBM_BoosterSetLeafValue",
+    "LGBM_BoosterFeatureImportance",
+    # network block
+    "LGBM_NetworkInit",
+    "LGBM_NetworkFree",
+)
+
+#: declaration matcher: return type at line start, then the symbol.
+#: Mentions of LGBM_* inside comments/docstrings never match.
+_DECL_RE = re.compile(r"^\s*(?:int|const\s+char\s*\*)\s+(LGBM_\w+)\s*\(",
+                      re.MULTILINE)
+
+
+def header_entry_points(header_path: str = HEADER) -> List[str]:
+    with open(header_path) as fh:
+        return sorted(set(_DECL_RE.findall(fh.read())))
+
+
+def implemented_reference_points(header_path: str = HEADER) -> List[str]:
+    ref = set(REFERENCE_C_API)
+    return [s for s in header_entry_points(header_path) if s in ref]
+
+
+def run(header_path: str = HEADER, capi_path: str = CAPI,
+        parity_path: str = PARITY) -> List[str]:
+    """Returns the list of drift problems (empty = clean)."""
+    problems: List[str] = []
+    exported = header_entry_points(header_path)
+    if not exported:
+        return ["no LGBM_* declarations found in %s" % header_path]
+    with open(capi_path) as fh:
+        capi_text = fh.read()
+    for sym in exported:
+        if not re.search(r"\b%s\b" % re.escape(sym), capi_text):
+            problems.append(
+                "%s is exported by the C header but has no wrapper or "
+                "mention in capi.py" % sym)
+    implemented = implemented_reference_points(header_path)
+    claim = "%d/%d" % (len(implemented), len(REFERENCE_C_API))
+    with open(parity_path) as fh:
+        parity_text = fh.read()
+    if claim not in parity_text:
+        got = sorted(set(re.findall(r"\b(\d+/%d)\b" % len(REFERENCE_C_API),
+                                    parity_text)))
+        problems.append(
+            "PARITY.md must state the derived C-API parity %r (header "
+            "implements %d of the %d reference entry points); found %s"
+            % (claim, len(implemented), len(REFERENCE_C_API),
+               got or "no count"))
+    return problems
+
+
+def main(argv=None) -> int:
+    problems = run()
+    implemented = implemented_reference_points()
+    print("check_abi: %d LGBM_* exports, %d/%d reference entry points"
+          % (len(header_entry_points()), len(implemented),
+             len(REFERENCE_C_API)))
+    for p in problems:
+        print("DRIFT: %s" % p)
+    if not problems:
+        print("check_abi: OK")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

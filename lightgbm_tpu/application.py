@@ -156,11 +156,26 @@ class Application:
         from .io.dataset import BinnedDataset
         resolved = {Config.resolve_alias(k): v for k, v in params.items()}
         with wd.stage_scope("ingest train data (%s)" % data_path):
+            import time as _time
+            t_ingest = _time.perf_counter()
             if BinnedDataset.is_binary_file(data_path):
+                # version-stamped cache: a stale format_version refuses
+                # here with a clear delete-and-rebuild error
                 train_set = Dataset(data_path, params=params)
                 train_set.construct(Config(params))
+                dt = _time.perf_counter() - t_ingest
+                wd.annotate("ingest", {
+                    "mode": "binary_cache",
+                    "rows": int(train_set.num_data()),
+                    "rows_per_sec": round(train_set.num_data() / dt, 1)
+                    if dt > 0 else None})
             else:
                 X, y, weight, query = self._load(data_path)
+                dt = _time.perf_counter() - t_ingest
+                wd.annotate("ingest", {
+                    "mode": "file_parse", "rows": int(X.shape[0]),
+                    "rows_per_sec": round(X.shape[0] / dt, 1)
+                    if dt > 0 else None})
                 group = None
                 if query is not None:
                     group = query.astype(np.int64)

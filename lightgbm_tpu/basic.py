@@ -161,6 +161,15 @@ class Dataset:
             return self
         if config is None:
             config = Config(self.params)
+        from .io.stream import StreamingDatasetBuilder
+        if isinstance(self.data, StreamingDatasetBuilder) or \
+                (hasattr(self.data, "__next__")
+                 and not isinstance(self.data, np.ndarray)):
+            # streaming ingest (ISSUE 8): a chunk iterator or an explicit
+            # StreamingDatasetBuilder — chunks were (or are now) pushed
+            # without a file detour, and finalize() produces the same
+            # binned dataset the parser path would
+            return self._construct_stream(config)
         if isinstance(self.data, str):
             # a path: binary dataset cache (save_binary) or a text data file.
             # A validation set given as a path still aligns to the training
@@ -226,6 +235,77 @@ class Dataset:
         md.set_init_score(self.init_score)
         md.set_query(self.group)
         return self
+
+    def _construct_stream(self, config: Config) -> "Dataset":
+        """Construct from a StreamingDatasetBuilder or a chunk iterator
+        (chunks: X, (X, y) or (X, y, w); see io/stream.py)."""
+        from .io.stream import StreamingDatasetBuilder
+        builder = self.data
+        if not isinstance(builder, StreamingDatasetBuilder):
+            it = builder
+            builder = StreamingDatasetBuilder(params=self.params)
+            for chunk in it:
+                builder.push(chunk)
+            self.data = builder
+        ref_mappers = ref_bundle = None
+        if self.reference is not None:
+            self.reference.construct(config)
+            ref_mappers = self.reference._binned.bin_mappers
+            ref_bundle = self.reference._binned.bundle_info
+        fn = None if self.feature_name == "auto" else list(self.feature_name)
+        cats: Sequence[int] = ()
+        if self.categorical_feature != "auto" and self.categorical_feature:
+            cats = [int(c) for c in self.categorical_feature]
+        self._binned = builder.finalize(
+            config, bin_mappers=ref_mappers, reference_bundle=ref_bundle,
+            feature_names=fn, categorical_feature=cats)
+        if self.label is None:
+            self.label = builder.labels()
+        if self.weight is None:
+            self.weight = builder.weights()
+        md = self._binned.metadata
+        if self.label is not None:
+            md.set_label(np.asarray(self.label))
+        md.set_weight(self.weight)
+        md.set_init_score(self.init_score)
+        md.set_query(self.group)
+        return self
+
+    def push_rows(self, data, start_row: int = -1) -> "Dataset":
+        """Streaming row push (LGBM_DatasetPushRows): only valid on a
+        Dataset whose data is a StreamingDatasetBuilder (created with one,
+        or through LGBM_DatasetCreateByReference) and not yet
+        constructed."""
+        self._stream_builder().push_dense(np.asarray(data),
+                                          start_row=start_row)
+        return self
+
+    def push_rows_csr(self, indptr, indices, values, num_col: int,
+                      start_row: int = -1) -> "Dataset":
+        """Streaming CSR push (LGBM_DatasetPushRowsByCSR)."""
+        self._stream_builder().push_csr(indptr, indices, values, num_col,
+                                        start_row=start_row)
+        return self
+
+    def _stream_builder(self):
+        from .io.stream import StreamingDatasetBuilder
+        if self._binned is not None:
+            raise LightGBMError(
+                "Cannot push rows after the dataset is constructed")
+        if not isinstance(self.data, StreamingDatasetBuilder):
+            raise LightGBMError(
+                "push_rows needs a streaming Dataset: create it from a "
+                "StreamingDatasetBuilder (or LGBM_DatasetCreateByReference)")
+        return self.data
+
+    @classmethod
+    def _from_binned(cls, binned: BinnedDataset,
+                     params: Optional[Dict] = None) -> "Dataset":
+        """Wrap an already-binned dataset (GetSubset results, C-ABI
+        plumbing) in the user-facing handle."""
+        ds = cls(None, params=params)
+        ds._binned = binned
+        return ds
 
     @property
     def binned(self) -> BinnedDataset:
@@ -355,6 +435,16 @@ class Dataset:
 
     def subset(self, used_indices, params=None) -> "Dataset":
         idx = np.asarray(used_indices)
+        from .io.stream import StreamingDatasetBuilder
+        if self.data is None or isinstance(self.data, (str,
+                                                       StreamingDatasetBuilder)) \
+                or hasattr(self.data, "__next__"):
+            # no raw matrix to re-bin (path-backed or streaming ingest):
+            # gather the BINNED rows directly (reference GetSubset)
+            self.construct()
+            return Dataset._from_binned(
+                self.binned.subset(np.sort(np.unique(idx))),
+                params=params or self.params)
         X = _slice_rows(self.data, idx)
         y = None if self.label is None else np.asarray(self.label)[idx]
         w = None if self.weight is None else np.asarray(self.weight)[idx]

@@ -23,6 +23,8 @@ _TRAIN_LIB_PATH = os.path.join(_CPP_DIR, "lib_lightgbm_tpu_train.so")
 
 C_API_DTYPE_FLOAT32 = 0
 C_API_DTYPE_FLOAT64 = 1
+C_API_DTYPE_INT32 = 2
+C_API_DTYPE_INT64 = 3
 C_API_PREDICT_NORMAL = 0
 C_API_PREDICT_RAW_SCORE = 1
 C_API_PREDICT_LEAF_INDEX = 2
@@ -100,6 +102,295 @@ def booster_refit(handle, X: np.ndarray, y: np.ndarray) -> None:
         ctypes.c_int32(nrow), ctypes.c_int32(ncol)))
 
 
+def network_init(machines: str, local_listen_port: int = 12400,
+                 listen_time_out: int = 120, num_machines: int = 1) -> None:
+    """LGBM_NetworkInit: reference machine-list bootstrap (maps onto
+    jax.distributed — docs/DISTRIBUTED.md)."""
+    _check_train(load_train_lib().LGBM_NetworkInit(
+        machines.encode(), ctypes.c_int(local_listen_port),
+        ctypes.c_int(listen_time_out), ctypes.c_int(num_machines)))
+
+
+def network_free() -> None:
+    """LGBM_NetworkFree (idempotent, reference Network::Dispose)."""
+    _check_train(load_train_lib().LGBM_NetworkFree())
+
+
+def _dtype_code(arr: np.ndarray) -> int:
+    code = {np.dtype(np.float32): C_API_DTYPE_FLOAT32,
+            np.dtype(np.float64): C_API_DTYPE_FLOAT64,
+            np.dtype(np.int32): C_API_DTYPE_INT32,
+            np.dtype(np.int64): C_API_DTYPE_INT64}.get(arr.dtype)
+    if code is None:
+        raise LightGBMError("unsupported dtype %s" % arr.dtype)
+    return code
+
+
+class TrainDataset:
+    """ctypes handle over the training-side LGBM_Dataset* surface,
+    including the zero-copy streaming ingest block (ISSUE 8):
+    CreateFromMat/CSR/CSC/File, CreateByReference + PushRows[ByCSR],
+    GetSubset, SaveBinary and the feature-name accessors."""
+
+    def __init__(self, handle: ctypes.c_void_p):
+        self._handle = handle
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            load_train_lib().LGBM_DatasetFree(self._handle)
+            self._handle = None
+
+    # -- constructors --------------------------------------------------------
+    @staticmethod
+    def _ref_handle(reference: Optional["TrainDataset"]):
+        return reference._handle if reference is not None else None
+
+    @classmethod
+    def from_mat(cls, X: np.ndarray, params: str = "",
+                 reference: Optional["TrainDataset"] = None) -> "TrainDataset":
+        X = np.ascontiguousarray(X)
+        if X.dtype not in (np.float32, np.float64):
+            X = np.ascontiguousarray(X, dtype=np.float64)
+        h = ctypes.c_void_p()
+        _check_train(load_train_lib().LGBM_DatasetCreateFromMat(
+            X.ctypes.data_as(ctypes.c_void_p), _dtype_code(X),
+            ctypes.c_int32(X.shape[0]), ctypes.c_int32(X.shape[1]), 1,
+            params.encode(), cls._ref_handle(reference), ctypes.byref(h)))
+        return cls(h)
+
+    @classmethod
+    def from_csr(cls, indptr, indices, values, num_col: int,
+                 params: str = "",
+                 reference: Optional["TrainDataset"] = None) -> "TrainDataset":
+        indptr = np.ascontiguousarray(indptr)
+        if indptr.dtype not in (np.int32, np.int64):
+            indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+        indices = np.ascontiguousarray(indices, dtype=np.int32)
+        values = np.ascontiguousarray(values)
+        if values.dtype not in (np.float32, np.float64):
+            values = np.ascontiguousarray(values, dtype=np.float64)
+        h = ctypes.c_void_p()
+        _check_train(load_train_lib().LGBM_DatasetCreateFromCSR(
+            indptr.ctypes.data_as(ctypes.c_void_p), _dtype_code(indptr),
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            values.ctypes.data_as(ctypes.c_void_p), _dtype_code(values),
+            ctypes.c_int64(len(indptr)), ctypes.c_int64(len(values)),
+            ctypes.c_int64(num_col), params.encode(),
+            cls._ref_handle(reference), ctypes.byref(h)))
+        return cls(h)
+
+    @classmethod
+    def from_csc(cls, col_ptr, indices, values, num_row: int,
+                 params: str = "",
+                 reference: Optional["TrainDataset"] = None) -> "TrainDataset":
+        col_ptr = np.ascontiguousarray(col_ptr)
+        if col_ptr.dtype not in (np.int32, np.int64):
+            col_ptr = np.ascontiguousarray(col_ptr, dtype=np.int64)
+        indices = np.ascontiguousarray(indices, dtype=np.int32)
+        values = np.ascontiguousarray(values)
+        if values.dtype not in (np.float32, np.float64):
+            values = np.ascontiguousarray(values, dtype=np.float64)
+        h = ctypes.c_void_p()
+        _check_train(load_train_lib().LGBM_DatasetCreateFromCSC(
+            col_ptr.ctypes.data_as(ctypes.c_void_p), _dtype_code(col_ptr),
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            values.ctypes.data_as(ctypes.c_void_p), _dtype_code(values),
+            ctypes.c_int64(len(col_ptr)), ctypes.c_int64(len(values)),
+            ctypes.c_int64(num_row), params.encode(),
+            cls._ref_handle(reference), ctypes.byref(h)))
+        return cls(h)
+
+    @classmethod
+    def from_file(cls, path: str, params: str = "",
+                  reference: Optional["TrainDataset"] = None) -> "TrainDataset":
+        h = ctypes.c_void_p()
+        _check_train(load_train_lib().LGBM_DatasetCreateFromFile(
+            path.encode(), params.encode(), cls._ref_handle(reference),
+            ctypes.byref(h)))
+        return cls(h)
+
+    @classmethod
+    def by_reference(cls, reference: "TrainDataset",
+                     num_total_rows: int) -> "TrainDataset":
+        h = ctypes.c_void_p()
+        _check_train(load_train_lib().LGBM_DatasetCreateByReference(
+            reference._handle, ctypes.c_int64(num_total_rows),
+            ctypes.byref(h)))
+        return cls(h)
+
+    # -- streaming push ------------------------------------------------------
+    def push_rows(self, X: np.ndarray, start_row: int) -> "TrainDataset":
+        X = np.ascontiguousarray(X)
+        if X.dtype not in (np.float32, np.float64):
+            X = np.ascontiguousarray(X, dtype=np.float64)
+        _check_train(load_train_lib().LGBM_DatasetPushRows(
+            self._handle, X.ctypes.data_as(ctypes.c_void_p), _dtype_code(X),
+            ctypes.c_int32(X.shape[0]), ctypes.c_int32(X.shape[1]),
+            ctypes.c_int32(start_row)))
+        return self
+
+    def push_rows_csr(self, indptr, indices, values, num_col: int,
+                      start_row: int) -> "TrainDataset":
+        indptr = np.ascontiguousarray(indptr)
+        if indptr.dtype not in (np.int32, np.int64):
+            indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+        indices = np.ascontiguousarray(indices, dtype=np.int32)
+        values = np.ascontiguousarray(values)
+        if values.dtype not in (np.float32, np.float64):
+            values = np.ascontiguousarray(values, dtype=np.float64)
+        _check_train(load_train_lib().LGBM_DatasetPushRowsByCSR(
+            self._handle, indptr.ctypes.data_as(ctypes.c_void_p),
+            _dtype_code(indptr),
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            values.ctypes.data_as(ctypes.c_void_p), _dtype_code(values),
+            ctypes.c_int64(len(indptr)), ctypes.c_int64(len(values)),
+            ctypes.c_int64(num_col), ctypes.c_int64(start_row)))
+        return self
+
+    # -- surface -------------------------------------------------------------
+    def set_field(self, name: str, data) -> "TrainDataset":
+        arr = np.ascontiguousarray(data)
+        if arr.dtype not in (np.float32, np.float64, np.int32, np.int64):
+            arr = np.ascontiguousarray(arr, dtype=np.float32)
+        _check_train(load_train_lib().LGBM_DatasetSetField(
+            self._handle, name.encode(),
+            arr.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(arr.size),
+            _dtype_code(arr)))
+        return self
+
+    @property
+    def num_data(self) -> int:
+        out = ctypes.c_int32(0)
+        _check_train(load_train_lib().LGBM_DatasetGetNumData(
+            self._handle, ctypes.byref(out)))
+        return out.value
+
+    @property
+    def num_feature(self) -> int:
+        out = ctypes.c_int32(0)
+        _check_train(load_train_lib().LGBM_DatasetGetNumFeature(
+            self._handle, ctypes.byref(out)))
+        return out.value
+
+    def get_subset(self, used_indices, params: str = "") -> "TrainDataset":
+        idx = np.ascontiguousarray(used_indices, dtype=np.int32)
+        h = ctypes.c_void_p()
+        _check_train(load_train_lib().LGBM_DatasetGetSubset(
+            self._handle, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int32(len(idx)), params.encode(), ctypes.byref(h)))
+        return TrainDataset(h)
+
+    def save_binary(self, path: str) -> "TrainDataset":
+        _check_train(load_train_lib().LGBM_DatasetSaveBinary(
+            self._handle, path.encode()))
+        return self
+
+    def set_feature_names(self, names) -> "TrainDataset":
+        arr = (ctypes.c_char_p * len(names))(
+            *[str(n).encode() for n in names])
+        _check_train(load_train_lib().LGBM_DatasetSetFeatureNames(
+            self._handle, arr, ctypes.c_int(len(names))))
+        return self
+
+    def get_feature_names(self) -> list:
+        n = self.num_feature
+        bufs = [ctypes.create_string_buffer(128) for _ in range(n)]
+        arr = (ctypes.c_char_p * n)(
+            *[ctypes.cast(b, ctypes.c_char_p) for b in bufs])
+        out_n = ctypes.c_int(0)
+        _check_train(load_train_lib().LGBM_DatasetGetFeatureNames(
+            self._handle, arr, ctypes.byref(out_n)))
+        return [bufs[i].value.decode() for i in range(out_n.value)]
+
+
+class TrainBooster:
+    """ctypes handle over the training-side Booster surface
+    (LGBM_BoosterCreate / AddValidData / UpdateOneIter[Custom] /
+    RollbackOneIter / GetEval*); model IO and predict flow through the
+    shared BoosterHandle entry points (NativeBooster's surface works on
+    training handles too)."""
+
+    def __init__(self, train_set: TrainDataset, params: str = ""):
+        self._train_set = train_set           # keep the dataset alive
+        self._handle = ctypes.c_void_p()
+        _check_train(load_train_lib().LGBM_BoosterCreate(
+            train_set._handle, params.encode(), ctypes.byref(self._handle)))
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            load_train_lib().LGBM_BoosterFree(self._handle)
+            self._handle = None
+
+    def add_valid(self, valid_set: TrainDataset) -> "TrainBooster":
+        _check_train(load_train_lib().LGBM_BoosterAddValidData(
+            self._handle, valid_set._handle))
+        return self
+
+    def update(self) -> bool:
+        fin = ctypes.c_int(0)
+        _check_train(load_train_lib().LGBM_BoosterUpdateOneIter(
+            self._handle, ctypes.byref(fin)))
+        return bool(fin.value)
+
+    def update_custom(self, grad: np.ndarray, hess: np.ndarray) -> bool:
+        g = np.ascontiguousarray(grad, dtype=np.float32)
+        h = np.ascontiguousarray(hess, dtype=np.float32)
+        fin = ctypes.c_int(0)
+        _check_train(load_train_lib().LGBM_BoosterUpdateOneIterCustom(
+            self._handle, g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            h.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.byref(fin)))
+        return bool(fin.value)
+
+    def rollback_one_iter(self) -> "TrainBooster":
+        _check_train(load_train_lib().LGBM_BoosterRollbackOneIter(
+            self._handle))
+        return self
+
+    @property
+    def current_iteration(self) -> int:
+        out = ctypes.c_int(0)
+        _check_train(load_train_lib().LGBM_BoosterGetCurrentIteration(
+            self._handle, ctypes.byref(out)))
+        return out.value
+
+    def eval_counts(self) -> int:
+        out = ctypes.c_int(0)
+        _check_train(load_train_lib().LGBM_BoosterGetEvalCounts(
+            self._handle, ctypes.byref(out)))
+        return out.value
+
+    def eval_names(self) -> list:
+        n = self.eval_counts()
+        bufs = [ctypes.create_string_buffer(128) for _ in range(n)]
+        arr = (ctypes.c_char_p * n)(
+            *[ctypes.cast(b, ctypes.c_char_p) for b in bufs])
+        out_n = ctypes.c_int(0)
+        _check_train(load_train_lib().LGBM_BoosterGetEvalNames(
+            self._handle, ctypes.byref(out_n), arr))
+        return [bufs[i].value.decode() for i in range(out_n.value)]
+
+    def get_eval(self, data_idx: int = 0) -> np.ndarray:
+        n = self.eval_counts()
+        out = np.zeros(max(n, 1), dtype=np.float64)
+        out_len = ctypes.c_int(0)
+        _check_train(load_train_lib().LGBM_BoosterGetEval(
+            self._handle, ctypes.c_int(data_idx), ctypes.byref(out_len),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        return out[: out_len.value]
+
+    def model_to_string(self, num_iteration: int = -1) -> str:
+        lib = load_train_lib()
+        out_len = ctypes.c_int64(0)
+        _check_train(lib.LGBM_BoosterSaveModelToString(
+            self._handle, num_iteration, 0, ctypes.byref(out_len), None))
+        buf = ctypes.create_string_buffer(out_len.value)
+        _check_train(lib.LGBM_BoosterSaveModelToString(
+            self._handle, num_iteration, out_len.value,
+            ctypes.byref(out_len), buf))
+        return buf.value.decode()
+
+
 class NativeBooster:
     """Minimal handle over the C API, mirroring Booster's predict surface."""
 
@@ -147,6 +438,44 @@ class NativeBooster:
         _check(load_lib().LGBM_BoosterNumModelPerIteration(
             self._handle, ctypes.byref(out)))
         return out.value
+
+    @property
+    def current_iteration(self) -> int:
+        """Completed iterations (LGBM_BoosterGetCurrentIteration)."""
+        out = ctypes.c_int(0)
+        _check(load_lib().LGBM_BoosterGetCurrentIteration(
+            self._handle, ctypes.byref(out)))
+        return out.value
+
+    def predict_csr(self, indptr, indices, values, num_col: int,
+                    raw_score: bool = False,
+                    num_iteration: int = -1) -> np.ndarray:
+        """Sparse prediction (LGBM_BoosterPredictForCSR): absent entries
+        are 0.0."""
+        indptr = np.ascontiguousarray(indptr)
+        if indptr.dtype not in (np.int32, np.int64):
+            indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+        indices = np.ascontiguousarray(indices, dtype=np.int32)
+        values = np.ascontiguousarray(values)
+        if values.dtype not in (np.float32, np.float64):
+            values = np.ascontiguousarray(values, dtype=np.float64)
+        nrow = len(indptr) - 1
+        k = self.num_class
+        ptype = C_API_PREDICT_RAW_SCORE if raw_score else C_API_PREDICT_NORMAL
+        out = np.zeros(nrow * max(k, 1), dtype=np.float64)
+        out_len = ctypes.c_int64(0)
+        _check(load_lib().LGBM_BoosterPredictForCSR(
+            self._handle, indptr.ctypes.data_as(ctypes.c_void_p),
+            _dtype_code(indptr),
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            values.ctypes.data_as(ctypes.c_void_p), _dtype_code(values),
+            ctypes.c_int64(len(indptr)), ctypes.c_int64(len(values)),
+            ctypes.c_int64(num_col), ptype, ctypes.c_int(num_iteration),
+            b"", ctypes.byref(out_len),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        out = out[: out_len.value]
+        per_row = out_len.value // max(nrow, 1)
+        return out.reshape(nrow, per_row) if per_row > 1 else out
 
     def get_leaf_value(self, tree_idx: int, leaf_idx: int) -> float:
         """One leaf's output value (LGBM_BoosterGetLeafValue — the

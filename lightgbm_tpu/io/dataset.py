@@ -176,6 +176,12 @@ class BinnedDataset:
     # -- binary dataset cache (reference save_binary / DatasetLoader::
     #    LoadFromBinFile, src/io/dataset_loader.cpp:267+) -------------------
     BINARY_MAGIC = "lightgbm_tpu.dataset.v1"
+    #: cache-format version stamp (ISSUE 8 satellite): bumped whenever the
+    #: on-disk layout or the binning semantics it froze change, so a stale
+    #: cache REFUSES to load with a clear rebuild instruction instead of
+    #: silently training on bins a newer build would not have produced.
+    #: v2 = the first stamped format (v1 files predate the stamp).
+    BINARY_FORMAT_VERSION = 2
 
     def save_binary(self, path: str) -> None:
         """Serialize the fully-constructed dataset (bins, mappers, bundles,
@@ -184,6 +190,7 @@ class BinnedDataset:
         import json as _json
         header = {
             "magic": self.BINARY_MAGIC,
+            "format_version": self.BINARY_FORMAT_VERSION,
             "num_data": self.num_data,
             "num_total_features": self.num_total_features,
             "num_data_padded": self.num_data_padded,
@@ -249,6 +256,14 @@ class BinnedDataset:
             header = _json.loads(bytes(z["header"].tobytes()).decode())
             if header.get("magic") != cls.BINARY_MAGIC:
                 Log.fatal("%s is not a lightgbm_tpu binary dataset", path)
+            version = int(header.get("format_version", 1))
+            if version != cls.BINARY_FORMAT_VERSION:
+                Log.fatal(
+                    "binary dataset cache %s has format version %d but "
+                    "this build reads version %d; the cache is stale — "
+                    "delete it and rebuild with save_binary "
+                    "(or save_binary=true)", path, version,
+                    cls.BINARY_FORMAT_VERSION)
             ds = cls()
             ds.num_data = int(header["num_data"])
             ds.num_total_features = int(header["num_total_features"])
@@ -329,6 +344,53 @@ class BinnedDataset:
         Log.info("Total bins: %d over %d features",
                  sum(m.num_bin for m in mappers), f - num_trivial)
         return mappers
+
+    # -- row subsetting (reference Dataset::CopySubrow via
+    #    LGBM_DatasetGetSubset): gather BINNED rows directly, sharing the
+    #    mappers/bundles — no raw data needed, so it also serves datasets
+    #    built from a stream whose raw chunks were dropped ------------------
+    def subset(self, used_indices) -> "BinnedDataset":
+        idx = np.asarray(used_indices, dtype=np.int64).reshape(-1)
+        if idx.size == 0:
+            Log.fatal("used_indices must not be empty")
+        if idx.min() < 0 or idx.max() >= self.num_data:
+            Log.fatal("used_indices out of range [0, %d)", self.num_data)
+        if np.any(np.diff(idx) <= 0):
+            Log.fatal("used_indices must be sorted ascending and unique "
+                      "(the reference GetSubset contract)")
+        k = int(idx.size)
+        ds = BinnedDataset()
+        ds.num_data = k
+        ds.num_total_features = self.num_total_features
+        ds.bin_mappers = list(self.bin_mappers)
+        ds.max_num_bin = self.max_num_bin
+        ds.bundle_info = self.bundle_info
+        n_pad = _round_up(k, 16384) if k > 16384 else _round_up(k, 128)
+        bins = np.zeros((self.bins.shape[0], n_pad), dtype=self.bins.dtype)
+        bins[:, :k] = self.bins[:, idx]
+        ds.bins = bins
+        ds.num_data_padded = n_pad
+        ds.feature_names = list(self.feature_names)
+        ds.monotone_constraints = self.monotone_constraints
+        ds.feature_penalty = self.feature_penalty
+        md = Metadata(k)
+        src = self.metadata
+        if src is not None:
+            if src.query_boundaries is not None:
+                Log.fatal("GetSubset of a ranking dataset (query "
+                          "boundaries set) is not supported; subset the "
+                          "raw data group-wise instead")
+            if src.label is not None:
+                md.set_label(src.label[idx])
+            if src.weight is not None:
+                md.set_weight(src.weight[idx])
+            if src.init_score is not None:
+                if len(src.init_score) != self.num_data:
+                    Log.fatal("cannot subset a multi-class init_score "
+                              "through GetSubset")
+                md.set_init_score(src.init_score[idx])
+        ds.metadata = md
+        return ds
 
     # -- accessors -----------------------------------------------------------
     @property

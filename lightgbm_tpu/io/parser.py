@@ -53,12 +53,11 @@ def _is_number(tok: str) -> bool:
         return False
 
 
-def parse_file(path: str, label_column: int = 0, has_header: Optional[bool] = None,
-               num_features: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
-    """Parse a data file -> (X [n, F], y [n]).  Auto-detects format and
-    header; missing values ('', 'na', 'nan', 'null') become NaN."""
-    # sniff format/header from the head only — materializing the whole
-    # file as Python strings would dwarf the chunked fast path's memory
+def sniff(path: str, has_header: Optional[bool] = None):
+    """Format/header sniff shared by parse_file and the incremental tail
+    parser (runtime/continuous.py) -> (fmt, sep, has_header, head_lines).
+    sep is None for libsvm.  Reads only the file head — materializing the
+    whole file as Python strings would dwarf the chunked fast path."""
     import itertools
     with open(path) as fh:
         head = [l for l in itertools.islice(fh, 200) if l.strip()][:20]
@@ -70,9 +69,16 @@ def parse_file(path: str, label_column: int = 0, has_header: Optional[bool] = No
         # a header needs a token that is neither numeric nor a missing marker
         has_header = bool(toks) and not all(
             _is_number(t.split(":")[0]) or t.strip().lower() in _MISSING
-            for t in toks if True)
+            for t in toks)
+    return fmt, {"csv": ",", "tsv": "\t"}.get(fmt), bool(has_header), head
+
+
+def parse_file(path: str, label_column: int = 0, has_header: Optional[bool] = None,
+               num_features: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse a data file -> (X [n, F], y [n]).  Auto-detects format and
+    header; missing values ('', 'na', 'nan', 'null') become NaN."""
+    fmt, sep, has_header, head = sniff(path, has_header)
     if fmt != "libsvm":
-        sep = "," if fmt == "csv" else "\t"
         # native mmap + OpenMP parser first (cpp/ingest.cc — the role of
         # the reference's native Parser), then the chunked pandas C-engine
         # pipeline, then the tolerant pure-Python parser

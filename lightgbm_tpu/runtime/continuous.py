@@ -90,11 +90,22 @@ class OnlineParams:
 
 
 class _IngestProducer(threading.Thread):
-    """Background ingest: re-parses `path` through io/parser.py whenever
-    its (mtime, size) stamp changes, staging the newest `window_rows`
-    rows.  The training loop never blocks on parsing an unchanged file —
-    it picks up whatever window is staged (the parse of a GROWING file
-    overlaps the previous cycle's training)."""
+    """Background ingest: incremental tail-append parser + rolling window.
+
+    The first pass parses `path` fully through io/parser.py and records
+    the sniffed format (separator, header, feature count), the consumed
+    byte offset and a signature of the bytes just before it.  When the
+    file GROWS and that signature still matches, only the appended tail
+    is read and parsed — rows outside the new tail are never re-read,
+    re-parsed or re-binned (ISSUE 8).  Any other change (rewrite,
+    truncation, signature mismatch, no trailing newline) falls back to a
+    full re-parse.  The newest `online_window_rows` rows stay staged; the
+    training loop never blocks on an unchanged file (the parse of a
+    growing file overlaps the previous cycle's training)."""
+
+    #: bytes hashed immediately before the consumed offset; a rewrite that
+    #: happens to grow the file is caught by this prefix check
+    _SIG_BYTES = 64
 
     def __init__(self, cfg: OnlineParams, log=Log):
         super().__init__(name="online-ingest", daemon=True)
@@ -106,6 +117,14 @@ class _IngestProducer(threading.Thread):
         self._latest: Optional[Tuple[Tuple, np.ndarray, np.ndarray]] = None
         self._error: Optional[BaseException] = None
         self._stamp: Optional[Tuple] = None
+        # incremental-parse state
+        self._fmt: Optional[Tuple] = None   # (fmt, sep, n_features)
+        self._offset: Optional[int] = None  # bytes consumed (None = no tail)
+        self._sig: bytes = b""
+        self._chunks: list = []             # [(X, y)] rolling window
+        # ingest telemetry (read by the cycle stage trail and the pins)
+        self.last_ingest: Optional[Dict[str, Any]] = None
+        self.rows_parsed_total = 0
 
     def _file_stamp(self) -> Optional[Tuple]:
         try:
@@ -114,16 +133,115 @@ class _IngestProducer(threading.Thread):
         except OSError:
             return None
 
-    def _parse_once(self) -> None:
-        from ..io.parser import parse_file
-        X, y = parse_file(self.cfg.data,
-                          label_column=self.cfg.label_column,
-                          has_header=self.cfg.has_header)
+    # -- incremental parsing -------------------------------------------------
+    def _sig_ok(self) -> bool:
+        if self._offset is None:
+            return False
+        lo = max(0, self._offset - self._SIG_BYTES)
+        try:
+            with open(self.cfg.data, "rb") as fh:
+                fh.seek(lo)
+                return fh.read(self._offset - lo) == self._sig
+        except OSError:
+            return False
+
+    def _record_offset(self, size: int) -> None:
+        """Arm tail mode at `size` if the consumed region ends on a line
+        boundary; otherwise disable it until the next full parse."""
+        try:
+            with open(self.cfg.data, "rb") as fh:
+                if size <= 0:
+                    self._offset = None
+                    return
+                fh.seek(size - 1)
+                if fh.read(1) != b"\n":
+                    self._offset = None
+                    return
+                lo = max(0, size - self._SIG_BYTES)
+                fh.seek(lo)
+                self._sig = fh.read(size - lo)
+                self._offset = size
+        except OSError:
+            self._offset = None
+
+    def _parse_tail(self, size: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Parse ONLY the appended bytes [offset, last complete line)."""
+        from ..io.parser import _parse_delimited, _parse_libsvm
+        fmt, sep, n_feat = self._fmt
+        with open(self.cfg.data, "rb") as fh:
+            fh.seek(self._offset)
+            blob = fh.read(size - self._offset)
+        cut = blob.rfind(b"\n")
+        if cut < 0:          # no complete appended line yet
+            return (np.empty((0, n_feat)), np.empty(0))
+        consumed = blob[:cut + 1]
+        lines = [l for l in consumed.decode("utf-8", "replace").splitlines()
+                 if l.strip()]
+        if lines:
+            if fmt == "libsvm":
+                X, y = _parse_libsvm(lines, n_feat)
+            else:
+                X, y = _parse_delimited(lines, sep, self.cfg.label_column,
+                                        n_feat)
+        else:
+            X, y = np.empty((0, n_feat)), np.empty(0)
+        self._offset += len(consumed)
+        lo = max(0, self._offset - self._SIG_BYTES)
+        self._sig = (self._sig + consumed)[-(self._offset - lo):]
+        return X, y
+
+    def _append_window(self, X: np.ndarray, y: np.ndarray) -> None:
+        if X.shape[0]:
+            self._chunks.append((X, y))
         w = self.cfg.window_rows
-        if w > 0 and X.shape[0] > w:
-            X, y = X[-w:], y[-w:]
+        if w <= 0:
+            return
+        total = sum(c[0].shape[0] for c in self._chunks)
+        while len(self._chunks) > 1 and \
+                total - self._chunks[0][0].shape[0] >= w:
+            total -= self._chunks[0][0].shape[0]
+            self._chunks.pop(0)
+        if total > w:
+            X0, y0 = self._chunks[0]
+            cut = total - w
+            self._chunks[0] = (X0[cut:], y0[cut:])
+
+    def _window(self) -> Tuple[np.ndarray, np.ndarray]:
+        Xs = [c[0] for c in self._chunks]
+        ys = [c[1] for c in self._chunks]
+        return (np.concatenate(Xs) if len(Xs) > 1 else Xs[0],
+                np.concatenate(ys) if len(ys) > 1 else ys[0])
+
+    def _parse_once(self) -> None:
+        t0 = time.perf_counter()
+        size = os.path.getsize(self.cfg.data)
+        mode = "full_parse"
+        if self._fmt is not None and self._offset is not None \
+                and size > self._offset and self._sig_ok():
+            X, y = self._parse_tail(size)
+            mode = "tail_append"
+        else:
+            from ..io.parser import parse_file, sniff
+            X, y = parse_file(self.cfg.data,
+                              label_column=self.cfg.label_column,
+                              has_header=self.cfg.has_header)
+            fmt, sep, _, _ = sniff(self.cfg.data, self.cfg.has_header)
+            self._fmt = (fmt, sep, X.shape[1])
+            self._chunks = []
+            self._record_offset(size)
+        parsed = int(X.shape[0])
+        self._append_window(X, y)
+        Xw, yw = self._window()
+        dt = time.perf_counter() - t0
         with self._lock:
-            self._latest = (self._stamp, X, y)
+            self._latest = (self._stamp, Xw, yw)
+        self.rows_parsed_total += parsed
+        self.last_ingest = {
+            "mode": mode, "rows_parsed": parsed,
+            "seconds": round(dt, 4),
+            "rows_per_sec": round(parsed / dt, 1) if dt > 0 else None,
+            "window_rows": int(Xw.shape[0]),
+        }
         self._ready.set()
 
     def run(self) -> None:
@@ -232,9 +350,15 @@ class ContinuousTrainer:
             ds.construct(Config(params))
             return ds
         if self.cfg.save_binary and self._cache_fresh():
-            ds = Dataset(self._binary_cache_path(), params=params)
-            ds.construct(Config(params))
-            return ds
+            try:
+                ds = Dataset(self._binary_cache_path(), params=params)
+                ds.construct(Config(params))
+                return ds
+            except LightGBMError as e:
+                # e.g. a stale format_version from an older build: the
+                # service rebuilds the cache instead of wedging the cycle
+                self.log.warning("online: binary window cache unusable "
+                                 "(%s); rebuilding it", e)
         ds = Dataset(X, label=y, params=params)
         if self.cfg.save_binary:
             ds.construct(Config(params))
@@ -413,6 +537,11 @@ class ContinuousTrainer:
         # -- ingest: adopt a fresh window if the producer staged one ---------
         self._stage(cycle, "ingest")
         stamp, X, y = producer.current(timeout=max(cfg.stage_timeout, 60))
+        info = getattr(producer, "last_ingest", None)
+        if info:
+            # ingest telemetry (mode + rows/sec) rides the cycle's stage
+            # trail next to the sync audit and publish latency
+            self.wd.annotate("ingest", dict(info))
         if stamp != self._window_stamp and cfg.mode == "boost":
             # continued training onto the new window: the live engine's
             # trees carry over as the init model (scores are replayed onto

@@ -110,6 +110,26 @@ def test_serve_bench_record_shape():
     assert rec["swap_latency_s"] is not None
 
 
+def test_ingest_bench_record_shape():
+    """BENCH_INGEST at toy scale (ISSUE 8): the record must carry the
+    four rows/sec readings and the cross-path bins-identical pin."""
+    env = {"BENCH_INGEST_ROWS": "3000"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        rec = bench.bench_ingest()
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.update({k: v})
+    for key in ("file_parse_rows_per_sec", "dense_push_rows_per_sec",
+                "csr_push_rows_per_sec", "binary_cache_rows_per_sec",
+                "push_speedup_vs_file_parse"):
+        assert key in rec and rec.get(key) is not None, key
+        if key.endswith("rows_per_sec"):
+            assert rec[key] > 0
+    assert rec["bins_identical_across_paths"] is True
+
+
 def test_fallback_reexec_preserves_every_section_toggle():
     """The CPU-fallback re-exec env pin (ISSUE 7 satellite): every
     BENCH_<SECTION> toggle — serve included — must ride
@@ -119,7 +139,8 @@ def test_fallback_reexec_preserves_every_section_toggle():
                 "BENCH_SERVE_SECONDS", "BENCH_SERVE_TREES",
                 "BENCH_SERVE_LEAVES", "BENCH_SERVE_BATCH",
                 "BENCH_ONLINE", "BENCH_PREDICT", "BENCH_PHASES",
-                "BENCH_HIST_QUANT", "BENCH_FRONTIER_BATCH"):
+                "BENCH_HIST_QUANT", "BENCH_FRONTIER_BATCH",
+                "BENCH_INGEST", "BENCH_INGEST_ROWS"):
         assert key in bench.FALLBACK_SECTION_ENV, key
     import inspect
     src = inspect.getsource(bench.main)

@@ -51,6 +51,46 @@ def test_binary_preserves_bundles(tmp_path):
     assert ds2.max_num_bin == ds.max_num_bin
 
 
+def test_stale_cache_version_refuses_with_clear_error(tmp_path):
+    """ISSUE 8 satellite: the cache header is version-stamped; a cache
+    with a mismatched format_version (stale build, or a v1 file from
+    before the stamp) must refuse to load with a clear rebuild message —
+    never train silently on stale bins."""
+    import json
+    import pytest
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    X, y = _data()
+    path = str(tmp_path / "c.bin")
+    ds = BinnedDataset.from_matrix(X, Config(dict(PARAMS)))
+    ds.save_binary(path)
+
+    def rewrite_version(version):
+        with np.load(path, allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files}
+        header = json.loads(bytes(arrays["header"].tobytes()).decode())
+        if version is None:
+            header.pop("format_version", None)   # a pre-stamp v1 cache
+        else:
+            header["format_version"] = version
+        arrays["header"] = np.frombuffer(json.dumps(header).encode(),
+                                         dtype=np.uint8)
+        with open(path, "wb") as fh:
+            np.savez_compressed(fh, **arrays)
+
+    for stale in (99, None):
+        rewrite_version(stale)
+        assert BinnedDataset.is_binary_file(path)   # still recognizably ours
+        with pytest.raises(LightGBMError, match="format version"):
+            BinnedDataset.load_binary(path)
+        with pytest.raises(LightGBMError, match="rebuild"):
+            BinnedDataset.load_binary(path)
+
+    # a matching stamp loads fine again
+    rewrite_version(BinnedDataset.BINARY_FORMAT_VERSION)
+    assert BinnedDataset.load_binary(path).num_data == ds.num_data
+
+
 def test_is_binary_file_rejects_text(tmp_path):
     p = str(tmp_path / "t.txt")
     with open(p, "w") as fh:

@@ -1,0 +1,69 @@
+"""C-ABI drift lint pin (ISSUE 8 satellite, helper/check_abi.py).
+
+The lint derives the PARITY.md C-API count from the header's exported
+symbols ∩ the canonical reference entry-point list and requires every
+export to have a capi.py binding — these tests pin that the repo is
+currently clean AND that the lint actually catches each drift mode."""
+import os
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "helper"))
+
+import check_abi  # noqa: E402
+
+
+def test_abi_lint_is_clean():
+    problems = check_abi.run()
+    assert problems == [], "\n".join(problems)
+
+
+def test_parity_count_is_at_least_39_of_58():
+    """ISSUE 8 acceptance floor: the dataset-from-memory block lifts the
+    LGBM_* parity to >= 39/58 (the derived count is 44/58)."""
+    implemented = check_abi.implemented_reference_points()
+    assert len(check_abi.REFERENCE_C_API) == 58
+    assert len(implemented) >= 39, implemented
+    for sym in ("LGBM_DatasetCreateFromCSR", "LGBM_DatasetCreateFromCSC",
+                "LGBM_DatasetCreateByReference", "LGBM_DatasetPushRows",
+                "LGBM_DatasetPushRowsByCSR", "LGBM_DatasetGetSubset",
+                "LGBM_DatasetSaveBinary", "LGBM_DatasetSetFeatureNames",
+                "LGBM_DatasetGetFeatureNames"):
+        assert sym in implemented, sym
+
+
+def test_lint_catches_unbound_header_export(tmp_path):
+    """A new header export with no capi.py binding must be flagged."""
+    header = str(tmp_path / "h.h")
+    shutil.copy(check_abi.HEADER, header)
+    with open(header, "a") as fh:
+        fh.write("\nint LGBM_DatasetDumpText(DatasetHandle handle, "
+                 "const char* filename);\n")
+    problems = check_abi.run(header_path=header)
+    assert any("LGBM_DatasetDumpText" in p and "capi.py" in p
+               for p in problems), problems
+
+
+def test_lint_catches_parity_count_rot(tmp_path):
+    """A stale hand-edited count in PARITY.md must be flagged."""
+    n = len(check_abi.implemented_reference_points())
+    parity = str(tmp_path / "PARITY.md")
+    with open(check_abi.PARITY) as fh:
+        text = fh.read()
+    with open(parity, "w") as fh:
+        fh.write(text.replace("%d/58" % n, "30/58"))
+    problems = check_abi.run(parity_path=parity)
+    assert any("PARITY.md" in p for p in problems), problems
+
+
+def test_lint_ignores_symbol_mentions_in_comments(tmp_path):
+    """Only real declarations count as exports — a comment referencing a
+    reference-only symbol must not inflate the parity count."""
+    header = str(tmp_path / "h.h")
+    shutil.copy(check_abi.HEADER, header)
+    with open(header, "a") as fh:
+        fh.write("\n/* see also LGBM_BoosterMerge in the reference */\n")
+    before = check_abi.implemented_reference_points()
+    after = check_abi.implemented_reference_points(header)
+    assert before == after

@@ -234,6 +234,86 @@ def test_online_preempt_resume_rejoins_schedule_byte_identical(online_runs):
     assert (churn / "m.txt").read_bytes() == (base / "m.txt").read_bytes()
 
 
+def test_ingest_producer_tail_append_never_reparses_old_rows(tmp_path):
+    """ISSUE 8 fix pin: when the data file only GROWS, the ingest
+    producer parses exactly the appended tail — rows outside the new
+    window are never re-read, re-parsed or re-binned.  A rewrite still
+    falls back to a full parse."""
+    from lightgbm_tpu.io.parser import parse_file
+    from lightgbm_tpu.runtime.continuous import _IngestProducer, OnlineParams
+
+    path = str(tmp_path / "t.tsv")
+
+    def rows(n, seed):
+        r = np.random.default_rng(seed)
+        X = r.standard_normal((n, 5))
+        return np.column_stack([(X[:, 0] > 0).astype(float), X])
+
+    np.savetxt(path, rows(300, 0), delimiter="\t", fmt="%.10g")
+    p = _IngestProducer(OnlineParams({"data": path,
+                                      "online_window_rows": 200}))
+    p._stamp = p._file_stamp()
+    p._parse_once()
+    assert p.last_ingest["mode"] == "full_parse"
+    assert p.last_ingest["rows_parsed"] == 300
+
+    # append 50 rows: exactly 50 parsed, window = newest 200 of the file
+    with open(path, "a") as fh:
+        np.savetxt(fh, rows(50, 7), delimiter="\t", fmt="%.10g")
+    p._stamp = p._file_stamp()
+    p._parse_once()
+    assert p.last_ingest["mode"] == "tail_append"
+    assert p.last_ingest["rows_parsed"] == 50
+    assert p.last_ingest["rows_per_sec"] > 0
+    _, X, y = p.current(1)
+    Xf, yf = parse_file(path)
+    np.testing.assert_array_equal(X, Xf[-200:])
+    np.testing.assert_array_equal(y, yf[-200:])
+    assert p.rows_parsed_total == 350   # never the full 350+300
+
+    # a rewrite (same grower signature broken) falls back to full parse
+    np.savetxt(path, rows(400, 9), delimiter="\t", fmt="%.10g")
+    p._stamp = p._file_stamp()
+    p._parse_once()
+    assert p.last_ingest["mode"] == "full_parse"
+    _, X2, _ = p.current(1)
+    np.testing.assert_array_equal(X2, parse_file(path)[0][-200:])
+
+    # a partially-written trailing line is held back, then consumed
+    with open(path, "a") as fh:
+        fh.write("1\t.1\t.1\t.1\t.1")
+    p._stamp = p._file_stamp()
+    p._parse_once()
+    assert p.last_ingest["rows_parsed"] == 0
+    with open(path, "a") as fh:
+        fh.write("\t.1\n")
+    p._stamp = p._file_stamp()
+    p._parse_once()
+    assert p.last_ingest["mode"] == "tail_append"
+    assert p.last_ingest["rows_parsed"] == 1
+
+
+def test_online_cycle_trail_records_ingest_rows_per_sec(tmp_path):
+    """The cycle stage trail carries the ingest telemetry (mode +
+    rows/sec) next to the sync audit and publish latency."""
+    from lightgbm_tpu.runtime.continuous import ContinuousTrainer
+
+    chaos.make_data(str(tmp_path / "train.tsv"))
+    trainer = ContinuousTrainer({
+        "data": str(tmp_path / "train.tsv"),
+        "output_model": str(tmp_path / "m.txt"),
+        "objective": "binary", "num_leaves": 7, "verbose": -1,
+        "online_cycles": 1, "online_rounds": 1, "online_interval": 0})
+    trainer.wd.stream = sys.stderr
+    assert trainer.run() == 0
+    trail = json.load(open(str(tmp_path / "m.txt.stage_trail.json")))
+    ingest = [s for s in trail["stages"]
+              if s["name"] == "cycle 1: ingest"][0]
+    assert ingest["ingest"]["mode"] == "full_parse"
+    assert ingest["ingest"]["rows_parsed"] > 0
+    assert "rows_per_sec" in ingest["ingest"]
+
+
 def test_online_slow_stage_times_out_and_cycle_retries(tmp_path):
     """`slow_stage:NAME:S` stalls a named stage past its watchdog
     deadline: the timeout lands in the stage trail (culprit named, NOT a

@@ -1,0 +1,350 @@
+"""Zero-copy streaming ingest subsystem (ISSUE 8 tentpole).
+
+Pins, in rough order of the acceptance criteria:
+
+* bins/metadata from pushed dense/CSR/CSC chunks are BYTE-IDENTICAL to
+  the file-parser path on the same rows (including every missing-value
+  mode: NaN, zero-as-missing, use_missing=false);
+* a model trained from pushed chunks is byte-identical to the CSV-path
+  model (gbdt + bagging);
+* the by-reference streaming mode (LGBM_DatasetCreateByReference
+  semantics) encodes eagerly, drops raw chunks, and still matches
+  from_matrix with the reference mappers bit-for-bit;
+* the bounded reservoir stays at its cap and finalize still works past
+  it; ``lgb.Dataset(data=<iterator>)``; binned GetSubset; binary-cache
+  round trip from a stream-built dataset.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.io.stream import StreamingDatasetBuilder
+from lightgbm_tpu.utils.log import LightGBMError
+
+PARAMS = {"objective": "binary", "metric": "auc", "num_leaves": 15,
+          "max_bin": 63, "min_data_in_leaf": 20, "verbose": -1}
+
+
+def _data(n=1500, f=8, seed=0, with_nan=True, with_zero=True):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, f))
+    if with_zero:
+        X[:, 2] = np.where(rng.random(n) < 0.6, 0.0, X[:, 2])
+    if with_nan:
+        X[rng.random((n, f)) < 0.04] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + 0.4 * np.nan_to_num(X[:, 1])
+         > 0).astype(np.float64)
+    return X, y
+
+
+def _write(path, X, y):
+    # %.17g: the text round trip reproduces the exact doubles the push
+    # paths see, so "byte-identical" really means byte-identical
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.17g")
+
+
+def _to_csr(M, keep_nan=True):
+    """Explicit entries for nonzeros (and NaNs); absent = 0.0 — the
+    reference C-API CSR contract."""
+    mask = (M != 0.0) & ~np.isnan(M)
+    if keep_nan:
+        mask |= np.isnan(M)
+    indptr = np.concatenate([[0], np.cumsum(mask.sum(1))]).astype(np.int64)
+    indices = np.nonzero(mask)[1].astype(np.int32)
+    return indptr, indices, M[mask]
+
+
+def _to_csc(M):
+    maskT = ((M != 0.0) | np.isnan(M)).T
+    col_ptr = np.concatenate([[0], np.cumsum(maskT.sum(1))]).astype(np.int64)
+    indices = np.nonzero(maskT)[1].astype(np.int32)
+    return col_ptr, indices, M.T[maskT]
+
+
+def _mapper_state(m):
+    d = m.to_arrays()
+    # reprs so the NaN sentinel bound compares equal (nan != nan)
+    return {k: ([repr(float(x)) for x in v.ravel()]
+                if isinstance(v, np.ndarray) and v.dtype.kind == "f"
+                else v.tolist() if isinstance(v, np.ndarray) else v)
+            for k, v in d.items()}
+
+
+def _assert_binned_equal(a: BinnedDataset, b: BinnedDataset):
+    assert a.num_data == b.num_data
+    assert a.num_data_padded == b.num_data_padded
+    assert a.bins.dtype == b.bins.dtype
+    np.testing.assert_array_equal(a.bins, b.bins)
+    assert len(a.bin_mappers) == len(b.bin_mappers)
+    for ma, mb in zip(a.bin_mappers, b.bin_mappers):
+        assert _mapper_state(ma) == _mapper_state(mb)
+    assert (a.bundle_info is None) == (b.bundle_info is None)
+    if a.bundle_info is not None:
+        assert a.bundle_info.groups == b.bundle_info.groups
+    assert a.feature_infos() == b.feature_infos()
+
+
+def _file_dataset(tmp_path, X, y, params=None):
+    path = str(tmp_path / "data.tsv")
+    _write(path, X, y)
+    ds = lgb.Dataset(path, params=dict(params or PARAMS))
+    ds.construct(Config(dict(params or PARAMS)))
+    return ds
+
+
+# ---------------------------------------------------------------------------
+# bins byte-identity vs the parser
+# ---------------------------------------------------------------------------
+
+def test_dense_push_bins_byte_identical_to_parser(tmp_path):
+    X, y = _data()
+    ds_file = _file_dataset(tmp_path, X, y)
+    b = StreamingDatasetBuilder(params=dict(PARAMS))
+    for s in range(0, len(X), 400):
+        b.push_dense(X[s:s + 400], label=y[s:s + 400])
+    ds_push = lgb.Dataset(b, params=dict(PARAMS))
+    ds_push.construct(Config(dict(PARAMS)))
+    _assert_binned_equal(ds_file.binned, ds_push.binned)
+    np.testing.assert_array_equal(ds_file.get_label(), ds_push.get_label())
+
+
+def test_csr_and_csc_push_bins_byte_identical_to_parser(tmp_path):
+    X, y = _data(seed=1)
+    ds_file = _file_dataset(tmp_path, X, y)
+
+    b = StreamingDatasetBuilder(params=dict(PARAMS))
+    for s in range(0, len(X), 333):
+        ip, ix, dv = _to_csr(X[s:s + 333])
+        b.push_csr(ip, ix, dv, X.shape[1], label=y[s:s + 333])
+    ds_csr = lgb.Dataset(b, params=dict(PARAMS))
+    ds_csr.construct(Config(dict(PARAMS)))
+    _assert_binned_equal(ds_file.binned, ds_csr.binned)
+
+    cp, cix, cdv = _to_csc(X)
+    b2 = StreamingDatasetBuilder(params=dict(PARAMS))
+    b2.push_csc(cp, cix, cdv, len(X), label=y)
+    ds_csc = lgb.Dataset(b2, params=dict(PARAMS))
+    ds_csc.construct(Config(dict(PARAMS)))
+    _assert_binned_equal(ds_file.binned, ds_csc.binned)
+
+
+@pytest.mark.parametrize("mode", ["nan", "zero_as_missing", "no_missing"])
+def test_missing_value_fidelity_through_push(tmp_path, mode):
+    """NaN / zero-as-missing / use_missing=false must bin identically
+    through CSR and dense push vs the CSV parse of the same rows (the
+    equivalence-sweep extension, ISSUE 8 satellite)."""
+    params = dict(PARAMS)
+    if mode == "zero_as_missing":
+        params["zero_as_missing"] = True
+        X, y = _data(seed=2, with_nan=False)
+    elif mode == "no_missing":
+        params["use_missing"] = False
+        X, y = _data(seed=3)
+    else:
+        X, y = _data(seed=4)
+    ds_file = _file_dataset(tmp_path, X, y, params)
+    from lightgbm_tpu.io.binning import (MISSING_NAN, MISSING_NONE,
+                                         MISSING_ZERO)
+    want = {"nan": MISSING_NAN, "zero_as_missing": MISSING_ZERO,
+            "no_missing": MISSING_NONE}[mode]
+    assert any(m.missing_type == want for m in ds_file.binned.bin_mappers)
+
+    b_dense = StreamingDatasetBuilder(params=dict(params))
+    b_csr = StreamingDatasetBuilder(params=dict(params))
+    for s in range(0, len(X), 500):
+        b_dense.push_dense(X[s:s + 500], label=y[s:s + 500])
+        ip, ix, dv = _to_csr(X[s:s + 500])
+        b_csr.push_csr(ip, ix, dv, X.shape[1], label=y[s:s + 500])
+    for b in (b_dense, b_csr):
+        ds = lgb.Dataset(b, params=dict(params))
+        ds.construct(Config(dict(params)))
+        _assert_binned_equal(ds_file.binned, ds.binned)
+
+
+# ---------------------------------------------------------------------------
+# trained-model byte identity (acceptance pin: gbdt + bagging)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("extra", [{}, {"bagging_fraction": 0.7,
+                                        "bagging_freq": 1,
+                                        "bagging_seed": 11}],
+                         ids=["gbdt", "bagging"])
+def test_model_from_pushed_chunks_byte_identical(tmp_path, extra):
+    X, y = _data(n=2000, seed=5)
+    params = {**PARAMS, **extra}
+    path = str(tmp_path / "train.tsv")
+    _write(path, X, y)
+    m_file = lgb.train(dict(params), lgb.Dataset(path, params=dict(params)),
+                       num_boost_round=8)
+
+    def chunks():
+        for s in range(0, len(X), 700):
+            yield X[s:s + 700], y[s:s + 700]
+    m_push = lgb.train(dict(params),
+                       lgb.Dataset(chunks(), params=dict(params)),
+                       num_boost_round=8)
+    assert m_file.model_to_string() == m_push.model_to_string()
+
+
+# ---------------------------------------------------------------------------
+# by-reference streaming mode (bounded memory)
+# ---------------------------------------------------------------------------
+
+def test_by_reference_push_encodes_eagerly_and_matches_from_matrix():
+    X, y = _data(n=1200, seed=6)
+    ref = lgb.Dataset(X, label=y, params=dict(PARAMS))
+    ref.construct(Config(dict(PARAMS)))
+    X2, _ = _data(n=700, seed=7)
+    b = StreamingDatasetBuilder(params=dict(PARAMS), reference=ref,
+                                num_total_rows=700)
+    assert b.streaming
+    # out-of-order positioned pushes: dense then a CSR chunk
+    b.push_dense(X2[300:], start_row=300)
+    ip, ix, dv = _to_csr(X2[:300])
+    b.push_csr(ip, ix, dv, X2.shape[1], start_row=0)
+    assert b._chunks == []            # raw chunks never retained
+    ds = lgb.Dataset(b, reference=ref, params=dict(PARAMS))
+    ds.construct(Config(dict(PARAMS)))
+    expect = BinnedDataset.from_matrix(
+        X2, Config(dict(PARAMS)), bin_mappers=ref.binned.bin_mappers,
+        reference_bundle=ref.binned.bundle_info)
+    np.testing.assert_array_equal(ds.binned.bins, expect.bins)
+    assert ds.binned.num_data_padded == expect.num_data_padded
+
+
+def test_by_reference_incomplete_stream_fails_with_named_gap():
+    X, y = _data(n=400, seed=8)
+    ref = lgb.Dataset(X, label=y, params=dict(PARAMS))
+    ref.construct(Config(dict(PARAMS)))
+    b = StreamingDatasetBuilder(params=dict(PARAMS), reference=ref,
+                                num_total_rows=500)
+    b.push_dense(X[:400], start_row=0)
+    with pytest.raises(LightGBMError, match="100 of the declared 500"):
+        b.finalize(Config(dict(PARAMS)))
+    # overlapping pushes are rejected too
+    b2 = StreamingDatasetBuilder(params=dict(PARAMS), reference=ref,
+                                 num_total_rows=500)
+    b2.push_dense(X[:300], start_row=0)
+    with pytest.raises(LightGBMError, match="already pushed"):
+        b2.push_dense(X[:300], start_row=200)
+
+
+# ---------------------------------------------------------------------------
+# reservoir bound
+# ---------------------------------------------------------------------------
+
+def test_reservoir_bounded_beyond_cap_and_bins_stay_valid():
+    params = {**PARAMS, "bin_construct_sample_cnt": 256}
+    X, y = _data(n=2000, seed=9)
+    b = StreamingDatasetBuilder(params=dict(params))
+    for s in range(0, len(X), 200):
+        b.push_dense(X[s:s + 200], label=y[s:s + 200])
+        assert b.reservoir_rows <= 256
+    assert b.reservoir_rows == 256    # full cap after 2000 rows
+    ds = lgb.Dataset(b, params=dict(params))
+    ds.construct(Config(dict(params)))
+    assert ds.num_data() == 2000
+    max_bin = int(params["max_bin"])
+    for m in ds.binned.bin_mappers:
+        assert 1 <= m.num_bin <= max_bin + 1
+    # the reservoir sample still trains a sane model
+    bst = lgb.train(dict(params), ds, num_boost_round=3)
+    assert bst.current_iteration() == 3
+
+
+def test_reservoir_matches_offline_sampling_below_cap(tmp_path):
+    """While the stream fits the cap the reservoir degenerates to the
+    full row set and binning is EXACTLY the offline path (the documented
+    byte-identity bound)."""
+    params = {**PARAMS, "bin_construct_sample_cnt": 5000}
+    X, y = _data(n=1200, seed=10)
+    ds_file = _file_dataset(tmp_path, X, y, params)
+    b = StreamingDatasetBuilder(params=dict(params))
+    for s in range(0, len(X), 100):
+        b.push_dense(X[s:s + 100], label=y[s:s + 100])
+    assert b.reservoir_rows == 1200
+    ds = lgb.Dataset(b, params=dict(params))
+    ds.construct(Config(dict(params)))
+    _assert_binned_equal(ds_file.binned, ds.binned)
+
+
+# ---------------------------------------------------------------------------
+# surface: iterator datasets, subset, binary cache, push errors
+# ---------------------------------------------------------------------------
+
+def test_dataset_accepts_chunk_iterator():
+    X, y = _data(n=900, seed=11)
+    direct = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
+                       num_boost_round=4)
+    streamed = lgb.train(dict(PARAMS),
+                         lgb.Dataset(iter([(X[:300], y[:300]),
+                                           (X[300:], y[300:])]),
+                                     params=dict(PARAMS)),
+                         num_boost_round=4)
+    assert direct.model_to_string() == streamed.model_to_string()
+
+
+def test_binned_subset_gathers_rows_and_metadata():
+    X, y = _data(n=800, seed=12)
+    w = np.abs(np.random.default_rng(0).standard_normal(800))
+    ds = lgb.Dataset(X, label=y, weight=w, params=dict(PARAMS))
+    ds.construct(Config(dict(PARAMS)))
+    idx = np.arange(5, 505, 5)
+    sub = ds.binned.subset(idx)
+    assert sub.num_data == 100
+    np.testing.assert_array_equal(sub.bins[:, :100], ds.binned.bins[:, idx])
+    np.testing.assert_array_equal(sub.metadata.label,
+                                  ds.binned.metadata.label[idx])
+    np.testing.assert_array_equal(sub.metadata.weight,
+                                  ds.binned.metadata.weight[idx])
+    with pytest.raises(Exception):
+        ds.binned.subset(idx[::-1])   # unsorted → reference contract error
+
+
+def test_python_subset_of_stream_dataset_uses_binned_gather():
+    X, y = _data(n=600, seed=13)
+    b = StreamingDatasetBuilder(params=dict(PARAMS))
+    b.push_dense(X, label=y)
+    ds = lgb.Dataset(b, params=dict(PARAMS))
+    ds.construct(Config(dict(PARAMS)))
+    sub = ds.subset(np.arange(100, 300))
+    assert sub.num_data() == 200
+    np.testing.assert_array_equal(sub.binned.bins[:, :200],
+                                  ds.binned.bins[:, 100:300])
+
+
+def test_stream_dataset_save_binary_roundtrip(tmp_path):
+    X, y = _data(n=700, seed=14)
+    b = StreamingDatasetBuilder(params=dict(PARAMS))
+    b.push_dense(X, label=y)
+    ds = lgb.Dataset(b, params=dict(PARAMS))
+    ds.construct(Config(dict(PARAMS)))
+    path = str(tmp_path / "s.bin")
+    ds.save_binary(path)
+    assert BinnedDataset.is_binary_file(path)
+    m1 = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y), num_boost_round=4)
+    m2 = lgb.train(dict(PARAMS), lgb.Dataset(path), num_boost_round=4)
+    assert m1.model_to_string() == m2.model_to_string()
+
+
+def test_push_errors_are_explicit():
+    b = StreamingDatasetBuilder(params=dict(PARAMS))
+    b.push_dense(np.zeros((10, 4)))
+    with pytest.raises(LightGBMError, match="4"):
+        b.push_dense(np.zeros((10, 5)))
+    with pytest.raises(LightGBMError, match="start_row"):
+        b.push_dense(np.zeros((10, 4)), start_row=20)
+    with pytest.raises(LightGBMError, match="empty"):
+        StreamingDatasetBuilder(params=dict(PARAMS)).finalize(
+            Config(dict(PARAMS)))
+    with pytest.raises(LightGBMError, match="out of range"):
+        bad = StreamingDatasetBuilder(params=dict(PARAMS))
+        bad.push_csr(np.array([0, 1]), np.array([7], np.int32),
+                     np.array([1.0]), 4)
+    ds = lgb.Dataset(np.zeros((10, 2)), label=np.zeros(10))
+    with pytest.raises(LightGBMError, match="streaming"):
+        ds.push_rows(np.zeros((2, 2)))
