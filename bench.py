@@ -45,6 +45,7 @@ FALLBACK_SECTION_ENV = (
     "BENCH_SERVE", "BENCH_SERVE_CLIENTS", "BENCH_SERVE_SECONDS",
     "BENCH_SERVE_TREES", "BENCH_SERVE_LEAVES", "BENCH_SERVE_BATCH",
     "BENCH_INGEST", "BENCH_INGEST_ROWS",
+    "BENCH_TELEMETRY", "BENCH_TELEMETRY_ROWS", "BENCH_TELEMETRY_ITERS",
 )
 
 #: most recent bench measured on REAL TPU hardware (updated by hand after
@@ -345,6 +346,8 @@ def bench_serve():
     from lightgbm_tpu.runtime import publish as pubmod
     from lightgbm_tpu.runtime.serving import ServeRejected, ServingRuntime
 
+    from lightgbm_tpu.runtime import telemetry
+
     clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
     seconds = float(os.environ.get("BENCH_SERVE_SECONDS", 6))
     n_trees = int(os.environ.get("BENCH_SERVE_TREES", 100))
@@ -353,6 +356,10 @@ def bench_serve():
     n_feat = 28
     rng = np.random.default_rng(23)
     rows = rng.standard_normal((4096, n_feat))
+    # the registry's serving-latency histogram drives the reported
+    # p50/p99 (ISSUE 9) — scope it to THIS bench run with a state delta
+    lat_hist = telemetry.histogram("lgbm_serve_latency_seconds")
+    h_before = lat_hist.state()
     with tempfile.TemporaryDirectory(prefix="bench_serve_") as d:
         pub = pubmod.ModelPublisher(os.path.join(d, "pub"), keep_last=0)
         pub.publish(synth_serving_model(n_trees, num_leaves, n_feat,
@@ -402,15 +409,29 @@ def bench_serve():
             raise RuntimeError("serve bench saw %d hard errors; first: %s"
                                % (len(errors), errors[0]))
         lat = np.asarray(latencies) if latencies else np.asarray([0.0])
+        hist_delta = telemetry.state_delta(lat_hist.state(), h_before)
+
+        def _q(q):
+            v = telemetry.quantile_from_state(hist_delta, q)
+            return round(v * 1e3, 3) if v is not None else None
         return {
             "clients": clients, "request_rows": req_rows,
             "n_trees": n_trees, "num_leaves": num_leaves,
             "requests": len(latencies), "shed": shed[0],
             "rows_per_sec": round(st["rows_served"] / dt, 1),
+            # p50/p99 come FROM the metrics registry histogram — the
+            # same series a live /metrics scrape exposes (exact to
+            # within one bucket of the fixed layout)
             "latency_ms": {
+                "p50": _q(0.5), "p99": _q(0.99),
+                "max": round(float(lat.max()) * 1e3, 3),
+                "source": "registry histogram lgbm_serve_latency_seconds",
+                "histogram_count": hist_delta["count"]},
+            "client_latency_ms": {
                 "p50": round(float(np.percentile(lat, 50)) * 1e3, 3),
                 "p99": round(float(np.percentile(lat, 99)) * 1e3, 3),
-                "max": round(float(lat.max()) * 1e3, 3)},
+                "note": "client-side wall clock, for cross-checking the "
+                        "registry quantiles (+- one bucket width)"},
             "swap_latency_s": (round(swap["seen"] - swap["published"], 3)
                                if swap["seen"] else None),
             "batches_device": st["batches_device"],
@@ -500,6 +521,80 @@ def bench_ingest():
             "note": "push paths skip parse entirely; file-parse includes "
                     "the native mmap parser + find-bin + encode",
         }
+
+
+def bench_telemetry():
+    """BENCH_TELEMETRY: observability-overhead A/B (ISSUE 9) — the SAME
+    booster (shared compiled programs) measured with the metrics
+    registry enabled vs disabled, plus a deterministic microbench of the
+    disabled-path instrument cost.  The contract asserted here: with
+    telemetry disabled, the instrumentation seam costs <1% of an
+    iteration (`disabled_path_overhead_pct`).  The wall-clock on/off
+    ratio is recorded too, but timing noise makes the microbench-derived
+    bound the honest assertion.  BENCH_TELEMETRY_{ROWS,ITERS} reshape."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.runtime import telemetry
+
+    rows = int(os.environ.get("BENCH_TELEMETRY_ROWS", 20_000))
+    iters = int(os.environ.get("BENCH_TELEMETRY_ITERS", 8))
+    X, y = synth_higgs(rows)
+    bst = lgb.Booster({"objective": "binary", "num_leaves": 31,
+                       "max_bin": 255, "learning_rate": 0.1,
+                       "verbose": -1}, lgb.Dataset(X, label=y))
+    for _ in range(3):                    # warm-up: compile + caches
+        bst.update()
+    bst._engine.flush()
+
+    ops0 = telemetry.REGISTRY.ops
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        bst.update()
+    bst._engine.flush()
+    dt_on = time.perf_counter() - t0
+    ops_per_iter = (telemetry.REGISTRY.ops - ops0) / iters
+
+    prev = telemetry.set_enabled(False)
+    try:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            bst.update()
+        bst._engine.flush()
+        dt_off = time.perf_counter() - t0
+
+        # deterministic disabled-path cost: one disabled instrument call
+        # is one global read + an early return — measure it directly
+        h = telemetry.histogram("lgbm_train_iteration_seconds")
+        c = telemetry.counter("lgbm_train_iterations_total")
+        n = 20_000
+        tm = time.perf_counter()
+        for _ in range(n):
+            h.observe(0.001)
+            c.inc()
+        call_cost_s = (time.perf_counter() - tm) / (2 * n)
+    finally:
+        telemetry.set_enabled(prev)
+
+    sec_per_iter_off = dt_off / iters
+    disabled_pct = (ops_per_iter * call_cost_s / sec_per_iter_off * 100
+                    if sec_per_iter_off > 0 else 0.0)
+    rec = {
+        "rows": rows, "iters": iters,
+        "sec_per_iter_on": round(dt_on / iters, 5),
+        "sec_per_iter_off": round(sec_per_iter_off, 5),
+        "wall_overhead_pct": round((dt_on - dt_off) / dt_off * 100, 3)
+        if dt_off > 0 else None,
+        "ops_per_iter": round(ops_per_iter, 1),
+        "disabled_call_cost_ns": round(call_cost_s * 1e9, 1),
+        "disabled_path_overhead_pct": round(disabled_pct, 4),
+        "note": "disabled_path_overhead_pct = instrument call sites per "
+                "iteration x disabled per-call cost / iteration time; "
+                "asserted < 1%",
+    }
+    if disabled_pct >= 1.0:
+        raise RuntimeError(
+            "telemetry disabled-path overhead %.3f%% >= 1%% of an "
+            "iteration — the instrumentation seam regressed" % disabled_pct)
+    return rec
 
 
 #: per-flag verdicts from the staged-kernel probe (None = probe not run);
@@ -675,6 +770,11 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
     from lightgbm_tpu.ops import segment as lseg
     from lightgbm_tpu.runtime import resilience
     from lightgbm_tpu.runtime import syncs
+    from lightgbm_tpu.runtime import telemetry as _telemetry
+
+    # batch runs export the registry through the atomic JSON-lines file
+    # when $LGBM_TPU_METRICS_FILE is set (ISSUE 9)
+    _telemetry.maybe_start_file_export("bench")
 
     # every bench stage runs under a named soft deadline: a hang dies as
     # a StageTimeout naming its stage (caught by main()'s rung handler,
@@ -915,6 +1015,21 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
                                   "above is unaffected"}
             stage("ingest bench FAILED (diagnostics only)")
 
+    # telemetry overhead A/B (BENCH_TELEMETRY=0 skips): registry on vs
+    # off on one booster + the <1% disabled-path assertion.  Guarded —
+    # a failure is recorded, never fatal to the headline.
+    telemetry_rec = None
+    if os.environ.get("BENCH_TELEMETRY", "1") != "0":
+        try:
+            telemetry_rec = bench_telemetry()
+            stage("telemetry A/B done (disabled path %.4f%%/iter)"
+                  % telemetry_rec["disabled_path_overhead_pct"])
+        except Exception as e:
+            telemetry_rec = {"error": "%s: %s" % (type(e).__name__, e),
+                             "note": "telemetry A/B failed; headline "
+                                     "result above is unaffected"}
+            stage("telemetry A/B FAILED (diagnostics only)")
+
     if isinstance(phases, dict):
         # the sync-audit counters ride the default phases output so every
         # bench record carries the blocking-fetch split next to the wall
@@ -971,6 +1086,8 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
         result["serve"] = serve_rec
     if ingest_rec is not None:
         result["ingest"] = ingest_rec
+    if telemetry_rec is not None:
+        result["telemetry"] = telemetry_rec
     if hist_quant is not None:
         result["hist_quant"] = hist_quant
     if STAGED_REPORT is not None:
@@ -983,6 +1100,7 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
         # measurement alongside (clearly labeled; this run's own numbers
         # above describe only what this run measured)
         result["last_verified_tpu"] = LAST_VERIFIED_TPU
+    _telemetry.write_snapshot_now("bench")
     return result
 
 

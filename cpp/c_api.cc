@@ -433,6 +433,31 @@ int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
   return 0;
 }
 
+int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle, int* out_models) {
+  ModelRef ref(handle);
+  Model* m = ref.m;
+  if (m == nullptr) return -1;
+  *out_models = static_cast<int>(m->trees.size());
+  return 0;
+}
+
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int* out_len,
+                                char** out_strs) {
+  ModelRef ref(handle);
+  Model* m = ref.m;
+  if (m == nullptr) return -1;
+  int nfeat = m->max_feature_idx + 1;
+  for (int f = 0; f < nfeat; ++f) {
+    std::string name = f < static_cast<int>(m->feature_names.size())
+                           ? m->feature_names[f]
+                           : "Column_" + std::to_string(f);
+    // fixed 128-byte buffers, the GetEvalNames convention of this ABI
+    std::snprintf(out_strs[f], 128, "%s", name.c_str());
+  }
+  *out_len = nfeat;
+  return 0;
+}
+
 int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
                              int leaf_idx, double* out_val) {
   ModelRef ref(handle);
@@ -772,6 +797,20 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
   }
   *out_len = static_cast<int64_t>(nrow) * width;
   return 0;
+}
+
+int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle,
+                                       const void* data, int data_type,
+                                       int ncol, int is_row_major,
+                                       int predict_type, int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len,
+                                       double* out_result) {
+  // one row is one row in either majorness
+  (void)is_row_major;
+  return LGBM_BoosterPredictForMat(handle, data, data_type, 1, ncol, 1,
+                                   predict_type, num_iteration, parameter,
+                                   out_len, out_result);
 }
 
 int LGBM_BoosterPredictForFile(BoosterHandle handle,

@@ -66,6 +66,18 @@ int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration);
 int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
                                      int* out_tree_per_iteration);
 
+/* Total number of weak models — trees — in the booster (reference
+ * LGBM_BoosterNumberOfTotalModel): iterations x trees-per-iteration. */
+int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle, int* out_models);
+
+/* Feature names the model was trained with (reference
+ * LGBM_BoosterGetFeatureNames).  Same fixed-buffer convention as
+ * LGBM_BoosterGetEvalNames / LGBM_DatasetGetFeatureNames here: the
+ * caller provides num_feature char* buffers of >=128 bytes; models
+ * without stored names get the canonical Column_<i>. */
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int* out_len,
+                                char** out_strs);
+
 /* Leaf-level access (reference LGBM_BoosterGetLeafValue/SetLeafValue).
  * SetLeafValue is the serving-side patch primitive: it updates BOTH the
  * in-memory tree used by every predict entry point and the stored model
@@ -116,6 +128,18 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
                               int is_row_major, int predict_type,
                               int num_iteration, const char* parameter,
                               int64_t* out_len, double* out_result);
+
+/* One-row prediction (reference LGBM_BoosterPredictForMatSingleRow):
+ * the stateless single-row spelling — per-call schema checks, no reuse
+ * handle.  Latency-sensitive callers should use the FastInit/Fast pair
+ * below, which pays validation once. */
+int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle,
+                                       const void* data, int data_type,
+                                       int ncol, int is_row_major,
+                                       int predict_type, int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len,
+                                       double* out_result);
 
 /* File-to-file prediction (reference c_api LGBM_BoosterPredictForFile /
  * src/application predictor.hpp): parse a delimited numeric data file

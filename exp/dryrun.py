@@ -93,17 +93,18 @@ def main(argv):
         rec["note"] = ("wrapper budget exceeded — the stage trail below "
                        "names the culprit")
 
-    # the rolling stage report survives any way the subprocess died
+    # the rolling stage report survives any way the subprocess died;
+    # the tolerant reader degrades a torn/missing file to "no trail"
     try:
-        with open(report_path) as fh:
-            stage_rep = json.load(fh)
-        rec["stages"] = stage_rep.get("stages", [])
-        rec["culprit_stage"] = stage_rep.get("culprit")
-        if stage_rep.get("tracebacks"):
-            rec["tracebacks"] = stage_rep["tracebacks"]
-    except (OSError, ValueError):
-        rec["stages"] = []
-        rec["culprit_stage"] = None
+        stage_rep = resilience.read_stage_report(report_path)
+        if stage_rep is not None:
+            rec["stages"] = stage_rep.get("stages", [])
+            rec["culprit_stage"] = stage_rep.get("culprit")
+            if stage_rep.get("tracebacks"):
+                rec["tracebacks"] = stage_rep["tracebacks"]
+        else:
+            rec["stages"] = []
+            rec["culprit_stage"] = None
     finally:
         try:
             os.unlink(report_path)

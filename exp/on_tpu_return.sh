@@ -101,6 +101,38 @@ os.environ.setdefault("BENCH_SERVE_SECONDS", "8")
 import bench
 print(json.dumps(bench.bench_serve(), indent=1))
 PYEOF3
+echo "=== 7a. live /metrics scrape during device serving (ISSUE 9) ==="
+echo "    (task=serve with metrics_port=0: drive a few hundred requests,"
+echo "     scrape the Prometheus endpoint, and print the serving-latency"
+echo "     quantiles the registry derived — the same numbers BENCH_SERVE"
+echo "     reports.  docs/OBSERVABILITY.md is the runbook.)"
+timeout 300 python - <<'PYEOF4' 2>&1 | tail -8
+import json, tempfile, threading, time, urllib.request
+import numpy as np
+import bench
+from lightgbm_tpu.runtime import publish as pubmod
+from lightgbm_tpu.runtime.serving import ServingRuntime
+
+with tempfile.TemporaryDirectory(prefix="metrics_scrape_") as d:
+    pub = pubmod.ModelPublisher(d + "/pub", keep_last=0)
+    pub.publish(bench.synth_serving_model(50, 31).save_model_to_string(),
+                meta={"cycle": 1})
+    rng = np.random.default_rng(5)
+    with ServingRuntime(publish_dir=d + "/pub", metrics_port=0) as rt:
+        lat = []
+        for _ in range(300):
+            t0 = time.perf_counter()
+            rt.predict(rng.standard_normal((8, 28)))
+            lat.append(time.perf_counter() - t0)
+        url = "http://127.0.0.1:%d/metrics" % rt.metrics_port
+        text = urllib.request.urlopen(url, timeout=10).read().decode()
+        assert "lgbm_serve_latency_seconds_bucket" in text
+        q = rt.stats()["latency_quantiles_s"]
+        print("scraped %d bytes from %s" % (len(text), url))
+        print("registry p50/p99: %.4fs / %.4fs  (client p50 %.4fs over "
+              "%d requests)" % (q["p50"], q["p99"],
+                                float(np.percentile(lat, 50)), len(lat)))
+PYEOF4
 echo "=== 7b. chaos-serve soak (device path under fault churn) ==="
 timeout 400 python exp/chaos_serve.py 8 /tmp/chaos_serve_tpu.json \
   || echo "chaos-serve soak FAILED on hardware — inspect /tmp/chaos_serve_tpu.json"

@@ -145,8 +145,10 @@ def main():
                               abs(ref_final - our_final), ATOL), flush=True)
 
     out_path = os.path.join(REPO, "docs", "PARITY_DEEP.json")
-    with open(out_path, "w") as fh:
-        json.dump(results, fh, indent=1)
+    # atomic like every other state/artifact JSON (ISSUE 9 satellite): a
+    # reader racing this write sees the old file or the new one, never half
+    from lightgbm_tpu.runtime.resilience import atomic_write
+    atomic_write(out_path, json.dumps(results, indent=1))
     print("wrote", out_path)
     ok = all(r["pass"] for r in results.values())
     print("PARITY_DEEP:", "PASS" if ok else "FAIL")

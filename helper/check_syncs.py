@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Blocking-fetch static lint (ISSUE 9 satellite).
+
+The sync-audit seam (`lightgbm_tpu/runtime/syncs.py`) is only a real
+instrument if every blocking device->host observation actually goes
+through it.  This lint pins that property statically for the four files
+the audit covers — `boosting/gbdt.py`, `basic.py`,
+`runtime/resilience.py`, `models/device_predictor.py`:
+
+1. no direct ``jax.device_get(...)`` / ``jax.block_until_ready(...)`` /
+   ``<x>.block_until_ready()`` / bare ``device_get(...)`` call — those
+   bypass the counters (``syncs.device_get`` is exempt: the seam itself);
+2. no ``np.asarray(...)`` / ``np.array(...)`` applied to an expression
+   that names a known device-resident source (the implicit-fetch
+   spelling of the same stall).  Static analysis cannot type arbitrary
+   expressions, so this arm matches a curated marker list — it is a
+   tripwire for the common regressions, not a proof;
+3. a known-legacy call site may be excused through the allowlist file
+   (``helper/check_syncs_allowlist.txt``: ``<basename>:<regex>`` lines)
+   so a deliberate exception is visible and reviewed, never silent.
+
+Run standalone (``python helper/check_syncs.py``; exit 1 on drift) or
+through the tier-1 pin in ``tests/test_check_syncs.py`` (which also
+pins that the lint CATCHES each violation class — the drift-detection
+negatives, same pattern as ``tests/test_check_abi.py``).
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+import tokenize
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "lightgbm_tpu")
+
+#: the audited files: everything the ISSUE-5 sync audit routed through
+#: the seam and must not regress out of it
+SCAN_FILES = (
+    os.path.join(PKG, "boosting", "gbdt.py"),
+    os.path.join(PKG, "basic.py"),
+    os.path.join(PKG, "runtime", "resilience.py"),
+    os.path.join(PKG, "models", "device_predictor.py"),
+)
+
+ALLOWLIST_PATH = os.path.join(REPO, "helper", "check_syncs_allowlist.txt")
+
+#: direct blocking-fetch spellings.  `syncs.device_get(` survives rule 3
+#: because the bare-name rule refuses a preceding ``.`` or word char.
+_DIRECT_RULES: Tuple[Tuple[str, re.Pattern], ...] = (
+    ("jax.device_get", re.compile(r"\bjax\.device_get\s*\(")),
+    ("jax.block_until_ready",
+     re.compile(r"\bjax\.block_until_ready\s*\(")),
+    ("method block_until_ready",
+     re.compile(r"\.block_until_ready\s*\(")),
+    ("bare device_get", re.compile(r"(?<![\w.])device_get\s*\(")),
+    ("bare block_until_ready",
+     re.compile(r"(?<![\w.])block_until_ready\s*\(")),
+)
+
+#: identifiers that are device-resident in the audited files; an
+#: np.asarray over one of these is an implicit blocking fetch
+_DEVICE_MARKERS = ("jnp.", "self.score", "eng.score", "engine.score",
+                   ".payload", "fs.aux", "leaf_out", "tree_dev")
+_NP_CAST = re.compile(r"\bnp\.(?:as)?array\s*\(")
+
+
+def load_allowlist(path: str = ALLOWLIST_PATH) -> List[Tuple[str, re.Pattern]]:
+    """``<basename>:<regex>`` entries; blank lines and # comments skipped."""
+    entries: List[Tuple[str, re.Pattern]] = []
+    try:
+        with open(path) as fh:
+            for raw in fh:
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                fname, _, pattern = line.partition(":")
+                entries.append((fname.strip(), re.compile(pattern.strip())))
+    except OSError:
+        pass
+    return entries
+
+
+def _allowed(fname: str, line: str,
+             allowlist: List[Tuple[str, re.Pattern]]) -> bool:
+    return any(f == fname and rx.search(line) for f, rx in allowlist)
+
+
+#: H2D upload spelling: jnp.asarray(np.asarray(host_data, ...)) moves
+#: bytes TOWARD the device — the opposite direction of the stall the
+#: lint hunts — and must not trip the np-cast rule
+_UPLOAD = re.compile(r"jnp\.(?:as)?array\(np\.")
+
+
+def _code_lines(path: str) -> Dict[int, str]:
+    """line number -> source with comments and string literals removed
+    (token-level, so docstrings mentioning device_get never match).
+    Tokens are joined bare — the rules' regexes are written for that."""
+    drop = {tokenize.COMMENT, tokenize.STRING, tokenize.NL,
+            tokenize.NEWLINE, tokenize.INDENT, tokenize.DEDENT,
+            tokenize.ENCODING, tokenize.ENDMARKER}
+    lines: Dict[int, List[str]] = {}
+    with open(path, "rb") as fh:
+        for tok in tokenize.tokenize(fh.readline):
+            if tok.type in drop:
+                continue
+            lines.setdefault(tok.start[0], []).append(tok.string)
+    out: Dict[int, str] = {}
+    for no, parts in lines.items():
+        joined = " ".join(parts)
+        # keep word boundaries between identifiers but re-fuse the
+        # attribute/call punctuation the rules' regexes expect
+        joined = re.sub(r"\s*\.\s*", ".", joined)
+        joined = re.sub(r"\s*\(\s*", "(", joined)
+        out[no] = joined
+    return out
+
+
+def scan_file(path: str,
+              allowlist: List[Tuple[str, re.Pattern]]) -> List[str]:
+    problems: List[str] = []
+    fname = os.path.basename(path)
+    with open(path) as fh:
+        raw_lines = fh.read().splitlines()
+    for no, code in sorted(_code_lines(path).items()):
+        raw = raw_lines[no - 1] if no <= len(raw_lines) else code
+        if "syncs." in code:
+            continue                    # routed through the seam
+        for label, rx in _DIRECT_RULES:
+            if rx.search(code):
+                if _allowed(fname, raw, allowlist):
+                    break
+                problems.append(
+                    "%s:%d: direct blocking fetch (%s) outside "
+                    "runtime/syncs.py: %s"
+                    % (fname, no, label, raw.strip()))
+                break
+        else:
+            if _NP_CAST.search(code) and not _UPLOAD.search(code) and \
+                    any(m in code for m in _DEVICE_MARKERS):
+                if not _allowed(fname, raw, allowlist):
+                    problems.append(
+                        "%s:%d: np.asarray over a device-resident source "
+                        "(implicit blocking fetch): %s"
+                        % (fname, no, raw.strip()))
+    return problems
+
+
+def run(files=SCAN_FILES, allowlist_path: str = ALLOWLIST_PATH) -> List[str]:
+    """Returns the list of drift problems (empty = clean)."""
+    allowlist = load_allowlist(allowlist_path)
+    problems: List[str] = []
+    for path in files:
+        if not os.path.exists(path):
+            problems.append("audited file missing: %s" % path)
+            continue
+        problems.extend(scan_file(path, allowlist))
+    return problems
+
+
+def main(argv=None) -> int:
+    problems = run()
+    print("check_syncs: scanned %d files, %d problem(s)"
+          % (len(SCAN_FILES), len(problems)))
+    for p in problems:
+        print("DRIFT: %s" % p)
+    if not problems:
+        print("check_syncs: OK")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

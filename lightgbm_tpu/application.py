@@ -23,7 +23,7 @@ from .config import Config
 from .engine import train as engine_train
 from .io.parser import load_sidecar, parse_file
 from .models.gbdt_model import GBDTModel
-from .runtime import resilience
+from .runtime import resilience, telemetry
 from .utils.log import LightGBMError, Log
 
 #: per-stage deadline for the CLI's ingest/save stages (seconds; 0
@@ -151,6 +151,11 @@ class Application:
                     GBDTModel.load_model(snap_path).save_model(output_model)
                     return
 
+        # $LGBM_TPU_METRICS_FILE: periodic atomic JSON-lines snapshots of
+        # the metrics registry (per-iteration timing, sync audit, spans)
+        # for batch runs that have no scrape endpoint (ISSUE 9)
+        telemetry.maybe_start_file_export("cli_train")
+
         wd = resilience.Watchdog(_INGEST_STAGE_TIMEOUT, hard=False,
                                  label="cli stage")
         from .io.dataset import BinnedDataset
@@ -238,10 +243,12 @@ class Application:
             Log.warning("Training preempted by signal %d at iteration %d; "
                         "snapshot %s written — rerun with resume=true to "
                         "continue", e.signum, e.iteration, e.snapshot)
+            telemetry.write_snapshot_now("cli_train_preempted")
             return
         with wd.stage_scope("save model (%s)" % output_model):
             booster.save_model(output_model)
         wd.done()
+        telemetry.write_snapshot_now("cli_train")
         Log.info("Finished training, model saved to %s", output_model)
 
     def train_online(self) -> None:
@@ -253,8 +260,9 @@ class Application:
         between cycles), `online_cycles` (total generations; 0 = run
         forever), `online_rounds`, `online_mode=boost|refit`,
         `online_window_rows`, `publish_retention`/`publish_grace`,
-        `snapshot_retention`/`snapshot_grace`.  See docs/RESILIENCE.md
-        for the runbook."""
+        `snapshot_retention`/`snapshot_grace`, `metrics_port` (live
+        GET /metrics endpoint — docs/OBSERVABILITY.md).  See
+        docs/RESILIENCE.md for the runbook."""
         from .runtime.continuous import ContinuousTrainer
         rc = ContinuousTrainer(dict(self.raw_params), log=Log).run()
         if rc != 0:
@@ -272,8 +280,10 @@ class Application:
         printed on stdout), `serve_host`, `serve_queue`,
         `serve_batch_rows`, `serve_batch_window`, `serve_deadline`,
         `predict_deadline`, `serve_poll_interval`, `breaker_cooldown`,
-        `serve_raw_score`.  SIGTERM/SIGINT stop cleanly with the final
-        stats on stderr.  See docs/SERVING.md for the runbook."""
+        `serve_raw_score`, `metrics_port` (GET /metrics Prometheus
+        endpoint; 0 = ephemeral, printed on stdout — see
+        docs/OBSERVABILITY.md).  SIGTERM/SIGINT stop cleanly with the
+        final stats on stderr.  See docs/SERVING.md for the runbook."""
         import signal as _signal
         import threading as _threading
 
@@ -283,7 +293,10 @@ class Application:
         input_model = params.pop("input_model", None)
         host = params.pop("serve_host", "127.0.0.1")
         port = int(params.pop("serve_port", 0) or 0)
+        metrics_port = params.pop("metrics_port", None)
         runtime = ServingRuntime(
+            metrics_port=int(metrics_port) if metrics_port is not None
+            else None,
             publish_dir=publish_dir, model_file=input_model,
             params=params,
             raw_score=str(params.pop("serve_raw_score", "")).lower()
@@ -317,6 +330,9 @@ class Application:
         # supervisors that asked for an ephemeral port
         print("serving %s on %s:%d" % (publish_dir or input_model,
                                        host, server.port), flush=True)
+        if runtime.metrics_port is not None:
+            print("metrics on %s:%d" % (host, runtime.metrics_port),
+                  flush=True)
         try:
             server.serve_forever(poll_interval=0.2)
         finally:

@@ -577,11 +577,18 @@ class Booster:
         self._model_version = getattr(self, "_model_version", 0) + 1
         guard = resilience.SentinelGuard(self._engine)
         try:
-            if fobj is not None:
-                grad, hess = fobj(self._engine.raw_train_score().reshape(-1),
-                                  self.train_set)
-                return self._engine.train_one_iter(grad, hess)
-            return self._engine.train_one_iter()
+            # observability seam (ISSUE 9): one iteration's wall time,
+            # the iteration counter, the per-iteration sync-audit gauges
+            # and the LGBM_TPU_PROFILE training hook — here because this
+            # is the one chokepoint EVERY boosting variant goes through
+            from .runtime import telemetry
+            with telemetry.train_iteration():
+                if fobj is not None:
+                    grad, hess = fobj(
+                        self._engine.raw_train_score().reshape(-1),
+                        self.train_set)
+                    return self._engine.train_one_iter(grad, hess)
+                return self._engine.train_one_iter()
         except resilience.NonFiniteDetected as e:
             # abort re-raises naming the iteration; rollback restores the
             # pre-iteration scores, drops the trees and reports finished

@@ -13,6 +13,7 @@ to the host, where the reference-format model is assembled.
 from __future__ import annotations
 
 import functools
+import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
@@ -26,7 +27,7 @@ from ..io.dataset import BinnedDataset
 from ..models.gbdt_model import GBDTModel
 from ..models.tree import Tree
 from ..ops.split import FeatureMeta
-from ..runtime import resilience, syncs
+from ..runtime import resilience, syncs, telemetry
 from ..utils import compat
 from ..utils.log import Log
 from ..utils.random import Random, partition_seed
@@ -1332,12 +1333,17 @@ class GBDT:
         if self._assembler is None:
             self._assembler = TreeAssembler(self._pipeline_depth)
         it = self.iter
+        t_dispatch = time.monotonic()
 
         def host_half():
             host = _fetch_packed(out, label="pipeline_drain")
             tree = self._finish_tree_host(host, init_score, lr)
             self.model.trees.append(tree)
             self._note_tree_drained(tree.num_leaves, it)
+            # dispatch-to-append latency of this tree's deferred host
+            # half: queue wait + packed fetch + Tree assembly (ISSUE 9)
+            telemetry.histogram("lgbm_pipeline_drain_seconds").observe(
+                time.monotonic() - t_dispatch)
 
         self._assembler.submit(host_half)
 

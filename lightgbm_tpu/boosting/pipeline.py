@@ -29,6 +29,8 @@ import collections
 import threading
 from typing import Callable, Deque, Optional
 
+from ..runtime import telemetry
+
 
 class TreeAssembler:
     """Bounded, strictly-ordered, single-worker deferred queue."""
@@ -62,6 +64,10 @@ class TreeAssembler:
                     err, self._error = self._error, None
                     raise err
             self._fifo.append(fn)
+            # live queue depth (ISSUE 9): how far the device is running
+            # ahead of the host model right now
+            telemetry.gauge("lgbm_pipeline_queue_depth").set(
+                len(self._fifo))
             if self._thread is None:
                 self._stopping = False
                 self._thread = threading.Thread(
@@ -86,6 +92,8 @@ class TreeAssembler:
                         self._error = e
             with self._cv:
                 self._fifo.popleft()
+                telemetry.gauge("lgbm_pipeline_queue_depth").set(
+                    len(self._fifo))
                 self._cv.notify_all()
 
     def flush(self) -> None:

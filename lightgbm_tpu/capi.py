@@ -447,6 +447,44 @@ class NativeBooster:
             self._handle, ctypes.byref(out)))
         return out.value
 
+    @property
+    def num_total_model(self) -> int:
+        """Total trees in the booster (LGBM_BoosterNumberOfTotalModel):
+        iterations x trees-per-iteration."""
+        out = ctypes.c_int(0)
+        _check(load_lib().LGBM_BoosterNumberOfTotalModel(
+            self._handle, ctypes.byref(out)))
+        return out.value
+
+    def feature_names(self) -> list:
+        """Model feature names (LGBM_BoosterGetFeatureNames; fixed
+        128-byte buffers like the eval-names convention); Column_<i>
+        when the model carries none."""
+        n = self.num_feature
+        bufs = [ctypes.create_string_buffer(128) for _ in range(n)]
+        arr = (ctypes.c_char_p * n)(
+            *[ctypes.cast(b, ctypes.c_char_p) for b in bufs])
+        out_n = ctypes.c_int(0)
+        _check(load_lib().LGBM_BoosterGetFeatureNames(
+            self._handle, ctypes.byref(out_n), arr))
+        return [bufs[i].value.decode() for i in range(out_n.value)]
+
+    def predict_single_row(self, row: np.ndarray, raw_score: bool = False,
+                           num_iteration: int = -1) -> np.ndarray:
+        """Stateless one-row prediction
+        (LGBM_BoosterPredictForMatSingleRow).  For hot serving loops use
+        FastSingleRowPredictor, which pays schema validation once."""
+        row = np.ascontiguousarray(row, dtype=np.float64).reshape(-1)
+        out = np.zeros(max(self.num_class, 1), dtype=np.float64)
+        out_len = ctypes.c_int64(0)
+        ptype = C_API_PREDICT_RAW_SCORE if raw_score else C_API_PREDICT_NORMAL
+        _check(load_lib().LGBM_BoosterPredictForMatSingleRow(
+            self._handle, row.ctypes.data_as(ctypes.c_void_p),
+            C_API_DTYPE_FLOAT64, ctypes.c_int(row.size), 1, ptype,
+            ctypes.c_int(num_iteration), b"", ctypes.byref(out_len),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        return out[: out_len.value]
+
     def predict_csr(self, indptr, indices, values, num_col: int,
                     raw_score: bool = False,
                     num_iteration: int = -1) -> np.ndarray:

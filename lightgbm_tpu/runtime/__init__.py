@@ -11,5 +11,12 @@ a serving runtime is actually being run.)
 """
 from . import publish  # noqa: F401
 from . import resilience  # noqa: F401
+from . import telemetry  # noqa: F401
 
-__all__ = ["resilience", "publish"]
+#: the observability surface (ISSUE 9): `from lightgbm_tpu.runtime import
+#: obs` is the supported spelling for metrics/span/exporter access —
+#: obs.REGISTRY, obs.span(...), obs.start_http_server(...),
+#: obs.METRIC_TABLE.
+obs = telemetry
+
+__all__ = ["resilience", "publish", "telemetry", "obs"]

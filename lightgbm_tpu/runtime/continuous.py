@@ -46,7 +46,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from . import publish, resilience, syncs
+from . import publish, resilience, syncs, telemetry
 from ..utils.log import LightGBMError, Log
 
 __all__ = ["ContinuousTrainer", "OnlineParams"]
@@ -78,6 +78,10 @@ class OnlineParams:
         self.snapshot_retention = int(p.pop("snapshot_retention", 4))
         self.snapshot_grace_s = float(p.pop("snapshot_grace", 30.0))
         self.stage_timeout = int(p.pop("online_stage_timeout", 600))
+        # metrics_port=N serves GET /metrics (Prometheus text) from the
+        # live trainer; 0 picks an ephemeral port (logged at start)
+        mp = p.pop("metrics_port", None)
+        self.metrics_port = int(mp) if mp is not None else None
         self.label_column = int(p.pop("label_column", p.pop("label", 0) or 0))
         self.has_header = str(p.pop("has_header", p.pop("header", ""))
                               ).lower() in ("true", "1") or None
@@ -242,6 +246,11 @@ class _IngestProducer(threading.Thread):
             "rows_per_sec": round(parsed / dt, 1) if dt > 0 else None,
             "window_rows": int(Xw.shape[0]),
         }
+        # the same ingest record feeds the live registry (ISSUE 9):
+        # rows/sec is the counter+histogram pair, the window a gauge
+        telemetry.counter("lgbm_ingest_rows_total").inc(parsed, mode=mode)
+        telemetry.histogram("lgbm_ingest_seconds").observe(dt)
+        telemetry.gauge("lgbm_ingest_window_rows").set(Xw.shape[0])
         self._ready.set()
 
     def run(self) -> None:
@@ -468,12 +477,21 @@ class ContinuousTrainer:
                                            log=self.log)
         producer = _IngestProducer(cfg, log=self.log)
         producer.start()
+        metrics_server = None
+        if cfg.metrics_port is not None:
+            metrics_server = telemetry.start_http_server(cfg.metrics_port)
+            self.log.info("online: serving /metrics on port %d",
+                          metrics_server.port)
+        telemetry.maybe_start_file_export("train_online")
         try:
             with guard:
                 return self._run_inner(guard, producer)
         finally:
             producer.stop()
             self.wd.done()
+            telemetry.write_snapshot_now("train_online")
+            if metrics_server is not None:
+                metrics_server.stop()
 
     def _run_inner(self, guard, producer) -> int:
         cfg = self.cfg
@@ -513,6 +531,8 @@ class ContinuousTrainer:
                 self._run_cycle(cycle, producer, guard)
             except resilience.StageTimeout as e:
                 self.timeouts += 1
+                telemetry.counter("lgbm_online_cycles_total").inc(
+                    status="timeout")
                 self.log.warning("online: %s — cycle %d will be retried at "
                                  "the next slot", e, cycle)
                 self.wd.annotate("retry", True)
@@ -588,6 +608,9 @@ class ContinuousTrainer:
             self._model_text(self._booster),
             meta=self._gen_meta(cycle, self._total_iter()),
             generation=cycle)
+        telemetry.histogram("lgbm_online_publish_seconds").observe(
+            time.monotonic() - t_pub)
+        telemetry.counter("lgbm_online_cycles_total").inc(status="ok")
         self.wd.annotate("publish_latency_s",
                          round(time.monotonic() - t_pub, 4))
         self.log.info("online: cycle %d published generation %d (%s)",

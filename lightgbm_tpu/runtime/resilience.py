@@ -64,7 +64,8 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = [
     "StageTimeout", "Watchdog", "wallclock",
     "probe_platform", "resolve_backend", "backoff_delays",
-    "atomic_write", "write_snapshot", "validate_snapshot",
+    "atomic_write", "read_stage_report", "write_snapshot",
+    "validate_snapshot",
     "load_snapshot_state", "find_resume_snapshot", "snapshot_paths",
     "capture_training_state", "restore_training_state",
     "make_resume_callback", "PreemptionGuard", "TrainingPreempted",
@@ -443,8 +444,20 @@ class Watchdog:
     # -- trail bookkeeping ---------------------------------------------------
     def _close_current(self, status: str) -> None:
         if self._t0 is not None and self.stages:
-            self.stages[-1]["dur_s"] = round(time.monotonic() - self._t0, 3)
+            dur = round(time.monotonic() - self._t0, 3)
+            self.stages[-1]["dur_s"] = dur
             self.stages[-1]["status"] = status
+            # every stage close is ALSO a span in the metrics registry
+            # (ISSUE 9): stages, spans and scraped metrics share one
+            # clock and one naming scheme.  Lazy import — telemetry
+            # imports helpers from THIS module at its module scope.
+            try:
+                from . import telemetry
+                telemetry.record_span(
+                    "%s/%s" % (self.label, self.stages[-1]["name"]),
+                    dur, status=status)
+            except Exception:            # noqa: BLE001 — never fatal
+                pass
         self._t0 = None
 
     def report(self) -> Dict[str, Any]:
@@ -726,6 +739,20 @@ def atomic_write(path: str, text: str) -> None:
         with contextlib.suppress(OSError):
             os.unlink(tmp)
         raise
+
+
+def read_stage_report(path: str) -> Optional[Dict[str, Any]]:
+    """Tolerant stage-trail reader for scrapers and artifact wrappers:
+    returns the report dict, or None for a missing, unreadable, torn or
+    non-JSON file.  Writers go through `atomic_write`, so a torn file
+    means a non-cooperating writer (or a dying filesystem) — the reader
+    must degrade to "no trail", never crash the post-mortem."""
+    try:
+        with open(path) as fh:
+            rep = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return rep if isinstance(rep, dict) else None
 
 
 _STATE_PREFIX = "!snapshot_state="

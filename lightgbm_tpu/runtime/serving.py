@@ -58,7 +58,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from . import publish, resilience
+from . import publish, resilience, telemetry
 from ..utils.log import Log
 
 __all__ = ["ServingRuntime", "ServingServer", "ServeRejected",
@@ -237,6 +237,7 @@ class ServingRuntime:
                  breaker_cooldown_s: float = 2.0,
                  probe_platform_on_start: bool = False,
                  report_path: Optional[str] = None,
+                 metrics_port: Optional[int] = None,
                  log=Log):
         """`publish_dir` subscribes the default model to a PR 6 publish
         directory; `models` maps model_id -> publish_dir for
@@ -305,6 +306,11 @@ class ServingRuntime:
         self._batcher: Optional[threading.Thread] = None
         self._poller: Optional[threading.Thread] = None
 
+        # live Prometheus endpoint (ISSUE 9): metrics_port=0 picks an
+        # ephemeral port, exposed via `metrics_port` after start()
+        self._metrics_port_req = metrics_port
+        self.metrics_server: Optional[telemetry.MetricsServer] = None
+
     # -- lifecycle -----------------------------------------------------------
     def __enter__(self) -> "ServingRuntime":
         return self.start()
@@ -316,6 +322,11 @@ class ServingRuntime:
         if self._started:
             return self
         self._started = True
+        if self._metrics_port_req is not None:
+            self.metrics_server = telemetry.start_http_server(
+                self._metrics_port_req)
+            self.log.info("serve: /metrics on port %d",
+                          self.metrics_server.port)
         with self._wd_lock:
             self.wd("start")
         if self.probe_platform_on_start:
@@ -369,6 +380,9 @@ class ServingRuntime:
                 t.join(timeout=5)
         with self._wd_lock:
             self.wd.done()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
 
     # -- model lifecycle -----------------------------------------------------
     def _swap_in(self, model_id: str, model_text: str, generation: int,
@@ -396,6 +410,7 @@ class ServingRuntime:
             self._entries[model_id] = entry
         with self._stats_lock:
             self._stats["swaps"] += 1
+        telemetry.counter("lgbm_serve_swaps_total").inc()
         with self._wd_lock:
             self.wd.annotate("last_swap", {
                 "model": model_id, "generation": generation,
@@ -429,6 +444,11 @@ class ServingRuntime:
         entry = self._entries.get(model_id)
         return entry.generation if entry is not None else None
 
+    @property
+    def metrics_port(self) -> Optional[int]:
+        """The live /metrics port (None unless metrics_port= was given)."""
+        return self.metrics_server.port if self.metrics_server else None
+
     # -- request surface -----------------------------------------------------
     def submit(self, data, deadline_s: Optional[float] = None,
                model_id: str = "default") -> _Request:
@@ -451,9 +471,11 @@ class ServingRuntime:
                 raise ServeRejected("queue_full", retryable=True,
                                     queue_depth=len(self._queue))
             self._queue.append(req)
+            depth = len(self._queue)
             self._cond.notify()
         with self._stats_lock:
             self._stats["admitted"] += 1
+        telemetry.gauge("lgbm_serve_queue_depth").set(depth)
         return req
 
     def predict(self, data, deadline_s: Optional[float] = None,
@@ -493,6 +515,7 @@ class ServingRuntime:
     def _count_rejection(self, reason: str) -> None:
         with self._stats_lock:
             self._stats["rejected"][reason] += 1
+        telemetry.counter("lgbm_serve_requests_total").inc(outcome=reason)
 
     def _next_batch(self) -> Optional[List[_Request]]:
         """Pop a batch of same-model requests: head-of-line model wins,
@@ -576,14 +599,27 @@ class ServingRuntime:
             self._stats["completed"] += len(batch)
             self._stats["batches_device" if served_by == "device"
                         else "batches_host"] += 1
+        telemetry.counter("lgbm_serve_rows_total").inc(int(X.shape[0]))
+        telemetry.counter("lgbm_serve_batches_total").inc(path=served_by)
+        telemetry.gauge("lgbm_serve_queue_depth").set(len(self._queue))
+        if served_by == "device":
+            # LGBM_TPU_PROFILE serving hook: the first M DEVICE batches
+            # land in one jax.profiler trace
+            telemetry.profile_hook("serve").tick()
+        lat_hist = telemetry.histogram("lgbm_serve_latency_seconds")
+        completed = telemetry.counter("lgbm_serve_requests_total")
         s = 0
         for req in batch:
             e = s + req.n_rows
+            latency = round(now - req.enqueued, 6)
             req.result = ServeResult(values[s:e], entry.generation,
-                                     model_id, served_by,
-                                     round(now - req.enqueued, 6))
+                                     model_id, served_by, latency)
             req.done.set()
             s = e
+            # the registry histogram IS the serving latency ledger: the
+            # /metrics quantiles and BENCH_SERVE's p50/p99 both read it
+            lat_hist.observe(latency, model=model_id)
+            completed.inc(outcome="completed")
 
     # -- device path + circuit breaker ---------------------------------------
     def _spawn_executor(self) -> _DeviceExecutor:
@@ -648,6 +684,7 @@ class ServingRuntime:
         self.recovery_events.append(event)
         with self._stats_lock:
             self._stats["recoveries"] += 1
+        telemetry.counter("lgbm_serve_recoveries_total").inc()
         with self._wd_lock:
             self.wd.annotate("recovery_event", event)
         self.log.warning("serve: device path recovered (probe ok); "
@@ -668,6 +705,7 @@ class ServingRuntime:
         self.degradation_events.append(event)
         with self._stats_lock:
             self._stats["degradations"] += 1
+        telemetry.counter("lgbm_serve_degradations_total").inc()
         with self._wd_lock:
             if timed_out:
                 # hung dispatch: the trail gets the timeout status AND
@@ -691,6 +729,16 @@ class ServingRuntime:
         st["recovery_events"] = list(self.recovery_events)
         if self.start_degradation is not None:
             st["start_degradation"] = self.start_degradation
+        # the registry histogram is the latency ledger: the same numbers
+        # a /metrics scrape (and BENCH_SERVE) reads
+        hist = telemetry.histogram("lgbm_serve_latency_seconds")
+        hstate = hist.state()
+        st["latency_quantiles_s"] = {
+            "p50": hist.quantile(0.5, state=hstate),
+            "p95": hist.quantile(0.95, state=hstate),
+            "p99": hist.quantile(0.99, state=hstate),
+            "count": hstate["count"],
+        }
         return st
 
 

@@ -31,6 +31,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, Optional
 
+from . import telemetry
+
 _lock = threading.Lock()
 _counts: Dict[str, int] = {}
 _critical_counts: Dict[str, int] = {}
@@ -69,6 +71,9 @@ def record(label: str) -> None:
         if crit:
             _critical_counts[label] = _critical_counts.get(label, 0) + 1
             _critical_total += 1
+    # the same event feeds the process-wide metrics registry (ISSUE 9),
+    # so a live /metrics scrape sees the sync profile the bench pins
+    telemetry.count_sync(label, crit)
 
 
 def device_get(x: Any, label: str = "host_fetch") -> Any:

@@ -1,0 +1,923 @@
+"""Unified observability subsystem (ISSUE 9): metrics, spans, exporters.
+
+Before this module the runtime's telemetry was five disconnected ad-hoc
+surfaces — resilience stage trails, the sync-audit counters, serving
+degradation events, bench phase telemetry and the chaos-soak ledgers —
+none of which could be scraped from a live ``task=serve`` or
+``task=train_online`` process.  This module is the one instrument panel
+they all now feed:
+
+* **Metrics registry** (`MetricsRegistry` / the process-global
+  `REGISTRY`): counters, gauges, and bounded-memory streaming histograms
+  with p50/p95/p99 exact to within one bucket of the FIXED bucket layout
+  (`Histogram.quantile`).  Label cardinality is bounded per family: past
+  `max_label_sets` distinct label sets, new ones land in an explicit
+  ``__overflow__`` bucket instead of growing without bound.  Every
+  product metric must be declared in `METRIC_TABLE` — the single source
+  of truth the docs/OBSERVABILITY.md catalog is test-pinned against
+  (same pattern as `resilience.FAULT_TABLE`).
+
+* **Span tracing** (`span` / `record_span`): named wall-clock spans
+  recorded into ``lgbm_span_seconds{span=...}`` /
+  ``lgbm_spans_total{span=...,status=...}``.  The PR 4 stage-trail
+  watchdog is a CLIENT of this API — every stage close lands here too
+  (digit runs normalized to ``N`` so per-cycle/per-batch stage names do
+  not explode cardinality), so stages, spans and metrics share one
+  clock (`resilience.wallclock`) and one naming scheme.
+
+* **Exporters** — three ways out of the process:
+  1. `MetricsServer` / ``metrics_port=``: a Prometheus text-exposition
+     HTTP endpoint (``GET /metrics``; ``/metrics.json`` returns the JSON
+     snapshot; ``/healthz``) served from `ServingRuntime` and the
+     continuous trainer.
+  2. ``$LGBM_TPU_METRICS_FILE``: a periodic ATOMIC JSON-lines snapshot
+     file for batch CLI/bench runs (each flush rewrites the whole file
+     tmp+fsync+rename, so a scraper never reads a torn line).
+  3. ``LGBM_TPU_PROFILE=<dir>``: wraps the first N training iterations
+     or M serving batches in a ``jax.profiler`` trace
+     (`profile_hook`), N/M via ``LGBM_TPU_PROFILE_ITERS`` /
+     ``LGBM_TPU_PROFILE_BATCHES``.
+
+The hot-loop contract: every instrument checks the module-level enable
+flag first, so with `set_enabled(False)` the whole subsystem costs one
+global read + a returned call per site (the BENCH ``telemetry`` section
+asserts the disabled path stays under 1% of an iteration).
+
+No jax / numpy at module scope — the hermetic dryrun bootstrap, the CLI
+entry and platform-free subscribers must be able to import this.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import math
+import os
+import re
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .resilience import atomic_write, wallclock
+
+__all__ = [
+    "METRIC_TABLE", "LATENCY_BUCKETS_S", "OVERFLOW_LABEL",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+    "set_enabled", "enabled", "counter", "gauge", "histogram",
+    "span", "record_span", "normalize_span_name", "count_sync",
+    "MetricsServer", "start_http_server",
+    "MetricsFileWriter", "maybe_start_file_export", "write_snapshot_now",
+    "snapshot", "render_prometheus", "profile_hook", "reset",
+]
+
+#: the fixed latency/duration bucket layout (seconds).  Quantiles read
+#: from these histograms are exact to within one bucket width — the
+#: serving acceptance gate compares them against client-side wall-clock
+#: measurements at exactly that tolerance.
+LATENCY_BUCKETS_S: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, math.inf)
+
+#: every label of an over-cardinality label set is rewritten to this
+#: value — overload is visible as an explicit bucket, never as silent
+#: unbounded growth or a dropped sample.
+OVERFLOW_LABEL = "__overflow__"
+
+#: THE metric registry: every product metric, its type, its label names
+#: and its one-line meaning.  docs/OBSERVABILITY.md's catalog table is
+#: pinned row-for-row against this dict (tests/test_telemetry.py), so
+#: the docs and the registry cannot drift — the FAULT_TABLE pattern.
+METRIC_TABLE: Dict[str, Dict[str, Any]] = {
+    "lgbm_train_iterations_total": {
+        "type": "counter", "labels": (),
+        "help": "Completed Booster.update calls (all boosting variants)"},
+    "lgbm_train_iteration_seconds": {
+        "type": "histogram", "labels": (),
+        "help": "Wall time of one boosting iteration (dispatch-side; at "
+                "pipeline_depth>0 host assembly drains off this clock)"},
+    "lgbm_train_host_syncs_per_iter": {
+        "type": "gauge", "labels": ("path",),
+        "help": "Blocking host fetches recorded during the last "
+                "iteration, path=total/critical (sync-audit seam)"},
+    "lgbm_host_syncs_total": {
+        "type": "counter", "labels": ("label",),
+        "help": "Blocking device->host syncs through runtime/syncs.py, "
+                "by call-site label"},
+    "lgbm_host_syncs_critical_total": {
+        "type": "counter", "labels": ("label",),
+        "help": "Sync-audit events recorded ON the tree->tree critical "
+                "path (pinned 0 at pipeline_depth=1 fused fast path)"},
+    "lgbm_pipeline_queue_depth": {
+        "type": "gauge", "labels": (),
+        "help": "Host halves pending-or-running in the async tree "
+                "assembler (bounded at pipeline_depth)"},
+    "lgbm_pipeline_drain_seconds": {
+        "type": "histogram", "labels": (),
+        "help": "Dispatch-to-append latency of one tree's deferred host "
+                "half (queue wait + packed fetch + Tree assembly)"},
+    "lgbm_ingest_rows_total": {
+        "type": "counter", "labels": ("mode",),
+        "help": "Rows parsed by ingest, mode=full_parse/tail_append/"
+                "binary_cache/file_parse"},
+    "lgbm_ingest_seconds": {
+        "type": "histogram", "labels": (),
+        "help": "Wall time of one ingest pass (parse or cache load)"},
+    "lgbm_ingest_window_rows": {
+        "type": "gauge", "labels": (),
+        "help": "Rows currently staged in the online rolling window"},
+    "lgbm_online_cycles_total": {
+        "type": "counter", "labels": ("status",),
+        "help": "Continuous-training cycles, status=ok/timeout"},
+    "lgbm_online_publish_seconds": {
+        "type": "histogram", "labels": (),
+        "help": "Atomic model publish latency per cycle"},
+    "lgbm_serve_latency_seconds": {
+        "type": "histogram", "labels": ("model",),
+        "help": "Per-request serving latency, admission to completion "
+                "(drives BENCH_SERVE's p50/p99)"},
+    "lgbm_serve_requests_total": {
+        "type": "counter", "labels": ("outcome",),
+        "help": "Serving requests by outcome: completed, or the shed "
+                "reason (queue_full/deadline_exceeded/no_model/shutdown)"},
+    "lgbm_serve_rows_total": {
+        "type": "counter", "labels": (),
+        "help": "Feature rows served (completed requests only)"},
+    "lgbm_serve_batches_total": {
+        "type": "counter", "labels": ("path",),
+        "help": "Micro-batches served, path=device/host (host = degraded)"},
+    "lgbm_serve_queue_depth": {
+        "type": "gauge", "labels": (),
+        "help": "Admission queue depth sampled at the last submit/batch"},
+    "lgbm_serve_swaps_total": {
+        "type": "counter", "labels": (),
+        "help": "Hot model swaps (new generation loaded + prewarmed)"},
+    "lgbm_serve_degradations_total": {
+        "type": "counter", "labels": (),
+        "help": "Circuit-breaker trips device->host"},
+    "lgbm_serve_recoveries_total": {
+        "type": "counter", "labels": (),
+        "help": "Probe-based recoveries host->device"},
+    "lgbm_span_seconds": {
+        "type": "histogram", "labels": ("span",),
+        "help": "Named span durations (watchdog stage closes land here; "
+                "digit runs in names normalized to N)"},
+    "lgbm_spans_total": {
+        "type": "counter", "labels": ("span", "status"),
+        "help": "Span completions by status=ok/error/timeout"},
+}
+
+# ---------------------------------------------------------------------------
+# enable flag (the hot-loop gate)
+# ---------------------------------------------------------------------------
+
+_enabled = True
+
+
+def set_enabled(on: bool) -> bool:
+    """Flip the whole subsystem; returns the previous state.  Disabled,
+    every instrument call is one global read + an early return."""
+    global _enabled
+    prev = _enabled
+    _enabled = bool(on)
+    return prev
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+# ---------------------------------------------------------------------------
+# instruments
+# ---------------------------------------------------------------------------
+
+class _Family:
+    """One metric family: name + label names + children per label set.
+    Children are created lazily under the lock; past `max_label_sets`
+    distinct sets, the overflow child absorbs new ones."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, labels: Tuple[str, ...],
+                 max_label_sets: int, registry: "MetricsRegistry",
+                 buckets: Tuple[float, ...] = LATENCY_BUCKETS_S):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(labels)
+        self.max_label_sets = max_label_sets
+        self._registry = registry
+        self._buckets = buckets
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], Any] = {}
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                "metric %s takes labels %r, got %r"
+                % (self.name, self.label_names, tuple(labels)))
+        return tuple(str(labels[n]) for n in self.label_names)
+
+    def _child(self, labels: Dict[str, str]):
+        key = self._key(labels)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                if (len(self._children) >= self.max_label_sets
+                        and self.label_names):
+                    key = (OVERFLOW_LABEL,) * len(self.label_names)
+                    child = self._children.get(key)
+                    if child is not None:
+                        return child
+                child = self._new_child()
+                self._children[key] = child
+            return child
+
+    def _new_child(self):
+        raise NotImplementedError
+
+    def items(self) -> List[Tuple[Tuple[str, ...], Any]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._children.clear()
+
+
+class _CounterChild:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+
+class Counter(_Family):
+    kind = "counter"
+
+    def _new_child(self) -> _CounterChild:
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if not _enabled:
+            return
+        child = self._child(labels)
+        with self._lock:
+            child.value += amount
+            self._registry.ops += 1
+
+    def value(self, **labels: str) -> float:
+        child = self._children.get(self._key(labels))
+        return child.value if child is not None else 0.0
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(c.value for c in self._children.values())
+
+
+class _GaugeChild:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+
+class Gauge(_Family):
+    kind = "gauge"
+
+    def _new_child(self) -> _GaugeChild:
+        return _GaugeChild()
+
+    def set(self, value: float, **labels: str) -> None:
+        if not _enabled:
+            return
+        child = self._child(labels)
+        with self._lock:
+            child.value = float(value)
+            self._registry.ops += 1
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if not _enabled:
+            return
+        child = self._child(labels)
+        with self._lock:
+            child.value += amount
+            self._registry.ops += 1
+
+    def value(self, **labels: str) -> float:
+        child = self._children.get(self._key(labels))
+        return child.value if child is not None else 0.0
+
+
+class _HistChild:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * n_buckets     # per-bucket (non-cumulative)
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Family):
+    """Bounded-memory streaming histogram: one int per fixed bucket plus
+    sum/count.  `quantile(q)` is exact to within one bucket width —
+    inside the resolved bucket it interpolates linearly (the Prometheus
+    ``histogram_quantile`` rule), and values past the largest finite
+    edge report that edge."""
+
+    kind = "histogram"
+
+    def _new_child(self) -> _HistChild:
+        return _HistChild(len(self._buckets))
+
+    @property
+    def buckets(self) -> Tuple[float, ...]:
+        return self._buckets
+
+    def observe(self, value: float, **labels: str) -> None:
+        if not _enabled:
+            return
+        child = self._child(labels)
+        i = 0
+        b = self._buckets
+        while value > b[i]:               # last bucket is +Inf: always stops
+            i += 1
+        with self._lock:
+            child.counts[i] += 1
+            child.sum += value
+            child.count += 1
+            self._registry.ops += 1
+
+    # -- read side -----------------------------------------------------------
+    def state(self, **labels: str) -> Dict[str, Any]:
+        """Aggregated (counts, sum, count) — over ALL label sets when no
+        labels are given.  A copyable snapshot: diff two of these to
+        scope quantiles to a measurement window (bench does)."""
+        with self._lock:
+            if labels:
+                child = self._children.get(self._key(labels))
+                children = [child] if child is not None else []
+            else:
+                children = list(self._children.values())
+            counts = [0] * len(self._buckets)
+            total, cnt = 0.0, 0
+            for c in children:
+                for i, v in enumerate(c.counts):
+                    counts[i] += v
+                total += c.sum
+                cnt += c.count
+        return {"buckets": list(self._buckets), "counts": counts,
+                "sum": total, "count": cnt}
+
+    def quantile(self, q: float, state: Optional[Dict[str, Any]] = None,
+                 **labels: str) -> Optional[float]:
+        st = state if state is not None else self.state(**labels)
+        return quantile_from_state(st, q)
+
+    def bucket_width_at(self, value: float) -> float:
+        """Width of the bucket `value` falls in — the quantile error
+        bound at that point (the +Inf bucket reports the last finite
+        width)."""
+        b = self._buckets
+        i = 0
+        while value > b[i]:
+            i += 1
+        if math.isinf(b[i]):
+            i = len(b) - 2
+        lo = b[i - 1] if i > 0 else 0.0
+        return b[i] - lo
+
+
+def state_delta(after: Dict[str, Any], before: Dict[str, Any]
+                ) -> Dict[str, Any]:
+    """Histogram movement between two `Histogram.state()` snapshots."""
+    return {
+        "buckets": list(after["buckets"]),
+        "counts": [a - b for a, b in zip(after["counts"], before["counts"])],
+        "sum": after["sum"] - before["sum"],
+        "count": after["count"] - before["count"],
+    }
+
+
+def quantile_from_state(state: Dict[str, Any], q: float) -> Optional[float]:
+    """The q-quantile of a histogram state (None when empty): resolve
+    the bucket holding rank q*count, interpolate linearly inside it."""
+    count = state["count"]
+    if count <= 0:
+        return None
+    rank = q * count
+    b = state["buckets"]
+    seen = 0
+    for i, c in enumerate(state["counts"]):
+        if seen + c >= rank and c > 0:
+            lo = b[i - 1] if i > 0 else 0.0
+            hi = b[i]
+            if math.isinf(hi):
+                return lo if i > 0 else None
+            frac = (rank - seen) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        seen += c
+    # rank beyond the recorded mass (q=1.0 edge): largest finite edge hit
+    for i in range(len(b) - 1, -1, -1):
+        if state["counts"][i] > 0:
+            return b[i] if not math.isinf(b[i]) else b[i - 1]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+class MetricsRegistry:
+    """Name -> instrument map over a declaration table.  Undeclared
+    names raise — the docs drift lint is only complete if every product
+    metric is table-declared."""
+
+    _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+    def __init__(self, table: Optional[Dict[str, Dict[str, Any]]] = None,
+                 max_label_sets: int = 64):
+        self.table = METRIC_TABLE if table is None else table
+        self.max_label_sets = int(max_label_sets)
+        self.ops = 0                       # recorded-op count (bench A/B)
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _family(self, name: str, kind: str) -> _Family:
+        fam = self._families.get(name)
+        if fam is not None:
+            if fam.kind != kind:
+                raise ValueError("metric %s is a %s, not a %s"
+                                 % (name, fam.kind, kind))
+            return fam
+        decl = self.table.get(name)
+        if decl is None:
+            raise KeyError(
+                "metric %r is not declared in METRIC_TABLE — declare it "
+                "(and document it in docs/OBSERVABILITY.md) first" % name)
+        if decl["type"] != kind:
+            raise ValueError("metric %s is declared as a %s, not a %s"
+                             % (name, decl["type"], kind))
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._KINDS[kind](
+                    name, decl["help"], tuple(decl["labels"]),
+                    self.max_label_sets, self,
+                    buckets=tuple(decl.get("buckets", LATENCY_BUCKETS_S)))
+                self._families[name] = fam
+        return fam
+
+    def counter(self, name: str) -> Counter:
+        return self._family(name, "counter")            # type: ignore
+
+    def gauge(self, name: str) -> Gauge:
+        return self._family(name, "gauge")              # type: ignore
+
+    def histogram(self, name: str) -> Histogram:
+        return self._family(name, "histogram")          # type: ignore
+
+    def families(self) -> List[_Family]:
+        with self._lock:
+            return [self._families[n] for n in sorted(self._families)]
+
+    def reset(self) -> None:
+        """Drop every recorded value (tests / bench sections).  The
+        declaration table is untouched."""
+        with self._lock:
+            fams = list(self._families.values())
+            self.ops = 0
+        for fam in fams:
+            fam.clear()
+
+    # -- export --------------------------------------------------------------
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        out: List[str] = []
+        for fam in self.families():
+            out.append("# HELP %s %s" % (fam.name, _esc_help(fam.help)))
+            out.append("# TYPE %s %s" % (fam.name, fam.kind))
+            for key, child in fam.items():
+                lbl = _label_str(fam.label_names, key)
+                if fam.kind == "histogram":
+                    cum = 0
+                    for i, edge in enumerate(fam.buckets):   # type: ignore
+                        cum += child.counts[i]
+                        le = "+Inf" if math.isinf(edge) else _fmt(edge)
+                        out.append('%s_bucket%s %d' % (
+                            fam.name,
+                            _label_str(fam.label_names + ("le",),
+                                       key + (le,), raw_last=True), cum))
+                    out.append("%s_sum%s %s" % (fam.name, lbl,
+                                                _fmt(child.sum)))
+                    out.append("%s_count%s %d" % (fam.name, lbl,
+                                                  child.count))
+                else:
+                    out.append("%s%s %s" % (fam.name, lbl,
+                                            _fmt(child.value)))
+        return "\n".join(out) + "\n"
+
+    def snapshot(self, context: Optional[str] = None) -> Dict[str, Any]:
+        """JSON-able dump of everything recorded (one snapshot-file line)."""
+        metrics: Dict[str, Any] = {}
+        for fam in self.families():
+            series = []
+            for key, child in fam.items():
+                entry: Dict[str, Any] = {
+                    "labels": dict(zip(fam.label_names, key))}
+                if fam.kind == "histogram":
+                    entry.update({
+                        "count": child.count, "sum": round(child.sum, 9),
+                        "counts": list(child.counts)})
+                    for qn, q in (("p50", 0.5), ("p95", 0.95),
+                                  ("p99", 0.99)):
+                        v = quantile_from_state(
+                            {"buckets": fam.buckets,      # type: ignore
+                             "counts": child.counts, "sum": child.sum,
+                             "count": child.count}, q)
+                        entry[qn] = None if v is None else round(v, 9)
+                else:
+                    entry["value"] = child.value
+                series.append(entry)
+            metrics[fam.name] = {"type": fam.kind, "series": series}
+        snap = {"wallclock": wallclock(), "pid": os.getpid(),
+                "metrics": metrics}
+        if context:
+            snap["context"] = context
+        return snap
+
+
+def _esc_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _esc_label(s: str) -> str:
+    return (s.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _label_str(names: Tuple[str, ...], values: Tuple[str, ...],
+               raw_last: bool = False) -> str:
+    if not names:
+        return ""
+    parts = []
+    for i, (n, v) in enumerate(zip(names, values)):
+        if raw_last and i == len(names) - 1:
+            parts.append('%s="%s"' % (n, v))
+        else:
+            parts.append('%s="%s"' % (n, _esc_label(v)))
+    return "{%s}" % ",".join(parts)
+
+
+#: the process-global registry every product instrument records into
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str) -> Counter:
+    return REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return REGISTRY.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return REGISTRY.histogram(name)
+
+
+def snapshot(context: Optional[str] = None) -> Dict[str, Any]:
+    return REGISTRY.snapshot(context)
+
+
+def render_prometheus() -> str:
+    return REGISTRY.render_prometheus()
+
+
+def reset() -> None:
+    REGISTRY.reset()
+
+
+def count_sync(label: str, critical: bool) -> None:
+    """Sync-audit bridge (called by runtime/syncs.record for every
+    blocking host fetch)."""
+    if not _enabled:
+        return
+    REGISTRY.counter("lgbm_host_syncs_total").inc(label=label)
+    if critical:
+        REGISTRY.counter("lgbm_host_syncs_critical_total").inc(label=label)
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+_DIGITS = re.compile(r"\d+")
+
+
+def normalize_span_name(name: str, max_len: int = 80) -> str:
+    """Digit runs -> ``N`` and a hard length cap, so per-cycle /
+    per-batch stage names ("cycle 17: train", "batch ... rows=512")
+    collapse to a bounded family of span names."""
+    return _DIGITS.sub("N", name)[:max_len]
+
+
+def record_span(name: str, dur_s: float, status: str = "ok") -> None:
+    """One completed span on the shared clock.  The stage-trail watchdog
+    calls this at every stage close."""
+    if not _enabled:
+        return
+    key = normalize_span_name(name)
+    REGISTRY.histogram("lgbm_span_seconds").observe(max(dur_s, 0.0),
+                                                    span=key)
+    REGISTRY.counter("lgbm_spans_total").inc(span=key, status=status)
+
+
+@contextlib.contextmanager
+def span(name: str):
+    """Context-manager span: records duration + ok/error status."""
+    t0 = time.monotonic()
+    try:
+        yield
+    except BaseException:
+        record_span(name, time.monotonic() - t0, status="error")
+        raise
+    record_span(name, time.monotonic() - t0, status="ok")
+
+
+# ---------------------------------------------------------------------------
+# per-iteration training instrumentation (the Booster.update seam)
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def train_iteration():
+    """Wraps one boosting iteration: wall time into the iteration
+    histogram, the iteration counter, the per-iteration sync-audit
+    gauges (total + critical path), and the training profiler hook."""
+    if not _enabled:
+        yield
+        return
+    from . import syncs
+    profile_hook("train").tick()
+    s0 = syncs.snapshot()
+    t0 = time.monotonic()
+    yield
+    dt = time.monotonic() - t0
+    d = syncs.delta(s0)
+    REGISTRY.histogram("lgbm_train_iteration_seconds").observe(dt)
+    REGISTRY.counter("lgbm_train_iterations_total").inc()
+    g = REGISTRY.gauge("lgbm_train_host_syncs_per_iter")
+    g.set(d["total"], path="total")
+    g.set(d["critical_path"], path="critical")
+
+
+# ---------------------------------------------------------------------------
+# HTTP exporter (GET /metrics)
+# ---------------------------------------------------------------------------
+
+class MetricsServer:
+    """Prometheus scrape endpoint over the stdlib HTTP server.  Serves
+    ``/metrics`` (text exposition), ``/metrics.json`` (snapshot) and
+    ``/healthz``; runs on a daemon thread, `stop()` shuts it down."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: Optional[MetricsRegistry] = None):
+        import http.server
+
+        reg = registry if registry is not None else REGISTRY
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:            # noqa: N802 — stdlib API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = reg.render_prometheus().encode("utf-8")
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/metrics.json":
+                    body = (json.dumps(reg.snapshot())
+                            + "\n").encode("utf-8")
+                    ctype = "application/json"
+                elif path == "/healthz":
+                    body = b"ok\n"
+                    ctype = "text/plain"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args) -> None:
+                pass                              # scrapes are not stderr news
+
+        class _Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self.registry = reg
+        self._httpd = _Server((host, int(port)), _Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            name="lgbm-metrics-http", daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def start_http_server(port: int = 0, host: str = "127.0.0.1",
+                      registry: Optional[MetricsRegistry] = None
+                      ) -> MetricsServer:
+    return MetricsServer(port=port, host=host, registry=registry)
+
+
+# ---------------------------------------------------------------------------
+# JSON-lines snapshot file ($LGBM_TPU_METRICS_FILE)
+# ---------------------------------------------------------------------------
+
+METRICS_FILE_ENV = "LGBM_TPU_METRICS_FILE"
+METRICS_INTERVAL_ENV = "LGBM_TPU_METRICS_INTERVAL"
+
+#: snapshot lines kept per file (the file is a rolling window, not an
+#: unbounded log; each flush rewrites it atomically)
+SNAPSHOT_KEEP_LAST = 256
+
+
+class MetricsFileWriter:
+    """Periodic atomic JSON-lines snapshots for batch runs.  Every flush
+    rewrites the WHOLE file via tmp+fsync+rename (`atomic_write`), so a
+    concurrent scraper reads either the previous window or the new one,
+    never a torn line — plain append could tear mid-line."""
+
+    def __init__(self, path: str, interval_s: float = 30.0,
+                 context: Optional[str] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        self.path = path
+        self.interval_s = float(interval_s)
+        self.context = context
+        self.registry = registry if registry is not None else REGISTRY
+        self._lines: "collections.deque[str]" = collections.deque(
+            maxlen=SNAPSHOT_KEEP_LAST)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if self.interval_s > 0:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="lgbm-metrics-file",
+                                            daemon=True)
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.write_now()
+            except OSError:
+                pass                    # export must never take the run down
+
+    def write_now(self, context: Optional[str] = None) -> None:
+        """Append one snapshot line and atomically rewrite the file."""
+        snap = self.registry.snapshot(context or self.context)
+        with self._lock:
+            self._lines.append(json.dumps(snap))
+            atomic_write(self.path, "\n".join(self._lines) + "\n")
+
+    def stop(self, final_flush: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if final_flush:
+            try:
+                self.write_now()
+            except OSError:
+                pass
+
+
+_file_writer: Optional[MetricsFileWriter] = None
+_file_writer_lock = threading.Lock()
+
+
+def maybe_start_file_export(context: Optional[str] = None
+                            ) -> Optional[MetricsFileWriter]:
+    """Start (once per process) the periodic snapshot writer when
+    ``$LGBM_TPU_METRICS_FILE`` is set; interval from
+    ``$LGBM_TPU_METRICS_INTERVAL`` (seconds, default 30).  Returns the
+    writer, or None when the env var is unset."""
+    global _file_writer
+    path = os.environ.get(METRICS_FILE_ENV)
+    if not path:
+        return None
+    with _file_writer_lock:
+        if _file_writer is None or _file_writer.path != path:
+            interval = float(os.environ.get(METRICS_INTERVAL_ENV, "30"))
+            _file_writer = MetricsFileWriter(path, interval_s=interval,
+                                             context=context)
+    return _file_writer
+
+
+def write_snapshot_now(context: Optional[str] = None) -> Optional[str]:
+    """One-shot snapshot flush (CLI/bench exit paths): writes through
+    the active writer, creating one (interval 0 = no background thread)
+    if the env var is set and none exists.  Returns the path written."""
+    writer = maybe_start_file_export(context)
+    if writer is None:
+        return None
+    writer.write_now(context)
+    return writer.path
+
+
+# ---------------------------------------------------------------------------
+# device-profiler hook (LGBM_TPU_PROFILE=<dir>)
+# ---------------------------------------------------------------------------
+
+PROFILE_ENV = "LGBM_TPU_PROFILE"
+PROFILE_ITERS_ENV = "LGBM_TPU_PROFILE_ITERS"
+PROFILE_BATCHES_ENV = "LGBM_TPU_PROFILE_BATCHES"
+
+
+class _ProfilerHook:
+    """Wraps the first N ticks (training iterations or serving batches)
+    of the process in ONE ``jax.profiler`` trace written under
+    ``$LGBM_TPU_PROFILE/<kind>``.  One-shot per kind per process;
+    anything raising inside the profiler disables the hook with a
+    warning — profiling is diagnostics, never a crash source."""
+
+    def __init__(self, kind: str, limit_env: str, default_limit: int):
+        self.kind = kind
+        self.dir = os.environ.get(PROFILE_ENV) or None
+        self.limit = int(os.environ.get(limit_env, default_limit)) \
+            if self.dir else 0
+        self.ticks = 0
+        self.active = False
+        self.done = self.dir is None
+        self._lock = threading.Lock()
+
+    def tick(self) -> None:
+        if self.done:
+            return
+        with self._lock:
+            if self.done:
+                return
+            try:
+                if not self.active:
+                    import jax
+                    out = os.path.join(self.dir, self.kind)
+                    os.makedirs(out, exist_ok=True)
+                    jax.profiler.start_trace(out)
+                    self.active = True
+                    sys.stderr.write(
+                        "[%s] telemetry: jax.profiler trace started for "
+                        "%d %s ticks -> %s\n"
+                        % (wallclock(), self.limit, self.kind, out))
+                self.ticks += 1
+                if self.ticks >= self.limit:
+                    import jax
+                    jax.profiler.stop_trace()
+                    self.active = False
+                    self.done = True
+                    sys.stderr.write(
+                        "[%s] telemetry: jax.profiler trace closed after "
+                        "%d %s ticks\n" % (wallclock(), self.ticks,
+                                           self.kind))
+            except Exception as e:       # noqa: BLE001 — diagnostics only
+                self.done = True
+                self.active = False
+                sys.stderr.write(
+                    "[%s] telemetry WARNING: profiler hook disabled "
+                    "(%s: %s)\n" % (wallclock(), type(e).__name__, e))
+
+
+_hooks: Dict[str, _ProfilerHook] = {}
+_hooks_lock = threading.Lock()
+
+
+def profile_hook(kind: str) -> _ProfilerHook:
+    """The per-process profiler hook for `kind` ("train" ticks per
+    boosting iteration, "serve" per device micro-batch)."""
+    hook = _hooks.get(kind)
+    if hook is None:
+        with _hooks_lock:
+            hook = _hooks.get(kind)
+            if hook is None:
+                env, dflt = ((PROFILE_ITERS_ENV, 5) if kind == "train"
+                             else (PROFILE_BATCHES_ENV, 20))
+                hook = _ProfilerHook(kind, env, dflt)
+                _hooks[kind] = hook
+    return hook
+
+
+def _reset_profile_hooks() -> None:
+    """Test seam: re-read the profiler environment."""
+    with _hooks_lock:
+        _hooks.clear()

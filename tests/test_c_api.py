@@ -444,3 +444,46 @@ def test_leaf_value_get_set_and_num_model_per_iteration(capi, tmp_path):
                 rounds=3)
     nbm, _ = _roundtrip(capi, bm, X, tmp_path, "leafk")
     assert nbm.num_model_per_iteration == 3
+
+
+def test_total_model_feature_names_single_row(capi, tmp_path):
+    """ISSUE 9 ABI satellite: LGBM_BoosterNumberOfTotalModel,
+    LGBM_BoosterGetFeatureNames and LGBM_BoosterPredictForMatSingleRow
+    — totals/names agree with the Python Booster, the single-row entry
+    agrees bit-for-bit with the batch entry for normal AND raw output,
+    both for binary and multiclass."""
+    rng = np.random.default_rng(41)
+    X = rng.standard_normal((300, 5))
+    y = (X[:, 0] - 0.3 * X[:, 2] > 0).astype(float)
+    bst = _train({"objective": "binary"}, X, y, rounds=6)
+    nb, _ = _roundtrip(capi, bst, X, tmp_path, "tot")
+    assert nb.num_total_model == 6
+    # default names round-trip as the canonical Column_<i>
+    assert nb.feature_names() == ["Column_%d" % i for i in range(5)]
+    for r in (0, 17, 299):
+        np.testing.assert_allclose(nb.predict_single_row(X[r]),
+                                   nb.predict(X[r:r + 1]),
+                                   rtol=0, atol=0)
+        np.testing.assert_allclose(
+            nb.predict_single_row(X[r], raw_score=True),
+            nb.predict(X[r:r + 1], raw_score=True), rtol=0, atol=0)
+        np.testing.assert_allclose(nb.predict_single_row(X[r]),
+                                   bst.predict(X[r:r + 1]),
+                                   rtol=0, atol=1e-15)
+
+    # stored names survive the C surface
+    ds = lgb.Dataset(X, label=y,
+                     feature_name=["f%d" % i for i in range(5)])
+    bstn = lgb.train({"objective": "binary", "verbose": -1,
+                      "num_leaves": 7}, ds, num_boost_round=2)
+    nbn = capi.NativeBooster(model_str=bstn.model_to_string())
+    assert nbn.feature_names() == ["f%d" % i for i in range(5)]
+
+    # multiclass: K values per row, total trees = iters * K
+    ym = rng.integers(0, 3, 300)
+    bm = _train({"objective": "multiclass", "num_class": 3}, X, ym,
+                rounds=2)
+    nbm, _ = _roundtrip(capi, bm, X, tmp_path, "totk")
+    assert nbm.num_total_model == 6
+    np.testing.assert_allclose(nbm.predict_single_row(X[3]),
+                               nbm.predict(X[3:4])[0], rtol=0, atol=0)

@@ -1,0 +1,103 @@
+"""Blocking-fetch lint pin (ISSUE 9 satellite, helper/check_syncs.py).
+
+The sync audit's tier-1 pin (0 critical-path fetches at
+pipeline_depth=1) is only meaningful while every blocking fetch goes
+through runtime/syncs.py — these tests pin that the audited files are
+currently clean AND that the lint actually catches each drift mode
+(the test_check_abi.py pattern)."""
+import os
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "helper"))
+
+import check_syncs  # noqa: E402
+
+
+def test_syncs_lint_is_clean():
+    problems = check_syncs.run()
+    assert problems == [], "\n".join(problems)
+
+
+def _copy_of(src_name, tmp_path):
+    src = dict(zip((os.path.basename(p) for p in check_syncs.SCAN_FILES),
+                   check_syncs.SCAN_FILES))[src_name]
+    dst = str(tmp_path / src_name)
+    shutil.copy(src, dst)
+    return dst
+
+
+def test_lint_catches_direct_device_get(tmp_path):
+    """A jax.device_get creeping back into gbdt.py must be flagged."""
+    dst = _copy_of("gbdt.py", tmp_path)
+    with open(dst, "a") as fh:
+        fh.write("\n\ndef _sneaky(x):\n    import jax\n"
+                 "    return jax.device_get(x)\n")
+    problems = check_syncs.run(files=(dst,))
+    assert any("jax.device_get" in p for p in problems), problems
+
+
+def test_lint_catches_method_block_until_ready(tmp_path):
+    dst = _copy_of("basic.py", tmp_path)
+    with open(dst, "a") as fh:
+        fh.write("\n\ndef _sneaky2(arr):\n"
+                 "    return arr.block_until_ready()\n")
+    problems = check_syncs.run(files=(dst,))
+    assert any("block_until_ready" in p for p in problems), problems
+
+
+def test_lint_catches_np_asarray_of_device_source(tmp_path):
+    """The implicit-fetch spelling: np.asarray over a device-resident
+    marker (e.g. the engine's score plane) must be flagged."""
+    dst = _copy_of("gbdt.py", tmp_path)
+    with open(dst, "a") as fh:
+        fh.write("\n\ndef _sneaky3(self):\n"
+                 "    return np.asarray(self.score)\n")
+    problems = check_syncs.run(files=(dst,))
+    assert any("np.asarray" in p and "device-resident" in p
+               for p in problems), problems
+
+
+def test_lint_ignores_docstrings_and_seam_calls(tmp_path):
+    """Mentions inside strings/comments and calls routed through
+    syncs.* must NOT be flagged (the audited files are full of both)."""
+    dst = _copy_of("device_predictor.py", tmp_path)
+    with open(dst, "a") as fh:
+        fh.write('\n\ndef _fine(x):\n'
+                 '    """uses jax.device_get( internally, via the '
+                 'seam"""\n'
+                 '    # jax.block_until_ready( would be wrong here\n'
+                 '    from lightgbm_tpu.runtime import syncs\n'
+                 '    return syncs.device_get(x, label="fine")\n')
+    problems = check_syncs.run(files=(dst,))
+    assert problems == [], problems
+
+
+def test_allowlist_excuses_a_reviewed_legacy_site(tmp_path):
+    """An allowlisted (file, regex) pair must excuse exactly that line
+    and nothing else."""
+    dst = _copy_of("gbdt.py", tmp_path)
+    with open(dst, "a") as fh:
+        fh.write("\n\ndef _legacy(x):\n    import jax\n"
+                 "    return jax.device_get(x)  # reviewed-legacy\n"
+                 "\n\ndef _not_legacy(x):\n    import jax\n"
+                 "    return jax.device_get(x)  # new drift\n")
+    allow = tmp_path / "allow.txt"
+    allow.write_text("# one reviewed exception\n"
+                     "gbdt.py:reviewed-legacy\n")
+    problems = check_syncs.run(files=(dst,),
+                               allowlist_path=str(allow))
+    assert len(problems) == 1 and "new drift" in problems[0], problems
+
+
+def test_upload_direction_is_not_flagged(tmp_path):
+    """jnp.asarray(np.asarray(host)) is H2D — the opposite direction —
+    and must pass."""
+    dst = _copy_of("gbdt.py", tmp_path)
+    with open(dst, "a") as fh:
+        fh.write("\n\ndef _upload(grad, K, n):\n"
+                 "    return jnp.asarray(np.asarray(grad, np.float32)"
+                 ".reshape(K, n))\n")
+    problems = check_syncs.run(files=(dst,))
+    assert problems == [], problems
