@@ -46,6 +46,7 @@ FALLBACK_SECTION_ENV = (
     "BENCH_SERVE_TREES", "BENCH_SERVE_LEAVES", "BENCH_SERVE_BATCH",
     "BENCH_INGEST", "BENCH_INGEST_ROWS",
     "BENCH_TELEMETRY", "BENCH_TELEMETRY_ROWS", "BENCH_TELEMETRY_ITERS",
+    "BENCH_ATTRIB", "BENCH_ATTRIB_ITERS",
 )
 
 #: most recent bench measured on REAL TPU hardware (updated by hand after
@@ -597,6 +598,96 @@ def bench_telemetry():
     return rec
 
 
+def bench_attrib(bst, measure_iters):
+    """BENCH_ATTRIB: device-time and cost attribution (ISSUE 10) — the
+    decomposition `vs_baseline` was missing.  Per iteration on the SAME
+    warm booster: dispatch wall (update() returns after the async
+    dispatch), device wait (block_until_ready of the training state),
+    and the pipeline drain (packed fetch + host assembly, from the PR 9
+    drain histogram); plus the compile ledger's verdicts — a
+    steady-state zero-retrace pin over the measured window (a violation
+    names the site and shape delta) and per-site compile-time totals
+    with `cost_analysis()` FLOPs/bytes captured for the window's sites.
+    BENCH_ATTRIB_ITERS reshapes it."""
+    import jax
+    from lightgbm_tpu.runtime import telemetry, xla_obs
+
+    eng = bst._engine
+    eng.flush()
+    fs = getattr(eng, "_fast", None)
+    iters = int(os.environ.get("BENCH_ATTRIB_ITERS",
+                               max(min(measure_iters, 6), 2)))
+    drain_h = telemetry.histogram("lgbm_pipeline_drain_seconds")
+    d0 = drain_h.state()
+    c0 = xla_obs.snapshot()
+    xla_obs.mark_steady(True)
+    dispatch_s = device_s = 0.0
+    try:
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            bst.update()
+            t1 = time.perf_counter()
+            state = fs.payload if fs is not None \
+                else getattr(eng, "score", None)
+            if state is not None:
+                jax.block_until_ready(state)
+            t2 = time.perf_counter()
+            dispatch_s += t1 - t0
+            device_s += t2 - t1
+        eng.flush()
+    finally:
+        xla_obs.mark_steady(False)
+    retraces = xla_obs.delta(c0)
+    drain = telemetry.state_delta(drain_h.state(), d0)
+
+    # cost capture: ONE extra iteration with lower().compile() capture on
+    # (per-site, first unseen signature only) — FLOPs/bytes per program
+    prev = xla_obs.set_cost_capture(True)
+    try:
+        bst.update()
+        eng.flush()
+    finally:
+        xla_obs.set_cost_capture(prev)
+
+    ledger = xla_obs.LEDGER
+    sites = []
+    for name in ledger.site_names():
+        rec = ledger.register(name)
+        if rec.compiles == 0 and not rec.cost:
+            continue
+        entry = {"site": name, "compiles": rec.compiles,
+                 "compile_seconds": round(rec.compile_seconds, 4)}
+        if rec.cost:
+            entry["cost_analysis"] = {
+                k: rec.cost[k] for k in ("flops", "bytes accessed")
+                if k in rec.cost}
+        sites.append(entry)
+    sites.sort(key=lambda e: -e["compile_seconds"])
+    total = dispatch_s + device_s
+    return {
+        "iters": iters,
+        "per_iter": {
+            "dispatch_s": round(dispatch_s / iters, 5),
+            "device_wait_s": round(device_s / iters, 5),
+            "drain_s": round(drain["sum"] / iters, 5),
+            "drains": drain["count"],
+        },
+        "device_share": round(device_s / total, 4) if total > 0 else None,
+        "steady_state_retraces": retraces,
+        "compile": {
+            "total_compiles": ledger.total_compiles(),
+            "compile_seconds_total": round(sum(
+                e["compile_seconds"] for e in sites), 3),
+            "sites": sites[:12],
+        },
+        "note": "dispatch = update() wall (async dispatch); device_wait "
+                "= block_until_ready of the training state after it; "
+                "drain = packed fetch + host tree assembly off the "
+                "critical path; steady_state_retraces must be {} — a "
+                "violation names the site and shape delta",
+    }
+
+
 #: per-flag verdicts from the staged-kernel probe (None = probe not run);
 #: recorded in the bench JSON so an unattended hardware window leaves
 #: evidence for the human flip (exp/flip_validated.py)
@@ -912,6 +1003,22 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
             phases = {"error": "%s: %s" % (type(e).__name__, e)}
             stage("phases FAILED (diagnostics only): %s" % phases["error"])
 
+    # compile/device/fetch attribution (BENCH_ATTRIB=0 skips): the ISSUE
+    # 10 decomposition + steady-state zero-retrace pin on the warm
+    # booster.  Guarded — a failure is recorded, never fatal.
+    attrib_rec = None
+    if os.environ.get("BENCH_ATTRIB", "1") != "0":
+        try:
+            attrib_rec = bench_attrib(bst, measure_iters)
+            stage("attrib done (device share %s, %s steady retraces)"
+                  % (attrib_rec["device_share"],
+                     len(attrib_rec["steady_state_retraces"])))
+        except Exception as e:
+            attrib_rec = {"error": "%s: %s" % (type(e).__name__, e),
+                          "note": "attrib failed; headline result above "
+                                  "is unaffected"}
+            stage("attrib FAILED (diagnostics only)")
+
     # quantized-gradient A/B (BENCH_HIST_QUANT=int8|int16): same data and
     # config with gradient_quantization on — reports the per-dispatch
     # grad/hess bytes reduction, the quantized-vs-f32 held-out AUC delta
@@ -1078,6 +1185,8 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
         result["degradation_event"] = json.loads(deg)
     if pipeline_rec is not None:
         result["pipeline"] = pipeline_rec
+    if attrib_rec is not None:
+        result["attrib"] = attrib_rec
     if predict_rec is not None:
         result["predict"] = predict_rec
     if online_rec is not None:

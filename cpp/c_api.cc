@@ -1071,4 +1071,25 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
   return 0;
 }
 
+int LGBM_BoosterPredictForCSRSingleRow(BoosterHandle handle,
+                                       const void* indptr, int indptr_type,
+                                       const int32_t* indices,
+                                       const void* data, int data_type,
+                                       int64_t nindptr, int64_t nelem,
+                                       int64_t num_col, int predict_type,
+                                       int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len,
+                                       double* out_result) {
+  if (nindptr != 2)
+    return Fail("PredictForCSRSingleRow takes exactly one row "
+                "(nindptr must be 2, got " + std::to_string(nindptr) + ")");
+  // the batch entry point's per-row inner loop IS the single-row path
+  // (dense scatter + PredictRow); nothing cheaper exists to delegate to
+  return LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices,
+                                   data, data_type, nindptr, nelem, num_col,
+                                   predict_type, num_iteration, parameter,
+                                   out_len, out_result);
+}
+
 }  // extern "C"

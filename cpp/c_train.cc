@@ -38,6 +38,9 @@ using lgbm_tpu_internal::SetLastError;
 struct TrainDataset {
   const uint32_t magic = kTrainDatasetMagic;
   PyObject* ds = nullptr;  // lightgbm_tpu.Dataset
+  // GetField contract: the returned pointer stays valid until the next
+  // GetField on this handle (or DatasetFree) — the bytes live here
+  std::string field_buf;
 };
 
 struct TrainBooster {
@@ -89,6 +92,49 @@ def dataset_from_mat(mv, dtype_code, nrow, ncol, is_row_major, params, ref):
 def dataset_set_field(ds, name, mv, dtype_code):
     dt = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}[dtype_code]
     ds.set_field(name, np.frombuffer(mv, dtype=dt).copy())
+
+
+def dataset_get_field(ds, name):
+    ds.construct()
+    v = ds.get_field(name)
+    if v is None:
+        raise KeyError('field %r is not set on this dataset' % name)
+    v = np.asarray(v)
+    if name in ('group', 'query'):
+        # reference GetField('group') returns CUMULATIVE query
+        # boundaries (num_queries + 1 int32), not the sizes SetField took
+        v = np.concatenate([[0], np.cumsum(v.astype(np.int64))]) \
+            .astype(np.int32)
+        code = 2
+    elif name == 'init_score':
+        v = np.ascontiguousarray(v, dtype=np.float64).reshape(-1)
+        code = 1
+    else:
+        v = np.ascontiguousarray(v, dtype=np.float32).reshape(-1)
+        code = 0
+    return (v.tobytes(), code, int(v.size))
+
+
+def dataset_feature_num_bin(ds, i):
+    ds.construct()
+    mappers = ds.binned.bin_mappers
+    if i < 0 or i >= len(mappers):
+        raise IndexError('feature index %d out of range (%d features)'
+                         % (i, len(mappers)))
+    return int(mappers[i].num_bin)
+
+
+def dataset_from_mats(mvs, dtype_code, nrows, ncol, is_row_major, params,
+                      ref):
+    dt = np.float32 if dtype_code == 0 else np.float64
+    parts = []
+    for mv, nr in zip(mvs, nrows):
+        a = np.frombuffer(mv, dtype=dt)
+        a = a.reshape(nr, ncol) if is_row_major else a.reshape(ncol, nr).T
+        parts.append(np.array(a, copy=True))
+    X = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+    return lgb.Dataset(X, reference=ref, params=_params(params),
+                       free_raw_data=False)
 
 
 def _as_np(mv, dtype_code, count):
@@ -799,6 +845,90 @@ int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
   if (r == nullptr) return -1;
   Py_DECREF(r);
   return 0;
+}
+
+int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
+                         int* out_len, const void** out_ptr,
+                         int* out_type) {
+  PyScope py;
+  if (!py.ok) return -1;
+  TrainDataset* d = AsDataset(handle);
+  if (d == nullptr) {
+    SetLastError("not a dataset handle");
+    return -1;
+  }
+  PyObject* r = CallHelper(
+      "dataset_get_field",
+      Py_BuildValue("(Os)", d->ds, field_name ? field_name : ""));
+  if (r == nullptr) return -1;
+  PyObject* bytes_obj = PyTuple_GetItem(r, 0);
+  char* buf = nullptr;
+  Py_ssize_t nbytes = 0;
+  if (PyBytes_AsStringAndSize(bytes_obj, &buf, &nbytes) != 0) {
+    Py_DECREF(r);
+    return FailPy("LGBM_DatasetGetField");
+  }
+  d->field_buf.assign(buf, static_cast<size_t>(nbytes));
+  *out_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  *out_len = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 2)));
+  *out_ptr = d->field_buf.data();
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetFeatureNumBin(DatasetHandle handle, int feature_idx,
+                                 int32_t* out) {
+  PyScope py;
+  if (!py.ok) return -1;
+  TrainDataset* d = AsDataset(handle);
+  if (d == nullptr) {
+    SetLastError("not a dataset handle");
+    return -1;
+  }
+  PyObject* r = CallHelper("dataset_feature_num_bin",
+                           Py_BuildValue("(Oi)", d->ds, feature_idx));
+  if (r == nullptr) return -1;
+  *out = static_cast<int32_t>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data,
+                               int data_type, int32_t* nrow, int32_t ncol,
+                               int is_row_major, const char* parameters,
+                               DatasetHandle reference,
+                               DatasetHandle* out) {
+  PyScope py;
+  if (!py.ok) return -1;
+  if (nmat <= 0 || data == nullptr || nrow == nullptr) {
+    SetLastError("LGBM_DatasetCreateFromMats needs nmat > 0 blocks");
+    return -1;
+  }
+  if (!CheckFloatCode(data_type, "data_type")) return -1;
+  Py_ssize_t esz = DTypeSize(data_type);
+  PyObject* mvs = PyList_New(0);
+  PyObject* rows = PyList_New(0);
+  for (int32_t i = 0; i < nmat; ++i) {
+    PyObject* mv = MemView(
+        data[i], static_cast<Py_ssize_t>(nrow[i]) * ncol * esz);
+    if (mv == nullptr) {
+      Py_DECREF(mvs);
+      Py_DECREF(rows);
+      return FailPy("LGBM_DatasetCreateFromMats");
+    }
+    PyList_Append(mvs, mv);
+    Py_DECREF(mv);
+    PyObject* n = PyLong_FromLong(nrow[i]);
+    PyList_Append(rows, n);
+    Py_DECREF(n);
+  }
+  TrainDataset* ref = AsDataset(reference);
+  PyObject* r = CallHelper(
+      "dataset_from_mats",
+      Py_BuildValue("(NiNiisO)", mvs, data_type, rows, ncol, is_row_major,
+                    parameters ? parameters : "",
+                    ref ? ref->ds : Py_None));
+  return WrapNewDataset(r, out);
 }
 
 int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out) {

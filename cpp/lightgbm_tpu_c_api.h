@@ -187,6 +187,22 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
                               const char* parameter, int64_t* out_len,
                               double* out_result);
 
+/* Single-row CSR fast path (reference PredictForCSRSingleRow): same
+ * contract as PredictForCSR with nindptr == 2.  The dense scatter a
+ * one-row CSR needs is already the per-row inner loop of the batch
+ * entry point, so this delegates (the Fast-config mat trio is the
+ * latency-optimized single-row path). */
+int LGBM_BoosterPredictForCSRSingleRow(BoosterHandle handle,
+                                       const void* indptr, int indptr_type,
+                                       const int32_t* indices,
+                                       const void* data, int data_type,
+                                       int64_t nindptr, int64_t nelem,
+                                       int64_t num_col, int predict_type,
+                                       int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len,
+                                       double* out_result);
+
 /* ---- training surface (embedded-engine; reference c_api.h:48-460) ----
  * parameters strings use the reference's "key=value key2=value2" form.
  * If the package is not importable from the default sys.path, set
@@ -270,6 +286,31 @@ int LGBM_DatasetGetFeatureNames(DatasetHandle handle, char** feature_names,
 /* field_name: label / weight / init_score / group (reference SetField). */
 int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
                          const void* field_data, int num_element, int type);
+
+/* Generic field getter (reference GetField).  *out_ptr points at a
+ * buffer owned by the dataset handle, valid until the next GetField
+ * call on the same handle or DatasetFree.  *out_type is a C_API_DTYPE_*
+ * code: label/weight -> float32, init_score -> float64, group -> int32
+ * CUMULATIVE query boundaries (num_queries + 1 entries — the
+ * reference's query_boundaries_ layout, not the sizes SetField takes). */
+int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
+                         int* out_len, const void** out_ptr,
+                         int* out_type);
+
+/* Bin count of one feature after construction (reference
+ * LGBM_DatasetGetFeatureNumBin; extension relative to the canonical
+ * 58-point parity list in helper/check_abi.py). */
+int LGBM_DatasetGetFeatureNumBin(DatasetHandle handle, int feature_idx,
+                                 int32_t* out);
+
+/* Concatenate nmat row-major (or column-major) blocks sharing ncol into
+ * one dataset (reference CreateFromMats): data[i] is an nrow[i] x ncol
+ * block of data_type. */
+int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data,
+                               int data_type, int32_t* nrow, int32_t ncol,
+                               int is_row_major, const char* parameters,
+                               DatasetHandle reference,
+                               DatasetHandle* out);
 
 int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out);
 

@@ -72,8 +72,14 @@ def main(argv):
     # -- 2. the dryrun itself, stage-watchdogged ----------------------------
     report_path = os.path.join(tempfile.gettempdir(),
                                "lgbm_tpu_dryrun_stages_%d.json" % os.getpid())
+    metrics_path = os.path.join(tempfile.gettempdir(),
+                                "lgbm_tpu_dryrun_metrics_%d.jsonl"
+                                % os.getpid())
     env = dict(os.environ)
     env["LGBM_TPU_STAGE_REPORT"] = report_path
+    # mesh metrics block (ISSUE 10): the dryrun child flushes its
+    # registry here; the artifact embeds the {host}-labeled merge
+    env["LGBM_TPU_METRICS_FILE"] = metrics_path
     if degradation is not None:
         # belt-and-braces: never let a child of THIS wrapper bind the
         # platform the probe just watched die
@@ -110,6 +116,44 @@ def main(argv):
             os.unlink(report_path)
         except OSError:
             pass
+
+    # per-host metrics block: the child's last registry snapshot, merged
+    # through the same {host}-labeling path a real multi-host gather uses
+    try:
+        from lightgbm_tpu.runtime import telemetry
+        with open(metrics_path) as fh:
+            lines = [ln for ln in fh.read().splitlines() if ln.strip()]
+        if lines:
+            snap = json.loads(lines[-1])
+            hosts = ({"0": snap} if "metrics" in snap
+                     and "hosts" not in snap else None)
+            rec["host_metrics"] = (telemetry.merge_host_snapshots(hosts)
+                                   if hosts is not None else snap)
+    except (OSError, ValueError):
+        pass
+    finally:
+        try:
+            os.unlink(metrics_path)
+        except OSError:
+            pass
+
+    if not rec["ok"]:
+        # a red artifact ships home WITH its evidence: the doctor bundle
+        # (probe already recorded above, so probe=False) lands next to
+        # the artifact and its manifest rides inside the artifact
+        try:
+            from lightgbm_tpu.runtime.doctor import collect_debug_bundle
+            bundle = collect_debug_bundle(
+                out_dir=os.path.dirname(os.path.abspath(artifact)) or ".",
+                tag="dryrun", probe=False,
+                stage_reports=[report_path], artifact_dir=REPO,
+                note="attached by exp/dryrun.py on rc=%s" % rec["rc"])
+            rec["debug_bundle"] = {"path": bundle["path"],
+                                   "manifest": bundle["manifest"]}
+        except Exception as e:   # noqa: BLE001 — artifact must still land
+            rec["debug_bundle"] = {"error": "%s: %s"
+                                   % (type(e).__name__, e)}
+
     rec["elapsed_s"] = round(time.monotonic() - t0, 1)
     rec["within_budget"] = rec["elapsed_s"] <= budget
     resilience.atomic_write(artifact, json.dumps(rec, indent=1) + "\n")

@@ -145,3 +145,22 @@ import json
 import bench
 print(json.dumps(bench.bench_ingest(), indent=1))
 PYEOF5
+echo "=== 9. device-time attribution + doctor bundle (ISSUE 10) ==="
+echo "    (BENCH_ATTRIB on a warm 2M-row booster: compile/dispatch/device/"
+echo "     fetch decomposition + the steady-state zero-retrace pin, with"
+echo "     per-site cost_analysis FLOPs/bytes.  Read it as: device share"
+echo "     low -> dispatch/fetch bound (pipeline + CHUNK levers); high ->"
+echo "     kernel bound (staged kernels); any retrace -> fix shape"
+echo "     bucketing FIRST.  docs/OBSERVABILITY.md 'Attribution workflow'.)"
+BENCH_ROWS=2000000 BENCH_ITERS=8 BENCH_PREDICT=0 BENCH_ONLINE=0 \
+  BENCH_SERVE=0 BENCH_INGEST=0 BENCH_TELEMETRY=0 BENCH_HIST_QUANT=0 \
+  timeout 900 python bench.py > /tmp/bench_attrib_tpu.json \
+  && python -c "import json; d=json.load(open('/tmp/bench_attrib_tpu.json')); print(json.dumps(d.get('attrib'), indent=1))" \
+  || echo "   attrib bench FAILED — /tmp/bench_attrib_tpu.json (or stderr above) has the stage trail"
+echo "    collate the round trajectory (flags >10% regressions vs best prior):"
+timeout 60 python helper/bench_history.py || echo "   REGRESSION flagged — read the table above before shipping this round"
+echo "    one-command debug bundle: ships probe + env + trails + metrics +"
+echo "    compile ledger + newest artifacts; COMMIT the printed manifest"
+echo "    line with the round's artifacts so the window leaves evidence"
+timeout 120 python -m lightgbm_tpu task=doctor output_dir=exp/logs 2>&1 | head -3 \
+  || echo "   doctor FAILED — collect /tmp manually"

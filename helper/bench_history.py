@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Bench-trajectory collator (ISSUE 10 satellite).
+
+Five ``BENCH_r*.json`` driver artifacts sit at the repo root, yet the
+round reports kept describing an "empty bench trajectory" — nothing
+collated them.  This tool turns the committed artifacts into one
+trajectory table (iters/sec, vs_baseline, per-section rows/sec) and
+flags any round that regressed more than ``REGRESSION_THRESHOLD``
+against the best PRIOR round measured at the same shape — cross-scale
+comparisons (a 2M-row CPU round vs a 200k-row fallback round) are
+meaningless and are never compared.
+
+Artifact shape: the driver wraps each round's bench stdout as
+``{"n": round, "rc": ..., "parsed": <bench JSON>, "tail": ...}``; when
+``parsed`` is missing the last JSON-looking line of ``tail`` is tried.
+
+Run standalone (``python helper/bench_history.py``; exit 1 when a
+regression is flagged) or through the tier-1 pin in
+``tests/test_bench_history.py`` (committed r01–r05 fixtures collate
+clean; synthetic drops ARE flagged)."""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: a round is flagged when its value drops more than this fraction below
+#: the best prior same-shape round
+REGRESSION_THRESHOLD = 0.10
+
+#: (series name, path into the parsed bench JSON, shape-key paths —
+#: values compare only between rounds whose shape keys all match)
+SERIES: Tuple[Tuple[str, Tuple[str, ...], Tuple[Tuple[str, ...], ...]], ...] = (
+    ("iters_per_sec", ("value",),
+     (("n_rows",), ("platform",))),
+    ("vs_baseline", ("vs_baseline",),
+     (("n_rows",), ("platform",))),
+    ("predict_rows_per_sec", ("predict", "engine_rows_per_sec"),
+     (("predict", "rows"), ("predict", "n_trees"))),
+    ("serve_rows_per_sec", ("serve", "rows_per_sec"),
+     (("serve", "n_trees"), ("serve", "clients"))),
+    ("ingest_push_rows_per_sec", ("ingest", "dense_push_rows_per_sec"),
+     (("ingest", "rows"),)),
+    ("online_cycles_per_sec", ("online", "cycles_per_sec"),
+     (("online", "rows"), ("online", "cycles"))),
+)
+
+
+def _get(d: Any, path: Tuple[str, ...]) -> Optional[Any]:
+    for k in path:
+        if not isinstance(d, dict) or k not in d:
+            return None
+        d = d[k]
+    return d
+
+
+def _parse_artifact(path: str) -> Optional[Dict[str, Any]]:
+    """One round's parsed bench JSON, or None when the round left no
+    usable record (red round: rc != 0 and nothing parsed)."""
+    try:
+        with open(path) as fh:
+            art = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    parsed = art.get("parsed")
+    if isinstance(parsed, dict) and "value" in parsed:
+        out = dict(parsed)
+        out["_round"] = int(art.get("n", 0))
+        out["_rc"] = art.get("rc")
+        return out
+    # fall back: last {...} line of the captured tail
+    for line in reversed((art.get("tail") or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                out = json.loads(line)
+            except ValueError:
+                continue
+            if "value" in out:
+                out["_round"] = int(art.get("n", 0))
+                out["_rc"] = art.get("rc")
+                return out
+    return None
+
+
+def load_rounds(repo: str = REPO) -> List[Dict[str, Any]]:
+    """Every parseable BENCH_r*.json, sorted by round number."""
+    rounds = []
+    for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        rec = _parse_artifact(path)
+        if rec is not None:
+            rec.setdefault("_round", int(m.group(1)))
+            rec["_file"] = os.path.basename(path)
+            rounds.append(rec)
+    return sorted(rounds, key=lambda r: r["_round"])
+
+
+def trajectory(rounds: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """One row per round: the SERIES values plus identifying shape."""
+    rows = []
+    for rec in rounds:
+        row: Dict[str, Any] = {
+            "round": rec["_round"], "file": rec.get("_file"),
+            "n_rows": rec.get("n_rows"),
+            "platform": rec.get("platform"),
+            "sec_per_iter": rec.get("sec_per_iter"),
+        }
+        for name, path, _ in SERIES:
+            v = _get(rec, path)
+            if v is not None:
+                row[name] = v
+        rows.append(row)
+    return rows
+
+
+def regressions(rounds: List[Dict[str, Any]],
+                threshold: float = REGRESSION_THRESHOLD
+                ) -> List[Dict[str, Any]]:
+    """Rounds whose series value dropped > threshold below the best
+    PRIOR round at the same shape."""
+    flags: List[Dict[str, Any]] = []
+    for name, path, shape_paths in SERIES:
+        best: Dict[Tuple, Tuple[float, int]] = {}
+        for rec in rounds:
+            v = _get(rec, path)
+            if not isinstance(v, (int, float)):
+                continue
+            shape = tuple(repr(_get(rec, sp)) for sp in shape_paths)
+            prior = best.get(shape)
+            if prior is not None and v < prior[0] * (1.0 - threshold):
+                flags.append({
+                    "round": rec["_round"], "series": name,
+                    "value": v, "best_prior": prior[0],
+                    "best_prior_round": prior[1],
+                    "drop_pct": round((1.0 - v / prior[0]) * 100, 1),
+                    "shape": shape,
+                })
+            if prior is None or v > prior[0]:
+                best[shape] = (float(v), rec["_round"])
+    return sorted(flags, key=lambda f: (f["round"], f["series"]))
+
+
+def run(repo: str = REPO,
+        threshold: float = REGRESSION_THRESHOLD) -> Dict[str, Any]:
+    """Trajectory + all per-round regression flags.  The CHECK gates on
+    the LATEST round only (``latest_regressions``): the tool runs after
+    every round, so an old round's drop was that round's report — only a
+    fresh drop should fail the current one."""
+    rounds = load_rounds(repo)
+    flags = regressions(rounds, threshold)
+    latest = rounds[-1]["_round"] if rounds else None
+    return {"rounds": len(rounds),
+            "latest_round": latest,
+            "trajectory": trajectory(rounds),
+            "regressions": flags,
+            "latest_regressions": [f for f in flags
+                                   if f["round"] == latest]}
+
+
+def main(argv=None) -> int:
+    rep = run()
+    cols = ["round", "n_rows", "platform", "iters_per_sec", "vs_baseline",
+            "sec_per_iter"]
+    print("bench_history: %d round(s) collated" % rep["rounds"])
+    header = "  ".join("%-13s" % c for c in cols)
+    print(header)
+    for row in rep["trajectory"]:
+        print("  ".join("%-13s" % (row.get(c, "-"),) for c in cols))
+    for f in rep["regressions"]:
+        kind = ("REGRESSION" if f["round"] == rep["latest_round"]
+                else "historical regression")
+        print("%s: round %d %s = %s is %.1f%% below round %d's %s"
+              % (kind, f["round"], f["series"], f["value"], f["drop_pct"],
+                 f["best_prior_round"], f["best_prior"]))
+    print(json.dumps(rep["trajectory"][-1] if rep["trajectory"] else {}))
+    if not rep["latest_regressions"]:
+        print("bench_history: OK (latest round has no >%.0f%% regression)"
+              % (REGRESSION_THRESHOLD * 100))
+    return 1 if rep["latest_regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

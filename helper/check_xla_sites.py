@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Compile-ledger coverage lint (ISSUE 10 satellite).
+
+The compile/retrace ledger (`lightgbm_tpu/runtime/xla_obs.py`) is only a
+real instrument if EVERY jit entry point actually registers through it —
+one raw ``jax.jit`` site and the zero-retrace pin can no longer prove
+"nothing compiled".  This lint pins that property statically for every
+``.py`` file under ``lightgbm_tpu/``:
+
+1. no ``jax.jit(...)`` call or ``@jax.jit`` decoration — jitted programs
+   go through ``xla_obs.jit(..., site=...)`` (which forwards to jax.jit
+   with the trace marker attached);
+2. no ``from jax import jit`` / ``from jax import ... jit ...`` — the
+   alias would dodge rule 1;
+3. a deliberate exception may be excused through the allowlist file
+   (``helper/check_xla_sites_allowlist.txt``: ``<basename>:<regex>``
+   lines) so it is visible and reviewed, never silent.
+
+``runtime/xla_obs.py`` itself is exempt (it IS the seam).  Tokenization
+strips comments and strings, so prose mentioning jax.jit never trips it
+— same machinery as ``helper/check_syncs.py``.  Run standalone
+(``python helper/check_xla_sites.py``; exit 1 on drift) or through the
+tier-1 pin in ``tests/test_check_xla_sites.py`` (which also pins that
+the lint CATCHES each violation class — drift-detection negatives).
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+import tokenize
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "lightgbm_tpu")
+
+ALLOWLIST_PATH = os.path.join(REPO, "helper",
+                              "check_xla_sites_allowlist.txt")
+
+#: the seam itself may (must) call jax.jit
+EXEMPT_BASENAMES = ("xla_obs.py",)
+
+_RULES: Tuple[Tuple[str, re.Pattern], ...] = (
+    ("raw jax.jit", re.compile(r"\bjax\.jit\b")),
+    ("jit imported from jax",
+     re.compile(r"\bfrom jax import\b[^\n]*(?<![\w.])jit\b")),
+)
+
+
+def load_allowlist(path: str = ALLOWLIST_PATH
+                   ) -> List[Tuple[str, re.Pattern]]:
+    """``<basename>:<regex>`` entries; blank lines and # comments
+    skipped."""
+    entries: List[Tuple[str, re.Pattern]] = []
+    try:
+        with open(path) as fh:
+            for raw in fh:
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                fname, _, pattern = line.partition(":")
+                entries.append((fname.strip(), re.compile(pattern.strip())))
+    except OSError:
+        pass
+    return entries
+
+
+def _allowed(fname: str, line: str,
+             allowlist: List[Tuple[str, re.Pattern]]) -> bool:
+    return any(f == fname and rx.search(line) for f, rx in allowlist)
+
+
+def _code_lines(path: str) -> Dict[int, str]:
+    """line number -> source with comments/strings removed (token-level,
+    so docstrings naming jax.jit never match)."""
+    drop = {tokenize.COMMENT, tokenize.STRING, tokenize.NL,
+            tokenize.NEWLINE, tokenize.INDENT, tokenize.DEDENT,
+            tokenize.ENCODING, tokenize.ENDMARKER}
+    lines: Dict[int, List[str]] = {}
+    with open(path, "rb") as fh:
+        for tok in tokenize.tokenize(fh.readline):
+            if tok.type in drop:
+                continue
+            lines.setdefault(tok.start[0], []).append(tok.string)
+    out: Dict[int, str] = {}
+    for no, parts in lines.items():
+        joined = " ".join(parts)
+        joined = re.sub(r"\s*\.\s*", ".", joined)
+        joined = re.sub(r"\s*\(\s*", "(", joined)
+        out[no] = joined
+    return out
+
+
+def scan_file(path: str,
+              allowlist: List[Tuple[str, re.Pattern]]) -> List[str]:
+    problems: List[str] = []
+    fname = os.path.basename(path)
+    if fname in EXEMPT_BASENAMES:
+        return problems
+    with open(path) as fh:
+        raw_lines = fh.read().splitlines()
+    for no, code in sorted(_code_lines(path).items()):
+        raw = raw_lines[no - 1] if no <= len(raw_lines) else code
+        for label, rx in _RULES:
+            if rx.search(code):
+                if _allowed(fname, raw, allowlist):
+                    break
+                problems.append(
+                    "%s:%d: %s bypasses the compile ledger — use "
+                    "xla_obs.jit(..., site=...): %s"
+                    % (fname, no, label, raw.strip()))
+                break
+    return problems
+
+
+def scan_files() -> List[str]:
+    out: List[str] = []
+    for root, _dirs, files in os.walk(PKG):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                out.append(os.path.join(root, f))
+    return out
+
+
+def run(files=None, allowlist_path: str = ALLOWLIST_PATH) -> List[str]:
+    """Returns the list of drift problems (empty = clean)."""
+    allowlist = load_allowlist(allowlist_path)
+    problems: List[str] = []
+    for path in (files if files is not None else scan_files()):
+        problems.extend(scan_file(path, allowlist))
+    return problems
+
+
+def main(argv=None) -> int:
+    files = scan_files()
+    problems = run(files)
+    print("check_xla_sites: scanned %d files, %d problem(s)"
+          % (len(files), len(problems)))
+    for p in problems:
+        print("DRIFT: %s" % p)
+    if not problems:
+        print("check_xla_sites: OK")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,20 +69,54 @@ class Application:
             # (application.cpp:168-171) — predict/convert stay local even
             # when the conf still carries the cluster's machine list
             self._maybe_init_network()
-        if self.task == "train":
-            self.train()
-        elif self.task == "train_online":
-            self.train_online()
-        elif self.task == "serve":
-            self.serve()
-        elif self.task in ("predict", "prediction", "test"):
-            self.predict()
-        elif self.task == "convert_model":
-            self.convert_model()
-        elif self.task == "refit":
-            self.refit()
-        else:
-            Log.fatal("Unknown task type %s", self.task)
+        try:
+            if self.task == "train":
+                self.train()
+            elif self.task == "train_online":
+                self.train_online()
+            elif self.task == "serve":
+                self.serve()
+            elif self.task in ("predict", "prediction", "test"):
+                self.predict()
+            elif self.task == "convert_model":
+                self.convert_model()
+            elif self.task == "refit":
+                self.refit()
+            elif self.task == "doctor":
+                self.doctor()
+            else:
+                Log.fatal("Unknown task type %s", self.task)
+        except (SystemExit, KeyboardInterrupt):
+            raise
+        except BaseException:
+            # crash path: ship the evidence before dying.  The bundle is
+            # the same artifact task=doctor builds (probe skipped — the
+            # crash may BE a wedged platform); LGBM_TPU_DOCTOR_ON_CRASH=0
+            # opts out, LGBM_TPU_DOCTOR_DIR redirects it.
+            self._crash_bundle()
+            raise
+
+    def _crash_bundle(self) -> None:
+        if os.environ.get("LGBM_TPU_DOCTOR_ON_CRASH", "1") == "0" \
+                or self.task == "doctor":
+            return
+        try:
+            import tempfile
+
+            from .runtime.doctor import collect_debug_bundle
+            out_dir = os.environ.get("LGBM_TPU_DOCTOR_DIR",
+                                     tempfile.gettempdir())
+            import traceback
+            rec = collect_debug_bundle(
+                out_dir=out_dir, tag="crash_%s" % self.task,
+                config=self.raw_params, probe=False,
+                note=traceback.format_exc(limit=20))
+            sys.stderr.write("doctor: crash bundle written to %s "
+                             "(%d members)\n"
+                             % (rec["path"],
+                                len(rec["manifest"]["members"])))
+        except BaseException:       # noqa: BLE001 — never mask the crash
+            pass
 
     def _maybe_init_network(self) -> None:
         """Reference CLI parity: a training task with a cluster config
@@ -397,6 +431,35 @@ class Application:
             fh.write(model_to_ifelse(model))
         Log.info("Finished converting model, saved to %s", out_path)
 
+    def doctor(self) -> None:
+        """One-command debug bundle (runtime/doctor.py): platform probe,
+        env/config fingerprint, stage trails, metrics snapshot, compile
+        ledger and the newest BENCH/CHAOS/MULTICHIP artifacts in one
+        atomic checksummed tar.  Params: `output_dir=` (default .),
+        `probe=false` skips the platform probe, `probe_deadline=S`,
+        `artifact_dir=` overrides where artifacts are collected from.
+        See docs/OBSERVABILITY.md for the runbook."""
+        from .runtime.doctor import collect_debug_bundle
+        params = dict(self.raw_params)
+        out_dir = params.pop("output_dir", params.pop("out_dir", "."))
+        probe = str(params.pop("probe", "true")).lower() not in ("false",
+                                                                 "0")
+        deadline = float(params.pop("probe_deadline", 10.0))
+        artifact_dir = params.pop("artifact_dir", None)
+        rec = collect_debug_bundle(out_dir=out_dir, tag=None,
+                                   config=params, probe=probe,
+                                   probe_deadline=deadline,
+                                   artifact_dir=artifact_dir)
+        # the path on stdout is the machine contract (exp scripts commit
+        # the manifest next to the round's artifacts)
+        print("doctor bundle %s" % rec["path"], flush=True)
+        for m in rec["manifest"]["members"]:
+            Log.info("doctor:   %-28s %7d bytes  sha256=%s...",
+                     m["name"], m["bytes"], m["sha256"][:12])
+        if rec["manifest"].get("errors"):
+            Log.warning("doctor: some members could not be gathered: %s",
+                        rec["manifest"]["errors"])
+
     def refit(self) -> None:
         params = dict(self.raw_params)
         data_path = params.pop("data", None)
@@ -481,6 +544,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     if not argv:
         print("usage: python -m lightgbm_tpu task=<train|train_online|serve|"
-              "predict|convert_model|refit> [config=<file>] [key=value ...]")
+              "predict|convert_model|refit|doctor> [config=<file>] "
+              "[key=value ...]")
         return
     Application(argv).run()

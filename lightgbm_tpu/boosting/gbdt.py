@@ -27,7 +27,7 @@ from ..io.dataset import BinnedDataset
 from ..models.gbdt_model import GBDTModel
 from ..models.tree import Tree
 from ..ops.split import FeatureMeta
-from ..runtime import resilience, syncs, telemetry
+from ..runtime import resilience, syncs, telemetry, xla_obs
 from ..utils import compat
 from ..utils.log import Log
 from ..utils.random import Random, partition_seed
@@ -73,9 +73,12 @@ def _cached_grower(meta_dev: FeatureMeta, cfg, max_num_bin: int, ds: BinnedDatas
            ds.monotone_constraints.tobytes(), ds.feature_penalty.tobytes())
     grower = _GROWER_CACHE.get(key)
     if grower is None:
+        xla_obs.cache_event("gbdt.grower_cache", "miss")
         grower = make_tree_grower(meta_dev, cfg, max_num_bin,
                                   bundle_map=bundle_map, forced=forced)
         _GROWER_CACHE[key] = grower
+    else:
+        xla_obs.cache_event("gbdt.grower_cache", "hit")
     return grower
 
 
@@ -97,10 +100,12 @@ _PACK_CACHE: "OrderedDict" = OrderedDict()
 _PACK_CACHE_MAX = 64
 
 
-def _pack_cache_put(cache: "OrderedDict", key, entry) -> None:
+def _pack_cache_put(cache: "OrderedDict", key, entry,
+                    site: str = "gbdt.pack_cache") -> None:
     cache[key] = entry
     while len(cache) > _PACK_CACHE_MAX:
         cache.popitem(last=False)
+        xla_obs.cache_event(site, "evict")
 
 
 def _fetch_packed(out: Dict, label: str = "tree_fetch") -> Dict[str, np.ndarray]:
@@ -116,13 +121,14 @@ def _fetch_packed(out: Dict, label: str = "tree_fetch") -> Dict[str, np.ndarray]
                         for k, v in out.items() if k != "leaf_id"))
     entry = _PACK_CACHE.get(spec)
     if entry is None:
+        xla_obs.cache_event("gbdt.pack_cache", "miss")
         keys = [k for k, _, _ in spec]
         shapes = {k: s for k, s, _ in spec}
         dtypes = {k: d for k, _, d in spec}
         sizes = [int(np.prod(shapes[k], dtype=np.int64)) for k in keys]
         offs = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
 
-        @jax.jit
+        @functools.partial(xla_obs.jit, site="gbdt.pack_fetch")
         def pack(o):
             return jnp.concatenate(
                 [o[k].astype(jnp.float32).reshape(-1) for k in keys])
@@ -130,6 +136,7 @@ def _fetch_packed(out: Dict, label: str = "tree_fetch") -> Dict[str, np.ndarray]
         entry = (keys, shapes, dtypes, offs, pack)
         _pack_cache_put(_PACK_CACHE, spec, entry)
     else:
+        xla_obs.cache_event("gbdt.pack_cache", "hit")
         _PACK_CACHE.move_to_end(spec)
     keys, shapes, dtypes, offs, pack = entry
     flat = np.asarray(syncs.device_get(pack(out), label=label))
@@ -177,6 +184,7 @@ def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
            ds.monotone_constraints.tobytes(), ds.feature_penalty.tobytes())
     grower = _PGROWER_CACHE.get(key)
     if grower is None:
+        xla_obs.cache_event("gbdt.pgrower_cache", "miss")
         if mesh is None:
             grower = make_partitioned_grower(
                 meta_dev, cfg, max_num_bin, cols, ds.num_features,
@@ -209,12 +217,15 @@ def _cached_pgrower(meta_dev: FeatureMeta, cfg, max_num_bin: int,
             in_specs = (P(ax, None), P(ax, None), P(None))
             if quantized:
                 in_specs = in_specs + (P(),)
-            grower = jax.jit(compat.shard_map(
+            grower = xla_obs.jit(compat.shard_map(
                 grow, mesh=mesh,
                 in_specs=in_specs,
                 out_specs=(tree_specs, P(ax, None), P(ax, None)),
-                check_vma=False), donate_argnums=(0, 1))
+                check_vma=False), donate_argnums=(0, 1),
+                site="gbdt.pgrower_mesh")
         _PGROWER_CACHE[key] = grower
+    else:
+        xla_obs.cache_event("gbdt.pgrower_cache", "hit")
     return grower
 
 
@@ -343,8 +354,9 @@ class _FastState:
             return pay
 
         if mesh is None:
-            build = jax.jit(functools.partial(build_block,
-                                              idx0=jnp.int32(0)))
+            build = xla_obs.jit(functools.partial(build_block,
+                                                 idx0=jnp.int32(0)),
+                                site="gbdt.payload_build")
         elif feature_par:
             from jax.sharding import PartitionSpec as PS
             ax = gbdt.mesh_axis
@@ -366,10 +378,11 @@ class _FastState:
                 return build_block(bins_all[perm], label_f, weight_f,
                                    vmask_f, score_f, jnp.int32(0))
 
-            build = jax.jit(compat.shard_map(
+            build = xla_obs.jit(compat.shard_map(
                 build_local_feat, mesh=mesh,
                 in_specs=(PS(ax, None), PS(), PS(), PS(), PS(None, None)),
-                out_specs=PS(ax, None), check_vma=False))
+                out_specs=PS(ax, None), check_vma=False),
+                site="gbdt.payload_build_feature_mesh")
         else:
             from jax.sharding import PartitionSpec as PS
             ax = gbdt.mesh_axis
@@ -379,11 +392,12 @@ class _FastState:
                 return build_block(bins_l, label_l, weight_l, vmask_l,
                                    score_l, my * n_loc)
 
-            build = jax.jit(compat.shard_map(
+            build = xla_obs.jit(compat.shard_map(
                 build_local, mesh=mesh,
                 in_specs=(PS(None, ax), PS(ax), PS(ax), PS(ax),
                           PS(None, ax)),
-                out_specs=PS(ax, None), check_vma=False))
+                out_specs=PS(ax, None), check_vma=False),
+                site="gbdt.payload_build_mesh")
 
         self._build = build
         self.reset(gbdt)
@@ -407,7 +421,8 @@ class _FastState:
         snap0, cnt_col = self.snap0, self.cnt_col
         grad_col, hess_col = self.grad_col, self.hess_col
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
+        @functools.partial(xla_obs.jit, site="gbdt.snap_scores",
+                           donate_argnums=(0,))
         def snap_scores(payload):
             # K lane-masked passes, not a slice DUS — see
             # seg.payload_col_write (the K wheres fuse into one pass)
@@ -418,7 +433,8 @@ class _FastState:
 
         idx_col = self.idx_col
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
+        @functools.partial(xla_obs.jit, site="gbdt.set_bag",
+                           donate_argnums=(0,))
         def set_bag(payload, combined):
             """Refresh the count-mask column from an ORIGINAL-order
             valid*bag vector — rows sit in partition order, so the index
@@ -470,8 +486,8 @@ class _FastState:
             payload = seg.payload_col_write(payload, grad_col, gk)
             return seg.payload_col_write(payload, hess_col, hk)
 
-        @functools.partial(jax.jit, donate_argnums=(0,),
-                           static_argnames=("k",))
+        @functools.partial(xla_obs.jit, site="gbdt.fill_class",
+                           donate_argnums=(0,), static_argnames=("k",))
         def fill_class(payload, k):
             return _fill_body(payload, k)
 
@@ -491,13 +507,15 @@ class _FastState:
                 payload = seg.payload_col_write(payload, hess_col, qh)
                 return payload, qscale
 
-            @functools.partial(jax.jit, donate_argnums=(0,),
+            @functools.partial(xla_obs.jit,
+                               site="gbdt.fill_class_quant",
+                               donate_argnums=(0,),
                                static_argnames=("k",))
             def fill_class_quant(payload, k, qseed):
                 return _fill_body_quant(payload, k, qseed)
 
-        @functools.partial(jax.jit, donate_argnums=(0,),
-                           static_argnames=("k",))
+        @functools.partial(xla_obs.jit, site="gbdt.apply_score",
+                           donate_argnums=(0,), static_argnames=("k",))
         def apply_score(payload, lr, k):
             upd = payload[:, self.value_col] * lr
             return seg.payload_col_write(payload, score0 + k, upd, "add")
@@ -518,7 +536,8 @@ class _FastState:
             payload = seg.payload_col_write(payload, score0 + k, upd, "add")
             return out, payload, aux
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        @functools.partial(xla_obs.jit, site="gbdt.step",
+                           donate_argnums=(0, 1))
         def step(payload, aux, fmask, lr, k):
             """One fused tree: gradients -> grow -> conditional score add.
             A tunneled TPU pays a round trip per dispatch; fusing the
@@ -529,7 +548,8 @@ class _FastState:
             return _grow_and_score(payload, aux, fmask, lr, k)
 
         if self.quant_on:
-            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            @functools.partial(xla_obs.jit, site="gbdt.step_quant",
+                               donate_argnums=(0, 1))
             def step_quant(payload, aux, fmask, lr, k, qseed):
                 """Quantized fused tree: the scale pair never leaves the
                 program — quantize, int32-histogram growth and the score
@@ -549,7 +569,8 @@ class _FastState:
                                             jnp.take(h, k, axis=0) * gw)
             return seg.payload_col_write(payload, cnt_col, cm)
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        @functools.partial(xla_obs.jit, site="gbdt.step_sampled",
+                           donate_argnums=(0, 1))
         def step_sampled(payload, aux, fmask, lr, k, key, enabled):
             """Fused tree with a per-iteration row-sampling hook (GOSS):
             gradients for ALL classes come from the snapshot, the hook
@@ -564,7 +585,8 @@ class _FastState:
 
         gweight_col = self.gweight_col
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
+        @functools.partial(xla_obs.jit, site="gbdt.apply_sample_masks",
+                           donate_argnums=(0,))
         def apply_sample_masks(payload, key, enabled):
             """Multiclass prelude: the selection is identical for every
             class tree of an iteration, so it is drawn ONCE and written
@@ -577,7 +599,8 @@ class _FastState:
             payload = seg.payload_col_write(payload, gweight_col, gw)
             return seg.payload_col_write(payload, cnt_col, cm)
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        @functools.partial(xla_obs.jit, site="gbdt.step_masked",
+                           donate_argnums=(0, 1))
         def step_masked(payload, aux, fmask, lr, k):
             g, h = _all_grads(payload)
             payload = _write_sampled(payload, g, h, k,
@@ -625,26 +648,32 @@ class _FastState:
                 return _tree_add_body(payload_l, tree_dev, leaf_scaled, k,
                                       col_of)
 
-            payload_tree_add = jax.jit(compat.shard_map(
+            payload_tree_add = xla_obs.jit(compat.shard_map(
                 _pta_local, mesh=mesh,
                 in_specs=(PS(ax_f, None), PS(), PS(), PS()),
                 out_specs=PS(ax_f, None), check_vma=False),
-                donate_argnums=(0,))
+                donate_argnums=(0,),
+                site="gbdt.payload_tree_add_mesh")
         else:
-            @functools.partial(jax.jit, donate_argnums=(0,))
+            @functools.partial(xla_obs.jit,
+                               site="gbdt.payload_tree_add",
+                               donate_argnums=(0,))
             def payload_tree_add(payload, tree_dev, leaf_scaled, k):
                 return _tree_add_body(payload, tree_dev, leaf_scaled, k,
                                       lambda g: g)
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
+        @functools.partial(xla_obs.jit, site="gbdt.apply_const_score",
+                           donate_argnums=(0,))
         def apply_const_score(payload, delta, k):
             return seg.payload_col_write(payload, score0 + k, delta, "add")
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
+        @functools.partial(xla_obs.jit, site="gbdt.scale_score",
+                           donate_argnums=(0,))
         def scale_score(payload, factor, k):
             return seg.payload_col_write(payload, score0 + k, factor, "mul")
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        @functools.partial(xla_obs.jit, site="gbdt.step_rf",
+                           donate_argnums=(0, 1))
         def step_rf(payload, aux, fmask):
             """RF's fused tree (rf.hpp Boosting): gradients of the ZERO
             score masked by the bagged count column, then growth — one
@@ -660,7 +689,8 @@ class _FastState:
                 if hasattr(grower, "__wrapped__") else grower(payload, aux,
                                                               fmask)
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
+        @functools.partial(xla_obs.jit, site="gbdt.rf_score_update",
+                           donate_argnums=(0,))
         def rf_score_update(payload, tree_dev, leaf_scaled, m):
             """score = (score*m + tree)/(m+1) in one dispatch."""
             payload = seg.payload_col_write(payload, score0,
@@ -752,7 +782,8 @@ def _feature_meta_device(ds: BinnedDataset) -> FeatureMeta:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@functools.partial(xla_obs.jit, site="gbdt.make_vals",
+                   static_argnames=("k",))
 def _make_vals(grads, hesss, gmask, cmask, k):
     """Per-row (grad, hess, count) columns for the histogram kernel.  gmask
     scales gradient/hessian mass (bagging zeroes, GOSS amplifies), cmask is
@@ -760,7 +791,8 @@ def _make_vals(grads, hesss, gmask, cmask, k):
     return jnp.stack([grads[k] * gmask, hesss[k] * gmask, cmask], axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@functools.partial(xla_obs.jit, site="gbdt.update_score_k",
+                   static_argnames=("k",))
 def _update_score_k(score, leaf_id, leaf_out, k):
     return score.at[k].add(leaf_out[leaf_id])
 
@@ -806,7 +838,8 @@ def _mark_critical_path(fn):
     return wrapped
 
 
-@functools.partial(jax.jit, static_argnames=("depth_iters", "k"))
+@functools.partial(xla_obs.jit, site="gbdt.traverse_update",
+                   static_argnames=("depth_iters", "k"))
 def _traverse_update(bins_v, score_kv, leaf_out, tree_dev, meta: FeatureMeta,
                      bmap: BundleMap, depth_iters: int, k: int):
     """Add one tree's (shrunk) outputs to row k of a [K, M] score matrix by
@@ -1176,10 +1209,11 @@ class GBDT:
         out_specs["leaf_id"] = leaf_id_spec
         # check_vma off: every shard carries the replicated winner through
         # the fori_loop, which the varying-axes tracker cannot prove
-        self.grower = jax.jit(compat.shard_map(
+        self.grower = xla_obs.jit(compat.shard_map(
             grow_core, mesh=self.mesh,
             in_specs=(bins_spec, vals_spec, fmask_spec),
-            out_specs=out_specs, check_vma=False))
+            out_specs=out_specs, check_vma=False),
+            site="gbdt.mesh_grower")
 
     # -- validation ----------------------------------------------------------
     def add_valid(self, name: str, valid: BinnedDataset, metrics: List) -> None:
@@ -1759,7 +1793,7 @@ class GBDT:
             def gradfn(score, label, weight):
                 return obj.get_gradients_multi(score, label, weight)
 
-            self._grad_fn = jax.jit(gradfn)
+            self._grad_fn = xla_obs.jit(gradfn, site="gbdt.gradients")
         return self._grad_fn(self.score, self.label_dev, self.weight_dev)
 
     def _boost_from_average(self) -> float:
@@ -2016,16 +2050,19 @@ class GBDT:
         spec = tuple(tuple(a.shape) for a in arrays)
         entry = _EVAL_PACK_CACHE.get(spec)
         if entry is None:
+            xla_obs.cache_event("gbdt.eval_pack_cache", "miss")
             sizes = [int(np.prod(s, dtype=np.int64)) for s in spec]
             offs = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
 
-            @jax.jit
+            @functools.partial(xla_obs.jit, site="gbdt.eval_pack")
             def pack(xs):
                 return jnp.concatenate([x.reshape(-1) for x in xs])
 
             entry = (offs, pack)
-            _pack_cache_put(_EVAL_PACK_CACHE, spec, entry)
+            _pack_cache_put(_EVAL_PACK_CACHE, spec, entry,
+                            site="gbdt.eval_pack_cache")
         else:
+            xla_obs.cache_event("gbdt.eval_pack_cache", "hit")
             _EVAL_PACK_CACHE.move_to_end(spec)
         offs, pack = entry
         flat = np.asarray(syncs.device_get(pack(arrays), label="eval_fetch"))

@@ -31,6 +31,7 @@ from ..ops.split import (FeatureMeta, K_MIN_SCORE, MISSING_NAN, MISSING_ZERO,
                          SplitResult, find_best_split,
                          find_best_split_batched, leaf_output,
                          pad_feature_meta, per_feature_best_gains)
+from ..runtime import xla_obs
 from ..utils import compat
 
 
@@ -576,4 +577,4 @@ def make_tree_grower(meta: FeatureMeta, cfg: GrowerConfig, num_bins_max: int,
             "internal_count": st["internal_count"],
         }
 
-    return jax.jit(grow) if jit else grow
+    return xla_obs.jit(grow, site="grower.serial") if jit else grow

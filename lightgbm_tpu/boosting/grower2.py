@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..runtime import xla_obs
+
 from ..ops.bundle import BundleMap, expand_histogram, identity_bundle_map
 from ..ops.split import (FeatureMeta, K_MIN_SCORE, SplitResult,
                          dequantize_hist, find_best_split,
@@ -1063,4 +1065,5 @@ def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
     # payload/aux are donated: the training state is updated in place across
     # trees, never copied (HistogramPool-style buffer discipline without the
     # pointer juggling of feature_histogram.hpp:655-826)
-    return jax.jit(grow, donate_argnums=(0, 1)) if jit else grow
+    return xla_obs.jit(grow, site="grower2.partitioned",
+                       donate_argnums=(0, 1)) if jit else grow

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..runtime import xla_obs
 from ..utils.log import Log
 from ..utils.random import Random, partition_seed
 from .gbdt import GBDT
@@ -79,7 +80,8 @@ class GOSS(GBDT):
                            self._goss_other_k, self._goss_multiply)
 
 
-@functools.partial(jax.jit, static_argnames=("top_k", "other_k"))
+@functools.partial(xla_obs.jit, site="variants.goss_masks",
+                   static_argnames=("top_k", "other_k"))
 def _goss_masks(grads, hesss, valid, key, top_k: int, other_k: int, multiply):
     """Select the top_k rows by sum_k |g*h|, sample other_k of the rest
     uniformly, amplify the sampled rest by (n - top_k) / other_k."""
@@ -260,7 +262,8 @@ class RF(GBDT):
             def gradfn(score, label, weight):
                 return obj.get_gradients_multi(jnp.zeros_like(score), label, weight)
 
-            self._grad_fn = jax.jit(gradfn)
+            self._grad_fn = xla_obs.jit(gradfn,
+                                        site="variants.rf_gradients")
         return self._grad_fn(self.score, self.label_dev, self.weight_dev)
 
     def _train_one_iter_fast_rf(self) -> bool:

@@ -248,6 +248,25 @@ class TrainDataset:
         return self
 
     # -- surface -------------------------------------------------------------
+    @classmethod
+    def from_mats(cls, mats, params: str = "",
+                  reference: Optional["TrainDataset"] = None
+                  ) -> "TrainDataset":
+        """LGBM_DatasetCreateFromMats: concatenate row blocks sharing a
+        column count into one dataset."""
+        blocks = [np.ascontiguousarray(m, dtype=np.float64) for m in mats]
+        ncol = blocks[0].shape[1]
+        ptrs = (ctypes.c_void_p * len(blocks))(
+            *[b.ctypes.data_as(ctypes.c_void_p).value for b in blocks])
+        rows = (ctypes.c_int32 * len(blocks))(
+            *[b.shape[0] for b in blocks])
+        h = ctypes.c_void_p()
+        _check_train(load_train_lib().LGBM_DatasetCreateFromMats(
+            ctypes.c_int32(len(blocks)), ptrs, C_API_DTYPE_FLOAT64, rows,
+            ctypes.c_int32(ncol), 1, params.encode(),
+            cls._ref_handle(reference), ctypes.byref(h)))
+        return cls(h)
+
     def set_field(self, name: str, data) -> "TrainDataset":
         arr = np.ascontiguousarray(data)
         if arr.dtype not in (np.float32, np.float64, np.int32, np.int64):
@@ -257,6 +276,34 @@ class TrainDataset:
             arr.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(arr.size),
             _dtype_code(arr)))
         return self
+
+    def get_field(self, name: str) -> np.ndarray:
+        """LGBM_DatasetGetField: label/weight as float32, init_score as
+        float64, group as CUMULATIVE int32 query boundaries (the
+        reference layout).  The returned array is a COPY — the C buffer
+        is only valid until the next get_field call on this handle."""
+        out_len = ctypes.c_int(0)
+        out_ptr = ctypes.c_void_p()
+        out_type = ctypes.c_int(-1)
+        _check_train(load_train_lib().LGBM_DatasetGetField(
+            self._handle, name.encode(), ctypes.byref(out_len),
+            ctypes.byref(out_ptr), ctypes.byref(out_type)))
+        dt = {C_API_DTYPE_FLOAT32: np.float32,
+              C_API_DTYPE_FLOAT64: np.float64,
+              C_API_DTYPE_INT32: np.int32,
+              C_API_DTYPE_INT64: np.int64}[out_type.value]
+        n = out_len.value
+        buf = ctypes.cast(out_ptr,
+                          ctypes.POINTER(ctypes.c_char * (n * dt().nbytes)))
+        return np.frombuffer(bytes(buf.contents), dtype=dt).copy()
+
+    def feature_num_bin(self, feature_idx: int) -> int:
+        """LGBM_DatasetGetFeatureNumBin: bins of one constructed
+        feature."""
+        out = ctypes.c_int32(0)
+        _check_train(load_train_lib().LGBM_DatasetGetFeatureNumBin(
+            self._handle, ctypes.c_int(feature_idx), ctypes.byref(out)))
+        return out.value
 
     @property
     def num_data(self) -> int:
@@ -514,6 +561,29 @@ class NativeBooster:
         out = out[: out_len.value]
         per_row = out_len.value // max(nrow, 1)
         return out.reshape(nrow, per_row) if per_row > 1 else out
+
+    def predict_csr_single_row(self, indices, values, num_col: int,
+                               raw_score: bool = False,
+                               num_iteration: int = -1) -> np.ndarray:
+        """One sparse row (LGBM_BoosterPredictForCSRSingleRow): indices/
+        values of the non-zero entries; absent entries are 0.0."""
+        indices = np.ascontiguousarray(indices, dtype=np.int32)
+        values = np.ascontiguousarray(values, dtype=np.float64)
+        indptr = np.asarray([0, len(values)], dtype=np.int64)
+        k = self.num_class
+        ptype = C_API_PREDICT_RAW_SCORE if raw_score else C_API_PREDICT_NORMAL
+        out = np.zeros(max(k, 1), dtype=np.float64)
+        out_len = ctypes.c_int64(0)
+        _check(load_lib().LGBM_BoosterPredictForCSRSingleRow(
+            self._handle, indptr.ctypes.data_as(ctypes.c_void_p),
+            _dtype_code(indptr),
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            values.ctypes.data_as(ctypes.c_void_p), _dtype_code(values),
+            ctypes.c_int64(2), ctypes.c_int64(len(values)),
+            ctypes.c_int64(num_col), ptype, ctypes.c_int(num_iteration),
+            b"", ctypes.byref(out_len),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        return out[: out_len.value]
 
     def get_leaf_value(self, tree_idx: int, leaf_idx: int) -> float:
         """One leaf's output value (LGBM_BoosterGetLeafValue — the

@@ -16,6 +16,8 @@ in the high slot semantics kept simple: stored as the high nibble).
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 
@@ -62,10 +64,12 @@ def unpack_nibbles(packed: np.ndarray, num_columns: int) -> np.ndarray:
 
 def unpack_nibbles_device(packed_host: np.ndarray, num_columns: int):
     """Upload the PACKED matrix (half the H2D bytes) and unpack on device."""
-    import jax
+    import jax  # noqa: F401 — platform bind happens here
     import jax.numpy as jnp
 
-    @jax.jit
+    from ..runtime import xla_obs
+
+    @functools.partial(xla_obs.jit, site="nbits.unpack_device")
     def unpack(p):
         hi = (p >> 4).astype(jnp.uint8)
         lo = (p & 0x0F).astype(jnp.uint8)

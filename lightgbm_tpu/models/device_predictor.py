@@ -43,7 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..runtime import resilience
+from ..runtime import resilience, xla_obs
 
 _K_ZERO_THRESHOLD = 1e-35
 MISSING_NONE, MISSING_ZERO, MISSING_NAN = 0, 1, 2
@@ -132,8 +132,9 @@ def pack_trees(trees, num_leaves_cap: int):
     return out, depth
 
 
-@functools.partial(jax.jit, static_argnames=("num_class", "depth_iters",
-                                             "early_mode", "early_freq"))
+@functools.partial(xla_obs.jit, site="predictor.tree_parallel",
+                   static_argnames=("num_class", "depth_iters",
+                                    "early_mode", "early_freq"))
 def _predict_tree_parallel(arrs, X, margin, *, num_class: int,
                            depth_iters: int, early_mode: Optional[str],
                            early_freq: int):
@@ -222,7 +223,8 @@ def _predict_tree_parallel(arrs, X, margin, *, num_class: int,
     return score
 
 
-@functools.partial(jax.jit, static_argnames=("num_class", "depth_iters"))
+@functools.partial(xla_obs.jit, site="predictor.packed_scan",
+                   static_argnames=("num_class", "depth_iters"))
 def _predict_packed_scan(arrs, X, *, num_class: int, depth_iters: int):
     """Pre-tree-parallel engine (sequential lax.scan over trees), kept as
     the A/B reference for BENCH_PREDICT and the equivalence tests.

@@ -24,12 +24,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..runtime import xla_obs
+
 
 def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "row_chunk"))
+@functools.partial(xla_obs.jit, site="ops.build_histogram",
+                   static_argnames=("num_bins", "row_chunk"))
 def build_histogram(bins: jax.Array, vals: jax.Array, *, num_bins: int,
                     row_chunk: int = 16384) -> jax.Array:
     """hist[F, num_bins, 3] from bins[F, N] (integer) and vals[N, 3] float32.

@@ -30,6 +30,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..runtime import xla_obs
 from .segment import CHUNK, GUARD
 from .split import MISSING_NAN, MISSING_ZERO
 
@@ -485,7 +486,7 @@ def segment_histogram(payload, start, count, *, num_features, num_bins,
                               expand_impl=expand_impl)
 
 
-@functools.partial(jax.jit, static_argnames=("num_features", "num_bins",
+@functools.partial(xla_obs.jit, site="pallas.segment_histogram", static_argnames=("num_features", "num_bins",
                                              "grad_col", "hess_col",
                                              "cnt_col", "interpret",
                                              "expand_impl"))
@@ -663,7 +664,7 @@ def segment_histogram_batched(payload, starts, counts, *, num_features,
         interpret=interpret, expand_impl=expand_impl)
 
 
-@functools.partial(jax.jit, static_argnames=("num_features", "num_bins",
+@functools.partial(xla_obs.jit, site="pallas.segment_histogram_batched", static_argnames=("num_features", "num_bins",
                                              "grad_col", "hess_col",
                                              "cnt_col", "num_segments",
                                              "interpret", "expand_impl"))
@@ -797,7 +798,7 @@ def segment_histogram_quant(payload, start, count, *, num_features,
         interpret=interpret)
 
 
-@functools.partial(jax.jit, static_argnames=("num_features", "num_bins",
+@functools.partial(xla_obs.jit, site="pallas.segment_histogram_quant", static_argnames=("num_features", "num_bins",
                                              "grad_col", "hess_col",
                                              "cnt_col", "interpret"))
 def _segment_histogram_quant(payload, start, count, *, num_features,
@@ -1022,7 +1023,7 @@ def segment_histogram_colblock(payload, start, count, *, num_features,
     return jnp.concatenate(outs, axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=(
+@functools.partial(xla_obs.jit, site="pallas.segment_histogram_colblock", static_argnames=(
     "num_features", "num_bins", "col_lo", "block_w", "aux_lo", "aux_w",
     "g_off", "h_off", "c_off", "interpret", "expand_impl"))
 def _segment_histogram_colblock(payload, start, count, *, num_features,
@@ -1204,7 +1205,7 @@ def _partition_kernel(scalars, fvals, bitset_ref, payload_hbm, aux_hbm,
     lax.fori_loop(0, nrch, body_b, 0)
 
 
-@functools.partial(jax.jit, static_argnames=("value_col", "num_bins",
+@functools.partial(xla_obs.jit, site="pallas.partition_segment", static_argnames=("value_col", "num_bins",
                                              "interpret"))
 def partition_segment(payload, aux, start, count, pred, left_value,
                       right_value, value_col, num_bins, interpret=False):
@@ -1628,7 +1629,7 @@ def partition_segment_acc(payload, aux, start, count, pred, left_value,
                                   int(ring_depth))
 
 
-@functools.partial(jax.jit, static_argnames=("value_col", "num_bins",
+@functools.partial(xla_obs.jit, site="pallas.partition_segment_acc", static_argnames=("value_col", "num_bins",
                                              "interpret", "roll_place",
                                              "ring_depth"))
 def _partition_segment_acc(payload, aux, start, count, pred, left_value,
@@ -1703,7 +1704,7 @@ def partition_segment_hist(payload, aux, start, count, pred, left_value,
                                    int(ring_depth))
 
 
-@functools.partial(jax.jit, static_argnames=(
+@functools.partial(xla_obs.jit, site="pallas.partition_segment_hist", static_argnames=(
     "value_col", "num_bins", "num_features", "grad_col", "hess_col",
     "cnt_col", "interpret", "roll_place", "expand_impl", "ring_depth"))
 def _partition_segment_hist(payload, aux, start, count, pred, left_value,
@@ -2077,7 +2078,7 @@ def partition_segment_acc_blocks(payload, aux, start, count, pred,
         int(block_w))
 
 
-@functools.partial(jax.jit, static_argnames=(
+@functools.partial(xla_obs.jit, site="pallas.partition_segment_acc_blocks", static_argnames=(
     "value_col", "num_bins", "interpret", "roll_place", "ring_depth",
     "block_w"))
 def _partition_segment_acc_blocks(payload, aux, start, count, pred,

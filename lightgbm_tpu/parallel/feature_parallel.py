@@ -18,6 +18,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..boosting.grower import GrowerConfig, make_tree_grower
 from ..ops.split import FeatureMeta, pad_feature_meta  # noqa: F401  (re-export)
+from ..runtime import xla_obs
 from ..utils import compat
 from ._common import make_step, resolve_objective
 
@@ -52,7 +53,7 @@ def make_feature_parallel_train_step(meta: FeatureMeta, cfg: GrowerConfig,
         step, mesh=mesh,
         in_specs=(P(FEATURE_AXIS, None), P(), P(), P(), P(), P(FEATURE_AXIS)),
         out_specs=(P(), P()))
-    return jax.jit(sharded)
+    return xla_obs.jit(sharded, site="parallel.feature_step")
 
 
 def shard_features(mesh: Mesh, bins, feature_mask, *replicated):

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..runtime import xla_obs
+
 DATA_AXIS = "find_bin_rows"
 
 
@@ -98,7 +100,7 @@ def make_distributed_find_bin(mesh: Mesh, max_bin: int,
     fn = shard_map(per_shard, mesh=mesh,
                    in_specs=P(DATA_AXIS, None),
                    out_specs=P(), check_rep=False)
-    return jax.jit(fn)
+    return xla_obs.jit(fn, site="parallel.find_bin")
 
 
 def shard_sample(mesh: Mesh, sample: np.ndarray) -> jax.Array:

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..boosting.grower import GrowerConfig, make_tree_grower
+from ..runtime import xla_obs
 from ..ops.split import FeatureMeta
 from ..utils import compat
 from ._common import make_step, resolve_objective
@@ -45,4 +46,4 @@ def make_voting_parallel_train_step(meta: FeatureMeta, cfg: GrowerConfig,
                   P(DATA_AXIS), P(DATA_AXIS), P(None)),
         out_specs=(P(DATA_AXIS), P()),
         check_vma=False)
-    return jax.jit(sharded)
+    return xla_obs.jit(sharded, site="parallel.voting_step")

@@ -46,7 +46,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from . import publish, resilience, syncs, telemetry
+from . import publish, resilience, syncs, telemetry, xla_obs
 from ..utils.log import LightGBMError, Log
 
 __all__ = ["ContinuousTrainer", "OnlineParams"]
@@ -578,6 +578,7 @@ class ContinuousTrainer:
         # -- train: to the cycle's absolute iteration target -----------------
         self._stage(cycle, "train")
         s0 = syncs.snapshot()
+        c0 = xla_obs.snapshot()
         refitting = (cfg.mode == "refit"
                      and self._booster._model.current_iteration > 0)
         if not refitting:
@@ -595,6 +596,10 @@ class ContinuousTrainer:
             X, y = self._refit_window
             self._booster = self._booster.refit(X, y)
         self.wd.annotate("syncs", syncs.delta(s0)["by_label"])
+        # per-cycle compile ledger delta (ISSUE 10): steady-state cycles
+        # on an unchanged window annotate {} — a rebuild (window reshape)
+        # names exactly which sites recompiled and why the cycle was slow
+        self.wd.annotate("xla_compiles", xla_obs.delta(c0))
 
         # -- snapshot (boost mode: full resume state at the boundary) --------
         if self._booster._engine is not None:

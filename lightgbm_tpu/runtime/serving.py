@@ -58,7 +58,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from . import publish, resilience, telemetry
+from . import publish, resilience, telemetry, xla_obs
 from ..utils.log import Log
 
 __all__ = ["ServingRuntime", "ServingServer", "ServeRejected",
@@ -96,15 +96,19 @@ class ServeResult:
     produced them, and how they were served."""
 
     __slots__ = ("values", "generation", "model_id", "served_by",
-                 "latency_s")
+                 "latency_s", "compiled")
 
     def __init__(self, values: np.ndarray, generation: int, model_id: str,
-                 served_by: str, latency_s: float):
+                 served_by: str, latency_s: float, compiled: bool = False):
         self.values = values
         self.generation = generation
         self.model_id = model_id
         self.served_by = served_by          # "device" | "host"
         self.latency_s = latency_s
+        # True when THIS request's batch triggered an XLA compile (the
+        # xla_obs ledger moved during the dispatch) — first-batch latency
+        # outliers become attributable instead of mysterious
+        self.compiled = compiled
 
 
 class _Request:
@@ -393,6 +397,7 @@ class ServingRuntime:
         in-flight batch finishes on the generation it started with."""
         from ..basic import Booster
         t0 = time.monotonic()
+        c0 = xla_obs.total_compiles()
         bst = Booster(params=dict(self._params), model_str=model_text)
         entry = _ModelEntry(model_id, generation, bst, meta)
         try:
@@ -406,6 +411,13 @@ class ServingRuntime:
             self.log.warning("serve: prewarm of %s gen %d failed (%s); "
                              "swapping anyway (host path serves)",
                              model_id, generation, e)
+        # prewarm compiles were invisible before ISSUE 10: tag them
+        # through the ledger so a slow swap names its cause (a reused
+        # shape bucket prewarms as a pure cache hit)
+        prewarm_compiles = xla_obs.total_compiles() - c0
+        xla_obs.cache_event("serving.prewarm",
+                            "compile" if prewarm_compiles else "hit",
+                            max(prewarm_compiles, 1))
         with self._entries_lock:
             self._entries[model_id] = entry
         with self._stats_lock:
@@ -415,6 +427,7 @@ class ServingRuntime:
             self.wd.annotate("last_swap", {
                 "model": model_id, "generation": generation,
                 "load_s": round(time.monotonic() - t0, 4),
+                "prewarm_compiles": prewarm_compiles,
                 "wallclock": resilience.wallclock()})
         self.log.info("serve: %s now at generation %d (loaded in %.3fs)",
                       model_id, generation, time.monotonic() - t0)
@@ -592,7 +605,14 @@ class ServingRuntime:
             self.wd("batch model=%s gen=%d rows=%d"
                     % (model_id, entry.generation, X.shape[0]),
                     seconds=0)
+        c0 = xla_obs.total_compiles()
         values, served_by = self._serve_path(entry, X)
+        # a batch that moved the compile ledger pays trace+compile wall
+        # time — stamp it on the batch span and every response in it
+        compiled = xla_obs.total_compiles() > c0
+        if compiled:
+            with self._wd_lock:
+                self.wd.annotate("compiled", True)
         now = time.monotonic()
         with self._stats_lock:
             self._stats["rows_served"] += int(X.shape[0])
@@ -613,7 +633,8 @@ class ServingRuntime:
             e = s + req.n_rows
             latency = round(now - req.enqueued, 6)
             req.result = ServeResult(values[s:e], entry.generation,
-                                     model_id, served_by, latency)
+                                     model_id, served_by, latency,
+                                     compiled=compiled)
             req.done.set()
             s = e
             # the registry histogram IS the serving latency ledger: the
@@ -773,7 +794,8 @@ class _Handler(socketserver.StreamRequestHandler):
                     out = {"values": np.asarray(rec.values).tolist(),
                            "generation": rec.generation,
                            "served_by": rec.served_by,
-                           "latency_s": rec.latency_s}
+                           "latency_s": rec.latency_s,
+                           "compiled": rec.compiled}
             except ServeRejected as e:
                 out = e.to_dict()
             except Exception as e:           # noqa: BLE001 — wire error

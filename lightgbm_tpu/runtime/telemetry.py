@@ -69,6 +69,8 @@ __all__ = [
     "MetricsServer", "start_http_server",
     "MetricsFileWriter", "maybe_start_file_export", "write_snapshot_now",
     "snapshot", "render_prometheus", "profile_hook", "reset",
+    "gather_host_snapshots", "merge_host_snapshots", "mesh_snapshot",
+    "render_prometheus_from_snapshot", "mesh_process_count",
 ]
 
 #: the fixed latency/duration bucket layout (seconds).  Quantiles read
@@ -165,6 +167,24 @@ METRIC_TABLE: Dict[str, Dict[str, Any]] = {
     "lgbm_spans_total": {
         "type": "counter", "labels": ("span", "status"),
         "help": "Span completions by status=ok/error/timeout"},
+    "lgbm_xla_compiles_total": {
+        "type": "counter", "labels": ("site",), "max_label_sets": 256,
+        "help": "XLA traces/compiles per registered jit site "
+                "(runtime/xla_obs.py ledger)"},
+    "lgbm_xla_compile_seconds": {
+        "type": "histogram", "labels": ("site",), "max_label_sets": 256,
+        "help": "Wall time of the call that triggered each trace "
+                "(trace + compile + first run)"},
+    "lgbm_xla_retraces_total": {
+        "type": "counter", "labels": ("site", "delta"),
+        "max_label_sets": 256,
+        "help": "Steady-state retraces (after xla_obs.mark_steady), "
+                "labeled with the shape delta that triggered them"},
+    "lgbm_program_cache_events_total": {
+        "type": "counter", "labels": ("site", "event"),
+        "max_label_sets": 256,
+        "help": "Program-cache traffic per site: event=hit/compile for "
+                "jit sites, hit/miss/evict for the python-side caches"},
 }
 
 # ---------------------------------------------------------------------------
@@ -462,7 +482,11 @@ class MetricsRegistry:
             if fam is None:
                 fam = self._KINDS[kind](
                     name, decl["help"], tuple(decl["labels"]),
-                    self.max_label_sets, self,
+                    # per-family override: the xla ledger families carry
+                    # one label set per jit site x event, more than the
+                    # default bound
+                    int(decl.get("max_label_sets", self.max_label_sets)),
+                    self,
                     buckets=tuple(decl.get("buckets", LATENCY_BUCKETS_S)))
                 self._families[name] = fam
         return fam
@@ -602,6 +626,132 @@ def reset() -> None:
     REGISTRY.reset()
 
 
+# ---------------------------------------------------------------------------
+# mesh-wide aggregation (ISSUE 10): per-process registries gather to
+# process 0 over the jax collective seam; merged series carry a {host}
+# label so a multi-host scrape/snapshot attributes every number
+# ---------------------------------------------------------------------------
+
+def mesh_process_count() -> int:
+    """Process count of the multi-host run this process is part of —
+    WITHOUT ever initializing a backend.  `jax.process_count()` binds
+    the platform when called on an un-initialized jax, which on a dead
+    accelerator tunnel hangs the caller (a metrics flush must never be
+    the thing that wedges a run); multi-host runs always bring
+    `jax.distributed` up first, so its client state is the safe probe."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 1
+    try:
+        from jax._src import distributed
+        state = distributed.global_state
+        if getattr(state, "client", None) is None:
+            return 1
+        return max(int(getattr(state, "num_processes", 1) or 1), 1)
+    except Exception:    # noqa: BLE001 — jax internals moved: stay local
+        return 1
+
+
+def gather_host_snapshots(context: Optional[str] = None,
+                          registry: Optional[MetricsRegistry] = None
+                          ) -> Dict[str, Dict[str, Any]]:
+    """{host_index: snapshot} across every process of a multi-host run.
+
+    Single-process (or jax not distributed-initialized — this function
+    must never INITIALIZE a platform, see `mesh_process_count`) degrades
+    to the local snapshot under host "0".  Multi-process, snapshots
+    travel as length-prefixed JSON blobs through `process_allgather` —
+    the same collective seam the mesh learners ride — so every process
+    returns the full map and process 0 can export it."""
+    reg = registry if registry is not None else REGISTRY
+    local = reg.snapshot(context)
+    if mesh_process_count() <= 1:
+        return {"0": local}
+    jax = sys.modules.get("jax")
+    try:
+        nproc = jax.process_count()
+        if nproc <= 1:
+            return {str(jax.process_index()): local}
+        import numpy as np
+        from jax.experimental import multihost_utils as mhu
+        blob = np.frombuffer(json.dumps(local).encode("utf-8"), np.uint8)
+        lens = np.asarray(mhu.process_allgather(
+            np.array([blob.size], np.int32))).reshape(-1)
+        buf = np.zeros(int(lens.max()), np.uint8)
+        buf[:blob.size] = blob
+        gathered = np.asarray(mhu.process_allgather(buf))
+        out: Dict[str, Dict[str, Any]] = {}
+        for p in range(nproc):
+            raw = bytes(gathered[p][:int(lens[p])])
+            out[str(p)] = json.loads(raw.decode("utf-8"))
+        return out
+    except Exception:   # noqa: BLE001 — observability must not take it down
+        return {str(getattr(jax, "process_index", lambda: 0)()): local}
+
+
+def merge_host_snapshots(hosts: Dict[str, Dict[str, Any]]
+                         ) -> Dict[str, Any]:
+    """One combined snapshot: every series of every host, with a
+    ``host`` label prepended — the artifact a multi-host dryrun ships
+    and the view a process-0 /metrics scrape serves."""
+    merged_metrics: Dict[str, Any] = {}
+    for host in sorted(hosts, key=lambda h: (len(h), h)):
+        snap = hosts[host]
+        for name, fam in snap.get("metrics", {}).items():
+            slot = merged_metrics.setdefault(
+                name, {"type": fam["type"], "series": []})
+            for entry in fam["series"]:
+                e = dict(entry)
+                e["labels"] = dict({"host": host}, **entry.get("labels", {}))
+                slot["series"].append(e)
+    return {"wallclock": wallclock(), "hosts": sorted(hosts),
+            "metrics": merged_metrics}
+
+
+def mesh_snapshot(context: Optional[str] = None,
+                  registry: Optional[MetricsRegistry] = None
+                  ) -> Dict[str, Any]:
+    """Gather + merge in one call (every process gets the merged view)."""
+    return merge_host_snapshots(gather_host_snapshots(context, registry))
+
+
+def render_prometheus_from_snapshot(snap: Dict[str, Any],
+                                    table: Optional[Dict[str, Any]] = None
+                                    ) -> str:
+    """Prometheus text exposition from a (possibly merged, {host}-
+    labeled) snapshot dict.  Histogram bucket edges come from the
+    METRIC_TABLE declaration (all product histograms ride the one fixed
+    layout); unknown names fall back to `LATENCY_BUCKETS_S`."""
+    table = METRIC_TABLE if table is None else table
+    out: List[str] = []
+    for name in sorted(snap.get("metrics", {})):
+        fam = snap["metrics"][name]
+        decl = table.get(name, {})
+        out.append("# HELP %s %s" % (name, _esc_help(
+            decl.get("help", "(undeclared)"))))
+        out.append("# TYPE %s %s" % (name, fam["type"]))
+        for entry in fam["series"]:
+            labels = entry.get("labels", {})
+            names = tuple(labels)
+            values = tuple(str(labels[k]) for k in names)
+            lbl = _label_str(names, values)
+            if fam["type"] == "histogram":
+                edges = tuple(decl.get("buckets", LATENCY_BUCKETS_S))
+                cum = 0
+                for i, edge in enumerate(edges):
+                    cum += entry["counts"][i] \
+                        if i < len(entry.get("counts", [])) else 0
+                    le = "+Inf" if math.isinf(edge) else _fmt(edge)
+                    out.append("%s_bucket%s %d" % (
+                        name, _label_str(names + ("le",), values + (le,),
+                                         raw_last=True), cum))
+                out.append("%s_sum%s %s" % (name, lbl, _fmt(entry["sum"])))
+                out.append("%s_count%s %d" % (name, lbl, entry["count"]))
+            else:
+                out.append("%s%s %s" % (name, lbl, _fmt(entry["value"])))
+    return "\n".join(out) + "\n"
+
+
 def count_sync(label: str, critical: bool) -> None:
     """Sync-audit bridge (called by runtime/syncs.record for every
     blocking host fetch)."""
@@ -685,7 +835,12 @@ class MetricsServer:
     ``/healthz``; runs on a daemon thread, `stop()` shuts it down."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 snapshot_provider: Optional[Any] = None):
+        """`snapshot_provider`: optional zero-arg callable returning a
+        snapshot dict (e.g. `mesh_snapshot` on process 0 of a multi-host
+        run) — when given, /metrics and /metrics.json serve ITS view
+        (with {host} labels) instead of the local registry."""
         import http.server
 
         reg = registry if registry is not None else REGISTRY
@@ -694,11 +849,16 @@ class MetricsServer:
             def do_GET(self) -> None:            # noqa: N802 — stdlib API
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
-                    body = reg.render_prometheus().encode("utf-8")
+                    if snapshot_provider is not None:
+                        body = render_prometheus_from_snapshot(
+                            snapshot_provider()).encode("utf-8")
+                    else:
+                        body = reg.render_prometheus().encode("utf-8")
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif path == "/metrics.json":
-                    body = (json.dumps(reg.snapshot())
-                            + "\n").encode("utf-8")
+                    snap = (snapshot_provider() if snapshot_provider
+                            is not None else reg.snapshot())
+                    body = (json.dumps(snap) + "\n").encode("utf-8")
                     ctype = "application/json"
                 elif path == "/healthz":
                     body = b"ok\n"
@@ -787,8 +947,14 @@ class MetricsFileWriter:
                 pass                    # export must never take the run down
 
     def write_now(self, context: Optional[str] = None) -> None:
-        """Append one snapshot line and atomically rewrite the file."""
-        snap = self.registry.snapshot(context or self.context)
+        """Append one snapshot line and atomically rewrite the file.  On
+        a multi-host run (jax already up, process_count > 1) the line is
+        the MERGED mesh snapshot with {host}-labeled series — process 0
+        ships the whole mesh's numbers in its file."""
+        if mesh_process_count() > 1:
+            snap = mesh_snapshot(context or self.context, self.registry)
+        else:
+            snap = self.registry.snapshot(context or self.context)
         with self._lock:
             self._lines.append(json.dumps(snap))
             atomic_write(self.path, "\n".join(self._lines) + "\n")

@@ -1,0 +1,107 @@
+"""Bench-trajectory collator (ISSUE 10 satellite, helper/bench_history.py).
+
+The committed BENCH_r01–r05 fixtures must collate into a non-empty
+trajectory with NO latest-round regression (the acceptance gate), and
+the regression detector must actually fire on a synthetic >10% drop —
+with cross-shape rounds never compared.
+"""
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "helper"))
+
+import bench_history  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_round(d, n, parsed=None, tail=""):
+    rec = {"n": n, "rc": 0, "tail": tail}
+    if parsed is not None:
+        rec["parsed"] = parsed
+    (d / ("BENCH_r%02d.json" % n)).write_text(json.dumps(rec))
+
+
+def test_committed_fixtures_collate_clean():
+    rep = bench_history.run(REPO)
+    assert rep["rounds"] == 5
+    assert len(rep["trajectory"]) == 5
+    latest = rep["trajectory"][-1]
+    assert latest["round"] == 5
+    # values come from the fixtures, not thin air
+    fix = json.load(open(os.path.join(REPO, "BENCH_r05.json")))["parsed"]
+    assert latest["iters_per_sec"] == fix["value"]
+    assert latest["vs_baseline"] == fix["vs_baseline"]
+    # the acceptance gate: the regression check runs clean on r01–r05
+    assert rep["latest_regressions"] == [], rep["latest_regressions"]
+
+
+def test_cli_exits_zero_on_committed_fixtures():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "helper", "bench_history.py")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "5 round(s) collated" in r.stdout
+
+
+def test_synthetic_regression_is_flagged(tmp_path):
+    base = {"value": 1.0, "vs_baseline": 0.5, "n_rows": 100,
+            "platform": "cpu"}
+    _write_round(tmp_path, 1, dict(base))
+    _write_round(tmp_path, 2, dict(base, value=0.85, vs_baseline=0.42))
+    rep = bench_history.run(str(tmp_path))
+    assert rep["rounds"] == 2
+    flagged = {f["series"] for f in rep["latest_regressions"]}
+    assert "iters_per_sec" in flagged and "vs_baseline" in flagged
+    f = [x for x in rep["latest_regressions"]
+         if x["series"] == "iters_per_sec"][0]
+    assert f["best_prior_round"] == 1 and f["drop_pct"] == 15.0
+
+
+def test_cross_shape_rounds_never_compared(tmp_path):
+    _write_round(tmp_path, 1, {"value": 1.0, "n_rows": 2_000_000,
+                               "platform": "tpu"})
+    # much slower, but a DIFFERENT shape/platform: not a regression
+    _write_round(tmp_path, 2, {"value": 0.2, "n_rows": 100_000,
+                               "platform": "cpu"})
+    rep = bench_history.run(str(tmp_path))
+    assert rep["regressions"] == []
+
+
+def test_historical_drop_does_not_fail_latest(tmp_path):
+    shape = {"n_rows": 100, "platform": "cpu"}
+    _write_round(tmp_path, 1, dict(shape, value=1.0))
+    _write_round(tmp_path, 2, dict(shape, value=0.5))    # historical drop
+    _write_round(tmp_path, 3, dict(shape, value=0.99))   # recovered
+    rep = bench_history.run(str(tmp_path))
+    assert [f["round"] for f in rep["regressions"]] == [2]
+    assert rep["latest_regressions"] == []
+
+
+def test_tail_fallback_parses_red_round(tmp_path):
+    """A round whose driver failed to parse still contributes when its
+    tail carries the bench JSON line."""
+    parsed = {"value": 0.3, "n_rows": 100, "platform": "cpu"}
+    _write_round(tmp_path, 1, None,
+                 tail="noise\n%s\nmore noise" % json.dumps(parsed))
+    rep = bench_history.run(str(tmp_path))
+    assert rep["rounds"] == 1
+    assert rep["trajectory"][0]["iters_per_sec"] == 0.3
+
+
+def test_section_series_collated(tmp_path):
+    p1 = {"value": 1.0, "n_rows": 100, "platform": "cpu",
+          "predict": {"engine_rows_per_sec": 1000.0, "rows": 10,
+                      "n_trees": 5}}
+    p2 = {"value": 1.0, "n_rows": 100, "platform": "cpu",
+          "predict": {"engine_rows_per_sec": 400.0, "rows": 10,
+                      "n_trees": 5}}
+    _write_round(tmp_path, 1, p1)
+    _write_round(tmp_path, 2, p2)
+    rep = bench_history.run(str(tmp_path))
+    assert rep["trajectory"][0]["predict_rows_per_sec"] == 1000.0
+    assert any(f["series"] == "predict_rows_per_sec"
+               for f in rep["latest_regressions"])

@@ -1,0 +1,86 @@
+"""Compile-ledger coverage lint (ISSUE 10, helper/check_xla_sites.py).
+
+Pins two properties: the tree is CLEAN (every jit site in lightgbm_tpu/
+registers through xla_obs.jit), and the lint actually CATCHES each
+violation class — drift-detection negatives, the check_syncs pattern.
+"""
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "helper"))
+
+import check_xla_sites  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_tree_is_clean():
+    problems = check_xla_sites.run()
+    assert problems == [], "\n".join(problems)
+
+
+def test_cli_exits_zero():
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "helper", "check_xla_sites.py")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+
+
+def _scan_src(tmp_path, src, allowlist=""):
+    f = tmp_path / "victim.py"
+    f.write_text(src)
+    al = tmp_path / "allow.txt"
+    al.write_text(allowlist)
+    return check_xla_sites.run([str(f)], allowlist_path=str(al))
+
+
+def test_catches_raw_jax_jit_call(tmp_path):
+    p = _scan_src(tmp_path, "import jax\ng = jax.jit(lambda x: x)\n")
+    assert len(p) == 1 and "raw jax.jit" in p[0]
+
+
+def test_catches_jax_jit_decorator(tmp_path):
+    p = _scan_src(tmp_path,
+                  "import functools, jax\n"
+                  "@functools.partial(jax.jit, static_argnames=('k',))\n"
+                  "def f(x, k):\n    return x\n")
+    assert len(p) == 1 and "raw jax.jit" in p[0]
+
+
+def test_catches_jit_import_alias(tmp_path):
+    p = _scan_src(tmp_path, "from jax import jit\ng = jit(lambda x: x)\n")
+    assert p and "imported from jax" in p[0]
+    p2 = _scan_src(tmp_path, "from jax import lax, jit\n")
+    assert p2 and "imported from jax" in p2[0]
+
+
+def test_docstring_and_comment_mentions_are_ignored(tmp_path):
+    p = _scan_src(tmp_path,
+                  '"""Docs mention jax.jit and from jax import jit."""\n'
+                  "# a comment naming jax.jit\n"
+                  "x = 1\n")
+    assert p == []
+
+
+def test_ledgered_site_is_clean(tmp_path):
+    p = _scan_src(tmp_path,
+                  "from lightgbm_tpu.runtime import xla_obs\n"
+                  "g = xla_obs.jit(lambda x: x, site='t.ok')\n")
+    assert p == []
+
+
+def test_allowlist_excuses_reviewed_exception(tmp_path):
+    src = "import jax\ng = jax.jit(lambda x: x)  # reviewed\n"
+    assert _scan_src(tmp_path, src) != []
+    p = _scan_src(tmp_path, src,
+                  allowlist="victim.py: jax\\.jit\\(lambda\n")
+    assert p == []
+
+
+def test_xla_obs_itself_is_exempt():
+    path = os.path.join(REPO, "lightgbm_tpu", "runtime", "xla_obs.py")
+    assert check_xla_sites.run([path]) == []

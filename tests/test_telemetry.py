@@ -593,3 +593,141 @@ def test_online_trainer_records_ingest_and_cycles(tmp_path):
     assert telemetry.histogram(
         "lgbm_online_publish_seconds").state()["count"] == pub_before + 2
     assert telemetry.gauge("lgbm_ingest_window_rows").value() == 800
+
+
+# ---------------------------------------------------------------------------
+# mesh-wide aggregation (ISSUE 10): gather/merge/{host} labels + the
+# concurrent scrape+flush torn-output pin
+# ---------------------------------------------------------------------------
+
+def _two_host_snapshots():
+    ra, rb = _registry(), _registry()
+    ra.counter("t_plain_total").inc(3)
+    ra.histogram("t_hist_seconds").observe(0.02, who="a")
+    rb.counter("t_plain_total").inc(5)
+    rb.gauge("t_gauge").set(7)
+    return {"0": ra.snapshot("hostA"), "1": rb.snapshot("hostB")}
+
+
+def test_merge_host_snapshots_labels_every_series():
+    hosts = _two_host_snapshots()
+    merged = telemetry.merge_host_snapshots(hosts)
+    assert merged["hosts"] == ["0", "1"]
+    series = merged["metrics"]["t_plain_total"]["series"]
+    assert [(e["labels"]["host"], e["value"]) for e in series] \
+        == [("0", 3.0), ("1", 5.0)]
+    h = merged["metrics"]["t_hist_seconds"]["series"][0]
+    assert h["labels"] == {"host": "0", "who": "a"}
+    # {host} labels STABLE: merging again yields the identical structure
+    assert telemetry.merge_host_snapshots(hosts) == merged or \
+        telemetry.merge_host_snapshots(hosts)["metrics"] == \
+        merged["metrics"]
+
+
+def test_render_prometheus_from_merged_snapshot():
+    merged = telemetry.merge_host_snapshots(_two_host_snapshots())
+    text = telemetry.render_prometheus_from_snapshot(
+        merged, table=TEST_TABLE)
+    assert 't_plain_total{host="0"} 3' in text
+    assert 't_plain_total{host="1"} 5' in text
+    assert 't_gauge{host="1"} 7' in text
+    # histogram rendered with cumulative buckets + the +Inf tail
+    assert 't_hist_seconds_bucket{host="0",who="a",le="+Inf"} 1' in text
+    assert 't_hist_seconds_count{host="0",who="a"} 1' in text
+
+
+def test_gather_host_snapshots_single_process_is_host_zero():
+    reg = _registry()
+    reg.counter("t_plain_total").inc()
+    hosts = telemetry.gather_host_snapshots("ctx", registry=reg)
+    assert list(hosts) == ["0"]
+    assert hosts["0"]["context"] == "ctx"
+    merged = telemetry.mesh_snapshot("ctx", registry=reg)
+    assert merged["metrics"]["t_plain_total"]["series"][0]["labels"] \
+        == {"host": "0"}
+
+
+def test_metrics_server_snapshot_provider_serves_merged_view():
+    merged = telemetry.merge_host_snapshots(_two_host_snapshots())
+    srv = telemetry.MetricsServer(
+        port=0, registry=_registry(),
+        snapshot_provider=lambda: merged)
+    try:
+        base = "http://127.0.0.1:%d" % srv.port
+        with urllib.request.urlopen(base + "/metrics.json", timeout=5) as r:
+            snap = json.loads(r.read().decode())
+        assert snap["hosts"] == ["0", "1"]
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert 't_plain_total{host="1"} 5' in text
+    finally:
+        srv.stop()
+
+
+def test_concurrent_scrape_flush_no_torn_output(tmp_path):
+    """Writers hammer the registry, the file exporter flushes, and
+    scrapers read /metrics throughout: every exposition parses with
+    monotone cumulative buckets, every snapshot-file line is valid
+    JSON (the ISSUE 10 test-coverage satellite)."""
+    reg = _registry()
+    srv = telemetry.MetricsServer(port=0, registry=reg)
+    writer = telemetry.MetricsFileWriter(str(tmp_path / "m.jsonl"),
+                                         interval_s=0.01, registry=reg)
+    stop = threading.Event()
+    errors = []
+
+    def hammer(seed):
+        i = 0
+        while not stop.is_set():
+            reg.counter("t_counter_total").inc(kind="k%d" % (seed % 3))
+            reg.histogram("t_hist_seconds").observe(
+                0.001 * ((i % 50) + 1), who="w%d" % seed)
+            reg.gauge("t_gauge").set(i)
+            i += 1
+
+    def scrape():
+        base = "http://127.0.0.1:%d/metrics" % srv.port
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(base, timeout=5) as r:
+                    text = r.read().decode()
+            except OSError as e:            # noqa: PERF203
+                errors.append("scrape: %s" % e)
+                continue
+            if not text.endswith("\n"):
+                errors.append("torn exposition (no trailing newline)")
+            cum = {}
+            for line in text.splitlines():
+                if line.startswith("#") or not line:
+                    continue
+                name_part, _, val = line.rpartition(" ")
+                try:
+                    v = float(val)
+                except ValueError:
+                    errors.append("unparseable sample: %r" % line)
+                    continue
+                if "_bucket{" in name_part:
+                    key = name_part.rsplit(',le="', 1)[0]
+                    if v < cum.get(key, 0.0):
+                        errors.append("non-monotone buckets: %r" % line)
+                    cum[key] = v
+
+    threads = [threading.Thread(target=hammer, args=(i,), daemon=True)
+               for i in range(3)]
+    threads.append(threading.Thread(target=scrape, daemon=True))
+    threads.append(threading.Thread(target=scrape, daemon=True))
+    for t in threads:
+        t.start()
+    time.sleep(1.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    srv.stop()
+    writer.stop()
+    assert errors == [], errors[:5]
+    # every flushed line is intact JSON (atomic rewrite: never torn)
+    lines = (tmp_path / "m.jsonl").read_text().splitlines()
+    assert lines
+    for ln in lines:
+        snap = json.loads(ln)
+        assert "metrics" in snap
