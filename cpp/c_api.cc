@@ -799,6 +799,29 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
   return 0;
 }
 
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                               int predict_type, int num_iteration,
+                               int64_t* out_len) {
+  ModelRef ref(handle);
+  Model* m = ref.m;
+  if (m == nullptr) return -1;
+  if (num_row < 0) return Fail("num_row must be >= 0");
+  int k = m->num_tree_per_iteration;
+  int iters = m->NumIterations();
+  if (num_iteration > 0 && num_iteration < iters) iters = num_iteration;
+  int64_t width;
+  if (predict_type == C_API_PREDICT_LEAF_INDEX) {
+    width = static_cast<int64_t>(iters) * k;
+  } else if (predict_type == C_API_PREDICT_NORMAL ||
+             predict_type == C_API_PREDICT_RAW_SCORE) {
+    width = k;
+  } else {
+    return Fail("unsupported predict_type " + std::to_string(predict_type));
+  }
+  *out_len = static_cast<int64_t>(num_row) * width;
+  return 0;
+}
+
 int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle,
                                        const void* data, int data_type,
                                        int ncol, int is_row_major,

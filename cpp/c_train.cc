@@ -284,6 +284,28 @@ def booster_eval_names(bst):
     return [str(m.name) for m in bst._engine.train_metrics]
 
 
+def booster_inner_predict(bst, data_idx):
+    # reference GBDT::GetPredictAt: the scores the engine already
+    # maintains for the training data (idx 0) or a validation set, with
+    # the objective transform applied, laid out class-major [K*N]
+    bst._drain()
+    if data_idx == 0:
+        raw = np.asarray(bst._engine.raw_train_score(), dtype=np.float64)
+    else:
+        n_valid = len(bst._valid_data)
+        if data_idx - 1 >= n_valid:
+            raise IndexError('data_idx %d out of range (%d valid sets)'
+                             % (data_idx, n_valid))
+        raw = np.asarray(bst._engine.raw_valid_score(data_idx - 1),
+                         dtype=np.float64)
+    obj = bst._objective
+    if obj is not None:
+        conv = np.asarray(obj.convert_output(raw.T), dtype=np.float64)
+        raw = conv.T if conv.ndim == 2 else conv.reshape(1, -1)
+    raw = np.ascontiguousarray(raw, dtype=np.float64)
+    return (raw.tobytes(), int(raw.size))
+
+
 def booster_grad_len(bst):
     ds = bst.train_set
     ds.construct()
@@ -1158,6 +1180,52 @@ int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
     out_results[i] = PyFloat_AsDouble(PyList_GetItem(r, i));
   Py_DECREF(r);
   return 0;
+}
+
+// shared body of GetNumPredict/GetPredict: the helper returns
+// (float64 bytes, count); out_result == nullptr fetches the size only
+static int InnerPredict(BoosterHandle handle, int data_idx, int64_t* out_len,
+                        double* out_result, const char* where) {
+  if (!lgbm_tpu_internal::IsTrainBooster(handle)) {
+    SetLastError(std::string(where) +
+                 ": inner prediction buffers exist on training boosters "
+                 "only (a loaded model has no attached data)");
+    return -1;
+  }
+  PyScope py;
+  if (!py.ok) return -1;
+  PyObject* r = CallHelper(
+      "booster_inner_predict",
+      Py_BuildValue("(Oi)", AsTrain(handle)->bst, data_idx));
+  if (r == nullptr) return -1;
+  PyObject* bytes = PyTuple_GetItem(r, 0);
+  int64_t n = PyLong_AsLongLong(PyTuple_GetItem(r, 1));
+  if (out_len) *out_len = n;
+  if (out_result != nullptr && n > 0) {
+    char* buf = nullptr;
+    Py_ssize_t blen = 0;
+    if (PyBytes_AsStringAndSize(bytes, &buf, &blen) != 0 ||
+        blen != static_cast<Py_ssize_t>(n * sizeof(double))) {
+      Py_DECREF(r);
+      SetLastError(std::string(where) + ": score buffer size mismatch");
+      return -1;
+    }
+    std::memcpy(out_result, buf, blen);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len) {
+  return InnerPredict(handle, data_idx, out_len, nullptr,
+                      "LGBM_BoosterGetNumPredict");
+}
+
+int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                           int64_t* out_len, double* out_result) {
+  return InnerPredict(handle, data_idx, out_len, out_result,
+                      "LGBM_BoosterGetPredict");
 }
 
 int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len) {

@@ -129,6 +129,17 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
                               int num_iteration, const char* parameter,
                               int64_t* out_len, double* out_result);
 
+/* Output-size calculator (reference LGBM_BoosterCalcNumPredict): the
+ * number of doubles a predict over num_row rows will write — num_row *
+ * num_class for normal/raw score, num_row * used_trees for leaf
+ * indices.  Callers size out_result buffers with this instead of
+ * duplicating the width arithmetic.  ADAPTATION: no start_iteration
+ * parameter — this ABI's predict entry points take num_iteration only
+ * (the pre-3.0 reference shape). */
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                               int predict_type, int num_iteration,
+                               int64_t* out_len);
+
 /* One-row prediction (reference LGBM_BoosterPredictForMatSingleRow):
  * the stateless single-row spelling — per-call schema checks, no reuse
  * handle.  Latency-sensitive callers should use the FastInit/Fast pair
@@ -368,6 +379,24 @@ int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len);
  * at least 128 bytes each (the reference's unsized-strcpy contract). */
 int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
                              char** out_strs);
+
+/* Inner prediction buffer (reference LGBM_BoosterGetNumPredict /
+ * LGBM_BoosterGetPredict): the engine's CURRENT scores for the training
+ * data (data_idx = 0) or the data_idx-th validation set, maintained
+ * incrementally across UpdateOneIter — read, never re-predicted.  The
+ * objective transform is applied (sigmoid/softmax/...; raw for
+ * objectives without one) and the layout is class-major
+ * ([class][row], num_class * num_data doubles), matching the
+ * reference's GBDT::GetPredictAt.  Training boosters only: a loaded
+ * model has no attached data.  GetNumPredict sizes out_result for
+ * GetPredict.  NOTE: the engine maintains training scores in float32
+ * on device, so these values agree with an offline float64 predict to
+ * f32 precision (~1e-7 relative), not bit-for-bit. */
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len);
+
+int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                           int64_t* out_len, double* out_result);
 
 /* Distributed bootstrap (reference Network::Init / LGBM_NetworkInit):
  * machines = "ip:port,ip:port,...".  Maps onto jax.distributed — see

@@ -164,3 +164,15 @@ echo "    compile ledger + newest artifacts; COMMIT the printed manifest"
 echo "    line with the round's artifacts so the window leaves evidence"
 timeout 120 python -m lightgbm_tpu task=doctor output_dir=exp/logs 2>&1 | head -3 \
   || echo "   doctor FAILED — collect /tmp manually"
+echo "=== 10. production-sim soak on hardware (ISSUE 11) ==="
+echo "    (closed loop: continuous trainer + 2 serving replicas sharing"
+echo "     one publish dir, diurnal/bursty/step load with priority/quota/"
+echo "     policy knobs live, LGBM_TPU_FAULT churn on — the device path"
+echo "     now serves real micro-batches, so p99/capacity here are the"
+echo "     first HARDWARE serving numbers.  Zero wrong-generation and"
+echo "     byte-identity are hard gates; the artifact is registry-scraped."
+echo "     Commit it as SIM_r<round>.json; helper/bench_history.py"
+echo "     collates SIM_r*.json and flags p99/capacity regressions.)"
+timeout 600 python exp/prod_sim.py /tmp/sim_tpu.json \
+  && python -c "import json; d=json.load(open('/tmp/sim_tpu.json')); print(json.dumps({k: {'p99': v['latency_s']['p99'], 'capacity': v['capacity_rows_per_sec_per_replica'], 'ok': v['ok']} for k, v in d['scenarios'].items()}, indent=1))" \
+  || echo "   prod sim FAILED on hardware — /tmp/sim_tpu.json + replica logs in the tempdir have the ledger"

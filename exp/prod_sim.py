@@ -1,0 +1,576 @@
+#!/usr/bin/env python
+"""Closed-loop production simulation (ISSUE 11 acceptance).
+
+Exercises everything PRs 6-10 built as ONE system under load: a
+deterministic open-loop load generator (runtime/loadgen.py) drives a
+REPLICATED serving fleet — N `ServingRuntime` subprocesses sharing one
+publish directory through the concurrent-reader subscriber seam — while
+the continuous trainer (`task=train_online`, its own subprocess) ingests
+a GROWING stream and publishes on its absolute-clock schedule, and
+`LGBM_TPU_FAULT` device kill/stall churn runs throughout.  The serving
+replicas exercise the full ISSUE 11 knob set: priority classes with
+per-class queue reservations, per-model quotas, and the queue-depth
+hysteresis autoscale/shed policy.
+
+Three scenarios ride the same harness: **binary**, **multiclass**, and
+**lambdarank** ranking (the online path's newest workload — the stream
+carries a query-id column, the rolling window trims on group
+boundaries).
+
+Every number in the committed ``SIM_r11.json`` artifact is scraped from
+the METRICS REGISTRY of the replica processes (latency/staleness
+histograms, per-class offered/shed counters, verification verdicts,
+policy decisions), not from client-side stopwatches.  The correctness
+bar is the chaos-soak bar, continuously applied: zero wrong-generation
+responses and byte-identity of every completed response against the
+offline predictor for the generation it reports.
+
+Usage:  python exp/prod_sim.py [artifact.json] [--quick]
+        (default artifact: SIM_r11.json at the repo root; --quick runs
+        the reduced binary-only smoke the tier-1 test uses)
+        python exp/prod_sim.py --replica <cfg.json> <out.json>
+        (internal: one serving replica + load generator)
+Env:    PROD_SIM_SEED, PROD_SIM_REPLICAS, PROD_SIM_DURATION
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from lightgbm_tpu.runtime import publish, resilience, telemetry  # noqa: E402
+
+SCHEMA_VERSION = 1
+
+#: serving-side fault windows a replica's churn thread draws from
+#: (None = quiet step); the armed fault kills or stalls every device
+#: batch, so the replica must degrade to the host path and recover.
+FAULT_POOL = [None, None, "die_at_predict:1", "slow_predict:0.6"]
+
+#: the three workloads; `query` marks the ranking stream layout
+#: (label, qid, features) consumed via query_column=0.
+SCENARIOS: Dict[str, Dict[str, Any]] = {
+    "binary": {
+        "objective": "binary", "n_features": 8, "num_class": 1,
+        "shape": {"kind": "diurnal"},
+        "train_params": {"objective": "binary", "num_leaves": 15},
+    },
+    "multiclass": {
+        "objective": "multiclass", "n_features": 8, "num_class": 4,
+        "shape": {"kind": "bursty"},
+        "train_params": {"objective": "multiclass", "num_class": 4,
+                         "num_leaves": 15},
+    },
+    "lambdarank": {
+        "objective": "lambdarank", "n_features": 8, "num_class": 1,
+        "query": True, "query_rows": 8,
+        "shape": {"kind": "step"},
+        "train_params": {"objective": "lambdarank", "num_leaves": 15,
+                         "min_data_in_leaf": 5},
+    },
+}
+
+
+# ---------------------------------------------------------------------------
+# stream data
+# ---------------------------------------------------------------------------
+
+def gen_rows(spec: Dict[str, Any], n: int, rng: np.random.Generator,
+             next_qid: int = 0):
+    """(file_rows, next_qid): one deterministic chunk of the scenario's
+    stream file.  Ranking rows carry a globally increasing qid column so
+    appended chunks keep query groups contiguous."""
+    f = spec["n_features"]
+    X = rng.standard_normal((n, f))
+    score = X[:, 0] + 0.4 * X[:, 1] + 0.3 * rng.standard_normal(n)
+    if spec["objective"] == "binary":
+        y = (score > 0).astype(np.float64)
+    elif spec["objective"] == "multiclass":
+        edges = np.quantile(score, np.linspace(0, 1, spec["num_class"] + 1))
+        y = np.clip(np.searchsorted(edges, score) - 1, 0,
+                    spec["num_class"] - 1).astype(np.float64)
+    else:                                   # lambdarank relevance 0..3
+        y = np.clip((score * 1.5 + 1.5), 0, 3).round().astype(np.float64)
+    if spec.get("query"):
+        qsz = spec["query_rows"]
+        n_groups = int(math.ceil(n / qsz))
+        qid = np.repeat(np.arange(next_qid, next_qid + n_groups), qsz)[:n]
+        rows = np.column_stack([y, qid.astype(np.float64), X])
+        return rows, next_qid + n_groups
+    return np.column_stack([y, X]), next_qid
+
+
+class StreamAppender(threading.Thread):
+    """Grows the scenario's stream file on an interval, so the trainer's
+    tail-append ingest and the rolling window both actually move."""
+
+    def __init__(self, path: str, spec: Dict[str, Any], rows_per_append: int,
+                 interval_s: float, seed: int, next_qid: int):
+        super().__init__(name="sim-appender", daemon=True)
+        self.path = path
+        self.spec = spec
+        self.rows_per_append = rows_per_append
+        self.interval_s = interval_s
+        self.rng = np.random.default_rng(seed)
+        self.next_qid = next_qid
+        self.appended = 0
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.wait(self.interval_s):
+            rows, self.next_qid = gen_rows(self.spec, self.rows_per_append,
+                                           self.rng, self.next_qid)
+            with open(self.path, "a") as fh:
+                np.savetxt(fh, rows, delimiter="\t", fmt="%.8g")
+            self.appended += len(rows)
+
+    def stop(self) -> None:
+        self._halt.set()
+
+
+# ---------------------------------------------------------------------------
+# replica subprocess
+# ---------------------------------------------------------------------------
+
+def _make_shape(cfg_shape: Dict[str, Any], duration_s: float):
+    from lightgbm_tpu.runtime.loadgen import TrafficShape
+    kind = cfg_shape.get("kind", "diurnal")
+    base = float(cfg_shape.get("base_rps", 30))
+    peak = float(cfg_shape.get("peak_rps", 120))
+    if kind == "diurnal":
+        return TrafficShape.diurnal(base, peak, period_s=duration_s)
+    if kind == "bursty":
+        return TrafficShape.bursty(base, peak,
+                                   period_s=max(duration_s / 4, 1.0),
+                                   burst_len_s=max(duration_s / 16, 0.25))
+    if kind == "step":
+        third = duration_s / 3.0
+        return TrafficShape.step([(third, base), (third, peak),
+                                  (third, (base + peak) / 2)])
+    raise ValueError("unknown shape kind %r" % kind)
+
+
+class _FaultChurn(threading.Thread):
+    """Seeded serving-fault windows: arm LGBM_TPU_FAULT for a step, then
+    clear it for at least as long (the breaker needs quiet windows to
+    run its recovery probe)."""
+
+    def __init__(self, seed: int, step_s: float, ledger: List[str]):
+        super().__init__(name="sim-fault-churn", daemon=True)
+        self.rng = np.random.default_rng(seed)
+        self.step_s = step_s
+        self.ledger = ledger
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.wait(self.step_s):
+            fault = FAULT_POOL[int(self.rng.integers(0, len(FAULT_POOL)))]
+            if fault is None:
+                continue
+            os.environ["LGBM_TPU_FAULT"] = fault
+            self.ledger.append(fault)
+            if self._halt.wait(self.step_s):
+                break
+        os.environ.pop("LGBM_TPU_FAULT", None)
+
+    def stop(self) -> None:
+        self._halt.set()
+        os.environ.pop("LGBM_TPU_FAULT", None)
+
+
+def run_replica(cfg: Dict[str, Any]) -> Dict[str, Any]:
+    """One serving replica: runtime + policy + fault churn + verifying
+    load generator.  Returns the machine-readable record (ledger +
+    runtime stats + the replica's full metrics snapshot)."""
+    from lightgbm_tpu.runtime.loadgen import (LoadGenerator, RequestClass,
+                                              ResponseVerifier)
+    from lightgbm_tpu.runtime.policy import AutoscaleShedPolicy
+    from lightgbm_tpu.runtime.serving import ServingRuntime
+
+    spec = SCENARIOS[cfg["scenario"]]
+    rng = np.random.default_rng(cfg["seed"])
+    probe = rng.standard_normal((64, spec["n_features"]))
+    policy = AutoscaleShedPolicy(**cfg.get("policy", {}))
+    rt = ServingRuntime(
+        publish_dir=cfg["pub_dir"], params={"verbose": -1},
+        max_queue=int(cfg.get("max_queue", 64)),
+        batch_window_s=0.002,
+        predict_deadline_s=float(cfg.get("predict_deadline_s", 0.5)),
+        breaker_cooldown_s=0.3, poll_interval_s=0.05,
+        priority_levels=3, quotas=cfg.get("quotas") or None,
+        policy=policy)
+    rt.start()
+    deadline = time.monotonic() + 60
+    while rt.generation() is None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    if rt.generation() is None:
+        rt.stop()
+        raise RuntimeError("replica: no generation appeared in %r"
+                           % cfg["pub_dir"])
+
+    classes = [RequestClass("gold", priority=0, weight=1.0, rows=2),
+               RequestClass("silver", priority=1, weight=2.0, rows=4),
+               RequestClass("bulk", priority=2, weight=3.0, rows=8)]
+    shape = _make_shape(dict(spec["shape"], **cfg.get("shape", {})),
+                        cfg["duration_s"])
+    verifier = ResponseVerifier(probe, pub_dir=cfg["pub_dir"],
+                                params={"verbose": -1})
+    faults: List[str] = []
+    churn = _FaultChurn(cfg["seed"] + 7,
+                        step_s=float(cfg.get("fault_step_s", 1.0)),
+                        ledger=faults)
+    gen = LoadGenerator(rt, classes, shape, cfg["duration_s"], probe,
+                        seed=cfg["seed"], verifier=verifier,
+                        deadline_s=float(cfg.get("deadline_s", 2.0)))
+    churn.start()
+    try:
+        ledger = gen.run()
+    finally:
+        churn.stop()
+        churn.join(timeout=10)
+        os.environ.pop("LGBM_TPU_FAULT", None)
+    # post-churn settle so the breaker can demonstrate recovery
+    time.sleep(0.3)
+    stats = rt.stats()
+    rt.stop()
+    return {
+        "ledger": ledger,
+        "stats": {k: stats[k] for k in
+                  ("admitted", "completed", "rows_served", "batches_device",
+                   "batches_host", "swaps", "degradations", "recoveries",
+                   "rejected", "shed_active", "priority_levels")},
+        "policy_decisions": policy.decisions,
+        "faults_injected": faults,
+        "final_generation": stats["generations"].get("default"),
+        "snapshot": telemetry.snapshot("prod_sim_replica"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# registry scraping (the artifact's numbers)
+# ---------------------------------------------------------------------------
+
+def _hist_state(snapshots: List[Dict[str, Any]], name: str
+                ) -> Dict[str, Any]:
+    """Merged histogram state (summed counts over every replica and
+    label set) for one metric family."""
+    buckets = list(telemetry.METRIC_TABLE[name].get(
+        "buckets", telemetry.LATENCY_BUCKETS_S))
+    counts = [0] * len(buckets)
+    total, cnt = 0.0, 0
+    for snap in snapshots:
+        for entry in snap.get("metrics", {}).get(name, {}).get("series", []):
+            for i, v in enumerate(entry.get("counts", [])):
+                counts[i] += v
+            total += entry.get("sum", 0.0)
+            cnt += entry.get("count", 0)
+    return {"buckets": buckets, "counts": counts, "sum": total, "count": cnt}
+
+
+def _sum_counter(snapshots: List[Dict[str, Any]], name: str,
+                 by: Optional[str] = None) -> Dict[str, float]:
+    """Summed counter values across replicas, keyed by label `by` (or
+    "_total" when by is None)."""
+    out: Dict[str, float] = {}
+    for snap in snapshots:
+        for entry in snap.get("metrics", {}).get(name, {}).get("series", []):
+            key = entry.get("labels", {}).get(by, "_total") \
+                if by else "_total"
+            out[key] = out.get(key, 0.0) + entry.get("value", 0.0)
+    return out
+
+
+def _quantiles(state: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        "p50": telemetry.quantile_from_state(state, 0.5),
+        "p99": telemetry.quantile_from_state(state, 0.99),
+        "count": state["count"],
+        "mean": round(state["sum"] / state["count"], 6)
+        if state["count"] else None,
+    }
+
+
+def collate_scenario(name: str, replica_records: List[Dict[str, Any]],
+                     duration_s: float, trainer_info: Dict[str, Any]
+                     ) -> Dict[str, Any]:
+    """One scenario's artifact section, scraped from the replicas'
+    registry snapshots."""
+    snaps = [r["snapshot"] for r in replica_records]
+    ledgers = [r["ledger"] for r in replica_records]
+    n_rep = len(replica_records)
+    rows = _sum_counter(snaps, "lgbm_serve_rows_total").get("_total", 0.0)
+    offered = _sum_counter(snaps, "lgbm_loadgen_offered_total", by="cls")
+    verify = _sum_counter(snaps, "lgbm_loadgen_verified_total", by="result")
+    policy = _sum_counter(snaps, "lgbm_policy_decisions_total", by="action")
+
+    # per-priority-class outcome matrix -> per-class shed ledger
+    class_names = {0: "gold", 1: "silver", 2: "bulk"}
+    by_class: Dict[str, Dict[str, float]] = {}
+    for snap in snaps:
+        fam = snap.get("metrics", {}).get("lgbm_serve_class_requests_total",
+                                          {})
+        for entry in fam.get("series", []):
+            lab = entry.get("labels", {})
+            cls = lab.get("cls", "?")
+            slot = by_class.setdefault(cls, {})
+            slot[lab.get("outcome", "?")] = \
+                slot.get(lab.get("outcome", "?"), 0.0) + entry["value"]
+    classes: Dict[str, Any] = {}
+    for p, cname in class_names.items():
+        outcomes = by_class.get("p%d" % p, {})
+        done = outcomes.get("completed", 0.0)
+        shed = sum(v for k, v in outcomes.items() if k != "completed")
+        off = offered.get(cname, 0.0)
+        classes[cname] = {
+            "priority": p,
+            "offered": int(off),
+            "completed": int(done),
+            "shed": int(shed),
+            "shed_rate": round(shed / off, 4) if off else 0.0,
+            "reasons": {k: int(v) for k, v in outcomes.items()
+                        if k != "completed"},
+        }
+
+    faults = sum((r["faults_injected"] for r in replica_records), [])
+    sec = {
+        "objective": SCENARIOS[name]["objective"],
+        "replicas": n_rep,
+        "duration_s": duration_s,
+        "shape": ledgers[0]["shape"] if ledgers else None,
+        "offered_total": int(sum(offered.values())),
+        "offered_rps_mean": round(sum(offered.values())
+                                  / max(duration_s, 1e-9), 2),
+        "latency_s": _quantiles(_hist_state(snaps,
+                                            "lgbm_serve_latency_seconds")),
+        "staleness_s": _quantiles(_hist_state(
+            snaps, "lgbm_serve_staleness_seconds")),
+        "capacity_rows_per_sec_per_replica": round(
+            rows / max(duration_s, 1e-9) / max(n_rep, 1), 2),
+        "classes": classes,
+        "verification": {k: int(v) for k, v in verify.items()},
+        "non_machine_readable_rejections": sum(
+            led["non_machine_readable_rejections"] for led in ledgers),
+        "hard_errors": sum((led["hard_errors"] for led in ledgers), [])[:10],
+        "served_by": {
+            "device": sum(led["served_by"].get("device", 0)
+                          for led in ledgers),
+            "host": sum(led["served_by"].get("host", 0) for led in ledgers)},
+        "degradations": sum(r["stats"]["degradations"]
+                            for r in replica_records),
+        "recoveries": sum(r["stats"]["recoveries"] for r in replica_records),
+        "swaps": sum(r["stats"]["swaps"] for r in replica_records),
+        "policy_decisions": {k: int(v) for k, v in policy.items()},
+        "faults_injected": faults,
+        "final_generations": [r["final_generation"]
+                              for r in replica_records],
+        "trainer": trainer_info,
+    }
+    # every completed response must have produced a verdict — a silent
+    # verification undercount (e.g. a dead client-pool thread) fails the
+    # scenario even when the verdicts that DID land are all clean
+    sec["loadgen_completed"] = sum(
+        sum(c["completed"] for c in led["classes"].values())
+        for led in ledgers)
+    sec["verified_total"] = int(sum(verify.values()))
+    wrong = sec["verification"].get("wrong_generation", 0) \
+        + sec["verification"].get("mismatch", 0) \
+        + sec["verification"].get("unverifiable", 0)
+    sec["ok"] = bool(
+        sec["verification"].get("ok", 0) > 0
+        and sec["verified_total"] == sec["loadgen_completed"]
+        and wrong == 0
+        and not sec["hard_errors"]
+        and sec["non_machine_readable_rejections"] == 0
+        and trainer_info.get("generations", 0) >= 2
+        and min(g or 0 for g in sec["final_generations"]) >= 2
+        # churn must actually have pushed traffic onto the host path
+        and (not faults or sec["served_by"]["host"] > 0))
+    return sec
+
+
+# ---------------------------------------------------------------------------
+# one scenario end to end
+# ---------------------------------------------------------------------------
+
+def run_scenario(name: str, workdir: str, replicas: int = 2,
+                 duration_s: float = 20.0, interval_s: float = 3.0,
+                 seed: int = 11, initial_rows: int = 1200,
+                 window_rows: int = 2000, log=print) -> Dict[str, Any]:
+    spec = SCENARIOS[name]
+    sdir = os.path.join(workdir, name)
+    os.makedirs(sdir, exist_ok=True)
+    pub_dir = os.path.join(sdir, "pub")
+    data_path = os.path.join(sdir, "stream.tsv")
+
+    rng = np.random.default_rng(seed)
+    rows, next_qid = gen_rows(spec, initial_rows, rng)
+    np.savetxt(data_path, rows, delimiter="\t", fmt="%.8g")
+
+    env = dict(os.environ)
+    env.pop("LGBM_TPU_FAULT", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    # -- the continuous trainer: its own process, publishing forever ------
+    train_args = ["task=train_online", "data=" + data_path,
+                  "output_model=" + os.path.join(sdir, "model.txt"),
+                  "publish_dir=" + pub_dir,
+                  "online_interval=%g" % interval_s,
+                  "online_cycles=0", "online_rounds=3",
+                  "online_window_rows=%d" % window_rows,
+                  # retention must cover the whole run: the verifier
+                  # re-reads any generation a response names
+                  "publish_retention=1000", "publish_grace=600",
+                  "verbose=-1"]
+    if spec.get("query"):
+        train_args.append("query_column=0")
+    for k, v in spec["train_params"].items():
+        train_args.append("%s=%s" % (k, v))
+    t_log = open(os.path.join(sdir, "trainer.log"), "w")
+    trainer = subprocess.Popen(
+        [sys.executable, "-m", "lightgbm_tpu"] + train_args,
+        cwd=sdir, env=env, stdout=t_log, stderr=subprocess.STDOUT)
+
+    appender = StreamAppender(data_path, spec,
+                              rows_per_append=max(window_rows // 8, 100),
+                              interval_s=max(interval_s / 2, 0.5),
+                              seed=seed + 1, next_qid=next_qid)
+    appender.start()
+
+    try:
+        # wait for generation 1 before pointing replicas at the dir
+        sub = publish.ModelSubscriber(pub_dir, attempts=1)
+        deadline = time.monotonic() + max(duration_s * 3, 120)
+        while sub.resolve_once() is None:
+            if trainer.poll() is not None:
+                raise RuntimeError(
+                    "trainer died before the first publish (see %s)"
+                    % t_log.name)
+            if time.monotonic() > deadline:
+                raise RuntimeError("no generation published in time")
+            time.sleep(0.1)
+
+        # -- the replica fleet -------------------------------------------
+        procs = []
+        for r in range(replicas):
+            cfg = {"scenario": name, "pub_dir": pub_dir,
+                   "duration_s": duration_s, "seed": seed + 100 * (r + 1),
+                   "quotas": {"default": 0.75},
+                   "policy": {"high_watermark": 0.6, "low_watermark": 0.2,
+                              "patience": 3, "interval_s": 0.05},
+                   "fault_step_s": max(duration_s / 12, 0.5)}
+            cfg_path = os.path.join(sdir, "replica%d.json" % r)
+            out_path = os.path.join(sdir, "replica%d.out.json" % r)
+            with open(cfg_path, "w") as fh:
+                json.dump(cfg, fh)
+            rlog = open(os.path.join(sdir, "replica%d.log" % r), "w")
+            procs.append((subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--replica",
+                 cfg_path, out_path],
+                cwd=sdir, env=env, stdout=rlog, stderr=subprocess.STDOUT),
+                out_path, rlog))
+        records = []
+        for proc, out_path, rlog in procs:
+            rc = proc.wait(timeout=duration_s * 6 + 180)
+            rlog.close()
+            if rc != 0:
+                with open(rlog.name) as fh:
+                    raise RuntimeError("replica failed (rc=%d): %s"
+                                       % (rc, fh.read()[-2000:]))
+            with open(out_path) as fh:
+                records.append(json.load(fh))
+    finally:
+        appender.stop()
+        trainer.send_signal(signal.SIGTERM)
+        try:
+            trainer.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            trainer.kill()
+        t_log.close()
+
+    latest = publish.ModelPublisher(pub_dir).latest_valid()
+    trainer_info = {
+        "generations": latest.generation if latest else 0,
+        "interval_s": interval_s,
+        "rows_appended": appender.appended,
+        "exit_rc": trainer.returncode,
+    }
+    sec = collate_scenario(name, records, duration_s, trainer_info)
+    log("prod_sim[%s]: ok=%s offered=%d p99=%.3fs staleness_p50=%.1fs "
+        "capacity=%.0f rows/s/replica sheds=%s gens=%s"
+        % (name, sec["ok"], sec["offered_total"],
+           sec["latency_s"]["p99"] or -1, sec["staleness_s"]["p50"] or -1,
+           sec["capacity_rows_per_sec_per_replica"],
+           {c: v["shed"] for c, v in sec["classes"].items()},
+           trainer_info["generations"]))
+    return sec
+
+
+def run_sim(workdir: str, scenarios: Optional[List[str]] = None,
+            replicas: int = 2, duration_s: float = 20.0,
+            interval_s: float = 3.0, seed: int = 11,
+            log=print) -> Dict[str, Any]:
+    t0 = time.monotonic()
+    out: Dict[str, Any] = {
+        "artifact": "SIM_r11",
+        "schema_version": SCHEMA_VERSION,
+        "t_start": resilience.wallclock(),
+        "replicas": replicas,
+        "duration_s": duration_s,
+        "seed": seed,
+        "scenarios": {},
+    }
+    for name in (scenarios or list(SCENARIOS)):
+        out["scenarios"][name] = run_scenario(
+            name, workdir, replicas=replicas, duration_s=duration_s,
+            interval_s=interval_s, seed=seed, log=log)
+    out["elapsed_s"] = round(time.monotonic() - t0, 1)
+    out["ok"] = bool(out["scenarios"]) and all(
+        s["ok"] for s in out["scenarios"].values())
+    return out
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) > 1 and argv[1] == "--replica":
+        with open(argv[2]) as fh:
+            cfg = json.load(fh)
+        rec = run_replica(cfg)
+        resilience.atomic_write(argv[3], json.dumps(rec))
+        return 0
+    import tempfile
+    quick = "--quick" in argv
+    args = [a for a in argv[1:] if not a.startswith("--")]
+    artifact = args[0] if args else os.path.join(REPO, "SIM_r11.json")
+    seed = int(os.environ.get("PROD_SIM_SEED", "11"))
+    replicas = int(os.environ.get("PROD_SIM_REPLICAS", "2"))
+    duration = float(os.environ.get("PROD_SIM_DURATION",
+                                    "8" if quick else "20"))
+    with tempfile.TemporaryDirectory(prefix="lgbm_prod_sim_") as wd:
+        rec = run_sim(wd, scenarios=["binary"] if quick else None,
+                      replicas=replicas, duration_s=duration,
+                      interval_s=2.0 if quick else 3.0, seed=seed)
+    # a malformed artifact must fail loudly, not land in the repo
+    from helper.bench_history import validate_sim_artifact
+    problems = validate_sim_artifact(rec)
+    if problems:
+        print("prod_sim: INVALID artifact: %s" % "; ".join(problems))
+        return 2
+    resilience.atomic_write(artifact, json.dumps(rec, indent=1) + "\n")
+    print("prod_sim: ok=%s scenarios=%s elapsed=%.0fs artifact=%s"
+          % (rec["ok"], ",".join(rec["scenarios"]), rec["elapsed_s"],
+             artifact), flush=True)
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Bench-trajectory collator (ISSUE 10 satellite).
+"""Bench + sim trajectory collator (ISSUE 10 / ISSUE 11 satellites).
 
 Five ``BENCH_r*.json`` driver artifacts sit at the repo root, yet the
 round reports kept describing an "empty bench trajectory" — nothing
@@ -10,14 +10,25 @@ against the best PRIOR round measured at the same shape — cross-scale
 comparisons (a 2M-row CPU round vs a 200k-row fallback round) are
 meaningless and are never compared.
 
-Artifact shape: the driver wraps each round's bench stdout as
+ISSUE 11 extends the same treatment to the production-sim artifacts
+(``SIM_r*.json`` from exp/prod_sim.py): per-scenario p99 latency
+(lower is better — a rise past the threshold flags) and capacity in
+rows/sec/replica (higher is better — a drop flags), compared only
+between rounds with the same replica count and duration.  Every SIM
+artifact is schema-validated first (`validate_sim_artifact`); a
+malformed sim run fails the collation loudly instead of collating as
+zeros.
+
+Artifact shape (bench): the driver wraps each round's bench stdout as
 ``{"n": round, "rc": ..., "parsed": <bench JSON>, "tail": ...}``; when
 ``parsed`` is missing the last JSON-looking line of ``tail`` is tried.
+SIM artifacts are written directly by exp/prod_sim.py (schema_version
+stamped).
 
 Run standalone (``python helper/bench_history.py``; exit 1 when a
-regression is flagged) or through the tier-1 pin in
-``tests/test_bench_history.py`` (committed r01–r05 fixtures collate
-clean; synthetic drops ARE flagged)."""
+regression is flagged or a SIM artifact is malformed) or through the
+tier-1 pin in ``tests/test_bench_history.py`` (committed fixtures
+collate clean; synthetic drops ARE flagged)."""
 from __future__ import annotations
 
 import glob
@@ -148,21 +159,186 @@ def regressions(rounds: List[Dict[str, Any]],
     return sorted(flags, key=lambda f: (f["round"], f["series"]))
 
 
+# ---------------------------------------------------------------------------
+# production-sim artifacts (SIM_r*.json, ISSUE 11)
+# ---------------------------------------------------------------------------
+
+#: (series name, scenario-relative path, higher_is_better)
+SIM_SERIES: Tuple[Tuple[str, Tuple[str, ...], bool], ...] = (
+    ("p99_latency_s", ("latency_s", "p99"), False),
+    ("staleness_p50_s", ("staleness_s", "p50"), False),
+    ("capacity_rows_per_sec_per_replica",
+     ("capacity_rows_per_sec_per_replica",), True),
+)
+
+#: scenario keys every SIM artifact must carry with these types; the
+#: schema gate that makes a malformed sim run fail loudly
+_SIM_SCENARIO_REQUIRED = (
+    ("objective", str),
+    ("latency_s", dict),
+    ("staleness_s", dict),
+    ("capacity_rows_per_sec_per_replica", (int, float)),
+    ("classes", dict),
+    ("verification", dict),
+    ("ok", bool),
+)
+
+
+def validate_sim_artifact(rec: Any) -> List[str]:
+    """Schema problems of one SIM artifact dict (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(rec, dict):
+        return ["artifact is not a JSON object"]
+    if not str(rec.get("artifact", "")).startswith("SIM_"):
+        problems.append("artifact name %r does not start with SIM_"
+                        % rec.get("artifact"))
+    if not isinstance(rec.get("schema_version"), int):
+        problems.append("schema_version missing or not an int")
+    if not isinstance(rec.get("replicas"), int) or rec.get("replicas", 0) < 1:
+        problems.append("replicas missing or < 1")
+    if not isinstance(rec.get("ok"), bool):
+        problems.append("ok flag missing")
+    scenarios = rec.get("scenarios")
+    if not isinstance(scenarios, dict) or not scenarios:
+        problems.append("scenarios missing or empty")
+        return problems
+    for name, sec in scenarios.items():
+        if not isinstance(sec, dict):
+            problems.append("scenario %r is not an object" % name)
+            continue
+        for key, typ in _SIM_SCENARIO_REQUIRED:
+            if not isinstance(sec.get(key), typ):
+                problems.append("scenario %r: %s missing or wrong type"
+                                % (name, key))
+        for hkey in ("latency_s", "staleness_s"):
+            h = sec.get(hkey)
+            if isinstance(h, dict):
+                for q in ("p50", "p99", "count"):
+                    if q not in h:
+                        problems.append("scenario %r: %s.%s missing"
+                                        % (name, hkey, q))
+        for cname, cls in (sec.get("classes") or {}).items():
+            if not isinstance(cls, dict):
+                problems.append("scenario %r: class %r is not an object"
+                                % (name, cname))
+                continue
+            for key in ("priority", "offered", "completed", "shed",
+                        "shed_rate", "reasons"):
+                if key not in cls:
+                    problems.append("scenario %r: class %r misses %s"
+                                    % (name, cname, key))
+    return problems
+
+
+def load_sim_rounds(repo: str = REPO):
+    """(valid rounds sorted by number, problems of the invalid ones)."""
+    rounds: List[Dict[str, Any]] = []
+    problems: List[str] = []
+    for path in glob.glob(os.path.join(repo, "SIM_r*.json")):
+        m = re.search(r"SIM_r(\d+)\.json$", path)
+        if not m:
+            continue
+        base = os.path.basename(path)
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError) as e:
+            problems.append("%s: unreadable (%s)" % (base, e))
+            continue
+        bad = validate_sim_artifact(rec)
+        if bad:
+            problems.append("%s: %s" % (base, "; ".join(bad)))
+            continue
+        rec["_round"] = int(m.group(1))
+        rec["_file"] = base
+        rounds.append(rec)
+    return sorted(rounds, key=lambda r: r["_round"]), problems
+
+
+def sim_trajectory(rounds: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """One row per (round, scenario) with the SIM_SERIES values."""
+    rows = []
+    for rec in rounds:
+        for scen, sec in sorted(rec["scenarios"].items()):
+            row: Dict[str, Any] = {
+                "round": rec["_round"], "scenario": scen,
+                "replicas": rec.get("replicas"),
+                "duration_s": rec.get("duration_s"),
+                "ok": sec.get("ok"),
+            }
+            for name, path, _ in SIM_SERIES:
+                v = _get(sec, path)
+                if v is not None:
+                    row[name] = v
+            rows.append(row)
+    return rows
+
+
+def sim_regressions(rounds: List[Dict[str, Any]],
+                    threshold: float = REGRESSION_THRESHOLD
+                    ) -> List[Dict[str, Any]]:
+    """Rounds whose scenario series moved > threshold the WRONG way vs
+    the best prior round at the same (scenario, replicas, duration)."""
+    flags: List[Dict[str, Any]] = []
+    for name, path, higher_better in SIM_SERIES:
+        best: Dict[Tuple, Tuple[float, int]] = {}
+        for rec in rounds:
+            for scen, sec in sorted(rec["scenarios"].items()):
+                v = _get(sec, path)
+                if not isinstance(v, (int, float)):
+                    continue
+                shape = (scen, repr(rec.get("replicas")),
+                         repr(rec.get("duration_s")))
+                prior = best.get(shape)
+                if prior is not None and prior[0] > 0:
+                    worse = (v < prior[0] * (1.0 - threshold)
+                             if higher_better
+                             else v > prior[0] * (1.0 + threshold))
+                    if worse:
+                        flags.append({
+                            "round": rec["_round"], "scenario": scen,
+                            "series": name, "value": v,
+                            "best_prior": prior[0],
+                            "best_prior_round": prior[1],
+                            "change_pct": round(
+                                (v / prior[0] - 1.0) * 100, 1),
+                            "shape": shape,
+                        })
+                better = (prior is None or
+                          (v > prior[0] if higher_better else v < prior[0]))
+                if better:
+                    best[shape] = (float(v), rec["_round"])
+    return sorted(flags, key=lambda f: (f["round"], f["scenario"],
+                                        f["series"]))
+
+
 def run(repo: str = REPO,
         threshold: float = REGRESSION_THRESHOLD) -> Dict[str, Any]:
     """Trajectory + all per-round regression flags.  The CHECK gates on
     the LATEST round only (``latest_regressions``): the tool runs after
     every round, so an old round's drop was that round's report — only a
-    fresh drop should fail the current one."""
+    fresh drop should fail the current one.  SIM artifacts collate
+    alongside with the same latest-round gating, plus a hard schema
+    gate: an invalid SIM artifact always fails."""
     rounds = load_rounds(repo)
     flags = regressions(rounds, threshold)
     latest = rounds[-1]["_round"] if rounds else None
+    sim_rounds, sim_problems = load_sim_rounds(repo)
+    sim_flags = sim_regressions(sim_rounds, threshold)
+    sim_latest = sim_rounds[-1]["_round"] if sim_rounds else None
     return {"rounds": len(rounds),
             "latest_round": latest,
             "trajectory": trajectory(rounds),
             "regressions": flags,
             "latest_regressions": [f for f in flags
-                                   if f["round"] == latest]}
+                                   if f["round"] == latest],
+            "sim_rounds": len(sim_rounds),
+            "sim_latest_round": sim_latest,
+            "sim_trajectory": sim_trajectory(sim_rounds),
+            "sim_regressions": sim_flags,
+            "sim_latest_regressions": [f for f in sim_flags
+                                       if f["round"] == sim_latest],
+            "invalid_sim_artifacts": sim_problems}
 
 
 def main(argv=None) -> int:
@@ -181,10 +357,30 @@ def main(argv=None) -> int:
               % (kind, f["round"], f["series"], f["value"], f["drop_pct"],
                  f["best_prior_round"], f["best_prior"]))
     print(json.dumps(rep["trajectory"][-1] if rep["trajectory"] else {}))
-    if not rep["latest_regressions"]:
+    if rep["sim_rounds"] or rep["invalid_sim_artifacts"]:
+        print("bench_history: %d sim round(s) collated" % rep["sim_rounds"])
+        sim_cols = ["round", "scenario", "p99_latency_s", "staleness_p50_s",
+                    "capacity_rows_per_sec_per_replica", "ok"]
+        print("  ".join("%-13s" % c for c in sim_cols))
+        for row in rep["sim_trajectory"]:
+            print("  ".join("%-13s" % (row.get(c, "-"),) for c in sim_cols))
+        for f in rep["sim_regressions"]:
+            kind = ("SIM REGRESSION"
+                    if f["round"] == rep["sim_latest_round"]
+                    else "historical sim regression")
+            print("%s: round %d %s %s = %s moved %+.1f%% vs round %d's %s"
+                  % (kind, f["round"], f["scenario"], f["series"],
+                     f["value"], f["change_pct"], f["best_prior_round"],
+                     f["best_prior"]))
+        for p in rep["invalid_sim_artifacts"]:
+            print("INVALID SIM ARTIFACT: %s" % p)
+    failed = bool(rep["latest_regressions"]
+                  or rep["sim_latest_regressions"]
+                  or rep["invalid_sim_artifacts"])
+    if not failed:
         print("bench_history: OK (latest round has no >%.0f%% regression)"
               % (REGRESSION_THRESHOLD * 100))
-    return 1 if rep["latest_regressions"] else 0
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

@@ -437,6 +437,48 @@ class TrainBooster:
             ctypes.byref(out_len), buf))
         return buf.value.decode()
 
+    # -- inner prediction buffer (reference GetNumPredict/GetPredict) --------
+    def num_predict(self, data_idx: int = 0) -> int:
+        """LGBM_BoosterGetNumPredict: size of the engine's current score
+        buffer for the training data (0) or the data_idx-th valid set."""
+        out = ctypes.c_int64(0)
+        _check_train(load_train_lib().LGBM_BoosterGetNumPredict(
+            self._handle, ctypes.c_int(data_idx), ctypes.byref(out)))
+        return out.value
+
+    def get_predict(self, data_idx: int = 0) -> np.ndarray:
+        """LGBM_BoosterGetPredict: the incrementally-maintained scores
+        with the objective transform applied, [num_class, num_data]
+        (class-major, the reference GetPredictAt layout); squeezed to
+        [num_data] for single-output objectives."""
+        n = self.num_predict(data_idx)
+        out = np.zeros(max(n, 1), dtype=np.float64)
+        out_len = ctypes.c_int64(0)
+        _check_train(load_train_lib().LGBM_BoosterGetPredict(
+            self._handle, ctypes.c_int(data_idx), ctypes.byref(out_len),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        out = out[: out_len.value]
+        k = max(self.num_class, 1)
+        return out.reshape(k, -1) if k > 1 else out
+
+    @property
+    def num_class(self) -> int:
+        out = ctypes.c_int(0)
+        _check_train(load_train_lib().LGBM_BoosterGetNumClasses(
+            self._handle, ctypes.byref(out)))
+        return out.value
+
+    def calc_num_predict(self, num_row: int, predict_type: int = 0,
+                         num_iteration: int = -1) -> int:
+        """LGBM_BoosterCalcNumPredict: doubles a predict over num_row
+        rows will write (works on training AND loaded boosters)."""
+        out = ctypes.c_int64(0)
+        _check_train(load_train_lib().LGBM_BoosterCalcNumPredict(
+            self._handle, ctypes.c_int(num_row),
+            ctypes.c_int(predict_type), ctypes.c_int(num_iteration),
+            ctypes.byref(out)))
+        return out.value
+
 
 class NativeBooster:
     """Minimal handle over the C API, mirroring Booster's predict surface."""
@@ -584,6 +626,19 @@ class NativeBooster:
             b"", ctypes.byref(out_len),
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
         return out[: out_len.value]
+
+    def calc_num_predict(self, num_row: int, predict_type: int = 0,
+                         num_iteration: int = -1) -> int:
+        """LGBM_BoosterCalcNumPredict: the number of doubles a predict
+        over num_row rows writes — num_row*num_class for normal/raw,
+        num_row*used_trees for leaf indices.  Size predict buffers with
+        this instead of duplicating the width arithmetic."""
+        out = ctypes.c_int64(0)
+        _check(load_lib().LGBM_BoosterCalcNumPredict(
+            self._handle, ctypes.c_int(num_row),
+            ctypes.c_int(predict_type), ctypes.c_int(num_iteration),
+            ctypes.byref(out)))
+        return out.value
 
     def get_leaf_value(self, tree_idx: int, leaf_idx: int) -> float:
         """One leaf's output value (LGBM_BoosterGetLeafValue — the

@@ -377,9 +377,19 @@ class BinnedDataset:
         src = self.metadata
         if src is not None:
             if src.query_boundaries is not None:
-                Log.fatal("GetSubset of a ranking dataset (query "
-                          "boundaries set) is not supported; subset the "
-                          "raw data group-wise instead")
+                # Ranking subset (ISSUE 11): slice the query structure
+                # along with the rows.  Each kept row maps to its source
+                # query; since idx is sorted ascending, rows of one query
+                # stay contiguous, so the subset's boundaries are the
+                # run lengths of that mapping.  Whole kept groups keep
+                # their size; partially-kept groups shrink (the
+                # rolling-window trainer cuts on group boundaries, so in
+                # that path groups are always whole).
+                qb = src.query_boundaries
+                row_query = np.searchsorted(qb, idx, side="right") - 1
+                starts = np.flatnonzero(np.diff(row_query)) + 1
+                counts = np.diff(np.concatenate([[0], starts, [k]]))
+                md.set_query(counts)
             if src.label is not None:
                 md.set_label(src.label[idx])
             if src.weight is not None:

@@ -85,12 +85,24 @@ class OnlineParams:
         self.label_column = int(p.pop("label_column", p.pop("label", 0) or 0))
         self.has_header = str(p.pop("has_header", p.pop("header", ""))
                               ).lower() in ("true", "1") or None
+        # ranking online path (ISSUE 11): `query_column=<i>` names the
+        # parsed FEATURE column (post label extraction) carrying the
+        # query id; consecutive equal ids form one query group.  The
+        # column is stripped from the features, the rolling window trims
+        # only on group boundaries, and each cycle's dataset carries the
+        # window's group sizes — lambdarank streams like any objective.
+        qc = p.pop("query_column", None)
+        self.query_column = int(qc) if qc is not None else None
         self.train_params = p
         if not self.data:
             raise LightGBMError("train_online needs data=<file>")
         if self.mode not in ("boost", "refit"):
             raise LightGBMError("online_mode must be boost or refit, got %r"
                                 % self.mode)
+        if self.query_column is not None and self.mode == "refit":
+            raise LightGBMError("query_column (ranking) requires "
+                                "online_mode=boost; refit re-fits leaf "
+                                "values without query structure")
 
 
 class _IngestProducer(threading.Thread):
@@ -118,14 +130,14 @@ class _IngestProducer(threading.Thread):
         self._ready = threading.Event()
         self._stop = threading.Event()
         self._lock = threading.Lock()
-        self._latest: Optional[Tuple[Tuple, np.ndarray, np.ndarray]] = None
+        self._latest: Optional[Tuple] = None   # (stamp, X, y, q)
         self._error: Optional[BaseException] = None
         self._stamp: Optional[Tuple] = None
         # incremental-parse state
         self._fmt: Optional[Tuple] = None   # (fmt, sep, n_features)
         self._offset: Optional[int] = None  # bytes consumed (None = no tail)
         self._sig: bytes = b""
-        self._chunks: list = []             # [(X, y)] rolling window
+        self._chunks: list = []             # [(X, y, q)] rolling window
         # ingest telemetry (read by the cycle stage trail and the pins)
         self.last_ingest: Optional[Dict[str, Any]] = None
         self.rows_parsed_total = 0
@@ -194,9 +206,23 @@ class _IngestProducer(threading.Thread):
         self._sig = (self._sig + consumed)[-(self._offset - lo):]
         return X, y
 
-    def _append_window(self, X: np.ndarray, y: np.ndarray) -> None:
+    def _split_query(self, X: np.ndarray):
+        """Strip the query-id column (ranking mode): (features, qid)."""
+        qc = self.cfg.query_column
+        if qc is None:
+            return X, None
+        if X.shape[0] == 0:
+            return X[:, : max(X.shape[1] - 1, 0)], np.empty(0, np.int64)
+        if not 0 <= qc < X.shape[1]:
+            raise LightGBMError("query_column %d out of range for %d "
+                                "parsed columns" % (qc, X.shape[1]))
+        q = X[:, qc].astype(np.int64)
+        return np.delete(X, qc, axis=1), q
+
+    def _append_window(self, X: np.ndarray, y: np.ndarray,
+                       q: Optional[np.ndarray]) -> None:
         if X.shape[0]:
-            self._chunks.append((X, y))
+            self._chunks.append((X, y, q))
         w = self.cfg.window_rows
         if w <= 0:
             return
@@ -206,15 +232,31 @@ class _IngestProducer(threading.Thread):
             total -= self._chunks[0][0].shape[0]
             self._chunks.pop(0)
         if total > w:
-            X0, y0 = self._chunks[0]
+            X0, y0, q0 = self._chunks[0]
             cut = total - w
-            self._chunks[0] = (X0[cut:], y0[cut:])
+            if q0 is not None and cut < q0.size:
+                # ranking window: never split a query group — advance the
+                # cut to the next group boundary (the window may come up
+                # slightly short of `window_rows`, never torn mid-query)
+                boundaries = np.flatnonzero(np.diff(q0)) + 1
+                later = boundaries[boundaries >= cut]
+                cut = int(later[0]) if later.size else q0.size
+            if cut >= X0.shape[0] and len(self._chunks) > 1:
+                self._chunks.pop(0)
+            else:
+                self._chunks[0] = (
+                    X0[cut:], y0[cut:],
+                    q0[cut:] if q0 is not None else None)
 
-    def _window(self) -> Tuple[np.ndarray, np.ndarray]:
+    def _window(self):
         Xs = [c[0] for c in self._chunks]
         ys = [c[1] for c in self._chunks]
+        qs = [c[2] for c in self._chunks]
+        q = None
+        if qs and qs[0] is not None:
+            q = np.concatenate(qs) if len(qs) > 1 else qs[0]
         return (np.concatenate(Xs) if len(Xs) > 1 else Xs[0],
-                np.concatenate(ys) if len(ys) > 1 else ys[0])
+                np.concatenate(ys) if len(ys) > 1 else ys[0], q)
 
     def _parse_once(self) -> None:
         t0 = time.perf_counter()
@@ -234,11 +276,12 @@ class _IngestProducer(threading.Thread):
             self._chunks = []
             self._record_offset(size)
         parsed = int(X.shape[0])
-        self._append_window(X, y)
-        Xw, yw = self._window()
+        X, q = self._split_query(X)
+        self._append_window(X, y, q)
+        Xw, yw, qw = self._window()
         dt = time.perf_counter() - t0
         with self._lock:
-            self._latest = (self._stamp, Xw, yw)
+            self._latest = (self._stamp, Xw, yw, qw)
         self.rows_parsed_total += parsed
         self.last_ingest = {
             "mode": mode, "rows_parsed": parsed,
@@ -273,7 +316,9 @@ class _IngestProducer(threading.Thread):
     def stop(self) -> None:
         self._stop.set()
 
-    def current(self, timeout: float) -> Tuple[Tuple, np.ndarray, np.ndarray]:
+    def current(self, timeout: float) -> Tuple:
+        """(stamp, X, y, query_ids) of the freshest staged window; the
+        query ids are None outside ranking mode."""
         if not self._ready.wait(timeout):
             raise LightGBMError("online ingest: no parsed window of %s "
                                 "within %.0fs" % (self.cfg.data, timeout))
@@ -349,7 +394,15 @@ class ContinuousTrainer:
         except OSError:
             return False
 
-    def _make_dataset(self, X, y):
+    @staticmethod
+    def _group_sizes(q: Optional[np.ndarray]) -> Optional[np.ndarray]:
+        """Run lengths of consecutive equal query ids (ranking mode)."""
+        if q is None or q.size == 0:
+            return None
+        starts = np.flatnonzero(np.diff(q)) + 1
+        return np.diff(np.concatenate([[0], starts, [q.size]]))
+
+    def _make_dataset(self, X, y, q=None):
         from ..basic import Dataset
         from ..config import Config
         from ..io.dataset import BinnedDataset
@@ -368,15 +421,15 @@ class ContinuousTrainer:
                 # service rebuilds the cache instead of wedging the cycle
                 self.log.warning("online: binary window cache unusable "
                                  "(%s); rebuilding it", e)
-        ds = Dataset(X, label=y, params=params)
+        ds = Dataset(X, label=y, group=self._group_sizes(q), params=params)
         if self.cfg.save_binary:
             ds.construct(Config(params))
             ds.save_binary(self._binary_cache_path())
         return ds
 
-    def _build_booster(self, X, y, init_model=None, snap_state=None):
+    def _build_booster(self, X, y, q=None, init_model=None, snap_state=None):
         from ..basic import Booster
-        ds = self._make_dataset(X, y)
+        ds = self._make_dataset(X, y, q)
         bst = Booster(params=dict(self.cfg.train_params), train_set=ds,
                       init_model=init_model)
         if snap_state is not None:
@@ -412,7 +465,7 @@ class ContinuousTrainer:
             time.sleep(min(remaining, 0.05))
 
     # -- recovery ------------------------------------------------------------
-    def _recover_boost(self, X, y) -> int:
+    def _recover_boost(self, X, y, q=None) -> int:
         """Boost-mode recovery: warm start from the newest valid snapshot
         and reconcile snapshots against published generations.  Returns
         the number of COMPLETED cycles."""
@@ -424,7 +477,7 @@ class ContinuousTrainer:
             init = GBDTModel.load_model(self.cfg.input_model)
             self._base_iter = int(init.current_iteration)
         if snap_path is None:
-            self._booster = self._build_booster(X, y, init_model=init)
+            self._booster = self._build_booster(X, y, q, init_model=init)
             return 0
         svc = snap_state.get("service", {})
         self._base_iter = int(svc.get("base_iter", self._base_iter))
@@ -434,7 +487,7 @@ class ContinuousTrainer:
                       "%d completed cycles)", snap_path, total, done_cycles)
         self.wd("recover: warm start")
         self._booster = self._build_booster(
-            X, y, init_model=GBDTModel.load_model(snap_path),
+            X, y, q, init_model=GBDTModel.load_model(snap_path),
             snap_state=snap_state)
         # republish a cycle whose publish was torn away with the dead
         # process: the snapshot's own model text IS what that publish
@@ -499,11 +552,11 @@ class ContinuousTrainer:
         t0 = float(state["t0"])
 
         self.wd("ingest: first window")
-        stamp, X, y = producer.current(timeout=max(cfg.stage_timeout, 60))
+        stamp, X, y, q = producer.current(timeout=max(cfg.stage_timeout, 60))
         self._window_stamp = stamp
 
         if cfg.mode == "boost":
-            done = self._recover_boost(X, y)
+            done = self._recover_boost(X, y, q)
         else:
             done = self._recover_refit()
         if self._booster is None:
@@ -513,7 +566,7 @@ class ContinuousTrainer:
                 if cfg.input_model else None
             if init is not None:
                 self._base_iter = int(init.current_iteration)
-            self._booster = self._build_booster(X, y, init_model=init)
+            self._booster = self._build_booster(X, y, q, init_model=init)
         # keep base_iter on disk so every relaunch derives the same cycle
         # arithmetic even before its first snapshot
         if int(state.get("base_iter", -1)) != self._base_iter:
@@ -556,7 +609,7 @@ class ContinuousTrainer:
 
         # -- ingest: adopt a fresh window if the producer staged one ---------
         self._stage(cycle, "ingest")
-        stamp, X, y = producer.current(timeout=max(cfg.stage_timeout, 60))
+        stamp, X, y, q = producer.current(timeout=max(cfg.stage_timeout, 60))
         info = getattr(producer, "last_ingest", None)
         if info:
             # ingest telemetry (mode + rows/sec) rides the cycle's stage
@@ -569,7 +622,7 @@ class ContinuousTrainer:
             self.log.info("online: data window changed; rebuilding the "
                           "engine on %d rows", X.shape[0])
             self._booster = self._build_booster(
-                X, y, init_model=self._booster._model)
+                X, y, q, init_model=self._booster._model)
             self._window_stamp = stamp
         elif stamp != self._window_stamp:
             self._window_stamp = stamp

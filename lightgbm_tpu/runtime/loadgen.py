@@ -1,0 +1,375 @@
+"""Deterministic open-loop load generator with a verifying client pool.
+
+ISSUE 11: the "millions of users" side of the closed-loop production
+sim.  Three properties matter and all are deliberate:
+
+* **Open loop.**  Arrival times are drawn from a seedable inhomogeneous
+  Poisson process over a traffic *shape* (diurnal / bursty / step) and
+  walked on an ABSOLUTE clock: a slow server does not slow the offered
+  load down — exactly the property that makes overload visible.  The
+  submitting thread never blocks on a response; completions are awaited
+  by a separate client pool.
+* **Deterministic.**  Same seed, same shape, same duration -> the same
+  arrival offsets, the same class assignment, the same probe rows.  A
+  sim run is reproducible load for a nondeterministic system.
+* **Verifying.**  Every completed response is checked BYTE-FOR-BYTE
+  against the offline predictor for the generation it reports, through
+  the path it reports (host responses against the exact f64 host
+  predictor, device responses against the device path — per-row device
+  outputs are batch-composition invariant, pinned in
+  tests/test_serving.py).  The chaos-soak correctness bar (ISSUE 7)
+  becomes a continuous property of every sim.
+
+Offered load and verification verdicts land in the metrics registry
+(``lgbm_loadgen_offered_total{cls}``,
+``lgbm_loadgen_verified_total{result}``), so the sim artifact's
+shed-rate and zero-wrong-generation numbers are registry-scraped like
+everything else.
+
+Only numpy at module scope; the model stack loads lazily inside the
+verifier (first generation resolution).
+"""
+from __future__ import annotations
+
+import math
+import os
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import publish, telemetry
+from .serving import ServeRejected
+
+__all__ = ["TrafficShape", "RequestClass", "ResponseVerifier",
+           "LoadGenerator", "poisson_arrivals"]
+
+
+class TrafficShape:
+    """A named offered-load curve: ``rate(t)`` in requests/second at
+    offset ``t`` from the run start, plus its peak (the thinning
+    envelope)."""
+
+    def __init__(self, name: str, rate_fn: Callable[[float], float],
+                 peak_rps: float):
+        self.name = name
+        self._rate_fn = rate_fn
+        self.peak_rps = float(peak_rps)
+
+    def rate(self, t: float) -> float:
+        return max(float(self._rate_fn(t)), 0.0)
+
+    # -- the three canonical shapes ------------------------------------------
+    @classmethod
+    def diurnal(cls, base_rps: float, peak_rps: float,
+                period_s: float) -> "TrafficShape":
+        """A day compressed to `period_s`: sinusoid from base (trough)
+        to peak, starting at the trough."""
+        amp = (peak_rps - base_rps) / 2.0
+        mid = base_rps + amp
+
+        def rate(t: float) -> float:
+            return mid - amp * math.cos(2.0 * math.pi * t / period_s)
+
+        return cls("diurnal", rate, peak_rps)
+
+    @classmethod
+    def bursty(cls, base_rps: float, burst_rps: float, period_s: float,
+               burst_len_s: float) -> "TrafficShape":
+        """Flat base load with a square-wave burst of `burst_len_s`
+        at the start of every `period_s` window."""
+
+        def rate(t: float) -> float:
+            return burst_rps if (t % period_s) < burst_len_s else base_rps
+
+        return cls("bursty", rate, max(base_rps, burst_rps))
+
+    @classmethod
+    def step(cls, levels: List) -> "TrafficShape":
+        """Piecewise-constant: ``levels`` is [(duration_s, rps), ...];
+        past the last level the last rps holds."""
+        levels = [(float(d), float(r)) for d, r in levels]
+
+        def rate(t: float) -> float:
+            acc = 0.0
+            for dur, rps in levels:
+                acc += dur
+                if t < acc:
+                    return rps
+            return levels[-1][1]
+
+        return cls("step", rate, max(r for _, r in levels))
+
+
+def poisson_arrivals(shape: TrafficShape, duration_s: float,
+                     seed: int = 0) -> np.ndarray:
+    """Sorted arrival offsets (seconds) of an inhomogeneous Poisson
+    process with rate ``shape.rate(t)``, by thinning a homogeneous
+    process at ``peak_rps``.  Deterministic per (shape, duration, seed)."""
+    rng = np.random.default_rng(seed)
+    peak = max(shape.peak_rps, 1e-9)
+    n = int(rng.poisson(peak * duration_s))
+    t = np.sort(rng.uniform(0.0, duration_s, size=n))
+    keep = rng.uniform(0.0, 1.0, size=n) * peak < \
+        np.array([shape.rate(x) for x in t])
+    return t[keep]
+
+
+class RequestClass:
+    """One slice of the request mix: a priority class hitting one model
+    with `rows` feature rows per request, drawn with probability
+    proportional to `weight`."""
+
+    __slots__ = ("name", "priority", "model_id", "weight", "rows")
+
+    def __init__(self, name: str, priority: int = 0,
+                 model_id: str = "default", weight: float = 1.0,
+                 rows: int = 1):
+        self.name = name
+        self.priority = int(priority)
+        self.model_id = model_id
+        self.weight = float(weight)
+        self.rows = int(rows)
+
+
+class ResponseVerifier:
+    """Byte-identity oracle: offline `Booster.predict` references per
+    (generation, served_by path), computed over the FIXED probe matrix so
+    verifying a response is pure indexing.
+
+    Generation texts resolve from `texts` (a {generation: model_text}
+    map) first, then from the publish directory (the validated
+    generation file — publish retention must cover the run, which the
+    sim harness configures).  A generation that resolves nowhere is a
+    ``wrong_generation`` verdict: the response names a model that was
+    never validly published."""
+
+    def __init__(self, probe: np.ndarray, pub_dir: Optional[str] = None,
+                 texts: Optional[Dict[int, str]] = None,
+                 params: Optional[Dict[str, Any]] = None,
+                 raw_score: bool = False):
+        self.probe = np.asarray(probe, dtype=np.float64)
+        self.pub_dir = pub_dir
+        self.texts: Dict[int, str] = dict(texts or {})
+        self.params = dict(params or {})
+        self.raw_score = bool(raw_score)
+        self._refs: Dict[int, Dict[str, np.ndarray]] = {}
+        self._lock = threading.Lock()
+
+    def _resolve_text(self, generation: int) -> Optional[str]:
+        text = self.texts.get(generation)
+        if text is not None:
+            return text
+        if self.pub_dir is None:
+            return None
+        path = os.path.join(self.pub_dir,
+                            publish._gen_name(generation))  # noqa: SLF001
+        try:
+            with open(path, "rb") as fh:
+                raw = fh.read().decode("utf-8", "replace")
+        except OSError:
+            return None
+        split = publish._split_validate(raw)                # noqa: SLF001
+        return split[0] if split is not None else None
+
+    def refs(self, generation: int) -> Optional[Dict[str, np.ndarray]]:
+        with self._lock:
+            cached = self._refs.get(generation)
+        if cached is not None:
+            return cached
+        text = self._resolve_text(generation)
+        if text is None:
+            return None
+        from ..basic import Booster
+        bst = Booster(params=dict(self.params), model_str=text)
+        # the reference DEVICE predict runs in the same process as the
+        # sim's LGBM_TPU_FAULT churn, so a kill window can take it down
+        # too — retry through the window (faults are transient by
+        # design; the cache makes this a once-per-generation cost)
+        entry: Optional[Dict[str, np.ndarray]] = None
+        for _ in range(40):
+            try:
+                entry = {
+                    "host": np.asarray(bst.predict(self.probe,
+                                                   raw_score=self.raw_score,
+                                                   device=False)),
+                    "device": np.asarray(bst.predict(self.probe,
+                                                     raw_score=self.raw_score,
+                                                     device=True)),
+                }
+                break
+            except BaseException:            # noqa: BLE001 — fault window
+                time.sleep(0.25)
+        if entry is None:
+            raise RuntimeError("reference predict for generation %d kept "
+                               "failing (fault window never closed?)"
+                               % generation)
+        with self._lock:
+            self._refs.setdefault(generation, entry)
+        return entry
+
+    def verify(self, result, idx: np.ndarray) -> str:
+        """Verdict for one `ServeResult` served over probe rows `idx`:
+        ok / wrong_generation / mismatch / unverifiable (the reference
+        itself could not be computed — never silently dropped)."""
+        try:
+            refs = self.refs(result.generation)
+        except BaseException:                # noqa: BLE001 — verdict below
+            return "unverifiable"
+        if refs is None:
+            return "wrong_generation"
+        ref = refs.get(result.served_by)
+        if ref is None or not np.array_equal(np.asarray(result.values),
+                                             ref[idx]):
+            return "mismatch"
+        return "ok"
+
+
+class LoadGenerator:
+    """Drive one `ServingRuntime` with a shaped, classed, verified
+    open-loop request stream.  `run()` blocks for `duration_s` and
+    returns the machine-readable ledger."""
+
+    def __init__(self, runtime, classes: List[RequestClass],
+                 shape: TrafficShape, duration_s: float,
+                 probe: np.ndarray, seed: int = 0,
+                 verifier: Optional[ResponseVerifier] = None,
+                 deadline_s: float = 2.0, waiters: int = 8):
+        if not classes:
+            raise ValueError("LoadGenerator needs at least one RequestClass")
+        self.runtime = runtime
+        self.classes = list(classes)
+        self.shape = shape
+        self.duration_s = float(duration_s)
+        self.probe = np.asarray(probe, dtype=np.float64)
+        self.seed = int(seed)
+        self.verifier = verifier
+        self.deadline_s = float(deadline_s)
+        self.waiters = max(int(waiters), 1)
+
+        self.offered: Dict[str, int] = {c.name: 0 for c in self.classes}
+        self.completed: Dict[str, int] = {c.name: 0 for c in self.classes}
+        self.shed: Dict[str, Dict[str, int]] = {c.name: {}
+                                                for c in self.classes}
+        self.verify_counts: Dict[str, int] = {}
+        self.served_by: Dict[str, int] = {}
+        self.bad_rejections = 0
+        self.hard_errors: List[str] = []
+        self.max_lag_s = 0.0
+
+    # -- the verifying client pool -------------------------------------------
+    def _waiter(self, q: "queue.Queue") -> None:
+        verified = telemetry.counter("lgbm_loadgen_verified_total")
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            req, idx, cls = item
+            try:
+                try:
+                    rec = req.wait(timeout=self.deadline_s
+                                   + self.runtime.predict_deadline_s + 10.0)
+                except ServeRejected as e:
+                    self._record_shed(cls, e)
+                    continue
+                self.completed[cls.name] += 1
+                self.served_by[rec.served_by] = \
+                    self.served_by.get(rec.served_by, 0) + 1
+                if self.verifier is not None:
+                    verdict = self.verifier.verify(rec, idx)
+                    self.verify_counts[verdict] = \
+                        self.verify_counts.get(verdict, 0) + 1
+                    verified.inc(result=verdict)
+            except BaseException as e:       # noqa: BLE001 — a waiter
+                # must NEVER die silently: a dead waiter would strand its
+                # queue share and undercount verification
+                self.hard_errors.append("%s: %s" % (type(e).__name__, e))
+
+    def _record_shed(self, cls: RequestClass, e: ServeRejected) -> None:
+        reasons = self.shed[cls.name]
+        reasons[e.reason] = reasons.get(e.reason, 0) + 1
+        d = e.to_dict()
+        # the machine-readability contract: retryable flag, a reason,
+        # and (ISSUE 11) the priority class the shed applied to
+        if not (d.get("error") == "rejected" and d.get("reason")
+                and "retryable" in d
+                and d.get("priority") == cls.priority):
+            self.bad_rejections += 1
+
+    # -- the open loop -------------------------------------------------------
+    def run(self) -> Dict[str, Any]:
+        arrivals = poisson_arrivals(self.shape, self.duration_s, self.seed)
+        rng = np.random.default_rng(self.seed + 1)
+        weights = np.asarray([c.weight for c in self.classes], np.float64)
+        weights = weights / weights.sum()
+        cls_idx = rng.choice(len(self.classes), size=len(arrivals),
+                             p=weights)
+        row_idx = [rng.integers(0, len(self.probe),
+                                size=self.classes[c].rows)
+                   for c in cls_idx]
+
+        q: "queue.Queue" = queue.Queue()
+        pool = [threading.Thread(target=self._waiter, args=(q,),
+                                 name="loadgen-waiter-%d" % i, daemon=True)
+                for i in range(self.waiters)]
+        for t in pool:
+            t.start()
+        offered = telemetry.counter("lgbm_loadgen_offered_total")
+        t0 = time.monotonic()
+        for off, ci, idx in zip(arrivals, cls_idx, row_idx):
+            cls = self.classes[ci]
+            now = time.monotonic() - t0
+            if off > now:
+                time.sleep(off - now)
+            else:
+                # open loop: late arrivals submit immediately; the lag is
+                # recorded, the offered schedule is never thinned
+                self.max_lag_s = max(self.max_lag_s, now - off)
+            self.offered[cls.name] += 1
+            offered.inc(cls=cls.name)
+            try:
+                req = self.runtime.submit(self.probe[idx],
+                                          deadline_s=self.deadline_s,
+                                          model_id=cls.model_id,
+                                          priority=cls.priority)
+            except ServeRejected as e:
+                self._record_shed(cls, e)
+                continue
+            q.put((req, idx, cls))
+        for _ in pool:
+            q.put(None)
+        for t in pool:
+            t.join(timeout=60)
+        return self.ledger()
+
+    def ledger(self) -> Dict[str, Any]:
+        total_offered = sum(self.offered.values())
+        out: Dict[str, Any] = {
+            "shape": self.shape.name,
+            "duration_s": self.duration_s,
+            "seed": self.seed,
+            "offered_total": total_offered,
+            "offered_rps_mean": round(total_offered
+                                      / max(self.duration_s, 1e-9), 2),
+            "max_lag_s": round(self.max_lag_s, 4),
+            "served_by": dict(self.served_by),
+            "verification": dict(self.verify_counts),
+            "non_machine_readable_rejections": self.bad_rejections,
+            "hard_errors": self.hard_errors[:10],
+            "classes": {},
+        }
+        for c in self.classes:
+            shed = sum(self.shed[c.name].values())
+            out["classes"][c.name] = {
+                "priority": c.priority,
+                "model": c.model_id,
+                "offered": self.offered[c.name],
+                "completed": self.completed[c.name],
+                "shed": shed,
+                "shed_rate": round(shed / self.offered[c.name], 4)
+                if self.offered[c.name] else 0.0,
+                "reasons": dict(self.shed[c.name]),
+            }
+        return out

@@ -1,0 +1,147 @@
+"""Autoscale/shed policy: the queue-depth gauge turned into a control loop.
+
+ISSUE 11: PR 9 made the serving runtime observable (the
+``lgbm_serve_queue_depth`` gauge IS the backpressure signal), but nothing
+acted on it — overload handling was a fixed-knob affair (bounded queue,
+fixed gather window).  This module closes the loop with the same
+measure-then-act shape production serving stacks use:
+
+* **Widen under pressure** — sustained queue depth above the high
+  watermark widens the micro-batch gather window (more coalescing per
+  device dispatch buys throughput at the cost of p50), stepping by
+  ``widen_factor`` up to ``max_window_s``.  This is the "autoscale" axis
+  available to a single replica: it scales the *work per dispatch*, the
+  way adding a replica scales dispatches.
+* **Shed the lowest class** — entering overload also flips load-shed
+  mode: the serving runtime rejects the LOWEST priority class at
+  admission with the machine-readable, retryable reason ``load_shed``
+  (runtime/serving.py), protecting the paid classes' latency.
+* **Hysteresis, not flapping** — transitions need ``patience``
+  consecutive observations past a watermark, and the band between the
+  watermarks is a deadband that resets both counters: a depth signal
+  oscillating around one threshold cannot toggle the mode (pinned in
+  tests/test_policy.py).
+* **Every decision is evidence** — each transition lands in the metrics
+  registry (``lgbm_policy_decisions_total{action}``, the
+  ``lgbm_policy_window_seconds`` / ``lgbm_policy_shed_active`` gauges)
+  AND in the caller's stage trail via the returned decision records, so
+  a sim artifact or a doctor bundle can reconstruct exactly when and why
+  the controller acted.
+
+The controller itself is a pure, clock-free state machine (`observe`
+takes a depth fraction, returns decision records) so the hysteresis
+semantics are unit-testable without a runtime; `ServingRuntime` drives
+it from its policy thread.  No jax / numpy at module scope.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from . import telemetry
+from .resilience import wallclock
+
+__all__ = ["AutoscaleShedPolicy"]
+
+
+class AutoscaleShedPolicy:
+    """Hysteresis controller over the admission-queue depth fraction.
+
+    Parameters
+    ----------
+    high_watermark / low_watermark:
+        Queue-depth fractions (of ``max_queue``) bounding the deadband.
+        ``observe`` counts consecutive samples above high (pressure) or
+        below low (slack); samples inside the band reset both counters.
+    patience:
+        Consecutive samples past a watermark required before acting.
+    min_window_s / max_window_s / widen_factor:
+        The gather-window range the controller walks: each widen
+        multiplies by ``widen_factor`` (capped), each narrow divides
+        (floored).  ``window_s`` starts at ``min_window_s``.
+    interval_s:
+        How often the serving runtime's policy thread samples the depth
+        (the controller itself is clock-free).
+    """
+
+    def __init__(self,
+                 high_watermark: float = 0.75,
+                 low_watermark: float = 0.25,
+                 patience: int = 3,
+                 min_window_s: float = 0.002,
+                 max_window_s: float = 0.064,
+                 widen_factor: float = 2.0,
+                 interval_s: float = 0.05):
+        if not (0.0 <= low_watermark < high_watermark <= 1.0):
+            raise ValueError("need 0 <= low_watermark < high_watermark <= 1,"
+                             " got %r / %r" % (low_watermark, high_watermark))
+        if widen_factor <= 1.0:
+            raise ValueError("widen_factor must be > 1")
+        self.high_watermark = float(high_watermark)
+        self.low_watermark = float(low_watermark)
+        self.patience = max(int(patience), 1)
+        self.min_window_s = float(min_window_s)
+        self.max_window_s = float(max_window_s)
+        self.widen_factor = float(widen_factor)
+        self.interval_s = float(interval_s)
+
+        self.window_s = self.min_window_s
+        self.shed_active = False
+        self._above = 0
+        self._below = 0
+        self.decisions: List[Dict[str, Any]] = []
+
+    # -- the state machine ---------------------------------------------------
+    def observe(self, depth_frac: float) -> List[Dict[str, Any]]:
+        """Feed one queue-depth sample (fraction of max_queue); returns
+        the decision records this sample triggered ([] for hold).  The
+        deadband between the watermarks resets both streak counters —
+        that reset IS the anti-flap guarantee."""
+        out: List[Dict[str, Any]] = []
+        if depth_frac > self.high_watermark:
+            self._above += 1
+            self._below = 0
+        elif depth_frac < self.low_watermark:
+            self._below += 1
+            self._above = 0
+        else:
+            self._above = 0
+            self._below = 0
+            return out
+        if self._above >= self.patience:
+            self._above = 0
+            if self.window_s < self.max_window_s:
+                self.window_s = min(self.window_s * self.widen_factor,
+                                    self.max_window_s)
+                out.append(self._decide("widen", depth_frac))
+            if not self.shed_active:
+                self.shed_active = True
+                out.append(self._decide("shed_on", depth_frac))
+        elif self._below >= self.patience:
+            self._below = 0
+            if self.window_s > self.min_window_s:
+                self.window_s = max(self.window_s / self.widen_factor,
+                                    self.min_window_s)
+                out.append(self._decide("narrow", depth_frac))
+            # shed releases only once the window is fully narrowed: the
+            # cheap lever (coalescing) is given back before admission is
+            elif self.shed_active:
+                self.shed_active = False
+                out.append(self._decide("shed_off", depth_frac))
+        return out
+
+    def _decide(self, action: str, depth_frac: float) -> Dict[str, Any]:
+        rec = {"event": "policy_decision", "action": action,
+               "window_s": round(self.window_s, 6),
+               "shed_active": self.shed_active,
+               "depth_frac": round(float(depth_frac), 4),
+               "wallclock": wallclock()}
+        self.decisions.append(rec)
+        telemetry.counter("lgbm_policy_decisions_total").inc(action=action)
+        telemetry.gauge("lgbm_policy_window_seconds").set(self.window_s)
+        telemetry.gauge("lgbm_policy_shed_active").set(
+            1.0 if self.shed_active else 0.0)
+        return rec
+
+    def state(self) -> Dict[str, Any]:
+        return {"window_s": self.window_s, "shed_active": self.shed_active,
+                "decisions": len(self.decisions)}

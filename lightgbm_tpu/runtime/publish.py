@@ -190,6 +190,9 @@ class ModelPublisher:
         full_meta = dict(meta or {})
         full_meta.setdefault("generation", gen)
         full_meta.setdefault("published_at", resilience.wallclock())
+        # machine-usable publish stamp (ISSUE 11): subscribers measure
+        # model staleness against this without parsing the wallclock
+        full_meta.setdefault("published_unix", round(time.time(), 3))
         body = _with_publish_footer(model_text, full_meta)
         path = os.path.join(self.pub_dir, _gen_name(gen))
         self._publish_count += 1

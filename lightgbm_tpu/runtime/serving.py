@@ -15,6 +15,14 @@ headline, not an afterthought:
   admission time — never an unbounded queue, never a silent hang.  A
   request whose deadline expires before its batch forms is shed the
   same way.
+* **Priority, quotas, and a control loop (ISSUE 11).**  Requests carry a
+  priority class; per-class queue reservations shed the lowest class
+  first under pressure.  Per-model `quotas` bound any one tenant's
+  share of the queue (`quota_exceeded`).  An optional
+  `runtime.policy.AutoscaleShedPolicy` closes the loop on the
+  queue-depth gauge: sustained pressure widens the micro-batch gather
+  window and flips load-shed mode for the lowest class (`load_shed`),
+  with every decision recorded as a metric and a trail event.
 * **Micro-batching.**  Concurrent requests are coalesced (bounded rows,
   bounded gathering window) into ONE device predict through the
   shape-bucketed program cache, so p99 latency buys throughput instead
@@ -71,7 +79,8 @@ class ServeRejected(RuntimeError):
     tells the client whether backing off and retrying can succeed."""
 
     def __init__(self, reason: str, retryable: bool = True,
-                 detail: str = "", queue_depth: Optional[int] = None):
+                 detail: str = "", queue_depth: Optional[int] = None,
+                 priority: Optional[int] = None):
         super().__init__("request rejected (%s%s)%s"
                          % (reason, ", retryable" if retryable else "",
                             ": " + detail if detail else ""))
@@ -79,6 +88,10 @@ class ServeRejected(RuntimeError):
         self.retryable = bool(retryable)
         self.detail = detail
         self.queue_depth = queue_depth
+        # the priority class the shed applies to (ISSUE 11): every shed
+        # is machine-readable WITH its class, so a client and the sim's
+        # per-class shed-rate ledger never have to guess
+        self.priority = priority
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"error": "rejected", "reason": self.reason,
@@ -88,6 +101,8 @@ class ServeRejected(RuntimeError):
             d["detail"] = self.detail
         if self.queue_depth is not None:
             d["queue_depth"] = self.queue_depth
+        if self.priority is not None:
+            d["priority"] = self.priority
         return d
 
 
@@ -115,13 +130,15 @@ class _Request:
     """Queued unit of work; doubles as the caller's future."""
 
     __slots__ = ("model_id", "X", "n_rows", "deadline", "enqueued",
-                 "done", "result", "rejection", "error")
+                 "done", "result", "rejection", "error", "priority")
 
-    def __init__(self, model_id: str, X: np.ndarray, deadline: float):
+    def __init__(self, model_id: str, X: np.ndarray, deadline: float,
+                 priority: int = 0):
         self.model_id = model_id
         self.X = X
         self.n_rows = int(X.shape[0])
         self.deadline = deadline            # absolute time.monotonic()
+        self.priority = int(priority)
         self.enqueued = time.monotonic()
         self.done = threading.Event()
         self.result: Optional[ServeResult] = None
@@ -242,11 +259,26 @@ class ServingRuntime:
                  probe_platform_on_start: bool = False,
                  report_path: Optional[str] = None,
                  metrics_port: Optional[int] = None,
+                 priority_levels: int = 3,
+                 quotas: Optional[Dict[str, float]] = None,
+                 policy=None,
                  log=Log):
         """`publish_dir` subscribes the default model to a PR 6 publish
         directory; `models` maps model_id -> publish_dir for
         multi-tenancy; `model_file`/`model_str` pin a static default
-        model (no poller).  At least one source is required."""
+        model (no poller).  At least one source is required.
+
+        ISSUE 11 admission knobs: `priority_levels` sets the number of
+        priority classes (0 = highest); under queue pressure lower
+        classes shed first through per-class queue reservations (class p
+        may only occupy ``max_queue * (P - p) / P`` slots).  `quotas`
+        maps model_id -> max fraction of the queue that tenant's
+        requests may hold (rejection `quota_exceeded`, retryable) so one
+        hot tenant cannot starve the rest.  `policy` is an
+        `runtime.policy.AutoscaleShedPolicy`: a background thread feeds
+        it the queue-depth fraction; its decisions retune
+        `batch_window_s` and flip load-shed mode for the lowest class
+        (rejection `load_shed`, retryable)."""
         self.log = log
         self._params = dict(params or {})
         self._raw_score = bool(raw_score)
@@ -258,6 +290,9 @@ class ServingRuntime:
         self.poll_interval_s = float(poll_interval_s)
         self.breaker_cooldown_s = float(breaker_cooldown_s)
         self.probe_platform_on_start = bool(probe_platform_on_start)
+        self.priority_levels = max(int(priority_levels), 1)
+        self.quotas: Dict[str, float] = dict(quotas or {})
+        self.policy = policy
 
         self._dirs: Dict[str, str] = dict(models or {})
         if publish_dir:
@@ -281,6 +316,11 @@ class ServingRuntime:
         self._cond = threading.Condition()
         self._stopped = False
         self._started = False
+        # per-tenant queued-request counts (the quota denominator) and
+        # the policy-driven load-shed latch; both live under self._cond
+        self._queued_by_model: "collections.Counter[str]" = \
+            collections.Counter()
+        self._shed_low = False
 
         # serving stage trail: PR 4 watchdog in thread mode with a
         # bounded flight recorder (one stage per batch — unbounded
@@ -309,6 +349,7 @@ class ServingRuntime:
         self._executor: Optional[_DeviceExecutor] = None
         self._batcher: Optional[threading.Thread] = None
         self._poller: Optional[threading.Thread] = None
+        self._policy_thread: Optional[threading.Thread] = None
 
         # live Prometheus endpoint (ISSUE 9): metrics_port=0 picks an
         # ephemeral port, exposed via `metrics_port` after start()
@@ -358,6 +399,10 @@ class ServingRuntime:
             self._poller = threading.Thread(target=self._poller_loop,
                                             name="serve-poller", daemon=True)
             self._poller.start()
+        if self.policy is not None:
+            self._policy_thread = threading.Thread(
+                target=self._policy_loop, name="serve-policy", daemon=True)
+            self._policy_thread.start()
         with self._wd_lock:
             self.wd("serving", seconds=0)
         return self
@@ -373,13 +418,15 @@ class ServingRuntime:
             pending = list(self._queue)
             self._queue.clear()
             self._cond.notify_all()
+        self._queued_by_model.clear()
         for req in pending:
-            req.rejection = ServeRejected("shutdown", retryable=False)
+            req.rejection = ServeRejected("shutdown", retryable=False,
+                                          priority=req.priority)
             req.done.set()
-            self._count_rejection("shutdown")
+            self._count_rejection("shutdown", priority=req.priority)
         if self._executor is not None:
             self._executor.submit(None)
-        for t in (self._batcher, self._poller):
+        for t in (self._batcher, self._poller, self._policy_thread):
             if t is not None:
                 t.join(timeout=5)
         with self._wd_lock:
@@ -453,6 +500,30 @@ class ServingRuntime:
                     self.log.warning("serve: poll of %s failed: %s", mid, e)
             time.sleep(self.poll_interval_s)
 
+    def _policy_loop(self) -> None:
+        """Feed the autoscale/shed policy the queue-depth fraction and
+        APPLY its decisions: the gather window retunes live (the batcher
+        reads `batch_window_s` per batch) and load-shed mode latches
+        under the admission lock.  Every decision lands in the stage
+        trail next to degradations and swaps."""
+        pol = self.policy
+        while not self._stopped:
+            time.sleep(pol.interval_s)
+            decisions = pol.observe(len(self._queue)
+                                    / max(self.max_queue, 1))
+            if not decisions:
+                continue
+            self.batch_window_s = pol.window_s
+            with self._cond:
+                self._shed_low = pol.shed_active
+            for rec in decisions:
+                with self._wd_lock:
+                    self.wd.annotate("policy_decision", rec)
+                self.log.warning(
+                    "serve: policy %s (window=%.4fs shed=%s depth=%.0f%%)",
+                    rec["action"], rec["window_s"], rec["shed_active"],
+                    rec["depth_frac"] * 100)
+
     def generation(self, model_id: str = "default") -> Optional[int]:
         entry = self._entries.get(model_id)
         return entry.generation if entry is not None else None
@@ -464,26 +535,60 @@ class ServingRuntime:
 
     # -- request surface -----------------------------------------------------
     def submit(self, data, deadline_s: Optional[float] = None,
-               model_id: str = "default") -> _Request:
+               model_id: str = "default", priority: int = 0) -> _Request:
         """Admit one request (a feature row [F] or small matrix [B, F]).
         Raises `ServeRejected` IMMEDIATELY when the queue is full or the
         server is stopped — shedding at admission is the backpressure
         contract; blocking the caller would just move the unbounded
-        queue into the clients."""
+        queue into the clients.
+
+        `priority` (0 = highest, clamped to `priority_levels`) selects
+        the admission class: class p only admits while the queue holds
+        fewer than ``max_queue * (P - p) / P`` requests, so under
+        pressure the lowest class sheds FIRST and the highest keeps the
+        full queue.  A policy-flipped load-shed mode rejects the lowest
+        class outright (`load_shed`); a tenant past its `quotas` share
+        is rejected `quota_exceeded`.  All three rejections are
+        machine-readable, carry the request's class, and are retryable."""
         X = np.atleast_2d(np.asarray(data, dtype=np.float64))
         deadline = time.monotonic() + (self.default_deadline_s
                                        if deadline_s is None
                                        else float(deadline_s))
-        req = _Request(model_id, X, deadline)
+        P = self.priority_levels
+        prio = min(max(int(priority), 0), P - 1)
+        req = _Request(model_id, X, deadline, priority=prio)
         with self._cond:
             if self._stopped or not self._started:
                 raise ServeRejected("shutdown", retryable=False,
-                                    detail="runtime not serving")
-            if len(self._queue) >= self.max_queue:
-                self._count_rejection("queue_full")
-                raise ServeRejected("queue_full", retryable=True,
-                                    queue_depth=len(self._queue))
+                                    detail="runtime not serving",
+                                    priority=prio)
+            if self._shed_low and prio == P - 1:
+                self._count_rejection("load_shed", priority=prio)
+                raise ServeRejected(
+                    "load_shed", retryable=True, priority=prio,
+                    queue_depth=len(self._queue),
+                    detail="policy shed mode active for the lowest class")
+            quota = self.quotas.get(model_id)
+            if quota is not None and self._queued_by_model[model_id] >= \
+                    max(int(quota * self.max_queue), 1):
+                self._count_rejection("quota_exceeded", priority=prio)
+                raise ServeRejected(
+                    "quota_exceeded", retryable=True, priority=prio,
+                    queue_depth=len(self._queue),
+                    detail="model %r is at its quota (%d queued >= %.0f%% "
+                           "of the queue)" % (model_id,
+                                              self._queued_by_model[model_id],
+                                              quota * 100))
+            limit = (self.max_queue * (P - prio)) // P
+            if len(self._queue) >= limit:
+                self._count_rejection("queue_full", priority=prio)
+                raise ServeRejected(
+                    "queue_full", retryable=True, priority=prio,
+                    queue_depth=len(self._queue),
+                    detail="class p%d reservation is %d slots" % (prio,
+                                                                  limit))
             self._queue.append(req)
+            self._queued_by_model[model_id] += 1
             depth = len(self._queue)
             self._cond.notify()
         with self._stats_lock:
@@ -493,7 +598,7 @@ class ServingRuntime:
 
     def predict(self, data, deadline_s: Optional[float] = None,
                 model_id: str = "default", attempts: int = 3,
-                seed: int = 0) -> ServeResult:
+                seed: int = 0, priority: int = 0) -> ServeResult:
         """Blocking client helper: submit + wait, with bounded jittered
         retry on RETRYABLE rejections (queue_full under a load spike,
         no_model while the first generation lands)."""
@@ -505,7 +610,7 @@ class ServingRuntime:
         for a in range(max(attempts, 1)):
             try:
                 req = self.submit(data, deadline_s=deadline,
-                                  model_id=model_id)
+                                  model_id=model_id, priority=priority)
                 return req.wait(timeout=deadline
                                 + self.predict_deadline_s + 10.0)
             except ServeRejected as e:
@@ -521,14 +626,18 @@ class ServingRuntime:
     def _reject(self, req: _Request, reason: str, retryable: bool = True,
                 detail: str = "") -> None:
         req.rejection = ServeRejected(reason, retryable=retryable,
-                                      detail=detail)
+                                      detail=detail, priority=req.priority)
         req.done.set()
-        self._count_rejection(reason)
+        self._count_rejection(reason, priority=req.priority)
 
-    def _count_rejection(self, reason: str) -> None:
+    def _count_rejection(self, reason: str,
+                         priority: Optional[int] = None) -> None:
         with self._stats_lock:
             self._stats["rejected"][reason] += 1
         telemetry.counter("lgbm_serve_requests_total").inc(outcome=reason)
+        if priority is not None:
+            telemetry.counter("lgbm_serve_class_requests_total").inc(
+                cls="p%d" % priority, outcome=reason)
 
     def _next_batch(self) -> Optional[List[_Request]]:
         """Pop a batch of same-model requests: head-of-line model wins,
@@ -553,12 +662,14 @@ class ServingRuntime:
                     while self._queue and rows < self.max_batch_rows:
                         req = self._queue.popleft()
                         if req.deadline < now:
+                            self._queued_by_model[req.model_id] -= 1
                             self._reject(req, "deadline_exceeded",
                                          detail="expired before batching")
                             continue
                         if batch and req.model_id != batch[0].model_id:
                             keep.append(req)
                             continue
+                        self._queued_by_model[req.model_id] -= 1
                         batch.append(req)
                         rows += req.n_rows
                     self._queue.extendleft(reversed(keep))
@@ -626,8 +737,19 @@ class ServingRuntime:
             # LGBM_TPU_PROFILE serving hook: the first M DEVICE batches
             # land in one jax.profiler trace
             telemetry.profile_hook("serve").tick()
+        # model staleness at completion: age of the serving generation —
+        # measured against its publish stamp when the publish meta
+        # carries one (ISSUE 11), else against the local swap-in time.
+        # The registry histogram is what the sim artifact scrapes.
+        published_unix = entry.meta.get("published_unix")
+        staleness = (time.time() - float(published_unix)
+                     if published_unix is not None
+                     else now - entry.loaded_at)
+        telemetry.histogram("lgbm_serve_staleness_seconds").observe(
+            max(staleness, 0.0), model=model_id)
         lat_hist = telemetry.histogram("lgbm_serve_latency_seconds")
         completed = telemetry.counter("lgbm_serve_requests_total")
+        by_class = telemetry.counter("lgbm_serve_class_requests_total")
         s = 0
         for req in batch:
             e = s + req.n_rows
@@ -641,6 +763,7 @@ class ServingRuntime:
             # /metrics quantiles and BENCH_SERVE's p50/p99 both read it
             lat_hist.observe(latency, model=model_id)
             completed.inc(outcome="completed")
+            by_class.inc(cls="p%d" % req.priority, outcome="completed")
 
     # -- device path + circuit breaker ---------------------------------------
     def _spawn_executor(self) -> _DeviceExecutor:
@@ -744,6 +867,15 @@ class ServingRuntime:
                   for k, v in self._stats.items()}
         st["queue_depth"] = len(self._queue)
         st["breaker"] = dict(self._breaker)
+        st["priority_levels"] = self.priority_levels
+        st["shed_active"] = self._shed_low
+        if self.quotas:
+            st["quotas"] = dict(self.quotas)
+            st["queued_by_model"] = {m: c for m, c
+                                     in self._queued_by_model.items() if c}
+        if self.policy is not None:
+            st["policy"] = dict(self.policy.state(),
+                                decisions_tail=self.policy.decisions[-16:])
         st["generations"] = {mid: e.generation
                              for mid, e in self._entries.items()}
         st["degradation_events"] = list(self.degradation_events)
@@ -789,6 +921,7 @@ class _Handler(socketserver.StreamRequestHandler):
                         np.asarray(msg["features"], np.float64),
                         deadline_s=msg.get("deadline_s"),
                         model_id=msg.get("model", "default"),
+                        priority=int(msg.get("priority", 0)),
                     ).wait(timeout=rt.default_deadline_s
                            + rt.predict_deadline_s + 10.0)
                     out = {"values": np.asarray(rec.values).tolist(),

@@ -185,6 +185,39 @@ METRIC_TABLE: Dict[str, Dict[str, Any]] = {
         "max_label_sets": 256,
         "help": "Program-cache traffic per site: event=hit/compile for "
                 "jit sites, hit/miss/evict for the python-side caches"},
+    "lgbm_serve_class_requests_total": {
+        "type": "counter", "labels": ("cls", "outcome"),
+        "help": "Serving requests by priority class (cls=p0 highest..pN "
+                "lowest) and outcome: completed or the machine-readable "
+                "shed reason (queue_full/load_shed/quota_exceeded/...)"},
+    "lgbm_serve_staleness_seconds": {
+        "type": "histogram", "labels": ("model",),
+        "help": "Age of the serving generation at batch completion "
+                "(now minus its publish stamp) - the model-staleness "
+                "distribution the production sim reports"},
+    "lgbm_policy_decisions_total": {
+        "type": "counter", "labels": ("action",),
+        "help": "Autoscale/shed policy transitions, action=widen/narrow/"
+                "shed_on/shed_off (runtime/policy.py hysteresis "
+                "controller)"},
+    "lgbm_policy_window_seconds": {
+        "type": "gauge", "labels": (),
+        "help": "Current micro-batch gather window the policy controller "
+                "has set on the serving runtime"},
+    "lgbm_policy_shed_active": {
+        "type": "gauge", "labels": (),
+        "help": "1 while the policy holds the lowest priority class in "
+                "load-shed mode, else 0"},
+    "lgbm_loadgen_offered_total": {
+        "type": "counter", "labels": ("cls",),
+        "help": "Requests the load generator offered (open-loop "
+                "arrivals), by priority class - the shed-rate "
+                "denominator the sim artifact scrapes"},
+    "lgbm_loadgen_verified_total": {
+        "type": "counter", "labels": ("result",),
+        "help": "Load-generator response verifications, result=ok/"
+                "wrong_generation/mismatch/unverifiable (byte-identity "
+                "vs the offline predictor for the reported generation)"},
 }
 
 # ---------------------------------------------------------------------------

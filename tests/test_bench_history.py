@@ -105,3 +105,98 @@ def test_section_series_collated(tmp_path):
     assert rep["trajectory"][0]["predict_rows_per_sec"] == 1000.0
     assert any(f["series"] == "predict_rows_per_sec"
                for f in rep["latest_regressions"])
+
+
+# ---------------------------------------------------------------------------
+# SIM_r*.json collation + schema gate (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def _sim_scenario(p99=0.05, staleness=2.0, capacity=300.0, ok=True):
+    return {
+        "objective": "binary",
+        "latency_s": {"p50": p99 / 3, "p99": p99, "count": 100,
+                      "mean": p99 / 2},
+        "staleness_s": {"p50": staleness, "p99": staleness * 2,
+                        "count": 50, "mean": staleness},
+        "capacity_rows_per_sec_per_replica": capacity,
+        "classes": {"gold": {"priority": 0, "offered": 10, "completed": 10,
+                             "shed": 0, "shed_rate": 0.0, "reasons": {}}},
+        "verification": {"ok": 100},
+        "ok": ok,
+    }
+
+
+def _write_sim(d, n, scenarios, replicas=2, duration=20.0):
+    rec = {"artifact": "SIM_r%02d" % n, "schema_version": 1,
+           "replicas": replicas, "duration_s": duration, "ok": True,
+           "scenarios": scenarios}
+    (d / ("SIM_r%02d.json" % n)).write_text(json.dumps(rec))
+    return rec
+
+
+def test_sim_artifact_schema_validates():
+    good = {"artifact": "SIM_r11", "schema_version": 1, "replicas": 2,
+            "duration_s": 20.0, "ok": True,
+            "scenarios": {"binary": _sim_scenario()}}
+    assert bench_history.validate_sim_artifact(good) == []
+    # a malformed sim run fails LOUDLY, field by field
+    assert bench_history.validate_sim_artifact({"artifact": "SIM_rX"})
+    bad = json.loads(json.dumps(good))
+    del bad["scenarios"]["binary"]["latency_s"]
+    assert any("latency_s" in p
+               for p in bench_history.validate_sim_artifact(bad))
+    bad2 = json.loads(json.dumps(good))
+    bad2["scenarios"]["binary"]["classes"]["gold"].pop("shed_rate")
+    assert any("shed_rate" in p
+               for p in bench_history.validate_sim_artifact(bad2))
+
+
+def test_sim_rounds_collate_and_regressions_flag(tmp_path):
+    """p99 is lower-better (a rise flags), capacity higher-better (a
+    drop flags); same-shape rounds only."""
+    _write_sim(tmp_path, 11, {"binary": _sim_scenario(p99=0.05,
+                                                      capacity=300)})
+    _write_sim(tmp_path, 12, {"binary": _sim_scenario(p99=0.08,
+                                                      capacity=250)})
+    rep = bench_history.run(str(tmp_path))
+    assert rep["sim_rounds"] == 2
+    assert rep["invalid_sim_artifacts"] == []
+    flagged = {f["series"] for f in rep["sim_latest_regressions"]}
+    assert "p99_latency_s" in flagged
+    assert "capacity_rows_per_sec_per_replica" in flagged
+    # an improvement never flags
+    for d in tmp_path.glob("SIM_r*.json"):
+        d.unlink()
+    _write_sim(tmp_path, 11, {"binary": _sim_scenario(p99=0.08,
+                                                      capacity=200)})
+    _write_sim(tmp_path, 12, {"binary": _sim_scenario(p99=0.05,
+                                                      capacity=300)})
+    rep = bench_history.run(str(tmp_path))
+    assert rep["sim_latest_regressions"] == []
+
+
+def test_sim_cross_shape_rounds_never_compared(tmp_path):
+    _write_sim(tmp_path, 11, {"binary": _sim_scenario(p99=0.01)},
+               replicas=2)
+    _write_sim(tmp_path, 12, {"binary": _sim_scenario(p99=0.5)},
+               replicas=4)     # different fleet size: not comparable
+    rep = bench_history.run(str(tmp_path))
+    assert rep["sim_latest_regressions"] == []
+
+
+def test_malformed_sim_artifact_fails_the_run(tmp_path):
+    """A SIM file that doesn't validate lands in invalid_sim_artifacts
+    and fails the collation — a malformed sim run can never collate as
+    silent zeros."""
+    _write_round(tmp_path, 1, parsed={"value": 1.0, "n_rows": 10,
+                                      "platform": "cpu"})
+    (tmp_path / "SIM_r11.json").write_text(json.dumps(
+        {"artifact": "SIM_r11", "scenarios": {}}))
+    rep = bench_history.run(str(tmp_path))
+    assert rep["invalid_sim_artifacts"]
+    assert rep["sim_rounds"] == 0
+    assert rep["latest_regressions"] == []   # bench side is clean...
+    # ...yet the would-be CLI verdict is failure (main() gates on
+    # invalid_sim_artifacts exactly like latest regressions)
+    assert bool(rep["latest_regressions"] or rep["sim_latest_regressions"]
+                or rep["invalid_sim_artifacts"])

@@ -770,3 +770,109 @@ def test_c_refit_matches_python(problem):
                                rtol=0, atol=1e-12)
     _check(lib, lib.LGBM_BoosterFree(bst))
     _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_c_inner_predict_buffer_trio(problem):
+    """ISSUE 11 ABI completion: LGBM_BoosterCalcNumPredict sizes output
+    buffers on both booster kinds, and GetNumPredict/GetPredict read the
+    engine's incrementally-maintained train/valid scores (objective
+    transform applied, class-major GetPredictAt layout) without a
+    re-predict.  The engine keeps scores in f32 on device, so parity
+    with the offline f64 predict holds to f32 precision."""
+    lib = _lib()
+    X, y = problem
+    ds = _c_dataset(lib, X, y)
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(ds, PARAMS.encode(),
+                                       ctypes.byref(bst)))
+    vX, vy = X[:100], y[:100]
+    dv = _c_dataset(lib, vX, vy)
+    _check(lib, lib.LGBM_BoosterAddValidData(bst, dv))
+    fin = ctypes.c_int()
+    for _ in range(8):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    # CalcNumPredict arithmetic: num_class width + leaf-index width
+    out64 = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterCalcNumPredict(
+        bst, ctypes.c_int(10), 0, -1, ctypes.byref(out64)))
+    assert out64.value == 10
+    _check(lib, lib.LGBM_BoosterCalcNumPredict(
+        bst, ctypes.c_int(10), 2, -1, ctypes.byref(out64)))
+    assert out64.value == 80                 # 10 rows * 8 trees
+    _check(lib, lib.LGBM_BoosterCalcNumPredict(
+        bst, ctypes.c_int(10), 2, 3, ctypes.byref(out64)))
+    assert out64.value == 30
+    assert lib.LGBM_BoosterCalcNumPredict(
+        bst, ctypes.c_int(10), 7, -1, ctypes.byref(out64)) != 0
+
+    # GetNumPredict sizes; GetPredict matches an offline predict to f32
+    n_train = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterGetNumPredict(bst, 0,
+                                              ctypes.byref(n_train)))
+    assert n_train.value == len(X)
+    n_valid = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterGetNumPredict(bst, 1,
+                                              ctypes.byref(n_valid)))
+    assert n_valid.value == len(vX)
+    buf = np.zeros(n_train.value, np.float64)
+    olen = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterGetPredict(
+        bst, 0, ctypes.byref(olen),
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert olen.value == len(X)
+    # model text -> offline python predict = the f64 oracle
+    slen = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterSaveModelToString(
+        bst, -1, 0, ctypes.byref(slen), None))
+    sbuf = ctypes.create_string_buffer(slen.value)
+    _check(lib, lib.LGBM_BoosterSaveModelToString(
+        bst, -1, slen.value, ctypes.byref(slen), sbuf))
+    pyb = lgb.Booster(model_str=sbuf.value.decode())
+    np.testing.assert_allclose(buf, pyb.predict(X, device=False),
+                               rtol=1e-5, atol=1e-6)
+    vbuf = np.zeros(n_valid.value, np.float64)
+    _check(lib, lib.LGBM_BoosterGetPredict(
+        bst, 1, ctypes.byref(olen),
+        vbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_allclose(vbuf, pyb.predict(vX, device=False),
+                               rtol=1e-5, atol=1e-6)
+    # out-of-range valid index and loaded boosters fail cleanly
+    assert lib.LGBM_BoosterGetPredict(
+        bst, 3, ctypes.byref(olen),
+        vbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) != 0
+    loaded = ctypes.c_void_p()
+    it = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterLoadModelFromString(
+        sbuf.value, ctypes.byref(it), ctypes.byref(loaded)))
+    assert lib.LGBM_BoosterGetNumPredict(
+        loaded, 0, ctypes.byref(olen)) != 0
+    assert "training boosters" in str(_err(lib))
+    _check(lib, lib.LGBM_BoosterCalcNumPredict(       # Calc works on both
+        loaded, ctypes.c_int(5), 1, -1, ctypes.byref(out64)))
+    assert out64.value == 5
+    _check(lib, lib.LGBM_BoosterFree(loaded))
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(dv))
+    _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_capi_wrapper_inner_predict(problem):
+    """The capi.py wrappers over the trio (TrainBooster.num_predict /
+    get_predict / calc_num_predict, NativeBooster.calc_num_predict)."""
+    from lightgbm_tpu import capi
+    X, y = problem
+    ds = capi.TrainDataset.from_mat(X, PARAMS).set_field("label", y)
+    bst = capi.TrainBooster(ds, PARAMS)
+    for _ in range(4):
+        bst.update()
+    assert bst.calc_num_predict(16) == 16
+    assert bst.calc_num_predict(16, capi.C_API_PREDICT_LEAF_INDEX) == 64
+    assert bst.num_predict(0) == len(X)
+    inner = bst.get_predict(0)
+    pyb = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(inner, pyb.predict(X, device=False),
+                               rtol=1e-5, atol=1e-6)
+    nb = capi.NativeBooster(model_str=bst.model_to_string())
+    assert nb.calc_num_predict(3) == 3
+    assert nb.calc_num_predict(3, capi.C_API_PREDICT_LEAF_INDEX) == 12

@@ -265,7 +265,7 @@ def test_ingest_producer_tail_append_never_reparses_old_rows(tmp_path):
     assert p.last_ingest["mode"] == "tail_append"
     assert p.last_ingest["rows_parsed"] == 50
     assert p.last_ingest["rows_per_sec"] > 0
-    _, X, y = p.current(1)
+    _, X, y, _ = p.current(1)
     Xf, yf = parse_file(path)
     np.testing.assert_array_equal(X, Xf[-200:])
     np.testing.assert_array_equal(y, yf[-200:])
@@ -276,7 +276,7 @@ def test_ingest_producer_tail_append_never_reparses_old_rows(tmp_path):
     p._stamp = p._file_stamp()
     p._parse_once()
     assert p.last_ingest["mode"] == "full_parse"
-    _, X2, _ = p.current(1)
+    _, X2, _, _ = p.current(1)
     np.testing.assert_array_equal(X2, parse_file(path)[0][-200:])
 
     # a partially-written trailing line is held back, then consumed

@@ -209,3 +209,73 @@ def test_lambdarank_fast_vs_legacy_ndcg_curves(rank_data):
                                    err_msg="curve diverged at %s" % k)
         # and the quality itself is in the reference band
         assert f[-1] > 0.6, (k, f[-1])
+
+
+# ---------------------------------------------------------------------------
+# ranking GetSubset + the online rolling window (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def _synth_rank(n_q, qsz, seed, f=6):
+    """Synthetic ranking problem (no /root/reference dependency): qsz
+    docs per query, relevance 0..3 driven by the first two features."""
+    rng = np.random.default_rng(seed)
+    n = n_q * qsz
+    X = rng.standard_normal((n, f))
+    rel = np.clip(np.round(X[:, 0] * 1.2 + 0.4 * X[:, 1] + 1.5
+                           + 0.3 * rng.standard_normal(n)), 0, 3)
+    return X, rel.astype(np.float64), np.full(n_q, qsz)
+
+
+def _ndcg10(bst, Xv, yv, gv):
+    (name, metric, val, hib) = bst.eval(
+        lgb.Dataset(Xv, label=yv, group=gv), "v")[0]
+    assert metric == "ndcg@10" and hib
+    return val
+
+
+def test_ranking_subset_rederives_query_boundaries():
+    """GetSubset of a ranking dataset slices the query structure with
+    the rows: whole groups keep their sizes, partial groups shrink."""
+    X, y, group = _synth_rank(12, 10, seed=4)
+    ds = lgb.Dataset(X, label=y, group=group)
+    ds.construct(Config({"objective": "lambdarank", "verbose": -1}))
+    sub = ds.binned.subset(np.arange(30, 90))          # groups 3..8 whole
+    np.testing.assert_array_equal(
+        np.diff(sub.metadata.query_boundaries), np.full(6, 10))
+    ragged = ds.binned.subset(
+        np.concatenate([np.arange(5), np.arange(10, 30), [115]]))
+    np.testing.assert_array_equal(
+        np.diff(ragged.metadata.query_boundaries), [5, 10, 10, 1])
+
+
+def test_ranking_window_subset_ndcg10_parity():
+    """The online path's binned-window training (GetSubset over the full
+    stream, sharing the stream's bin mappers) matches an offline train
+    on the same raw window: held-out NDCG@10 parity — the quality pin
+    that makes the sim's lambdarank scenario meaningful."""
+    params = {"objective": "lambdarank", "num_leaves": 15, "verbose": -1,
+              "metric": "ndcg", "eval_at": [10], "min_data_in_leaf": 5,
+              "seed": 3}
+    X, y, group = _synth_rank(60, 10, seed=5)
+    Xv, yv, gv = _synth_rank(24, 10, seed=6)
+    full_ds = lgb.Dataset(X, label=y, group=group)
+    full_ds.construct(Config(params))
+    # the newest 40-query window, as the rolling trainer would slice it
+    idx = np.arange(20 * 10, 60 * 10)
+    sub = full_ds.binned.subset(idx)
+    np.testing.assert_array_equal(
+        np.diff(sub.metadata.query_boundaries), np.full(40, 10))
+    from lightgbm_tpu.basic import Dataset as _DS
+    bst_sub = lgb.Booster(dict(params), _DS._from_binned(sub, params=params))
+    bst_off = lgb.Booster(dict(params),
+                          lgb.Dataset(X[idx], label=y[idx],
+                                      group=np.full(40, 10)))
+    for _ in range(30):
+        bst_sub.update()
+        bst_off.update()
+    n_sub = _ndcg10(bst_sub, Xv, yv, gv)
+    n_off = _ndcg10(bst_off, Xv, yv, gv)
+    # same window, same params; only the bin edges differ (stream-wide
+    # vs window-local mappers) — held-out quality must agree closely
+    assert abs(n_sub - n_off) < 0.05, (n_sub, n_off)
+    assert n_sub > 0.55 and n_off > 0.55, (n_sub, n_off)
