@@ -1094,6 +1094,77 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
   return 0;
 }
 
+int LGBM_BoosterPredictForCSC(BoosterHandle handle, const void* col_ptr,
+                              int col_ptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result) {
+  (void)parameter;
+  (void)nelem;
+  ModelRef ref(handle);
+  Model* m = ref.m;
+  if (m == nullptr) return -1;
+  if (col_ptr_type != C_API_DTYPE_INT32 && col_ptr_type != C_API_DTYPE_INT64)
+    return Fail("col_ptr_type must be C_API_DTYPE_INT32/INT64, got " +
+                std::to_string(col_ptr_type));
+  if (data_type != C_API_DTYPE_FLOAT32 && data_type != C_API_DTYPE_FLOAT64)
+    return Fail("data_type must be C_API_DTYPE_FLOAT32/FLOAT64, got " +
+                std::to_string(data_type));
+  int64_t ncol = ncol_ptr - 1;
+  int nfeat = m->max_feature_idx + 1;
+  if (ncol < nfeat)
+    return Fail("CSC has " + std::to_string(ncol) +
+                " columns, model needs " + std::to_string(nfeat));
+  bool leaf = predict_type == C_API_PREDICT_LEAF_INDEX;
+  if (!leaf && predict_type != C_API_PREDICT_NORMAL &&
+      predict_type != C_API_PREDICT_RAW_SCORE)
+    return Fail("unsupported predict_type " + std::to_string(predict_type));
+  int k = m->num_tree_per_iteration;
+  int iters = m->NumIterations();
+  if (num_iteration > 0 && num_iteration < iters) iters = num_iteration;
+  int used_trees = iters * k;
+  int64_t width = leaf ? used_trees : k;
+
+  auto col_range = [&](int64_t c, int64_t* b, int64_t* e) {
+    if (col_ptr_type == C_API_DTYPE_INT32) {
+      *b = static_cast<const int32_t*>(col_ptr)[c];
+      *e = static_cast<const int32_t*>(col_ptr)[c + 1];
+    } else {
+      *b = static_cast<const int64_t*>(col_ptr)[c];
+      *e = static_cast<const int64_t*>(col_ptr)[c + 1];
+    }
+  };
+  auto val = [&](int64_t i) -> double {
+    if (data_type == C_API_DTYPE_FLOAT32)
+      return static_cast<const float*>(data)[i];
+    return static_cast<const double*>(data)[i];
+  };
+
+  // one dense row-major scatter of the whole matrix: CSC carries whole
+  // columns, so a per-row buffer cannot stream it the way CSR does
+  std::vector<double> dense(static_cast<size_t>(num_row) * ncol, 0.0);
+  for (int64_t c = 0; c < ncol; ++c) {
+    int64_t b, e;
+    col_range(c, &b, &e);
+    for (int64_t i = b; i < e; ++i) {
+      int64_t r = indices[i];
+      if (r < 0 || r >= num_row)
+        return Fail("CSC row index " + std::to_string(r) +
+                    " out of range for num_row=" + std::to_string(num_row));
+      dense[static_cast<size_t>(r) * ncol + c] = val(i);
+    }
+  }
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < num_row; ++r) {
+    PredictRow(*m, dense.data() + static_cast<size_t>(r) * ncol,
+               predict_type, iters, used_trees, out_result + r * width);
+  }
+  *out_len = num_row * width;
+  return 0;
+}
+
 int LGBM_BoosterPredictForCSRSingleRow(BoosterHandle handle,
                                        const void* indptr, int indptr_type,
                                        const int32_t* indices,

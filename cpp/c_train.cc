@@ -207,6 +207,25 @@ def dataset_save_binary(ds, fname):
     ds.save_binary(fname)
 
 
+def dataset_dump_text(ds, fname):
+    # reference LGBM_DatasetDumpText, adapted content: the dump shows
+    # what training actually consumes — the post-bundling integer bin
+    # matrix — under a small self-describing header
+    ds.construct()
+    b = ds.binned
+    with open(fname, 'w') as fh:
+        fh.write('num_data: %d\n' % int(b.num_data))
+        fh.write('num_features: %d\n' % int(b.num_total_features))
+        fh.write('feature_names: %s\n' % ','.join(b.feature_names))
+        fh.write('num_bins: %s\n'
+                 % ','.join(str(int(m.num_bin)) for m in b.bin_mappers))
+        fh.write('storage_rows: %d\n' % int(b.bins.shape[0]))
+        fh.write('has_label: %d\n'
+                 % (0 if b.metadata.label is None else 1))
+        fh.write('bin_data:\n')
+        np.savetxt(fh, b.bins[:, :int(b.num_data)].T, fmt='%d')
+
+
 def dataset_set_feature_names(ds, names):
     ds.set_feature_name([str(s) for s in names])
 
@@ -787,6 +806,21 @@ int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename) {
     return -1;
   }
   PyObject* r = CallHelper("dataset_save_binary",
+                           Py_BuildValue("(Os)", d->ds, filename));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetDumpText(DatasetHandle handle, const char* filename) {
+  PyScope py;
+  if (!py.ok) return -1;
+  TrainDataset* d = AsDataset(handle);
+  if (d == nullptr) {
+    SetLastError("not a dataset handle");
+    return -1;
+  }
+  PyObject* r = CallHelper("dataset_dump_text",
                            Py_BuildValue("(Os)", d->ds, filename));
   if (r == nullptr) return -1;
   Py_DECREF(r);

@@ -198,6 +198,20 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
                               const char* parameter, int64_t* out_len,
                               double* out_result);
 
+/* Sparse (CSC) prediction (reference LGBM_BoosterPredictForCSC):
+ * col_ptr[ncol_ptr] column offsets, indices[nelem] ROW ids,
+ * data[nelem] values, num_row rows.  The column-major triplets are
+ * scattered into a dense row-major buffer once (absent entries 0.0,
+ * missing-zero semantics) and predicted with the same per-row kernel
+ * as PredictForMat — bit-identical to transposing client-side. */
+int LGBM_BoosterPredictForCSC(BoosterHandle handle, const void* col_ptr,
+                              int col_ptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
+
 /* Single-row CSR fast path (reference PredictForCSRSingleRow): same
  * contract as PredictForCSR with nindptr == 2.  The dense scatter a
  * one-row CSR needs is already the per-row inner loop of the batch
@@ -283,6 +297,13 @@ int LGBM_DatasetGetSubset(DatasetHandle handle,
  * (version-stamped; LGBM_DatasetCreateFromFile loads it back directly,
  * skipping parse + find-bin + bundling). */
 int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename);
+
+/* Debug dump of the constructed dataset to a text file (reference
+ * LGBM_DatasetDumpText, adapted content: header lines — num_data,
+ * num_features, feature names, per-feature bin counts, label presence —
+ * followed by the BINNED storage rows, i.e. the post-bundling integer
+ * bin matrix training actually consumes). */
+int LGBM_DatasetDumpText(DatasetHandle handle, const char* filename);
 
 /* Feature names (reference Set/GetFeatureNames).  Get follows the
  * GetEvalNames contract: out_strs must hold num_feature pointers to

@@ -176,3 +176,17 @@ echo "     collates SIM_r*.json and flags p99/capacity regressions.)"
 timeout 600 python exp/prod_sim.py /tmp/sim_tpu.json \
   && python -c "import json; d=json.load(open('/tmp/sim_tpu.json')); print(json.dumps({k: {'p99': v['latency_s']['p99'], 'capacity': v['capacity_rows_per_sec_per_replica'], 'ok': v['ok']} for k, v in d['scenarios'].items()}, indent=1))" \
   || echo "   prod sim FAILED on hardware — /tmp/sim_tpu.json + replica logs in the tempdir have the ledger"
+echo "=== 11. quality-firewall soak on hardware (ISSUE 12) ==="
+echo "    (the three-stage model-quality firewall under data/model faults:"
+echo "     poison_rows -> ingest quarantine, label_flip -> pre-publish eval"
+echo "     gate, regress_model -> serving canary + automatic rollback."
+echo "     On hardware the canary's latency signal judges real device"
+echo "     batches, so a generation that only regresses in DEVICE latency"
+echo "     (e.g. a shape-bucket blowup) is caught here first.  Hard gates:"
+echo "     zero poisoned generations published, zero regressed responses"
+echo "     outside the canary fraction, every rollback byte-verified."
+echo "     Commit it as CHAOS_QUALITY_r<round>.json; helper/bench_history.py"
+echo "     schema-gates it and flags canary-detection-window regressions.)"
+timeout 600 python exp/chaos_quality.py /tmp/chaos_quality_tpu.json \
+  && python -c "import json; d=json.load(open('/tmp/chaos_quality_tpu.json')); p1=d['phases']['ingest_gate']; p2=d['phases'].get('canary',{}); print(json.dumps({'ok': d['ok'], 'quarantined': p1['quarantined_total'], 'gate_rejections': p1['gate_rejections'], 'rollbacks': p2.get('rollback_count'), 'rollback_byte_verified': p2.get('rollback_byte_verified')}, indent=1))" \
+  || echo "   quality soak FAILED — /tmp/chaos_quality_tpu.json.invalid + trainer/replica logs in the tempdir have the ledger"

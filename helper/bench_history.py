@@ -19,6 +19,14 @@ artifact is schema-validated first (`validate_sim_artifact`); a
 malformed sim run fails the collation loudly instead of collating as
 zeros.
 
+ISSUE 12 adds the quality-firewall artifacts (``CHAOS_QUALITY_r*.json``
+from exp/chaos_quality.py): schema-validated like the sims (a rollback
+that is not byte-verified, or a regressed generation reaching the
+non-canary fleet, is an INVALID artifact), with the quarantine / gate /
+rollback counts carried in the trajectory and the canary detection
+window (batches-to-rollback, lower is better) under the same >10 %
+regression-flag treatment.
+
 Artifact shape (bench): the driver wraps each round's bench stdout as
 ``{"n": round, "rc": ..., "parsed": <bench JSON>, "tail": ...}``; when
 ``parsed`` is missing the last JSON-looking line of ``tail`` is tried.
@@ -155,6 +163,153 @@ def regressions(rounds: List[Dict[str, Any]],
                     "shape": shape,
                 })
             if prior is None or v > prior[0]:
+                best[shape] = (float(v), rec["_round"])
+    return sorted(flags, key=lambda f: (f["round"], f["series"]))
+
+
+# ---------------------------------------------------------------------------
+# quality-firewall artifacts (CHAOS_QUALITY_r*.json, ISSUE 12)
+# ---------------------------------------------------------------------------
+
+#: (series name, artifact-relative path, higher_is_better) — only the
+#: canary detection window is treated as a performance series (how many
+#: canary batches degradation took to catch; lower is better); the
+#: quarantine/gate/rollback COUNTS are correctness evidence carried in
+#: the trajectory rows and gated by the schema, not thresholds.
+QUALITY_SERIES: Tuple[Tuple[str, Tuple[str, ...], bool], ...] = (
+    ("canary_batches_to_rollback",
+     ("phases", "canary", "canary_batches_to_rollback"), False),
+)
+
+_QUALITY_P1_REQUIRED = (
+    ("quarantined_total", int),
+    ("gate_rejections", int),
+    ("published_generations", list),
+    ("rejected_cycles", list),
+    ("nonfinite_predictions", int),
+    ("ok", bool),
+)
+_QUALITY_P2_REQUIRED = (
+    ("rollback_count", int),
+    ("canary_fraction", (int, float)),
+    ("responses_bad_outside_canary", int),
+    ("canary_events", dict),
+    ("canary_batches", dict),
+    ("ok", bool),
+)
+
+
+def validate_quality_artifact(rec: Any) -> List[str]:
+    """Schema problems of one CHAOS_QUALITY artifact (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(rec, dict):
+        return ["artifact is not a JSON object"]
+    if not str(rec.get("artifact", "")).startswith("CHAOS_QUALITY_"):
+        problems.append("artifact name %r does not start with "
+                        "CHAOS_QUALITY_" % rec.get("artifact"))
+    if not isinstance(rec.get("schema_version"), int):
+        problems.append("schema_version missing or not an int")
+    if not isinstance(rec.get("ok"), bool):
+        problems.append("ok flag missing")
+    phases = rec.get("phases")
+    if not isinstance(phases, dict) or "ingest_gate" not in phases:
+        problems.append("phases.ingest_gate missing")
+        return problems
+    p1 = phases["ingest_gate"]
+    for key, typ in _QUALITY_P1_REQUIRED:
+        if not isinstance(p1.get(key), typ):
+            problems.append("ingest_gate: %s missing or wrong type" % key)
+    p2 = phases.get("canary")
+    if p2 is not None:
+        for key, typ in _QUALITY_P2_REQUIRED:
+            if not isinstance(p2.get(key), typ):
+                problems.append("canary: %s missing or wrong type" % key)
+        if p2.get("responses_bad_outside_canary"):
+            problems.append("canary: responses_bad_outside_canary must be "
+                            "0 — a regressed generation reached the "
+                            "non-canary fleet")
+        if p2.get("rollback_count") and \
+                p2.get("rollback_byte_verified") is not True:
+            problems.append("canary: rollback happened but was not "
+                            "byte-verified against the restored "
+                            "generation")
+    return problems
+
+
+def load_quality_rounds(repo: str = REPO):
+    """(valid CHAOS_QUALITY rounds sorted, problems of invalid ones)."""
+    rounds: List[Dict[str, Any]] = []
+    problems: List[str] = []
+    for path in glob.glob(os.path.join(repo, "CHAOS_QUALITY_r*.json")):
+        m = re.search(r"CHAOS_QUALITY_r(\d+)\.json$", path)
+        if not m:
+            continue
+        base = os.path.basename(path)
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError) as e:
+            problems.append("%s: unreadable (%s)" % (base, e))
+            continue
+        bad = validate_quality_artifact(rec)
+        if bad:
+            problems.append("%s: %s" % (base, "; ".join(bad)))
+            continue
+        rec["_round"] = int(m.group(1))
+        rec["_file"] = base
+        rounds.append(rec)
+    return sorted(rounds, key=lambda r: r["_round"]), problems
+
+
+def quality_trajectory(rounds: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """One row per round: the firewall's counts + the canary window."""
+    rows = []
+    for rec in rounds:
+        p1 = rec["phases"]["ingest_gate"]
+        p2 = rec["phases"].get("canary") or {}
+        rows.append({
+            "round": rec["_round"], "ok": rec.get("ok"),
+            "quarantined_total": p1.get("quarantined_total"),
+            "gate_rejections": p1.get("gate_rejections"),
+            "published_generations": len(
+                p1.get("published_generations") or []),
+            "rollback_count": p2.get("rollback_count"),
+            "canary_batches_to_rollback":
+                p2.get("canary_batches_to_rollback"),
+            "canary_fraction": p2.get("canary_fraction"),
+        })
+    return rows
+
+
+def quality_regressions(rounds: List[Dict[str, Any]],
+                        threshold: float = REGRESSION_THRESHOLD
+                        ) -> List[Dict[str, Any]]:
+    """Rounds whose QUALITY_SERIES moved > threshold the wrong way vs
+    the best prior round at the same canary_fraction."""
+    flags: List[Dict[str, Any]] = []
+    for name, path, higher_better in QUALITY_SERIES:
+        best: Dict[Tuple, Tuple[float, int]] = {}
+        for rec in rounds:
+            v = _get(rec, path)
+            if not isinstance(v, (int, float)):
+                continue
+            shape = (repr(_get(rec, ("phases", "canary",
+                                     "canary_fraction"))),)
+            prior = best.get(shape)
+            if prior is not None and prior[0] > 0:
+                worse = (v < prior[0] * (1.0 - threshold) if higher_better
+                         else v > prior[0] * (1.0 + threshold))
+                if worse:
+                    flags.append({
+                        "round": rec["_round"], "series": name,
+                        "value": v, "best_prior": prior[0],
+                        "best_prior_round": prior[1],
+                        "change_pct": round((v / prior[0] - 1.0) * 100, 1),
+                        "shape": shape,
+                    })
+            better = (prior is None or
+                      (v > prior[0] if higher_better else v < prior[0]))
+            if better:
                 best[shape] = (float(v), rec["_round"])
     return sorted(flags, key=lambda f: (f["round"], f["series"]))
 
@@ -326,6 +481,9 @@ def run(repo: str = REPO,
     sim_rounds, sim_problems = load_sim_rounds(repo)
     sim_flags = sim_regressions(sim_rounds, threshold)
     sim_latest = sim_rounds[-1]["_round"] if sim_rounds else None
+    q_rounds, q_problems = load_quality_rounds(repo)
+    q_flags = quality_regressions(q_rounds, threshold)
+    q_latest = q_rounds[-1]["_round"] if q_rounds else None
     return {"rounds": len(rounds),
             "latest_round": latest,
             "trajectory": trajectory(rounds),
@@ -338,7 +496,14 @@ def run(repo: str = REPO,
             "sim_regressions": sim_flags,
             "sim_latest_regressions": [f for f in sim_flags
                                        if f["round"] == sim_latest],
-            "invalid_sim_artifacts": sim_problems}
+            "invalid_sim_artifacts": sim_problems,
+            "quality_rounds": len(q_rounds),
+            "quality_latest_round": q_latest,
+            "quality_trajectory": quality_trajectory(q_rounds),
+            "quality_regressions": q_flags,
+            "quality_latest_regressions": [f for f in q_flags
+                                           if f["round"] == q_latest],
+            "invalid_quality_artifacts": q_problems}
 
 
 def main(argv=None) -> int:
@@ -374,9 +539,29 @@ def main(argv=None) -> int:
                      f["best_prior"]))
         for p in rep["invalid_sim_artifacts"]:
             print("INVALID SIM ARTIFACT: %s" % p)
+    if rep["quality_rounds"] or rep["invalid_quality_artifacts"]:
+        print("bench_history: %d quality round(s) collated"
+              % rep["quality_rounds"])
+        q_cols = ["round", "quarantined_total", "gate_rejections",
+                  "rollback_count", "canary_batches_to_rollback", "ok"]
+        print("  ".join("%-13s" % c for c in q_cols))
+        for row in rep["quality_trajectory"]:
+            print("  ".join("%-13s" % (row.get(c, "-"),) for c in q_cols))
+        for f in rep["quality_regressions"]:
+            kind = ("QUALITY REGRESSION"
+                    if f["round"] == rep["quality_latest_round"]
+                    else "historical quality regression")
+            print("%s: round %d %s = %s moved %+.1f%% vs round %d's %s"
+                  % (kind, f["round"], f["series"], f["value"],
+                     f["change_pct"], f["best_prior_round"],
+                     f["best_prior"]))
+        for p in rep["invalid_quality_artifacts"]:
+            print("INVALID QUALITY ARTIFACT: %s" % p)
     failed = bool(rep["latest_regressions"]
                   or rep["sim_latest_regressions"]
-                  or rep["invalid_sim_artifacts"])
+                  or rep["invalid_sim_artifacts"]
+                  or rep["quality_latest_regressions"]
+                  or rep["invalid_quality_artifacts"])
     if not failed:
         print("bench_history: OK (latest round has no >%.0f%% regression)"
               % (REGRESSION_THRESHOLD * 100))

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Fault-injection coverage lint (ISSUE 12 satellite).
+
+`resilience.FAULT_TABLE` is the single registry of every injectable
+fault, and docs/RESILIENCE.md is pinned row-for-row against it — but
+nothing guaranteed a registered fault is actually EXERCISED.  A fault
+mode nobody injects is worse than none: it documents a defense that has
+never once been proven to fire.
+
+This lint greps ``tests/test_*.py`` — plus the ``exp/*.py`` soak
+drivers, whose fault POOLS are what the tier-1 quick-soak tests
+(``test_quick_chaos_soak`` / ``test_quick_chaos_serve_soak`` / the
+quality-soak pins) actually inject — for every FAULT_TABLE name: each
+fault must appear in at least one of them as an injection spec, inside
+a STRING LITERAL that arms it (``LGBM_TPU_FAULT=<name>...`` /
+``"<name>:arg"`` / a fault-pool member).  A bare mention in a comment
+or in code text does not count (only string literals are matched).
+
+Run standalone (``python helper/check_fault_coverage.py``; exit 1 on a
+gap) or through the tier-1 pin in ``tests/test_check_fault_coverage.py``
+(which also pins the negative: a fabricated table entry IS reported).
+"""
+from __future__ import annotations
+
+import glob
+import io
+import os
+import re
+import sys
+import tokenize
+from typing import Dict, List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS_DIR = os.path.join(REPO, "tests")
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _string_literals(path: str) -> List[str]:
+    """Every string literal in a python file (comments and code text
+    excluded) — fault names must appear in an actual injection spec."""
+    with open(path, "rb") as fh:
+        src = fh.read()
+    out: List[str] = []
+    try:
+        for tok in tokenize.tokenize(io.BytesIO(src).readline):
+            if tok.type == tokenize.STRING:
+                out.append(tok.string)
+    except tokenize.TokenizeError:
+        pass
+    return out
+
+
+def coverage(fault_names=None, tests_dir: str = TESTS_DIR
+             ) -> Dict[str, List[str]]:
+    """{fault_name: [files whose string literals arm it]}."""
+    if fault_names is None:
+        from lightgbm_tpu.runtime.resilience import FAULT_NAMES
+        fault_names = FAULT_NAMES
+    paths = sorted(glob.glob(os.path.join(tests_dir, "test_*.py")))
+    exp_dir = os.path.join(os.path.dirname(os.path.abspath(tests_dir)),
+                           "exp")
+    paths += sorted(glob.glob(os.path.join(exp_dir, "*.py")))
+    hits: Dict[str, List[str]] = {name: [] for name in fault_names}
+    for path in paths:
+        blob = "\n".join(_string_literals(path))
+        base = os.path.basename(path)
+        for name in fault_names:
+            if re.search(r"\b%s\b" % re.escape(name), blob):
+                hits[name].append(base)
+    return hits
+
+
+def run(fault_names=None, tests_dir: str = TESTS_DIR) -> List[str]:
+    """Drift problems (empty = every registered fault is exercised)."""
+    hits = coverage(fault_names, tests_dir)
+    return ["fault %r is registered in resilience.FAULT_TABLE but no "
+            "tests/test_*.py or exp/*.py string literal arms it — a "
+            "defense that has never fired is not a defense" % name
+            for name, files in sorted(hits.items()) if not files]
+
+
+def main(argv=None) -> int:
+    hits = coverage()
+    problems = run()
+    for name, files in sorted(hits.items()):
+        print("%-20s %s" % (name, ", ".join(files) or "UNCOVERED"))
+    for p in problems:
+        print("DRIFT: %s" % p)
+    if not problems:
+        print("check_fault_coverage: OK (%d faults, all exercised)"
+              % len(hits))
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -295,7 +295,10 @@ class Application:
         forever), `online_rounds`, `online_mode=boost|refit`,
         `online_window_rows`, `publish_retention`/`publish_grace`,
         `snapshot_retention`/`snapshot_grace`, `metrics_port` (live
-        GET /metrics endpoint — docs/OBSERVABILITY.md).  See
+        GET /metrics endpoint — docs/OBSERVABILITY.md), and the
+        quality-firewall knobs `online_quarantine_limit`,
+        `publish_gate_tolerance` (inf = gate off),
+        `publish_gate_holdout`, `publish_gate_metric`.  See
         docs/RESILIENCE.md for the runbook."""
         from .runtime.continuous import ContinuousTrainer
         rc = ContinuousTrainer(dict(self.raw_params), log=Log).run()
@@ -316,8 +319,13 @@ class Application:
         `predict_deadline`, `serve_poll_interval`, `breaker_cooldown`,
         `serve_raw_score`, `metrics_port` (GET /metrics Prometheus
         endpoint; 0 = ephemeral, printed on stdout — see
-        docs/OBSERVABILITY.md).  SIGTERM/SIGINT stop cleanly with the
-        final stats on stderr.  See docs/SERVING.md for the runbook."""
+        docs/OBSERVABILITY.md), and the ISSUE-12 canary knobs
+        `canary_fraction` (0 = off) with `canary_min_samples`,
+        `canary_patience`, `canary_error_ratio`, `canary_error_margin`,
+        `canary_latency_ratio`, `canary_promote_after`
+        (docs/RESILIENCE.md quality-firewall runbook).  SIGTERM/SIGINT
+        stop cleanly with the final stats on stderr.  See
+        docs/SERVING.md for the runbook."""
         import signal as _signal
         import threading as _threading
 
@@ -328,7 +336,24 @@ class Application:
         host = params.pop("serve_host", "127.0.0.1")
         port = int(params.pop("serve_port", 0) or 0)
         metrics_port = params.pop("metrics_port", None)
+        # ISSUE 12 canary knobs: canary_fraction=F routes F of batches
+        # to each newly published generation until the CanaryPolicy
+        # promotes it or rolls the fleet back (docs/RESILIENCE.md)
+        canary_fraction = float(params.pop("canary_fraction", 0.0) or 0.0)
+        canary_policy = None
+        if canary_fraction > 0:
+            from .runtime.policy import CanaryPolicy
+            canary_policy = CanaryPolicy(
+                min_samples=int(params.pop("canary_min_samples", 8)),
+                patience=int(params.pop("canary_patience", 3)),
+                error_ratio=float(params.pop("canary_error_ratio", 1.5)),
+                error_margin=float(params.pop("canary_error_margin",
+                                              0.02)),
+                latency_ratio=float(params.pop("canary_latency_ratio",
+                                               5.0)),
+                promote_after=int(params.pop("canary_promote_after", 64)))
         runtime = ServingRuntime(
+            canary_fraction=canary_fraction, canary_policy=canary_policy,
             metrics_port=int(metrics_port) if metrics_port is not None
             else None,
             publish_dir=publish_dir, model_file=input_model,

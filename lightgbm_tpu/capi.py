@@ -332,6 +332,15 @@ class TrainDataset:
             self._handle, path.encode()))
         return self
 
+    def dump_text(self, path: str) -> "TrainDataset":
+        """LGBM_DatasetDumpText: debug dump — self-describing header
+        (num_data/num_features/feature names/bin counts/label presence)
+        followed by the post-bundling integer bin matrix, one row per
+        data row."""
+        _check_train(load_train_lib().LGBM_DatasetDumpText(
+            self._handle, path.encode()))
+        return self
+
     def set_feature_names(self, names) -> "TrainDataset":
         arr = (ctypes.c_char_p * len(names))(
             *[str(n).encode() for n in names])
@@ -603,6 +612,36 @@ class NativeBooster:
         out = out[: out_len.value]
         per_row = out_len.value // max(nrow, 1)
         return out.reshape(nrow, per_row) if per_row > 1 else out
+
+    def predict_csc(self, col_ptr, indices, values, num_row: int,
+                    raw_score: bool = False,
+                    num_iteration: int = -1) -> np.ndarray:
+        """Column-major sparse prediction (LGBM_BoosterPredictForCSC):
+        col_ptr per column, indices carry ROW ids; absent entries are
+        0.0.  Bit-identical to transposing to CSR/dense client-side."""
+        col_ptr = np.ascontiguousarray(col_ptr)
+        if col_ptr.dtype not in (np.int32, np.int64):
+            col_ptr = np.ascontiguousarray(col_ptr, dtype=np.int64)
+        indices = np.ascontiguousarray(indices, dtype=np.int32)
+        values = np.ascontiguousarray(values)
+        if values.dtype not in (np.float32, np.float64):
+            values = np.ascontiguousarray(values, dtype=np.float64)
+        k = self.num_class
+        ptype = C_API_PREDICT_RAW_SCORE if raw_score else C_API_PREDICT_NORMAL
+        out = np.zeros(num_row * max(k, 1), dtype=np.float64)
+        out_len = ctypes.c_int64(0)
+        _check(load_lib().LGBM_BoosterPredictForCSC(
+            self._handle, col_ptr.ctypes.data_as(ctypes.c_void_p),
+            _dtype_code(col_ptr),
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            values.ctypes.data_as(ctypes.c_void_p), _dtype_code(values),
+            ctypes.c_int64(len(col_ptr)), ctypes.c_int64(len(values)),
+            ctypes.c_int64(num_row), ptype, ctypes.c_int(num_iteration),
+            b"", ctypes.byref(out_len),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        out = out[: out_len.value]
+        per_row = out_len.value // max(num_row, 1)
+        return out.reshape(num_row, per_row) if per_row > 1 else out
 
     def predict_csr_single_row(self, indices, values, num_col: int,
                                raw_score: bool = False,

@@ -95,7 +95,19 @@ class StreamingDatasetBuilder:
                  num_features: Optional[int] = None,
                  reference=None, num_total_rows: Optional[int] = None,
                  feature_names: Optional[Sequence[str]] = None,
-                 categorical_feature: Sequence[int] = ()):
+                 categorical_feature: Sequence[int] = (),
+                 quarantine=None):
+        """`quarantine` (a `runtime.quality.QuarantineLedger`, or True
+        for a fresh one) arms push-time schema validation (ISSUE 12
+        firewall stage one): rows with non-finite labels/weights are
+        routed to the ledger instead of the dataset.  Default off — a
+        quarantine-less build is byte-identical; even armed, a clean
+        stream's chunks pass through untouched (same objects, still
+        zero-copy)."""
+        if quarantine is True:
+            from ..runtime.quality import QuarantineLedger
+            quarantine = QuarantineLedger()
+        self.quarantine = quarantine
         self.params = dict(params or {})
         self.feature_names = list(feature_names) if feature_names else None
         self.categorical_feature = tuple(int(c) for c in categorical_feature)
@@ -211,6 +223,14 @@ class StreamingDatasetBuilder:
             X = np.asarray(X).reshape(1, -1)
         if getattr(X, "ndim", None) != 2:
             raise LightGBMError("pushed chunks must be 2-dimensional")
+        keep = self._quarantine_mask(X.shape[0], label, weight, start_row)
+        if keep is not None:
+            X = np.asarray(X, dtype=np.float64)[keep]
+            label = np.asarray(label, dtype=np.float64).reshape(-1)[keep] \
+                if label is not None else None
+            weight = np.asarray(weight,
+                                dtype=np.float64).reshape(-1)[keep] \
+                if weight is not None else None
         m, f = X.shape
         self._check_features(f)
         chunk = _Chunk(start_row, m, dense=X)
@@ -236,6 +256,20 @@ class StreamingDatasetBuilder:
             raise LightGBMError("CSR column index %d out of range for "
                                 "num_col=%d" % (int(idx[:nnz].max()),
                                                 int(num_col)))
+        keep = self._quarantine_mask(m, label, weight, start_row)
+        if keep is not None:
+            ip = np.asarray(indptr, dtype=np.int64)
+            counts = np.diff(ip)[keep]
+            row_sel = np.repeat(keep, np.diff(ip))
+            idx = idx[:nnz][row_sel]
+            values = np.asarray(values)[:nnz][row_sel]
+            indptr = np.concatenate([[0], np.cumsum(counts)])
+            m = int(keep.sum())
+            label = np.asarray(label, dtype=np.float64).reshape(-1)[keep] \
+                if label is not None else None
+            weight = np.asarray(weight,
+                                dtype=np.float64).reshape(-1)[keep] \
+                if weight is not None else None
         self._check_features(int(num_col))
         chunk = _Chunk(start_row, m, csr=(indptr, idx, values, int(num_col)))
         return self._push(chunk, label, weight)
@@ -258,6 +292,34 @@ class StreamingDatasetBuilder:
         return self.push_dense(X, label=label, weight=weight)
 
     # -- internals -----------------------------------------------------------
+    def _quarantine_mask(self, n_rows: int, label, weight,
+                         start_row: int) -> Optional[np.ndarray]:
+        """Push-time schema validation (armed by `quarantine=`): the
+        keep-mask when rows must be dropped, None when the chunk is
+        clean (or validation is off) so the zero-copy path is untouched.
+        Positioned (`start_row`) pushes cannot silently renumber rows —
+        there a quarantine hit is a loud error instead."""
+        if self.quarantine is None or (label is None and weight is None):
+            return None
+        from ..runtime.quality import validate_rows
+        y = None if label is None \
+            else np.asarray(label, dtype=np.float64).reshape(-1)
+        w = None if weight is None \
+            else np.asarray(weight, dtype=np.float64).reshape(-1)
+        # a width-0 X placeholder: validation only reads labels/weights
+        # here, so the caller's chunk is never densified on this path
+        keep, counts = validate_rows(np.zeros((n_rows, 0)), y, weight=w,
+                                     ledger=self.quarantine)
+        if keep.all():
+            return None
+        if start_row >= 0:
+            raise LightGBMError(
+                "quarantine: positioned push at start_row=%d carries %d "
+                "schema-invalid row(s) (%s); by-reference streams cannot "
+                "renumber rows — clean the chunk upstream"
+                % (start_row, int((~keep).sum()), counts))
+        return keep
+
     def _check_features(self, f: int) -> None:
         if self._finalized is not None:
             raise LightGBMError("cannot push rows into a finalized stream")

@@ -46,7 +46,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from . import publish, resilience, syncs, telemetry, xla_obs
+from . import publish, quality, resilience, syncs, telemetry, xla_obs
 from ..utils.log import LightGBMError, Log
 
 __all__ = ["ContinuousTrainer", "OnlineParams"]
@@ -93,6 +93,23 @@ class OnlineParams:
         # window's group sizes — lambdarank streams like any objective.
         qc = p.pop("query_column", None)
         self.query_column = int(qc) if qc is not None else None
+        # -- model-quality firewall (ISSUE 12) -------------------------------
+        # stage one: quarantine threshold — an ingest pass whose
+        # quarantined fraction exceeds this fails the CYCLE loudly
+        # (status=quarantine) instead of training on the remainder.
+        self.quarantine_limit = float(p.pop("online_quarantine_limit", 0.5))
+        # stage two: pre-publish eval gate.  tolerance=inf (the default)
+        # DISABLES the gate entirely: no holdout is carved out of the
+        # window and the training path is byte-identical to a gate-less
+        # build (the default-off contract).  A finite tolerance holds out
+        # `publish_gate_holdout` of each window, evaluates candidate vs
+        # incumbent with the configured metric stack, and refuses to
+        # publish a regression.
+        self.gate_tolerance = float(p.pop("publish_gate_tolerance",
+                                          math.inf))
+        self.gate_holdout = float(p.pop("publish_gate_holdout", 0.2))
+        gm = p.pop("publish_gate_metric", None)
+        self.gate_metric = str(gm) if gm else None
         self.train_params = p
         if not self.data:
             raise LightGBMError("train_online needs data=<file>")
@@ -103,6 +120,16 @@ class OnlineParams:
             raise LightGBMError("query_column (ranking) requires "
                                 "online_mode=boost; refit re-fits leaf "
                                 "values without query structure")
+        if self.gate_enabled and not 0.0 < self.gate_holdout < 1.0:
+            raise LightGBMError("publish_gate_holdout must be in (0, 1), "
+                                "got %r" % self.gate_holdout)
+        if not 0.0 <= self.quarantine_limit <= 1.0:
+            raise LightGBMError("online_quarantine_limit must be in "
+                                "[0, 1], got %r" % self.quarantine_limit)
+
+    @property
+    def gate_enabled(self) -> bool:
+        return math.isfinite(self.gate_tolerance)
 
 
 class _IngestProducer(threading.Thread):
@@ -141,6 +168,10 @@ class _IngestProducer(threading.Thread):
         # ingest telemetry (read by the cycle stage trail and the pins)
         self.last_ingest: Optional[Dict[str, Any]] = None
         self.rows_parsed_total = 0
+        # ingest quarantine (ISSUE 12 stage one): schema-invalid rows are
+        # routed here instead of the window; the cycle reads the ledger
+        # for its stage trail and the quarantine-fraction threshold
+        self.quarantine = quality.QuarantineLedger()
 
     def _file_stamp(self) -> Optional[Tuple]:
         try:
@@ -276,7 +307,21 @@ class _IngestProducer(threading.Thread):
             self._chunks = []
             self._record_offset(size)
         parsed = int(X.shape[0])
+        # fault seam: an upstream logging outage poisoning a fraction of
+        # every chunk's labels — the quarantine below must catch it
+        y, _ = resilience.maybe_poison_rows(X, y)
         X, q = self._split_query(X)
+        # firewall stage one: schema validation — offenders go to the
+        # bounded ledger, never the window.  The clean-path fast case
+        # (keep.all()) adds zero copies, so a healthy stream's windows
+        # (and therefore its models) are byte-identical to a
+        # quarantine-less build.
+        keep, _ = quality.validate_rows(X, y, query=q,
+                                        ledger=self.quarantine)
+        quarantined = parsed - int(keep.sum())
+        if quarantined:
+            X, y = X[keep], np.asarray(y)[keep]
+            q = q[keep] if q is not None else None
         self._append_window(X, y, q)
         Xw, yw, qw = self._window()
         dt = time.perf_counter() - t0
@@ -288,6 +333,9 @@ class _IngestProducer(threading.Thread):
             "seconds": round(dt, 4),
             "rows_per_sec": round(parsed / dt, 1) if dt > 0 else None,
             "window_rows": int(Xw.shape[0]),
+            "quarantined": quarantined,
+            "quarantine_frac": round(quarantined / parsed, 4)
+            if parsed else 0.0,
         }
         # the same ingest record feeds the live registry (ISSUE 9):
         # rows/sec is the counter+histogram pair, the window a gauge
@@ -348,6 +396,12 @@ class ContinuousTrainer:
         self._window_stamp: Optional[Tuple] = None
         self._base_iter = 0              # iterations in the pre-service model
         self.timeouts = 0
+        # pre-publish eval gate state (ISSUE 12 stage two): the holdout
+        # slice of the CURRENT window, refreshed whenever a window is
+        # adopted; None while the gate is disabled
+        self._holdout: Optional[Tuple] = None
+        self.gate_rejections = 0
+        self.quarantine_failures = 0
 
     # -- service state file (the schedule clock) ----------------------------
     @property
@@ -522,6 +576,49 @@ class ContinuousTrainer:
                 "mode": self.cfg.mode, "rounds_per_cycle": self.cfg.rounds,
                 "window_rows": self.cfg.window_rows}
 
+    # -- pre-publish eval gate (ISSUE 12 stage two) --------------------------
+    def _gate_split(self, X, y, q=None) -> Tuple:
+        """Carve the deterministic holdout out of a freshly adopted
+        window (gate enabled) and stage it for this window's gate
+        evaluations; with the gate disabled the window passes through
+        UNTOUCHED (same arrays, no copy — the byte-identity contract)."""
+        if not self.cfg.gate_enabled:
+            self._holdout = None
+            return X, y, q
+        hold = quality.holdout_mask(X.shape[0], self.cfg.gate_holdout, q)
+        self._holdout = (X[hold], np.asarray(y)[hold],
+                         q[hold] if q is not None else None)
+        keep = ~hold
+        return (X[keep], np.asarray(y)[keep],
+                q[keep] if q is not None else None)
+
+    def _gate_decide(self, cycle: int) -> Dict[str, Any]:
+        """Evaluate the candidate (the live model) and the incumbent (the
+        newest published generation) on the SAME holdout slice with the
+        configured metric stack; returns the auditable gate record."""
+        from ..models.gbdt_model import GBDTModel
+        Xh, yh, qh = self._holdout
+        self._booster._drain()
+        params = dict(self.cfg.train_params)
+        cand = quality.evaluate_model(self._booster._model, Xh, yh, params,
+                                      query=qh)
+        inc_rec = self.publisher.latest_valid()
+        inc = None
+        if inc_rec is not None:
+            inc = quality.evaluate_model(
+                GBDTModel.load_model_from_string(inc_rec.model_text),
+                Xh, yh, params, query=qh)
+        rec = quality.gate_verdict(cand, inc, self.cfg.gate_tolerance,
+                                   self.cfg.gate_metric)
+        rec["cycle"] = cycle
+        rec["holdout_rows"] = int(len(yh))
+        rec["incumbent_generation"] = \
+            inc_rec.generation if inc_rec is not None else None
+        telemetry.counter("lgbm_publish_gate_total").inc(
+            verdict=rec["verdict"])
+        self.wd.annotate("publish_gate", rec)
+        return rec
+
     # -- the loop ------------------------------------------------------------
     def run(self) -> int:
         cfg = self.cfg
@@ -554,6 +651,7 @@ class ContinuousTrainer:
         self.wd("ingest: first window")
         stamp, X, y, q = producer.current(timeout=max(cfg.stage_timeout, 60))
         self._window_stamp = stamp
+        X, y, q = self._gate_split(X, y, q)
 
         if cfg.mode == "boost":
             done = self._recover_boost(X, y, q)
@@ -590,6 +688,17 @@ class ContinuousTrainer:
                                  "the next slot", e, cycle)
                 self.wd.annotate("retry", True)
                 continue
+            except quality.QuarantineExceeded as e:
+                # firewall stage one tripping its threshold: the window
+                # is mostly garbage — refuse the cycle LOUDLY and retry
+                # at the next slot (fresh data may arrive; training on
+                # the remainder would launder the outage into a model)
+                self.quarantine_failures += 1
+                telemetry.counter("lgbm_online_cycles_total").inc(
+                    status="quarantine")
+                self.log.warning("online: %s", e)
+                self.wd.annotate("quarantine_failed", str(e))
+                continue
             except resilience.TrainingPreempted:
                 return self._preempt(guard, cycle, snapshot_written=True)
             if guard.signum is not None:
@@ -615,23 +724,50 @@ class ContinuousTrainer:
             # ingest telemetry (mode + rows/sec) rides the cycle's stage
             # trail next to the sync audit and publish latency
             self.wd.annotate("ingest", dict(info))
-        if stamp != self._window_stamp and cfg.mode == "boost":
+            if info.get("quarantined"):
+                self.wd.annotate("quarantine",
+                                 producer.quarantine.summary())
+            frac = float(info.get("quarantine_frac", 0.0) or 0.0)
+            if frac > cfg.quarantine_limit:
+                raise quality.QuarantineExceeded(
+                    "cycle %d: ingest quarantined %.0f%% of the last "
+                    "parse (online_quarantine_limit=%.0f%%) — refusing "
+                    "to train on the remainder" % (
+                        cycle, frac * 100, cfg.quarantine_limit * 100))
+        # fault seam: valid-looking but WRONG labels for this cycle's
+        # TRAINING slice (the flip lands after the gate split, so the
+        # holdout stays trustworthy — the eval gate below is the
+        # defense, not the quarantine)
+        flip_armed = (resilience.fault_active("label_flip") and
+                      int(resilience.fault_arg("label_flip", "-1") or -1)
+                      == cycle)
+        if (stamp != self._window_stamp or flip_armed) \
+                and cfg.mode == "boost":
             # continued training onto the new window: the live engine's
             # trees carry over as the init model (scores are replayed onto
             # the new data — reference continued-training semantics)
             self.log.info("online: data window changed; rebuilding the "
                           "engine on %d rows", X.shape[0])
+            Xtr, ytr, qtr = self._gate_split(X, y, q)
+            ytr, _ = resilience.maybe_flip_labels(ytr, cycle)
             self._booster = self._build_booster(
-                X, y, q, init_model=self._booster._model)
+                Xtr, ytr, qtr, init_model=self._booster._model)
             self._window_stamp = stamp
         elif stamp != self._window_stamp:
             self._window_stamp = stamp
-        self._refit_window = (X, y)
+        if cfg.mode == "refit":
+            Xtr, ytr, _ = self._gate_split(X, y, None)
+            ytr, _ = resilience.maybe_flip_labels(ytr, cycle)
+            self._refit_window = (Xtr, ytr)
+        else:
+            self._refit_window = (X, y)
 
         # -- train: to the cycle's absolute iteration target -----------------
         self._stage(cycle, "train")
         s0 = syncs.snapshot()
         c0 = xla_obs.snapshot()
+        it0 = self._total_iter()
+        pre_refit = None
         refitting = (cfg.mode == "refit"
                      and self._booster._model.current_iteration > 0)
         if not refitting:
@@ -647,12 +783,22 @@ class ContinuousTrainer:
                         self._snapshot(cycle, mid_cycle=True))
         else:
             X, y = self._refit_window
+            pre_refit = self._booster
             self._booster = self._booster.refit(X, y)
         self.wd.annotate("syncs", syncs.delta(s0)["by_label"])
         # per-cycle compile ledger delta (ISSUE 10): steady-state cycles
         # on an unchanged window annotate {} — a rebuild (window reshape)
         # names exactly which sites recompiled and why the cycle was slow
         self.wd.annotate("xla_compiles", xla_obs.delta(c0))
+
+        # -- eval gate: judge the candidate BEFORE it can become state -------
+        gate_rec = None
+        if cfg.gate_enabled and self._holdout is not None:
+            self._stage(cycle, "gate")
+            gate_rec = self._gate_decide(cycle)
+            if gate_rec["verdict"] == "reject":
+                self._reject_cycle(cycle, gate_rec, it0, pre_refit)
+                return
 
         # -- snapshot (boost mode: full resume state at the boundary) --------
         if self._booster._engine is not None:
@@ -662,10 +808,15 @@ class ContinuousTrainer:
         # -- publish ---------------------------------------------------------
         self._stage(cycle, "publish")
         t_pub = time.monotonic()
+        meta = self._gen_meta(cycle, self._total_iter())
+        if gate_rec is not None:
+            meta["gate"] = gate_rec
+        # fault seam: a regression the offline gate cannot see (injected
+        # AFTER the verdict) — the serving canary is the defense
         rec = self.publisher.publish(
-            self._model_text(self._booster),
-            meta=self._gen_meta(cycle, self._total_iter()),
-            generation=cycle)
+            resilience.maybe_regress_model(
+                self._model_text(self._booster), cycle),
+            meta=meta, generation=cycle)
         telemetry.histogram("lgbm_online_publish_seconds").observe(
             time.monotonic() - t_pub)
         telemetry.counter("lgbm_online_cycles_total").inc(status="ok")
@@ -673,6 +824,43 @@ class ContinuousTrainer:
                          round(time.monotonic() - t_pub, 4))
         self.log.info("online: cycle %d published generation %d (%s)",
                       cycle, rec.generation, os.path.basename(rec.path))
+
+    def _reject_cycle(self, cycle: int, gate_rec: Dict[str, Any],
+                      it0: int, pre_refit) -> None:
+        """Gate rejection: persist the rejected candidate for the audit
+        trail, then UNDO the cycle so the regressed trees cannot leak
+        into the next cycle's lineage — boost mode rolls the cycle's
+        iterations back (scores restored per iteration), refit mode
+        restores the pre-refit booster.  The incumbent generation keeps
+        serving; the trainer retries toward the same absolute targets on
+        the next window."""
+        self.gate_rejections += 1
+        rej_path = self.publisher.record_rejection(
+            self._model_text(self._booster), gate_rec, cycle)
+        if pre_refit is not None:
+            self._booster = pre_refit
+        else:
+            while self._total_iter() > it0:
+                self._booster.rollback_one_iter()
+            # the rejected cycle's TRAINING DATA may be what was wrong
+            # (label_flip models exactly this): force the next cycle to
+            # rebuild from the freshest window instead of continuing on
+            # the suspect dataset
+            self._window_stamp = None
+        telemetry.counter("lgbm_online_cycles_total").inc(
+            status="gate_reject")
+        self.wd.annotate("gate_rejected", {
+            "cycle": cycle, "rejected_model": os.path.basename(rej_path),
+            "metric": gate_rec.get("metric"),
+            "regression": gate_rec.get("regression")})
+        self.log.warning(
+            "online: cycle %d REJECTED by the publish gate (%s regressed "
+            "%.4f > tolerance %s); rejected model persisted at %s, "
+            "incumbent generation %s keeps serving",
+            cycle, gate_rec.get("metric"),
+            gate_rec.get("regression") or float("nan"),
+            gate_rec.get("tolerance"), rej_path,
+            gate_rec.get("incumbent_generation"))
 
     def _snapshot(self, cycle: int, mid_cycle: bool = False) -> Optional[str]:
         extra = {"cycle": cycle - 1 if mid_cycle else cycle,

@@ -35,12 +35,13 @@ it from its policy thread.  No jax / numpy at module scope.
 """
 from __future__ import annotations
 
+import collections
 from typing import Any, Dict, List, Optional
 
 from . import telemetry
 from .resilience import wallclock
 
-__all__ = ["AutoscaleShedPolicy"]
+__all__ = ["AutoscaleShedPolicy", "CanaryPolicy"]
 
 
 class AutoscaleShedPolicy:
@@ -145,3 +146,152 @@ class AutoscaleShedPolicy:
     def state(self) -> Dict[str, Any]:
         return {"window_s": self.window_s, "shed_active": self.shed_active,
                 "decisions": len(self.decisions)}
+
+
+class CanaryPolicy:
+    """Hysteresis state machine judging a canary generation against the
+    incumbent (ISSUE 12 stage three — the `AutoscaleShedPolicy` pattern
+    applied to model QUALITY instead of queue depth).
+
+    The serving runtime routes a configurable fraction of batches to a
+    freshly published generation and feeds every batch outcome here:
+    ``observe(kind, error=, latency_s=)`` with ``kind`` canary or
+    incumbent, ``error`` the batch's observed prediction error (clients
+    that submitted labels; None when no label rode the batch) and the
+    batch latency.  The controller is pure and clock-free — decisions
+    depend only on the observation sequence, so the hysteresis semantics
+    are unit-testable without a runtime.
+
+    * **Warm-up** — no judgment before ``min_samples`` canary AND
+      ``min_samples`` incumbent observations (of each signal kind): a
+      single unlucky batch must not kill a good model.
+    * **Degradation** — a canary comparison round is degraded when its
+      windowed mean error exceeds ``incumbent_mean * error_ratio +
+      error_margin`` or its windowed mean latency exceeds
+      ``incumbent_mean * latency_ratio``.  Means are over the last
+      ``window`` observations per side (a bounded sliding window, so a
+      canary that RECOVERS pulls its mean back down instead of being
+      condemned by history).  ``patience`` CONSECUTIVE degraded rounds
+      latch the ``rollback`` decision; any healthy round in between
+      resets the streak (the anti-flap deadband, same contract as the
+      autoscale controller).
+    * **Promotion** — ``promote_after`` canary observations with no
+      active degradation streak latch ``promote``: the canary becomes
+      the incumbent and full traffic cuts over.
+
+    Every decision lands in ``lgbm_canary_events_total{event}`` and in
+    the returned records (the serving runtime writes them to its stage
+    trail and, on rollback, into the publish directory's durable
+    ROLLBACK marker).
+    """
+
+    def __init__(self,
+                 min_samples: int = 8,
+                 patience: int = 3,
+                 error_ratio: float = 1.5,
+                 error_margin: float = 0.02,
+                 latency_ratio: float = 5.0,
+                 promote_after: int = 64,
+                 window: int = 64):
+        if error_ratio < 1.0 or latency_ratio < 1.0:
+            raise ValueError("error_ratio/latency_ratio must be >= 1")
+        self.min_samples = max(int(min_samples), 1)
+        self.patience = max(int(patience), 1)
+        self.error_ratio = float(error_ratio)
+        self.error_margin = float(error_margin)
+        self.latency_ratio = float(latency_ratio)
+        self.promote_after = max(int(promote_after), self.min_samples)
+        self.window = max(int(window), self.min_samples)
+        self.decisions: List[Dict[str, Any]] = []
+        self.reset(None)
+
+    def reset(self, generation: Optional[int]) -> None:
+        """Arm for a new canary generation (old streaks must not carry
+        over to a different model)."""
+        self.generation = generation
+        self._err = {"canary": collections.deque(maxlen=self.window),
+                     "incumbent": collections.deque(maxlen=self.window)}
+        self._lat = {"canary": collections.deque(maxlen=self.window),
+                     "incumbent": collections.deque(maxlen=self.window)}
+        self._streak = 0
+        self._decided: Optional[str] = None
+        self._canary_batches = 0
+
+    # -- the state machine ---------------------------------------------------
+    def observe(self, kind: str, error: Optional[float] = None,
+                latency_s: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Feed one batch outcome; returns the decision records this
+        observation triggered ([] for hold).  `kind` is "canary" or
+        "incumbent"."""
+        if kind not in self._err:
+            raise ValueError("kind must be canary or incumbent, got %r"
+                             % kind)
+        if self._decided is not None:
+            return []
+        if error is not None:
+            self._err[kind].append(float(error))
+        if latency_s is not None:
+            self._lat[kind].append(float(latency_s))
+        if kind != "canary":
+            return []
+        self._canary_batches += 1
+        degraded = None
+        ce, ie = self._err["canary"], self._err["incumbent"]
+        if len(ce) >= self.min_samples and len(ie) >= self.min_samples:
+            can_err = sum(ce) / len(ce)
+            inc_err = sum(ie) / len(ie)
+            if can_err > inc_err * self.error_ratio + self.error_margin:
+                degraded = {"signal": "error", "canary": round(can_err, 6),
+                            "incumbent": round(inc_err, 6)}
+        cl, il = self._lat["canary"], self._lat["incumbent"]
+        if degraded is None and len(cl) >= self.min_samples \
+                and len(il) >= self.min_samples:
+            can_lat = sum(cl) / len(cl)
+            inc_lat = sum(il) / len(il)
+            if can_lat > inc_lat * self.latency_ratio:
+                degraded = {"signal": "latency",
+                            "canary": round(can_lat, 6),
+                            "incumbent": round(inc_lat, 6)}
+        out: List[Dict[str, Any]] = []
+        if degraded is not None:
+            self._streak += 1
+            if self._streak >= self.patience:
+                self._decided = "rollback"
+                out.append(self._decide("rollback", degraded))
+        else:
+            self._streak = 0
+            if self._canary_batches >= self.promote_after:
+                self._decided = "promote"
+                out.append(self._decide("promote", None))
+        return out
+
+    def _decide(self, event: str,
+                evidence: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        rec = {"event": "canary_" + event, "generation": self.generation,
+               "canary_batches": self._canary_batches,
+               "evidence": evidence, "wallclock": wallclock()}
+        self.decisions.append(rec)
+        telemetry.counter("lgbm_canary_events_total").inc(event=event)
+        return rec
+
+    def note_start(self, generation: int) -> Dict[str, Any]:
+        """Record (and count) the canary window opening for `generation`."""
+        self.reset(generation)
+        rec = {"event": "canary_start", "generation": generation,
+               "wallclock": wallclock()}
+        self.decisions.append(rec)
+        telemetry.counter("lgbm_canary_events_total").inc(event="start")
+        return rec
+
+    @property
+    def decided(self) -> Optional[str]:
+        """"rollback"/"promote" once latched for this generation."""
+        return self._decided
+
+    def state(self) -> Dict[str, Any]:
+        ce, ie = self._err["canary"], self._err["incumbent"]
+        return {"generation": self.generation,
+                "canary_batches": self._canary_batches,
+                "streak": self._streak, "decided": self._decided,
+                "canary_mean_error": sum(ce) / len(ce) if ce else None,
+                "incumbent_mean_error": sum(ie) / len(ie) if ie else None}

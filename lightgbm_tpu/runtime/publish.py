@@ -43,13 +43,21 @@ from typing import Any, Dict, List, Optional, Tuple
 from . import resilience
 
 __all__ = ["ModelPublisher", "ModelSubscriber", "PublishedModel",
-           "NoValidGeneration", "generation_paths", "validate_generation"]
+           "NoValidGeneration", "generation_paths", "validate_generation",
+           "read_rollback_marker", "mark_rollback", "rejection_paths"]
 
 _META_PREFIX = "!publish_meta="
 _CHECKSUM_PREFIX = "!publish_checksum=sha256:"
 _GEN_PREFIX = "gen_"
 _GEN_SUFFIX = ".txt"
+_REJECT_PREFIX = "rejected_"
 MANIFEST = "MANIFEST.json"
+#: durable quality-rollback marker (ISSUE 12 stage three).  A single
+#: atomic JSON file in the publish dir naming the generations the canary
+#: condemned — it is not a gen_ file, so pruning never touches it, a
+#: relaunched subscriber reads it before its first resolve, and
+#: concurrent readers all see one consistent bad-set.
+ROLLBACK_MARKER = "ROLLBACK.json"
 
 
 class NoValidGeneration(RuntimeError):
@@ -71,6 +79,67 @@ def generation_paths(pub_dir: str) -> List[Tuple[int, str]]:
     for name in names:
         if name.startswith(_GEN_PREFIX) and name.endswith(_GEN_SUFFIX):
             digits = name[len(_GEN_PREFIX):-len(_GEN_SUFFIX)]
+            if digits.isdigit():
+                out.append((int(digits), os.path.join(pub_dir, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def read_rollback_marker(pub_dir: str) -> Dict[str, Any]:
+    """The durable rollback record: ``{"bad_generations": [...],
+    "pinned": [...], "events": [...]}`` (empty dict when no rollback has
+    ever happened).  A torn/unreadable marker reads as empty — the
+    marker is written atomically, so that only happens when it does not
+    exist."""
+    try:
+        with open(os.path.join(pub_dir, ROLLBACK_MARKER)) as fh:
+            rec = json.load(fh)
+        if isinstance(rec, dict):
+            return rec
+    except (OSError, ValueError):
+        pass
+    return {}
+
+
+def mark_rollback(pub_dir: str, bad_generation: int,
+                  pinned_generation: Optional[int] = None,
+                  reason: str = "", evidence: Optional[Dict] = None
+                  ) -> Dict[str, Any]:
+    """Condemn `bad_generation` fleet-wide: merge it into the publish
+    dir's ROLLBACK marker (read-merge-atomic-write, so concurrent
+    replicas condemning independently both land) and record the
+    generation the fleet is rolled back to.  Every subscriber —
+    including ones launched AFTER this call — skips condemned
+    generations during resolution; the marker survives pruning and
+    relaunch because it is its own atomic non-generation file."""
+    rec = read_rollback_marker(pub_dir)
+    bad = set(int(g) for g in rec.get("bad_generations", []))
+    bad.add(int(bad_generation))
+    pinned = set(int(g) for g in rec.get("pinned", []))
+    if pinned_generation is not None:
+        pinned.add(int(pinned_generation))
+    events = list(rec.get("events", []))
+    events.append({"bad_generation": int(bad_generation),
+                   "pinned_generation": pinned_generation,
+                   "reason": reason, "evidence": evidence,
+                   "wallclock": resilience.wallclock()})
+    out = {"bad_generations": sorted(bad), "pinned": sorted(pinned),
+           "events": events[-64:]}
+    resilience.atomic_write(os.path.join(pub_dir, ROLLBACK_MARKER),
+                            json.dumps(out, indent=1))
+    return out
+
+
+def rejection_paths(pub_dir: str) -> List[Tuple[int, str]]:
+    """Persisted gate rejections (``rejected_<N>.txt``), newest first."""
+    out: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(pub_dir)
+    except OSError:
+        return []
+    for name in names:
+        if name.startswith(_REJECT_PREFIX) and name.endswith(_GEN_SUFFIX):
+            digits = name[len(_REJECT_PREFIX):-len(_GEN_SUFFIX)]
             if digits.isdigit():
                 out.append((int(digits), os.path.join(pub_dir, name)))
     out.sort(reverse=True)
@@ -218,14 +287,37 @@ class ModelPublisher:
         resilience.atomic_write(os.path.join(self.pub_dir, MANIFEST),
                                 json.dumps(manifest, indent=1))
 
+    def record_rejection(self, model_text: str, gate: Dict[str, Any],
+                         cycle: int) -> str:
+        """Persist a gate-REJECTED candidate for the audit trail (ISSUE
+        12 stage two): ``rejected_<cycle>.txt`` carries the full rejected
+        model text with the same checksummed footer a publication gets —
+        the meta holds the gate record (candidate metrics, incumbent
+        metrics, tolerance, verdict) — but the ``rejected_`` name keeps
+        it invisible to every subscriber.  Returns the path."""
+        meta = {"rejected": True, "cycle": int(cycle), "gate": gate,
+                "rejected_at": resilience.wallclock()}
+        body = _with_publish_footer(model_text, meta)
+        path = os.path.join(self.pub_dir,
+                            "%s%08d%s" % (_REJECT_PREFIX, cycle,
+                                          _GEN_SUFFIX))
+        resilience.atomic_write(path, body)
+        return path
+
     def _prune(self) -> None:
         """keep-last-K AND older-than-grace: both conditions must hold
         before a generation is unlinked (satellite pin: a subscriber that
-        just resolved a path must get to read it)."""
+        just resolved a path must get to read it).  A generation the
+        rollback marker names as a PIN TARGET is never pruned — after a
+        quality rollback the whole fleet is serving it, however old it
+        is."""
         if self.keep_last <= 0:
             return
         cutoff = time.time() - max(self.grace_s, 0.0)
+        protected = set(read_rollback_marker(self.pub_dir).get("pinned", []))
         for gen, old in generation_paths(self.pub_dir)[self.keep_last:]:
+            if gen in protected:
+                continue
             with contextlib.suppress(OSError):
                 if self.grace_s <= 0 or os.path.getmtime(old) < cutoff:
                     os.unlink(old)
@@ -253,7 +345,33 @@ class ModelSubscriber:
         self.backoff_cap = backoff_cap
         self.seed = seed
         self.skipped_invalid = 0         # torn/corrupt files stepped past
+        self.skipped_rolled_back = 0     # marker-condemned gens stepped past
         self.resolved_count = 0
+        self._pin: Optional[Tuple[int, Optional[int]]] = None
+
+    # -- quality rollback (ISSUE 12 stage three) ----------------------------
+    def pin_generation(self, generation: int,
+                       release_above: Optional[int] = None) -> None:
+        """Roll this subscriber back: resolve `generation` (and only it)
+        until either the pin is released (`unpin`) or — when
+        `release_above` is given — a generation NEWER than
+        `release_above` lands, i.e. the trainer has published a fresh
+        candidate that deserves its own canary window.  The pin is
+        runtime-local and immediate; the durable fleet-wide counterpart
+        is the publish dir's ROLLBACK marker (`mark_rollback`), which
+        every resolve consults."""
+        self._pin = (int(generation), release_above)
+
+    def unpin(self) -> None:
+        self._pin = None
+
+    @property
+    def pinned_generation(self) -> Optional[int]:
+        return self._pin[0] if self._pin is not None else None
+
+    def _bad_generations(self) -> set:
+        return set(read_rollback_marker(self.pub_dir).get(
+            "bad_generations", []))
 
     def _candidates(self) -> List[Tuple[int, str]]:
         """Generation candidates newest-first: the manifest pointer is
@@ -278,8 +396,27 @@ class ModelSubscriber:
 
     def resolve_once(self) -> Optional[PublishedModel]:
         """One resolution attempt (no retry).  Never raises on torn or
-        vanishing files — those are skipped."""
-        for gen, path in self._candidates():
+        vanishing files — those are skipped, as are generations the
+        publish dir's ROLLBACK marker condemns.  While a pin is active
+        the pinned generation is resolved instead of the newest one —
+        until a candidate newer than the pin's `release_above` bound
+        appears, which releases the pin (the fresh candidate gets its
+        own canary judgment)."""
+        bad = self._bad_generations()
+        cands = self._candidates()
+        if self._pin is not None:
+            pin_gen, release_above = self._pin
+            newest_ok = max((g for g, _ in cands
+                             if g not in bad), default=None)
+            if release_above is not None and newest_ok is not None \
+                    and newest_ok > release_above:
+                self._pin = None
+            else:
+                cands = [(g, p) for g, p in cands if g == pin_gen] or cands
+        for gen, path in cands:
+            if gen in bad:
+                self.skipped_rolled_back += 1
+                continue
             try:
                 with open(path, "rb") as fh:
                     text = fh.read().decode("utf-8", "replace")

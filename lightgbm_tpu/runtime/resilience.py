@@ -74,6 +74,7 @@ __all__ = [
     "maybe_probe_hang_seconds", "maybe_corrupt_snapshot",
     "maybe_inject_nan", "maybe_slow_stage", "maybe_torn_publish",
     "maybe_die_at_publish", "maybe_fail_predict", "DevicePredictFault",
+    "maybe_poison_rows", "maybe_flip_labels", "maybe_regress_model",
     "snapshot_model_text", "FAULT_TABLE", "FAULT_NAMES",
 ]
 
@@ -135,6 +136,19 @@ FAULT_TABLE: Dict[str, Dict[str, str]] = {
         "arg": "SECS",
         "injects_at": "device-predict micro-batch boundary "
                       "(maybe_fail_predict; every batch while armed)"},
+    "poison_rows": {
+        "arg": "F",
+        "injects_at": "online ingest, after parse / before quarantine "
+                      "(maybe_poison_rows; fraction F of every chunk)"},
+    "label_flip": {
+        "arg": "K",
+        "injects_at": "online cycle K's training-window labels "
+                      "(maybe_flip_labels in the continuous trainer)"},
+    "regress_model": {
+        "arg": "K",
+        "injects_at": "continuous trainer's publish seam, AFTER the "
+                      "eval gate (maybe_regress_model on cycle K's "
+                      "model text)"},
 }
 
 FAULT_NAMES = tuple(FAULT_TABLE)
@@ -360,6 +374,77 @@ def maybe_fail_predict() -> None:
                 "injected device predict failure "
                 "(LGBM_TPU_FAULT=die_at_predict, batch #%d)"
                 % _PREDICT_FAULT["batches"])
+
+
+def maybe_poison_rows(X, y):
+    """`poison_rows:F` corrupts fraction F of every parsed ingest chunk
+    the way an upstream logging outage would: a deterministic stride of
+    rows gets a non-finite label (alternating NaN / +inf so both spellings
+    are exercised).  The quarantine (ISSUE 12 stage one) must route every
+    poisoned row to the ledger — a single NaN label reaching a histogram
+    poisons every split under it.  Returns (y, n_poisoned); X is
+    returned untouched (NaN FEATURES are legitimate missing values and
+    are deliberately not part of this fault)."""
+    if not fault_active("poison_rows") or y is None or len(y) == 0:
+        return y, 0
+    frac = float(fault_arg("poison_rows", "0.1"))
+    if frac <= 0:
+        return y, 0
+    stride = max(int(round(1.0 / min(frac, 1.0))), 1)
+    import numpy as np
+    y = np.array(y, dtype=np.float64, copy=True)
+    idx = np.arange(0, len(y), stride)
+    y[idx[0::2]] = float("nan")
+    y[idx[1::2]] = float("inf")
+    sys.stderr.write("[%s] FAULT poison_rows: poisoned %d/%d labels\n"
+                     % (wallclock(), len(idx), len(y)))
+    sys.stderr.flush()
+    return y, int(len(idx))
+
+
+def maybe_flip_labels(y, cycle: int):
+    """`label_flip:K` inverts the training labels of cycle K's window —
+    valid-looking values carrying wrong information, the data bug the
+    ingest quarantine CANNOT catch (every row passes schema validation).
+    The pre-publish eval gate (ISSUE 12 stage two) is the defense: the
+    model trained on flipped labels regresses on the holdout and must
+    not be published.  Returns (y, flipped?)."""
+    if not fault_active("label_flip") or y is None or len(y) == 0:
+        return y, False
+    if int(fault_arg("label_flip", "0")) != int(cycle):
+        return y, False
+    import numpy as np
+    y = np.asarray(y, dtype=np.float64)
+    flipped = (float(np.max(y)) + float(np.min(y))) - y
+    sys.stderr.write("[%s] FAULT label_flip: inverted cycle %d's %d "
+                     "labels\n" % (wallclock(), cycle, len(y)))
+    sys.stderr.flush()
+    return flipped, True
+
+
+def maybe_regress_model(model_text: str, cycle: int) -> str:
+    """`regress_model:K` sabotages cycle K's model text at the publish
+    seam, AFTER the eval gate has judged the (clean) candidate — the
+    regression the offline gate cannot see and only the serving canary
+    (ISSUE 12 stage three) can catch.  Every `leaf_value=` line is
+    rescaled by -2, so the published generation is a VALID, loadable
+    model whose live predictions are badly wrong.  The canary must roll
+    the fleet back to the prior generation."""
+    if not fault_active("regress_model"):
+        return model_text
+    if int(fault_arg("regress_model", "0")) != int(cycle):
+        return model_text
+    lines = model_text.split("\n")
+    for i, line in enumerate(lines):
+        if line.startswith("leaf_value="):
+            vals = ["%.17g" % (-2.0 * float(tok))
+                    for tok in line[len("leaf_value="):].split()]
+            lines[i] = "leaf_value=" + " ".join(vals)
+    sys.stderr.write("[%s] FAULT regress_model: sabotaged cycle %d's "
+                     "leaf values at the publish seam\n"
+                     % (wallclock(), cycle))
+    sys.stderr.flush()
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------

@@ -66,6 +66,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from . import policy as policy_mod
 from . import publish, resilience, telemetry, xla_obs
 from ..utils.log import Log
 
@@ -130,15 +131,20 @@ class _Request:
     """Queued unit of work; doubles as the caller's future."""
 
     __slots__ = ("model_id", "X", "n_rows", "deadline", "enqueued",
-                 "done", "result", "rejection", "error", "priority")
+                 "done", "result", "rejection", "error", "priority",
+                 "label")
 
     def __init__(self, model_id: str, X: np.ndarray, deadline: float,
-                 priority: int = 0):
+                 priority: int = 0, label: Optional[np.ndarray] = None):
         self.model_id = model_id
         self.X = X
         self.n_rows = int(X.shape[0])
         self.deadline = deadline            # absolute time.monotonic()
         self.priority = int(priority)
+        # optional ground-truth outcome the client already knows (the
+        # online feedback loop): per-row labels feed the canary policy's
+        # live error signal — never the prediction itself
+        self.label = label
         self.enqueued = time.monotonic()
         self.done = threading.Event()
         self.result: Optional[ServeResult] = None
@@ -262,6 +268,8 @@ class ServingRuntime:
                  priority_levels: int = 3,
                  quotas: Optional[Dict[str, float]] = None,
                  policy=None,
+                 canary_fraction: float = 0.0,
+                 canary_policy=None,
                  log=Log):
         """`publish_dir` subscribes the default model to a PR 6 publish
         directory; `models` maps model_id -> publish_dir for
@@ -278,7 +286,22 @@ class ServingRuntime:
         `runtime.policy.AutoscaleShedPolicy`: a background thread feeds
         it the queue-depth fraction; its decisions retune
         `batch_window_s` and flip load-shed mode for the lowest class
-        (rejection `load_shed`, retryable)."""
+        (rejection `load_shed`, retryable).
+
+        ISSUE 12 canary knobs: `canary_fraction` > 0 turns newly
+        published generations into CANARIES — the poller loads them
+        beside the incumbent instead of swapping, the batcher routes
+        that fraction of batches to them (deterministic interleave at
+        the existing swap seam), and a `runtime.policy.CanaryPolicy`
+        (`canary_policy`, default-constructed when omitted) judges
+        canary vs incumbent error/latency with hysteresis.  Sustained
+        degradation ROLLS BACK: the canary is dropped, the publish dir
+        gets a durable ROLLBACK marker condemning the generation
+        fleet-wide, and the subscriber pins the incumbent until a fresh
+        candidate lands.  Sustained health PROMOTES the canary to
+        incumbent.  At the default `canary_fraction=0` every new
+        generation swaps in directly — byte-identical to the pre-canary
+        behavior."""
         self.log = log
         self._params = dict(params or {})
         self._raw_score = bool(raw_score)
@@ -293,6 +316,15 @@ class ServingRuntime:
         self.priority_levels = max(int(priority_levels), 1)
         self.quotas: Dict[str, float] = dict(quotas or {})
         self.policy = policy
+        self.canary_fraction = float(canary_fraction)
+        if not 0.0 <= self.canary_fraction <= 1.0:
+            raise ValueError("canary_fraction must be in [0, 1], got %r"
+                             % canary_fraction)
+        self._canary_policy_proto = canary_policy
+        self._canary_policies: Dict[str, policy_mod.CanaryPolicy] = {}
+        self._canary: Dict[str, _ModelEntry] = {}
+        self._canary_seq: "collections.Counter[str]" = collections.Counter()
+        self.rollback_events: List[Dict[str, Any]] = []
 
         self._dirs: Dict[str, str] = dict(models or {})
         if publish_dir:
@@ -343,6 +375,7 @@ class ServingRuntime:
             "rejected": collections.Counter(),
             "rows_served": 0, "batches_device": 0, "batches_host": 0,
             "swaps": 0, "degradations": 0, "recoveries": 0,
+            "canary_batches": 0, "rollbacks": 0, "promotes": 0,
         }
 
         self._executor_idx = 0
@@ -489,7 +522,128 @@ class ServingRuntime:
         cur = self._entries.get(model_id)
         if cur is not None and cur.generation == rec.generation:
             return
-        self._swap_in(model_id, rec.model_text, rec.generation, rec.meta)
+        if self.canary_fraction <= 0 or cur is None:
+            # canary disabled (or nothing to compare against yet): the
+            # pre-ISSUE-12 direct swap, unchanged
+            self._swap_in(model_id, rec.model_text, rec.generation,
+                          rec.meta)
+            return
+        can = self._canary.get(model_id)
+        if can is not None and can.generation == rec.generation:
+            return
+        self._canary_in(model_id, rec)
+
+    # -- canary + automatic rollback (ISSUE 12 stage three) -----------------
+    def _policy_for(self, model_id: str) -> policy_mod.CanaryPolicy:
+        pol = self._canary_policies.get(model_id)
+        if pol is None:
+            pol = (self._canary_policy_proto
+                   if self._canary_policy_proto is not None
+                   and not self._canary_policies
+                   else policy_mod.CanaryPolicy())
+            self._canary_policies[model_id] = pol
+        return pol
+
+    def _canary_in(self, model_id: str, rec) -> None:
+        """Load a freshly published generation as the CANARY: it serves
+        only `canary_fraction` of batches until the policy promotes or
+        rolls it back.  The incumbent keeps full ownership of the rest —
+        a regressed publish can never touch more than the canary share
+        of traffic."""
+        from ..basic import Booster
+        t0 = time.monotonic()
+        bst = Booster(params=dict(self._params), model_str=rec.model_text)
+        entry = _ModelEntry(model_id, rec.generation, bst, rec.meta)
+        try:
+            bst.predict(np.zeros((1, entry.num_features)),
+                        raw_score=self._raw_score, device=True)
+        except BaseException as e:          # noqa: BLE001 — degraded path
+            self.log.warning("serve: canary prewarm of %s gen %d failed "
+                             "(%s); host path serves it", model_id,
+                             rec.generation, e)
+        self._canary[model_id] = entry
+        start = self._policy_for(model_id).note_start(rec.generation)
+        with self._wd_lock:
+            self.wd.annotate("canary_start", dict(
+                start, model=model_id,
+                load_s=round(time.monotonic() - t0, 4)))
+        self.log.warning("serve: generation %d of %s entered CANARY "
+                         "(%.0f%% of batches); incumbent stays %d",
+                         rec.generation, model_id,
+                         self.canary_fraction * 100,
+                         self._entries[model_id].generation)
+
+    def _batch_error(self, values: np.ndarray,
+                     batch: List[_Request]) -> Optional[float]:
+        """Mean observed prediction error over the requests that carried
+        a label (None when nobody did) — the canary policy's live
+        quality signal.  Classification matrices score top-1 error;
+        everything else scores mean absolute error on the transformed
+        output."""
+        errs: List[float] = []
+        s = 0
+        vals = np.asarray(values)
+        for req in batch:
+            e = s + req.n_rows
+            if req.label is not None:
+                lab = np.asarray(req.label, dtype=np.float64).reshape(-1)
+                v = vals[s:e]
+                if v.ndim == 2 and v.shape[1] > 1:
+                    errs.append(float(np.mean(
+                        np.argmax(v, axis=1) != lab[: v.shape[0]])))
+                else:
+                    errs.append(float(np.mean(np.abs(
+                        v.reshape(-1) - lab[: v.size]))))
+            s = e
+        return float(np.mean(errs)) if errs else None
+
+    def _apply_canary_decision(self, model_id: str,
+                               rec: Dict[str, Any]) -> None:
+        can = self._canary.pop(model_id, None)
+        if can is None:
+            return
+        incumbent = self._entries.get(model_id)
+        if rec["event"] == "canary_promote":
+            with self._entries_lock:
+                self._entries[model_id] = can
+            with self._stats_lock:
+                self._stats["promotes"] += 1
+                self._stats["swaps"] += 1
+            telemetry.counter("lgbm_serve_swaps_total").inc()
+            with self._wd_lock:
+                self.wd.annotate("canary_promote", dict(rec,
+                                                        model=model_id))
+            self.log.warning("serve: canary generation %d of %s PROMOTED "
+                             "to incumbent", can.generation, model_id)
+            return
+        # rollback: condemn the generation fleet-wide and pin the
+        # subscriber to the incumbent until a NEWER candidate lands.
+        # The marker is durable (atomic file in the publish dir): it
+        # survives pruning, relaunch, and is seen by every concurrent
+        # reader — a condemned generation can never be resolved again.
+        pinned = incumbent.generation if incumbent is not None else None
+        pub_dir = self._dirs.get(model_id)
+        marker = None
+        if pub_dir:
+            marker = publish.mark_rollback(
+                pub_dir, can.generation, pinned_generation=pinned,
+                reason="canary degradation", evidence=rec.get("evidence"))
+            sub = self._subs.get(model_id)
+            if sub is not None and pinned is not None:
+                sub.pin_generation(pinned, release_above=can.generation)
+        event = dict(rec, model=model_id, bad_generation=can.generation,
+                     pinned_generation=pinned,
+                     marker=bool(marker))
+        self.rollback_events.append(event)
+        with self._stats_lock:
+            self._stats["rollbacks"] += 1
+        with self._wd_lock:
+            self.wd.annotate("canary_rollback", event)
+        self.log.warning(
+            "serve: canary generation %d of %s ROLLED BACK after %s "
+            "batches (%s); fleet pinned to generation %s",
+            can.generation, model_id, rec.get("canary_batches"),
+            rec.get("evidence"), pinned)
 
     def _poller_loop(self) -> None:
         while not self._stopped:
@@ -528,6 +682,12 @@ class ServingRuntime:
         entry = self._entries.get(model_id)
         return entry.generation if entry is not None else None
 
+    def canary_generation(self, model_id: str = "default") -> Optional[int]:
+        """Generation currently under canary judgment (None when no
+        canary window is open for this model)."""
+        entry = self._canary.get(model_id)
+        return entry.generation if entry is not None else None
+
     @property
     def metrics_port(self) -> Optional[int]:
         """The live /metrics port (None unless metrics_port= was given)."""
@@ -535,7 +695,8 @@ class ServingRuntime:
 
     # -- request surface -----------------------------------------------------
     def submit(self, data, deadline_s: Optional[float] = None,
-               model_id: str = "default", priority: int = 0) -> _Request:
+               model_id: str = "default", priority: int = 0,
+               label=None) -> _Request:
         """Admit one request (a feature row [F] or small matrix [B, F]).
         Raises `ServeRejected` IMMEDIATELY when the queue is full or the
         server is stopped — shedding at admission is the backpressure
@@ -549,14 +710,20 @@ class ServingRuntime:
         full queue.  A policy-flipped load-shed mode rejects the lowest
         class outright (`load_shed`); a tenant past its `quotas` share
         is rejected `quota_exceeded`.  All three rejections are
-        machine-readable, carry the request's class, and are retryable."""
+        machine-readable, carry the request's class, and are retryable.
+
+        `label` optionally carries the request's ground-truth outcome
+        (per row): it never influences the prediction — it feeds the
+        canary policy's live error signal (ISSUE 12)."""
         X = np.atleast_2d(np.asarray(data, dtype=np.float64))
         deadline = time.monotonic() + (self.default_deadline_s
                                        if deadline_s is None
                                        else float(deadline_s))
         P = self.priority_levels
         prio = min(max(int(priority), 0), P - 1)
-        req = _Request(model_id, X, deadline, priority=prio)
+        req = _Request(model_id, X, deadline, priority=prio,
+                       label=None if label is None
+                       else np.asarray(label, dtype=np.float64))
         with self._cond:
             if self._stopped or not self._started:
                 raise ServeRejected("shutdown", retryable=False,
@@ -598,7 +765,8 @@ class ServingRuntime:
 
     def predict(self, data, deadline_s: Optional[float] = None,
                 model_id: str = "default", attempts: int = 3,
-                seed: int = 0, priority: int = 0) -> ServeResult:
+                seed: int = 0, priority: int = 0,
+                label=None) -> ServeResult:
         """Blocking client helper: submit + wait, with bounded jittered
         retry on RETRYABLE rejections (queue_full under a load spike,
         no_model while the first generation lands)."""
@@ -610,7 +778,8 @@ class ServingRuntime:
         for a in range(max(attempts, 1)):
             try:
                 req = self.submit(data, deadline_s=deadline,
-                                  model_id=model_id, priority=priority)
+                                  model_id=model_id, priority=priority,
+                                  label=label)
                 return req.wait(timeout=deadline
                                 + self.predict_deadline_s + 10.0)
             except ServeRejected as e:
@@ -710,6 +879,21 @@ class ServingRuntime:
                              detail="no generation loaded for %r"
                              % model_id)
             return
+        # canary routing (ISSUE 12): while a canary window is open,
+        # a deterministic interleave hands it exactly canary_fraction of
+        # batches — the per-batch generation routing at the swap seam,
+        # so in-flight batches still finish on the entry they captured
+        canary = self._canary.get(model_id)
+        kind = "incumbent"
+        if canary is not None:
+            self._canary_seq[model_id] += 1
+            n, f = self._canary_seq[model_id], self.canary_fraction
+            if int(n * f) > int((n - 1) * f):
+                entry, kind = canary, "canary"
+            telemetry.counter("lgbm_canary_batches_total").inc(kind=kind)
+            if kind == "canary":
+                with self._stats_lock:
+                    self._stats["canary_batches"] += 1
         X = (batch[0].X if len(batch) == 1
              else np.concatenate([r.X for r in batch], axis=0))
         with self._wd_lock:
@@ -717,7 +901,15 @@ class ServingRuntime:
                     % (model_id, entry.generation, X.shape[0]),
                     seconds=0)
         c0 = xla_obs.total_compiles()
+        t_dispatch = time.monotonic()
         values, served_by = self._serve_path(entry, X)
+        if canary is not None:
+            pol = self._policy_for(model_id)
+            decisions = pol.observe(
+                kind, error=self._batch_error(values, batch),
+                latency_s=time.monotonic() - t_dispatch)
+            for d in decisions:
+                self._apply_canary_decision(model_id, d)
         # a batch that moved the compile ledger pays trace+compile wall
         # time — stamp it on the batch span and every response in it
         compiled = xla_obs.total_compiles() > c0
@@ -878,6 +1070,13 @@ class ServingRuntime:
                                 decisions_tail=self.policy.decisions[-16:])
         st["generations"] = {mid: e.generation
                              for mid, e in self._entries.items()}
+        if self.canary_fraction > 0:
+            st["canary_fraction"] = self.canary_fraction
+            st["canary_generations"] = {mid: e.generation
+                                        for mid, e in self._canary.items()}
+            st["canary_policy"] = {mid: p.state() for mid, p
+                                   in self._canary_policies.items()}
+            st["rollback_events"] = list(self.rollback_events)
         st["degradation_events"] = list(self.degradation_events)
         st["recovery_events"] = list(self.recovery_events)
         if self.start_degradation is not None:
@@ -922,6 +1121,7 @@ class _Handler(socketserver.StreamRequestHandler):
                         deadline_s=msg.get("deadline_s"),
                         model_id=msg.get("model", "default"),
                         priority=int(msg.get("priority", 0)),
+                        label=msg.get("label"),
                     ).wait(timeout=rt.default_deadline_s
                            + rt.predict_deadline_s + 10.0)
                     out = {"values": np.asarray(rec.values).tolist(),

@@ -130,7 +130,8 @@ METRIC_TABLE: Dict[str, Dict[str, Any]] = {
         "help": "Rows currently staged in the online rolling window"},
     "lgbm_online_cycles_total": {
         "type": "counter", "labels": ("status",),
-        "help": "Continuous-training cycles, status=ok/timeout"},
+        "help": "Continuous-training cycles, status=ok/timeout/"
+                "quarantine/gate_reject"},
     "lgbm_online_publish_seconds": {
         "type": "histogram", "labels": (),
         "help": "Atomic model publish latency per cycle"},
@@ -218,6 +219,28 @@ METRIC_TABLE: Dict[str, Dict[str, Any]] = {
         "help": "Load-generator response verifications, result=ok/"
                 "wrong_generation/mismatch/unverifiable (byte-identity "
                 "vs the offline predictor for the reported generation)"},
+    "lgbm_ingest_quarantined_total": {
+        "type": "counter", "labels": ("reason",),
+        "help": "Rows the ingest quarantine dropped before they could "
+                "reach a training window, reason=nonfinite_label/"
+                "nonfinite_weight/bad_query_id/column_drift "
+                "(runtime/quality.py firewall stage one)"},
+    "lgbm_publish_gate_total": {
+        "type": "counter", "labels": ("verdict",),
+        "help": "Pre-publish eval-gate decisions per cycle, verdict="
+                "pass/reject/no_incumbent/no_metric/disabled (firewall "
+                "stage two; a reject persists the rejected model next "
+                "to the publish dir)"},
+    "lgbm_canary_events_total": {
+        "type": "counter", "labels": ("event",),
+        "help": "Canary lifecycle events, event=start/promote/rollback "
+                "(runtime/policy.CanaryPolicy; rollback also writes the "
+                "durable ROLLBACK marker in the publish dir)"},
+    "lgbm_canary_batches_total": {
+        "type": "counter", "labels": ("kind",),
+        "help": "Serving micro-batches routed while a canary window is "
+                "open, kind=canary/incumbent (the canary-fraction "
+                "accounting the chaos artifact scrapes)"},
 }
 
 # ---------------------------------------------------------------------------

@@ -200,3 +200,77 @@ def test_malformed_sim_artifact_fails_the_run(tmp_path):
     # invalid_sim_artifacts exactly like latest regressions)
     assert bool(rep["latest_regressions"] or rep["sim_latest_regressions"]
                 or rep["invalid_sim_artifacts"])
+
+
+# ---------------------------------------------------------------------------
+# quality-firewall artifacts (CHAOS_QUALITY_r*.json, ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def _quality_rec(round_no=12, quarantined=175, rejections=1, rollbacks=1,
+                 window=5, bad_outside=0, byte_verified=True):
+    return {
+        "artifact": "CHAOS_QUALITY_r%d" % round_no,
+        "schema_version": 1,
+        "ok": True,
+        "phases": {
+            "ingest_gate": {
+                "quarantined_total": quarantined,
+                "gate_rejections": rejections,
+                "gate_passes": 5,
+                "published_generations": [1, 2, 4, 5, 6],
+                "rejected_cycles": [3],
+                "nonfinite_predictions": 0,
+                "ok": True,
+            },
+            "canary": {
+                "rollback_count": rollbacks,
+                "canary_fraction": 0.25,
+                "responses_bad_outside_canary": bad_outside,
+                "canary_batches_to_rollback": window,
+                "rollback_byte_verified": byte_verified,
+                "canary_events": {"start": 1, "rollback": 1},
+                "canary_batches": {"canary": 10, "incumbent": 30},
+                "ok": True,
+            },
+        },
+    }
+
+
+def _write_quality(tmp_path, round_no, rec):
+    (tmp_path / ("CHAOS_QUALITY_r%02d.json" % round_no)).write_text(
+        json.dumps(rec))
+
+
+def test_committed_quality_artifact_validates():
+    path = os.path.join(REPO, "CHAOS_QUALITY_r12.json")
+    rec = json.load(open(path))
+    assert bench_history.validate_quality_artifact(rec) == []
+    assert rec["ok"] is True
+
+
+def test_quality_trajectory_and_detection_window_regression(tmp_path):
+    _write_quality(tmp_path, 12, _quality_rec(window=5))
+    _write_quality(tmp_path, 13, _quality_rec(13, window=9))
+    rep = bench_history.run(str(tmp_path))
+    assert rep["quality_rounds"] == 2
+    rows = rep["quality_trajectory"]
+    assert rows[0]["quarantined_total"] == 175
+    assert rows[0]["rollback_count"] == 1
+    # the canary detection window WIDENED >10%: flagged on the latest
+    flags = rep["quality_latest_regressions"]
+    assert flags and flags[0]["series"] == "canary_batches_to_rollback"
+
+
+def test_quality_artifact_schema_gates(tmp_path):
+    # a regressed generation reaching the non-canary fleet is INVALID
+    bad = _quality_rec(bad_outside=3)
+    assert any("non-canary" in p
+               for p in bench_history.validate_quality_artifact(bad))
+    # an unverified rollback is INVALID
+    bad2 = _quality_rec(byte_verified=None)
+    assert any("byte-verified" in p
+               for p in bench_history.validate_quality_artifact(bad2))
+    _write_quality(tmp_path, 12, bad)
+    rep = bench_history.run(str(tmp_path))
+    assert rep["invalid_quality_artifacts"]
+    assert rep["quality_rounds"] == 0

@@ -487,3 +487,46 @@ def test_total_model_feature_names_single_row(capi, tmp_path):
     assert nbm.num_total_model == 6
     np.testing.assert_allclose(nbm.predict_single_row(X[3]),
                                nbm.predict(X[3:4])[0], rtol=0, atol=0)
+
+
+# -- CSC prediction (ISSUE 12 ABI satellite) ---------------------------------
+
+def test_predict_for_csc_bit_equal_to_csr_and_python(capi, tmp_path):
+    """LGBM_BoosterPredictForCSC: column-major triplets must predict
+    bit-identically to the CSR path and to client-side densification —
+    binary and multiclass, with explicit zeros in play."""
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.default_rng(20)
+    X = rng.standard_normal((150, 6))
+    X[X < -0.8] = 0.0                     # real sparsity
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    bst = _train({"objective": "binary"}, X, y)
+    nb, _ = _roundtrip(capi, bst, X, tmp_path, "csc_bin")
+    csc = sp.csc_matrix(X)
+    csr = sp.csr_matrix(X)
+    got = nb.predict_csc(csc.indptr, csc.indices, csc.data, X.shape[0])
+    ref = nb.predict_csr(csr.indptr, csr.indices, csr.data, X.shape[1])
+    np.testing.assert_array_equal(got, ref)
+    np.testing.assert_array_equal(got, nb.predict(X))
+    raw = nb.predict_csc(csc.indptr, csc.indices, csc.data, X.shape[0],
+                         raw_score=True)
+    np.testing.assert_array_equal(raw, nb.predict(X, raw_score=True))
+
+    ym = rng.integers(0, 3, size=len(X)).astype(float)
+    mbst = _train({"objective": "multiclass", "num_class": 3}, X, ym)
+    mnb, _ = _roundtrip(capi, mbst, X, tmp_path, "csc_mc")
+    got_m = mnb.predict_csc(csc.indptr, csc.indices, csc.data, X.shape[0])
+    np.testing.assert_array_equal(got_m, mnb.predict(X))
+    assert got_m.shape == (len(X), 3)
+
+
+def test_predict_for_csc_validates_inputs(capi, tmp_path):
+    sp = pytest.importorskip("scipy.sparse")
+    rng = np.random.default_rng(21)
+    X = rng.standard_normal((40, 5))
+    y = (X[:, 0] > 0).astype(float)
+    bst = _train({"objective": "binary"}, X, y)
+    nb, _ = _roundtrip(capi, bst, X, tmp_path, "csc_err")
+    csc = sp.csc_matrix(X[:, :3])         # too few columns for the model
+    with pytest.raises(Exception, match="columns"):
+        nb.predict_csc(csc.indptr, csc.indices, csc.data, X.shape[0])

@@ -876,3 +876,37 @@ def test_capi_wrapper_inner_predict(problem):
     nb = capi.NativeBooster(model_str=bst.model_to_string())
     assert nb.calc_num_predict(3) == 3
     assert nb.calc_num_predict(3, capi.C_API_PREDICT_LEAF_INDEX) == 12
+
+
+def test_dataset_dump_text_matches_binned_storage(problem, tmp_path):
+    """LGBM_DatasetDumpText (ISSUE 12 ABI satellite): the dump's header
+    must describe the dataset and its bin matrix must equal the binned
+    storage the Python pipeline produces for the same rows."""
+    from lightgbm_tpu import capi
+    from lightgbm_tpu.basic import Dataset
+    from lightgbm_tpu.config import Config
+    X, y = problem
+    ds = capi.TrainDataset.from_mat(X.astype(np.float64), "verbose=-1")
+    ds.set_field("label", y)
+    out = str(tmp_path / "dump.txt")
+    ds.dump_text(out)
+    lines = open(out).read().splitlines()
+    head = dict(ln.split(": ", 1) for ln in lines[:6])
+    assert head["num_data"] == str(X.shape[0])
+    assert head["num_features"] == str(X.shape[1])
+    assert head["has_label"] == "1"
+    body_at = lines.index("bin_data:") + 1
+    dumped = np.loadtxt(lines[body_at:], dtype=np.int64)
+    assert dumped.shape[0] == X.shape[0]
+    # same rows through the Python pipeline: identical binned storage
+    pyds = Dataset(X.astype(np.float64), label=y, params={"verbose": -1})
+    pyds.construct(Config({"verbose": -1}))
+    ref = pyds.binned.bins[:, : pyds.binned.num_data].T.astype(np.int64)
+    np.testing.assert_array_equal(dumped, ref)
+
+
+def test_dataset_dump_text_rejects_non_dataset_handle(problem):
+    from lightgbm_tpu import capi
+    lib = capi.load_train_lib()
+    rc = lib.LGBM_DatasetDumpText(None, b"/tmp/nope.txt")
+    assert rc != 0

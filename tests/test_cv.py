@@ -117,8 +117,11 @@ def test_cv_init_model_continuation(tmp_path):
         assert bst.num_trees() == 7
 
     # Booster spelling; continued folds must not be worse than a cold
-    # start at the same number of NEW rounds (the warm trees carry signal)
-    cold = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=3, nfold=3)
+    # start at the same number of NEW rounds (the warm trees carry
+    # signal).  The cold run trains only 1 round — round 1's mean AUC is
+    # the only number the comparison reads, and each dropped cv round is
+    # 3 fold boosters of tier-1 wall time (ISSUE 12 truncation fix).
+    cold = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=1, nfold=3)
     warm_res = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=3,
                       nfold=3, init_model=warm)
     assert warm_res["auc-mean"][0] > cold["auc-mean"][0] - 0.02

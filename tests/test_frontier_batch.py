@@ -121,7 +121,12 @@ def test_batched_grower_window_wider_than_frontier():
     ({"objective": "binary", "num_leaves": 31, "min_data_in_leaf": 20}, 10),
     ({"objective": "regression", "num_leaves": 31, "bagging_freq": 1,
       "bagging_fraction": 0.7}, 8),
-    ({"objective": "multiclass", "num_class": 3, "num_leaves": 15}, 5),
+    # the multiclass variant compiles a third shape family for ~9s of
+    # tier-1 wall time; the class-shaped paths are already pinned by
+    # test_batched_grower_bit_identical — full-suite-budget call
+    # (ISSUE 12 truncation fix)
+    pytest.param({"objective": "multiclass", "num_class": 3,
+                  "num_leaves": 15}, 5, marks=pytest.mark.slow),
 ])
 def test_model_text_byte_identical(params, rounds):
     """End to end through the Booster: identical model FILES across many

@@ -1,0 +1,655 @@
+"""Model-quality firewall (ISSUE 12).
+
+Layers under test:
+
+* runtime/quality.py — row validation + bounded quarantine ledger,
+  deterministic holdout selection (incl. ranking group alignment), the
+  gate-verdict semantics (direction, tolerance, disabled);
+* runtime/policy.CanaryPolicy — hysteresis: warm-up, anti-flap streak
+  reset, rollback latch, promotion;
+* runtime/publish.py — durable ROLLBACK marker (pruning / relaunch /
+  concurrent readers), subscriber pin + auto-release, persisted gate
+  rejections invisible to subscribers;
+* runtime/serving.py — canary routing at the swap seam, automatic
+  rollback with byte-verified restoration, default-off direct swap;
+* runtime/continuous.py — quarantine-threshold cycle failure, the
+  default-off byte-identity contract (gate disabled ⇒ the window passes
+  through untouched), and the slow-marked end-to-end gate-rejection
+  run under `label_flip`;
+* io/stream.py — push-time quarantine (default off = old behavior).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.runtime import publish, quality, resilience, telemetry
+from lightgbm_tpu.runtime.policy import CanaryPolicy
+from lightgbm_tpu.runtime.serving import ServingRuntime
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _synth_model(n_trees=12, num_leaves=15, n_feat=6, seed=1):
+    from bench import synth_serving_model
+    return synth_serving_model(n_trees, num_leaves, n_feat,
+                               seed=seed).save_model_to_string()
+
+
+@pytest.fixture()
+def clean_fault_env():
+    old = os.environ.pop("LGBM_TPU_FAULT", None)
+    yield
+    if old is None:
+        os.environ.pop("LGBM_TPU_FAULT", None)
+    else:
+        os.environ["LGBM_TPU_FAULT"] = old
+
+
+# ---------------------------------------------------------------------------
+# stage one: quarantine
+# ---------------------------------------------------------------------------
+
+def test_validate_rows_reasons_and_mask():
+    X = np.random.default_rng(0).standard_normal((12, 4))
+    y = np.arange(12.0)
+    y[1] = np.nan
+    y[7] = np.inf
+    w = np.ones(12)
+    w[3] = np.nan
+    q = np.zeros(12)
+    q[5] = -2
+    led = quality.QuarantineLedger()
+    keep, counts = quality.validate_rows(X, y, weight=w, query=q,
+                                         ledger=led)
+    assert counts == {"nonfinite_label": 2, "nonfinite_weight": 1,
+                      "bad_query_id": 1}
+    assert keep.sum() == 8
+    assert led.total == 4 and led.rows_seen == 8
+    assert 0 < led.fraction() < 1
+    # a row failing several checks is counted once, under the first
+    y2 = np.array([np.nan]); w2 = np.array([np.nan])
+    _, counts2 = quality.validate_rows(np.zeros((1, 2)), y2, weight=w2)
+    assert counts2 == {"nonfinite_label": 1}
+
+
+def test_validate_rows_column_drift_quarantines_whole_chunk():
+    keep, counts = quality.validate_rows(
+        np.zeros((5, 3)), np.zeros(5), expected_features=4)
+    assert not keep.any() and counts == {"column_drift": 5}
+
+
+def test_nan_features_are_not_quarantined():
+    X = np.full((4, 3), np.nan)
+    keep, counts = quality.validate_rows(X, np.zeros(4))
+    assert keep.all() and counts == {}
+
+
+def test_quarantine_ledger_samples_are_bounded():
+    led = quality.QuarantineLedger()
+    for i in range(50):
+        led.record("nonfinite_label", 1, ["row %d" % i])
+    assert led.counts["nonfinite_label"] == 50
+    assert len(led.summary()["samples"]["nonfinite_label"]) <= 4
+
+
+def test_quarantine_metric_lands_in_registry():
+    before = _counter_value("lgbm_ingest_quarantined_total",
+                            reason="nonfinite_label")
+    led = quality.QuarantineLedger()
+    quality.validate_rows(np.zeros((3, 2)),
+                          np.array([np.nan, 1.0, np.nan]), ledger=led)
+    after = _counter_value("lgbm_ingest_quarantined_total",
+                           reason="nonfinite_label")
+    assert after - before == 2
+
+
+def _counter_value(name, **labels):
+    snap = telemetry.snapshot("test")
+    for entry in snap["metrics"].get(name, {}).get("series", []):
+        if entry.get("labels", {}) == labels:
+            return entry["value"]
+    return 0.0
+
+
+def test_stream_builder_quarantine_default_off_and_armed(tmp_path):
+    from lightgbm_tpu.io.stream import StreamingDatasetBuilder
+    X = np.random.default_rng(1).standard_normal((20, 3))
+    y = np.ones(20)
+    y[4] = np.nan
+    # default off: the bad label is RETAINED (old behavior, byte-for-byte)
+    b0 = StreamingDatasetBuilder(params={"min_data_in_leaf": 2})
+    b0.push_dense(X, label=y)
+    assert b0.num_pushed_rows == 20
+    assert np.isnan(b0.labels()).sum() == 1
+    # armed: the row is dropped and the ledger carries the evidence
+    b1 = StreamingDatasetBuilder(params={"min_data_in_leaf": 2},
+                                 quarantine=True)
+    b1.push_dense(X, label=y)
+    assert b1.num_pushed_rows == 19
+    assert not np.isnan(b1.labels()).any()
+    assert b1.quarantine.counts == {"nonfinite_label": 1}
+
+
+def test_stream_builder_quarantine_csr_and_positioned_error():
+    import scipy.sparse as sp
+    from lightgbm_tpu.io.stream import StreamingDatasetBuilder
+    from lightgbm_tpu.utils.log import LightGBMError
+    X = np.random.default_rng(2).standard_normal((10, 4))
+    X[X < 0] = 0.0
+    y = np.ones(10)
+    y[3] = np.inf
+    csr = sp.csr_matrix(X)
+    b = StreamingDatasetBuilder(quarantine=True)
+    b.push_csr(csr.indptr, csr.indices, csr.data, 4, label=y)
+    assert b.num_pushed_rows == 9
+    ds = b.finalize()
+    assert ds.num_data == 9
+    # positioned (by-reference-style) pushes cannot renumber: loud error
+    ref = StreamingDatasetBuilder().push_dense(X, label=np.ones(10)) \
+        .finalize()
+    b2 = StreamingDatasetBuilder(reference=ref, num_total_rows=10,
+                                 quarantine=True)
+    with pytest.raises(LightGBMError, match="quarantine"):
+        b2.push_dense(X, label=y, start_row=0)
+
+
+# ---------------------------------------------------------------------------
+# stage two: deterministic holdout + gate verdict
+# ---------------------------------------------------------------------------
+
+def test_holdout_mask_is_deterministic_and_proportional():
+    a = quality.holdout_mask(1000, 0.2)
+    b = quality.holdout_mask(1000, 0.2)
+    assert np.array_equal(a, b)
+    assert abs(a.mean() - 0.2) < 0.01
+
+
+def test_holdout_mask_never_tears_a_query_group():
+    q = np.repeat(np.arange(30), 7)
+    mask = quality.holdout_mask(len(q), 0.25, q)
+    for g in np.unique(q):
+        sel = mask[q == g]
+        assert sel.all() or not sel.any()
+    assert 0 < mask.sum() < len(q)
+
+
+def test_gate_determinism_same_window_same_verdict():
+    """Same window ⇒ same holdout ⇒ same metrics ⇒ same verdict —
+    twice through the whole evaluate+decide path, records identical."""
+    text = _synth_model(seed=5)
+    from lightgbm_tpu.models.gbdt_model import GBDTModel
+    model = GBDTModel.load_model_from_string(_synth_model(seed=5))
+    inc = GBDTModel.load_model_from_string(_synth_model(seed=6))
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((200, 6))
+    y = (X[:, 0] > 0).astype(np.float64)
+    params = {"objective": "regression", "metric": "l2", "verbose": -1}
+    hold = quality.holdout_mask(len(y), 0.25)
+    recs = []
+    for _ in range(2):
+        cand = quality.evaluate_model(model, X[hold], y[hold], params)
+        base = quality.evaluate_model(inc, X[hold], y[hold], params)
+        recs.append(quality.gate_verdict(cand, base, 0.1))
+    assert recs[0] == recs[1]
+    assert recs[0]["verdict"] in ("pass", "reject")
+    assert text  # keep the first build alive for the loader cache
+
+
+def test_gate_verdict_direction_and_tolerance():
+    higher = [("auc", 0.70, True)]
+    higher_inc = [("auc", 0.80, True)]
+    assert quality.gate_verdict(higher, higher_inc, 0.05)["verdict"] \
+        == "reject"
+    assert quality.gate_verdict(higher_inc, higher, 0.05)["verdict"] \
+        == "pass"
+    lower = [("l2", 0.30, False)]
+    lower_inc = [("l2", 0.20, False)]
+    rec = quality.gate_verdict(lower, lower_inc, 0.1)
+    assert rec["verdict"] == "reject" and rec["regression"] > 0.1
+    # within tolerance passes
+    assert quality.gate_verdict([("l2", 0.21, False)], lower_inc,
+                                0.1)["verdict"] == "pass"
+    # disabled (inf) never rejects and says so
+    assert quality.gate_verdict(lower, lower_inc,
+                                float("inf"))["verdict"] == "disabled"
+    # no incumbent: first publish always passes, auditable as such
+    assert quality.gate_verdict(lower, None, 0.1)["verdict"] \
+        == "no_incumbent"
+
+
+def test_gate_rejection_record_is_invisible_to_subscribers(tmp_path):
+    d = str(tmp_path / "pub")
+    pub = publish.ModelPublisher(d)
+    pub.publish(_synth_model(seed=1), generation=1)
+    path = pub.record_rejection(_synth_model(seed=2),
+                                {"verdict": "reject", "metric": "l2"},
+                                cycle=2)
+    assert os.path.basename(path) == "rejected_00000002.txt"
+    assert publish.rejection_paths(d) == [(2, path)]
+    # the audit record round-trips through the publish footer format
+    split = publish._split_validate(open(path).read())  # noqa: SLF001
+    assert split is not None and split[1]["gate"]["verdict"] == "reject"
+    # a subscriber never resolves it
+    sub = publish.ModelSubscriber(d, attempts=1)
+    assert sub.resolve_once().generation == 1
+
+
+# ---------------------------------------------------------------------------
+# quarantine threshold fails the cycle loudly
+# ---------------------------------------------------------------------------
+
+class _GuardStub:
+    signum = None
+
+
+def test_quarantine_threshold_fails_cycle(tmp_path, clean_fault_env):
+    from lightgbm_tpu.runtime.continuous import (ContinuousTrainer,
+                                                 _IngestProducer)
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((200, 5))
+    y = (X[:, 0] > 0).astype(np.float64)
+    data = str(tmp_path / "train.tsv")
+    np.savetxt(data, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+    trainer = ContinuousTrainer({
+        "data": data, "output_model": str(tmp_path / "m.txt"),
+        "objective": "binary", "num_leaves": 7, "verbose": -1,
+        "min_data_in_leaf": 5, "online_quarantine_limit": 0.2,
+        "online_rounds": 1})
+    os.environ["LGBM_TPU_FAULT"] = "poison_rows:0.5"
+    producer = _IngestProducer(trainer.cfg)
+    producer.start()
+    try:
+        stamp, Xw, yw, qw = producer.current(timeout=30)
+        # half the parse went to quarantine — over the 20% limit
+        assert producer.last_ingest["quarantine_frac"] > 0.2
+        assert np.isfinite(yw).all()        # the window itself is clean
+        trainer._booster = trainer._build_booster(Xw, yw, qw)
+        trainer._window_stamp = stamp
+        with pytest.raises(quality.QuarantineExceeded):
+            trainer._run_cycle(1, producer, _GuardStub())
+        # nothing was published for the failed cycle
+        assert publish.generation_paths(trainer.cfg.publish_dir) == []
+    finally:
+        producer.stop()
+        trainer.wd.done()
+
+
+def test_gate_split_disabled_passes_window_through_untouched(tmp_path):
+    """The default-off byte-identity contract at its root: with the gate
+    disabled the adopted window is THE SAME OBJECTS, no copy, no slice —
+    so training input (and therefore every published model) is
+    bit-identical to a pre-firewall build."""
+    from lightgbm_tpu.runtime.continuous import ContinuousTrainer
+    data = str(tmp_path / "t.tsv")
+    np.savetxt(data, np.zeros((5, 3)), delimiter="\t")
+    trainer = ContinuousTrainer({"data": data,
+                                 "output_model": str(tmp_path / "m.txt")})
+    assert not trainer.cfg.gate_enabled
+    X, y, q = np.zeros((10, 2)), np.zeros(10), None
+    Xtr, ytr, qtr = trainer._gate_split(X, y, q)
+    assert Xtr is X and ytr is y and qtr is None
+    assert trainer._holdout is None
+    # enabled: a real split, deterministic
+    trainer.cfg.gate_tolerance = 0.1
+    Xtr, ytr, _ = trainer._gate_split(X, y, q)
+    assert len(Xtr) < len(X) and trainer._holdout is not None
+    trainer.wd.done()
+
+
+# ---------------------------------------------------------------------------
+# stage three: canary policy hysteresis
+# ---------------------------------------------------------------------------
+
+def _feed(pol, kind, n, err, lat=0.01):
+    out = []
+    for _ in range(n):
+        out += pol.observe(kind, error=err, latency_s=lat)
+    return out
+
+
+def test_canary_policy_warmup_then_rollback_latch():
+    pol = CanaryPolicy(min_samples=4, patience=3, error_ratio=1.5,
+                       error_margin=0.0, promote_after=100)
+    pol.note_start(7)
+    _feed(pol, "incumbent", 4, 0.1)
+    # below min_samples nothing can latch, however bad
+    assert _feed(pol, "canary", 3, 9.9) == []
+    decs = _feed(pol, "canary", 3, 9.9)
+    assert pol.decided == "rollback"
+    assert decs[-1]["event"] == "canary_rollback"
+    assert decs[-1]["evidence"]["signal"] == "error"
+    # latched: further observations decide nothing
+    assert _feed(pol, "canary", 5, 9.9) == []
+
+
+def test_canary_policy_streak_resets_no_flap():
+    # window=1 makes each comparison use the latest sample only, so the
+    # alternating pattern below yields degraded streaks of exactly 2 —
+    # one short of patience: the healthy round's reset IS the anti-flap
+    # guarantee (and the bounded window is what lets a recovered canary
+    # pull its mean back down instead of being condemned by history)
+    pol = CanaryPolicy(min_samples=1, patience=3, error_ratio=1.5,
+                       error_margin=0.0, promote_after=10_000, window=1)
+    pol.note_start(1)
+    _feed(pol, "incumbent", 4, 0.1)
+    for _ in range(20):
+        _feed(pol, "canary", 2, 0.9)     # two degraded rounds...
+        _feed(pol, "canary", 1, 0.05)    # ...then a healthy reset
+    assert pol.decided is None
+    # the same pressure WITHOUT the healthy round latches immediately
+    pol.note_start(2)
+    _feed(pol, "incumbent", 4, 0.1)
+    _feed(pol, "canary", 3, 0.9)
+    assert pol.decided == "rollback"
+
+
+def test_canary_policy_promotes_after_sustained_health():
+    pol = CanaryPolicy(min_samples=2, patience=2, error_ratio=1.5,
+                       error_margin=0.0, promote_after=12)
+    pol.note_start(2)
+    _feed(pol, "incumbent", 4, 0.1)
+    decs = _feed(pol, "canary", 12, 0.1)
+    assert pol.decided == "promote"
+    assert decs[-1]["event"] == "canary_promote"
+
+
+def test_canary_policy_latency_signal():
+    pol = CanaryPolicy(min_samples=3, patience=2, error_ratio=10.0,
+                       latency_ratio=3.0, promote_after=100)
+    pol.note_start(3)
+    _feed(pol, "incumbent", 3, 0.1, lat=0.01)
+    decs = _feed(pol, "canary", 5, 0.1, lat=0.2)
+    assert pol.decided == "rollback"
+    assert decs[-1]["evidence"]["signal"] == "latency"
+
+
+# ---------------------------------------------------------------------------
+# serving: canary routing, rollback, default-off swap
+# ---------------------------------------------------------------------------
+
+def _regressed(text):
+    os.environ["LGBM_TPU_FAULT"] = "regress_model:1"
+    try:
+        return resilience.maybe_regress_model(text, 1)
+    finally:
+        os.environ.pop("LGBM_TPU_FAULT", None)
+
+
+def test_canary_rollback_end_to_end(tmp_path, clean_fault_env):
+    """A regressed publish is canaried, rolled back, condemned in the
+    durable marker, pinned out for fresh subscribers, and the fleet's
+    post-rollback responses are byte-identical to the restored
+    generation's offline predictions."""
+    good = _synth_model(seed=11)
+    bad = _regressed(good)
+    d = str(tmp_path / "pub")
+    pub = publish.ModelPublisher(d)
+    pub.publish(good, generation=1)
+    rt = ServingRuntime(
+        publish_dir=d, params={"verbose": -1}, poll_interval_s=0.05,
+        canary_fraction=0.5,
+        canary_policy=CanaryPolicy(min_samples=3, patience=2,
+                                   error_ratio=1.3, error_margin=0.0,
+                                   promote_after=10_000))
+    rt.start()
+    try:
+        _wait(lambda: rt.generation() == 1)
+        probe = np.random.default_rng(4).standard_normal((6, 6))
+        # labels = the incumbent's own predictions: incumbent error ~0,
+        # the sabotaged canary's error is large
+        from lightgbm_tpu.basic import Booster
+        labels = np.asarray(Booster(model_str=good).predict(probe))
+        pub.publish(bad, generation=2)
+        _wait(lambda: rt.canary_generation() == 2)
+        for _ in range(60):
+            rt.predict(probe, label=labels, deadline_s=5)
+            if rt.stats()["rollbacks"]:
+                break
+        st = rt.stats()
+        assert st["rollbacks"] == 1
+        assert rt.generation() == 1 and rt.canary_generation() is None
+        marker = publish.read_rollback_marker(d)
+        assert marker["bad_generations"] == [2]
+        assert marker["pinned"] == [1]
+        assert marker["events"][-1]["reason"] == "canary_degradation" \
+            or marker["events"][-1]["reason"]
+        # relaunch-equivalent: a FRESH subscriber skips the condemned gen
+        sub = publish.ModelSubscriber(d, attempts=1)
+        assert sub.resolve_once().generation == 1
+        assert sub.skipped_rolled_back >= 1
+        # byte verification of the restored generation
+        res = rt.predict(probe, deadline_s=5)
+        assert res.generation == 1
+        ref = np.asarray(Booster(model_str=good).predict(
+            probe, device=(res.served_by == "device")))
+        assert np.array_equal(np.asarray(res.values), ref)
+        # a NEWER generation releases the pin and gets its own canary
+        pub.publish(good, generation=3)
+        _wait(lambda: rt.canary_generation() == 3)
+        assert rt.generation() == 1
+    finally:
+        rt.stop()
+
+
+def test_canary_promotion_cuts_over(tmp_path, clean_fault_env):
+    good = _synth_model(seed=21)
+    better = _synth_model(seed=22)
+    d = str(tmp_path / "pub")
+    pub = publish.ModelPublisher(d)
+    pub.publish(good, generation=1)
+    rt = ServingRuntime(
+        publish_dir=d, params={"verbose": -1}, poll_interval_s=0.05,
+        canary_fraction=0.5,
+        canary_policy=CanaryPolicy(min_samples=2, patience=2,
+                                   error_ratio=1.5, promote_after=6))
+    rt.start()
+    try:
+        _wait(lambda: rt.generation() == 1)
+        probe = np.random.default_rng(5).standard_normal((4, 6))
+        pub.publish(better, generation=2)
+        _wait(lambda: rt.canary_generation() == 2)
+        for _ in range(80):
+            rt.predict(probe, deadline_s=5)   # unlabeled: latency only
+            if rt.stats()["promotes"]:
+                break
+        assert rt.stats()["promotes"] == 1
+        assert rt.generation() == 2 and rt.canary_generation() is None
+        assert publish.read_rollback_marker(d) == {}
+    finally:
+        rt.stop()
+
+
+def test_canary_fraction_zero_swaps_directly(tmp_path, clean_fault_env):
+    """Default-off pin: canary_fraction=0 keeps the pre-ISSUE-12 direct
+    swap — no canary entry ever exists, new generations take over
+    immediately."""
+    d = str(tmp_path / "pub")
+    pub = publish.ModelPublisher(d)
+    pub.publish(_synth_model(seed=31), generation=1)
+    rt = ServingRuntime(publish_dir=d, params={"verbose": -1},
+                        poll_interval_s=0.05)
+    rt.start()
+    try:
+        _wait(lambda: rt.generation() == 1)
+        pub.publish(_synth_model(seed=32), generation=2)
+        _wait(lambda: rt.generation() == 2)
+        assert rt.canary_generation() is None
+        assert rt.stats()["rollbacks"] == 0
+        assert "canary_fraction" not in rt.stats()
+    finally:
+        rt.stop()
+
+
+def _wait(cond, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError("condition not reached within %.0fs" % timeout)
+
+
+# ---------------------------------------------------------------------------
+# subscriber rollback under concurrent swap + prune + relaunch (the PR 7
+# three-readers pin, extended with a mid-soak rollback)
+# ---------------------------------------------------------------------------
+
+def test_subscriber_rollback_under_concurrent_swap_prune_relaunch(tmp_path):
+    from lightgbm_tpu.models.gbdt_model import GBDTModel
+    d = str(tmp_path / "pub")
+    texts = {g: _synth_model(seed=g, n_trees=4 + g) for g in range(1, 13)}
+    # keep_last=2 + zero grace: the incumbent (N-1) is still on disk
+    # when the canary condemns N — the production floor for rollback
+    pub = publish.ModelPublisher(d, keep_last=2, grace_s=0.0)
+    pub.publish(texts[1], meta={}, generation=1)
+    stop = threading.Event()
+    rolled_back_at = {}                  # gen -> wallclock of the marker
+    problems, seen = [], []
+
+    def reader(fresh_each_resolve):
+        sub = publish.ModelSubscriber(d, attempts=1)
+        while not stop.is_set():
+            if fresh_each_resolve:
+                # relaunch model: a brand-new subscriber every resolve
+                sub = publish.ModelSubscriber(d, attempts=1)
+            rec = sub.resolve_once()
+            if rec is None:
+                continue
+            if rec.generation in rolled_back_at:
+                problems.append("resolved condemned generation %d"
+                                % rec.generation)
+            if rec.model_text != texts.get(rec.generation):
+                problems.append("gen %d bytes differ" % rec.generation)
+            try:
+                m = GBDTModel.load_model_from_string(rec.model_text)
+                assert m.current_iteration > 0
+            except Exception as e:       # noqa: BLE001 — ledger
+                problems.append("gen %d torn: %s" % (rec.generation, e))
+            seen.append(rec.generation)
+
+    threads = [threading.Thread(target=reader, args=(i == 2,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    # publisher churn with keep_last=2 + zero grace; at gen 6 the canary
+    # condemns it mid-soak — every reader must step past it from the
+    # next resolve on, and pruning must keep the pinned gen 5 alive
+    # long after keep_last would have dropped it
+    for g in range(2, 13):
+        pub.publish(texts[g], meta={}, generation=g)
+        if g == 6:
+            publish.mark_rollback(d, 6, pinned_generation=5,
+                                  reason="test rollback")
+            time.sleep(0.05)     # let in-flight resolves complete
+            rolled_back_at[6] = time.time()
+        time.sleep(0.02)
+    time.sleep(0.1)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert problems == []
+    assert seen and max(seen) == 12
+    # the pinned generation survived keep_last=2 pruning
+    gens_on_disk = {g for g, _ in publish.generation_paths(d)}
+    assert 5 in gens_on_disk
+    assert publish.read_rollback_marker(d)["bad_generations"] == [6]
+
+
+def test_concurrent_rollback_markers_merge(tmp_path):
+    """Two replicas condemning different generations concurrently must
+    both land (read-merge-atomic-write)."""
+    d = str(tmp_path / "pub")
+    os.makedirs(d)
+    errs = []
+
+    def condemn(gen):
+        try:
+            for _ in range(20):
+                publish.mark_rollback(d, gen, pinned_generation=1,
+                                      reason="r%d" % gen)
+        except Exception as e:           # noqa: BLE001 — ledger
+            errs.append(e)
+
+    ts = [threading.Thread(target=condemn, args=(g,)) for g in (7, 9)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    marker = publish.read_rollback_marker(d)
+    assert set(marker["bad_generations"]) == {7, 9}
+
+
+# ---------------------------------------------------------------------------
+# new fault modes registered + table coverage lint
+# ---------------------------------------------------------------------------
+
+def test_new_fault_modes_registered_and_documented():
+    for name in ("poison_rows", "label_flip", "regress_model"):
+        assert name in resilience.FAULT_TABLE
+    doc = open(os.path.join(REPO, "docs", "RESILIENCE.md")).read()
+    for name in ("poison_rows", "label_flip", "regress_model"):
+        assert "`%s" % name in doc
+
+
+def test_fault_coverage_lint_is_clean_and_detects_gaps():
+    sys.path.insert(0, os.path.join(REPO, "helper"))
+    import check_fault_coverage
+    assert check_fault_coverage.run() == []
+    # negative: a fabricated fault name must be reported.  The name is
+    # assembled at runtime — a single literal here would be matched by
+    # the lint itself (it scans THIS file's string literals too)
+    fake = "_".join(["totally", "unexercised", "fault"])
+    problems = check_fault_coverage.run(
+        fault_names=tuple(resilience.FAULT_NAMES) + (fake,))
+    assert len(problems) == 1
+    assert fake in problems[0]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the gate rejects a label-flipped cycle (subprocess)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_online_gate_rejects_label_flipped_cycle(tmp_path):
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((600, 6))
+    y = (X[:, 0] + 0.4 * X[:, 1]
+         + 0.3 * rng.standard_normal(600) > 0).astype(float)
+    np.savetxt(str(tmp_path / "train.tsv"), np.column_stack([y, X]),
+               delimiter="\t", fmt="%.8g")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               LGBM_TPU_FAULT="label_flip:2")
+    r = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu", "task=train_online",
+         "data=train.tsv", "output_model=m.txt", "online_cycles=3",
+         "online_rounds=2", "online_interval=0", "objective=binary",
+         "num_leaves=7", "metric=binary_logloss", "verbose=-1", "seed=3",
+         "publish_gate_tolerance=0.05", "publish_gate_holdout=0.25"],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    pub_dir = str(tmp_path / "m.txt.pub")
+    gens = [g for g, _ in publish.generation_paths(pub_dir)]
+    assert 2 not in gens and {1, 3} <= set(gens)
+    assert publish.rejection_paths(pub_dir)[0][0] == 2
+    # the published generation's meta carries the auditable gate record
+    sub = publish.ModelSubscriber(pub_dir, attempts=1)
+    meta = sub.resolve_once().meta
+    assert meta["gate"]["verdict"] == "pass"
+    assert meta["gate"]["metric"] == "binary_logloss"
+
+
+@pytest.mark.slow
+def test_chaos_quality_quick_soak(tmp_path, clean_fault_env):
+    sys.path.insert(0, os.path.join(REPO, "exp"))
+    import chaos_quality
+    rec = chaos_quality.run_soak(str(tmp_path), seed=11, quick=True)
+    assert rec["phases"]["ingest_gate"]["ok"], \
+        json.dumps(rec["phases"]["ingest_gate"], indent=1)[:4000]

@@ -214,17 +214,25 @@ def _binary_problem(n, f=20, seed=7):
 BASE = {"objective": "binary", "metric": "auc", "verbose": -1, "seed": 11}
 
 
-@pytest.mark.parametrize("qdtype", ["int16", "int8"])
-def test_quant_training_auc_parity(qdtype):
-    """Quantized training tracks the f32 path on held-out AUC (the
-    paper's headline claim) at a tier-1-sized slice of the bench config;
-    the full 200k-row bench-config pin is the `slow` test below."""
+@pytest.fixture(scope="module")
+def auc_parity_baseline():
+    """The f32 reference run for the AUC-parity pins — trained ONCE and
+    shared by both dtype parametrizations (the baseline is identical
+    across them; retraining it per-param was pure tier-1 wall time)."""
     X, y = _binary_problem(24_000)
     Xtr, ytr, Xte, yte = X[:20_000], y[:20_000], X[20_000:], y[20_000:]
     params = dict(BASE, num_leaves=31)
     bf = lgb.train(dict(params), lgb.Dataset(Xtr, label=ytr),
                    num_boost_round=11)
-    auc_f = _auc(yte, bf.predict(Xte))
+    return Xtr, ytr, Xte, yte, params, _auc(yte, bf.predict(Xte))
+
+
+@pytest.mark.parametrize("qdtype", ["int16", "int8"])
+def test_quant_training_auc_parity(qdtype, auc_parity_baseline):
+    """Quantized training tracks the f32 path on held-out AUC (the
+    paper's headline claim) at a tier-1-sized slice of the bench config;
+    the full 200k-row bench-config pin is the `slow` test below."""
+    Xtr, ytr, Xte, yte, params, auc_f = auc_parity_baseline
     bq = lgb.train(dict(params, gradient_quantization=True,
                         gradient_quant_dtype=qdtype),
                    lgb.Dataset(Xtr, label=ytr), num_boost_round=11)

@@ -161,7 +161,11 @@ def test_dryrun_wrapper_green_under_injected_hang(tmp_path):
     env = dict(os.environ)
     env.update({"LGBM_TPU_FAULT": "bogus_platform,hang_import:300",
                 "JAX_PLATFORMS": "axon",
-                "LGBM_TPU_PROBE_DEADLINE": "8",
+                # one 4s probe: the pin is the degradation CHAIN, not the
+                # deadline's size — 8s x 2 attempts was a third of this
+                # test's 30s tier-1 bill (ISSUE 12 truncation fix)
+                "LGBM_TPU_PROBE_DEADLINE": "4",
+                "LGBM_TPU_PROBE_ATTEMPTS": "1",
                 "LGBM_TPU_DRYRUN_BUDGET": "200"})
     t0 = time.monotonic()
     r = subprocess.run([sys.executable, os.path.join(REPO, "exp/dryrun.py"),
@@ -470,11 +474,14 @@ def test_resume_scan_past_three_mixed_corrupt_snapshots(tmp_path):
                for it in (3, 4, 5)), reasons
 
 
+@pytest.mark.slow
 def test_sigterm_during_pipeline_drain_depth2(tmp_path):
     """SIGTERM landing while the async dispatch pipeline is in flight at
     pipeline_depth=2 still produces rc=0 and a VALID final snapshot (the
     preemption callback drains before capturing state), and the resumed
-    model is byte-identical to an uninterrupted depth-2 run."""
+    model is byte-identical to an uninterrupted depth-2 run.  Slow-marked
+    (ISSUE 12 truncation fix): two full CLI subprocess runs ~18s; the
+    depth-1 SIGTERM byte-identity pin stays tier-1."""
     X, y = _data()
     np.savetxt(tmp_path / "train.tsv", np.column_stack([y, X]),
                delimiter="\t", fmt="%.8g")

@@ -61,7 +61,11 @@ def test_allstate_shape_trains_and_fits_memory():
     while total < 4228 - 64:
         total += cards[n_vars % len(cards)]
         n_vars += 1
-    X, y = _onehot_problem(20000, n_vars, cards, noise_cols=4228 - total)
+    # 8k rows (was 20k): every assertion here — EFB collapse, conflict
+    # rates, fast-path activation, the HBM arithmetic — is a function of
+    # WIDTH, and the payload column count is explicitly row-invariant;
+    # 20k rows only bought tier-1 wall time (ISSUE 12 truncation fix)
+    X, y = _onehot_problem(8000, n_vars, cards, noise_cols=4228 - total)
     assert X.shape[1] >= 4200
     ds = BinnedDataset.from_matrix(X, Config(dict(PARAMS)))
     assert ds.bundle_info is not None
@@ -73,8 +77,12 @@ def test_allstate_shape_trains_and_fits_memory():
     assert rates is not None, "construction must record realized conflicts"
     assert rates.max() <= 0.05, "one-hot bundles should be near-exclusive"
 
-    bst = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
-                    num_boost_round=5)
+    # train from the ALREADY-binned dataset: find-bin + EFB over 4228
+    # columns is the dominant cost here and was being paid twice
+    # (ISSUE 12 truncation fix)
+    ds.metadata.set_label(y)
+    bst = lgb.train(dict(PARAMS), lgb.Dataset._from_binned(
+        ds, params=dict(PARAMS)), num_boost_round=5)
     assert bst._engine._fast_active
     assert bst._engine.train_set.bundle_info is not None
     p = bst.predict(X[:2000])
@@ -97,7 +105,9 @@ def test_expo_shape_trains_and_fits_memory():
     while total < 700 - 8:
         total += cards[n_vars % len(cards)]
         n_vars += 1
-    X, y = _onehot_problem(20000, n_vars, cards, seed=3,
+    # 8k rows (was 20k): width-driven assertions, row-invariant memory
+    # arithmetic — same rationale as the Allstate test above
+    X, y = _onehot_problem(8000, n_vars, cards, seed=3,
                            noise_cols=700 - total)
     assert X.shape[1] >= 690
     ds = BinnedDataset.from_matrix(X, Config(dict(PARAMS)))
@@ -105,8 +115,9 @@ def test_expo_shape_trains_and_fits_memory():
     G = ds.bins.shape[0]
     assert G <= 120, G
 
-    bst = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
-                    num_boost_round=5)
+    ds.metadata.set_label(y)
+    bst = lgb.train(dict(PARAMS), lgb.Dataset._from_binned(
+        ds, params=dict(PARAMS)), num_boost_round=5)
     assert bst._engine._fast_active
     acc = float(np.mean((bst.predict(X[:2000]) > 0.5) == (y[:2000] > 0.5)))
     assert acc > 0.55, acc
