@@ -190,3 +190,18 @@ echo "     schema-gates it and flags canary-detection-window regressions.)"
 timeout 600 python exp/chaos_quality.py /tmp/chaos_quality_tpu.json \
   && python -c "import json; d=json.load(open('/tmp/chaos_quality_tpu.json')); p1=d['phases']['ingest_gate']; p2=d['phases'].get('canary',{}); print(json.dumps({'ok': d['ok'], 'quarantined': p1['quarantined_total'], 'gate_rejections': p1['gate_rejections'], 'rollbacks': p2.get('rollback_count'), 'rollback_byte_verified': p2.get('rollback_byte_verified')}, indent=1))" \
   || echo "   quality soak FAILED — /tmp/chaos_quality_tpu.json.invalid + trainer/replica logs in the tempdir have the ledger"
+echo "=== 12. fused boosting window A/B on hardware (ISSUE 13) ==="
+echo "    (boost_window=J runs J boosting iterations per device dispatch;"
+echo "     on the tunneled chip each saved dispatch is a ~90 ms round trip"
+echo "     (BENCH_r05), so this is the lever the CPU A/B could only count,"
+echo "     not weigh.  The bench 'window' key reports sec/iter +"
+echo "     dispatches/iter + fetches/iter for both arms on the SAME"
+echo "     booster.  Flip criterion (docs/PERFORMANCE.md expiry row):"
+echo "     sec_per_iter no worse AND dispatches_per_iter <= (1/J)*baseline"
+echo "     -> flip the config default boost_window=4; else keep 1 and"
+echo "     record why.  Commit the run as BENCH_WINDOW_r<round>.json.)"
+BENCH_WINDOW=4 BENCH_PREDICT=0 BENCH_SERVE=0 BENCH_ONLINE=0 BENCH_INGEST=0 \
+  BENCH_TELEMETRY=0 BENCH_ITERS=12 timeout 1800 python bench.py \
+  > /tmp/bench_window_tpu.json \
+  && python -c "import json; d=json.load(open('/tmp/bench_window_tpu.json')); print(json.dumps({'window': d.get('window'), 'dispatches_per_iter': d.get('attrib',{}).get('per_iter',{}).get('dispatches_per_iter')}, indent=1))" \
+  || echo "   window A/B FAILED on hardware — /tmp/bench_window_tpu.json + stderr have the ledger"

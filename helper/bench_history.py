@@ -69,6 +69,17 @@ SERIES: Tuple[Tuple[str, Tuple[str, ...], Tuple[Tuple[str, ...], ...]], ...] = (
      (("online", "rows"), ("online", "cycles"))),
 )
 
+#: like SERIES but LOWER is better — a RISE past the threshold flags.
+#: dispatches_per_iter is BENCH_ATTRIB's device-program launch count per
+#: iteration (ISSUE 13): the boost_window collapse of the dispatch loop
+#: must not silently regress between rounds.
+SERIES_LOWER: Tuple[Tuple[str, Tuple[str, ...],
+                          Tuple[Tuple[str, ...], ...]], ...] = (
+    ("dispatches_per_iter",
+     ("attrib", "per_iter", "dispatches_per_iter"),
+     (("n_rows",), ("platform",))),
+)
+
 
 def _get(d: Any, path: Tuple[str, ...]) -> Optional[Any]:
     for k in path:
@@ -132,7 +143,7 @@ def trajectory(rounds: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             "platform": rec.get("platform"),
             "sec_per_iter": rec.get("sec_per_iter"),
         }
-        for name, path, _ in SERIES:
+        for name, path, _ in SERIES + SERIES_LOWER:
             v = _get(rec, path)
             if v is not None:
                 row[name] = v
@@ -143,10 +154,13 @@ def trajectory(rounds: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
 def regressions(rounds: List[Dict[str, Any]],
                 threshold: float = REGRESSION_THRESHOLD
                 ) -> List[Dict[str, Any]]:
-    """Rounds whose series value dropped > threshold below the best
-    PRIOR round at the same shape."""
+    """Rounds whose series value moved > threshold the WRONG way vs the
+    best PRIOR round at the same shape (below best for SERIES, above
+    best for SERIES_LOWER)."""
     flags: List[Dict[str, Any]] = []
-    for name, path, shape_paths in SERIES:
+    for name, path, shape_paths, higher_better in \
+            [s + (True,) for s in SERIES] + \
+            [s + (False,) for s in SERIES_LOWER]:
         best: Dict[Tuple, Tuple[float, int]] = {}
         for rec in rounds:
             v = _get(rec, path)
@@ -154,15 +168,21 @@ def regressions(rounds: List[Dict[str, Any]],
                 continue
             shape = tuple(repr(_get(rec, sp)) for sp in shape_paths)
             prior = best.get(shape)
-            if prior is not None and v < prior[0] * (1.0 - threshold):
-                flags.append({
-                    "round": rec["_round"], "series": name,
-                    "value": v, "best_prior": prior[0],
-                    "best_prior_round": prior[1],
-                    "drop_pct": round((1.0 - v / prior[0]) * 100, 1),
-                    "shape": shape,
-                })
-            if prior is None or v > prior[0]:
+            if prior is not None and prior[0] > 0:
+                worse = (v < prior[0] * (1.0 - threshold) if higher_better
+                         else v > prior[0] * (1.0 + threshold))
+                if worse:
+                    flags.append({
+                        "round": rec["_round"], "series": name,
+                        "value": v, "best_prior": prior[0],
+                        "best_prior_round": prior[1],
+                        "drop_pct": round(abs(1.0 - v / prior[0]) * 100, 1),
+                        "higher_is_better": higher_better,
+                        "shape": shape,
+                    })
+            better = (prior is None or
+                      (v > prior[0] if higher_better else v < prior[0]))
+            if better:
                 best[shape] = (float(v), rec["_round"])
     return sorted(flags, key=lambda f: (f["round"], f["series"]))
 
@@ -518,9 +538,11 @@ def main(argv=None) -> int:
     for f in rep["regressions"]:
         kind = ("REGRESSION" if f["round"] == rep["latest_round"]
                 else "historical regression")
-        print("%s: round %d %s = %s is %.1f%% below round %d's %s"
+        direction = ("below" if f.get("higher_is_better", True)
+                     else "above")
+        print("%s: round %d %s = %s is %.1f%% %s round %d's %s"
               % (kind, f["round"], f["series"], f["value"], f["drop_pct"],
-                 f["best_prior_round"], f["best_prior"]))
+                 direction, f["best_prior_round"], f["best_prior"]))
     print(json.dumps(rep["trajectory"][-1] if rep["trajectory"] else {}))
     if rep["sim_rounds"] or rep["invalid_sim_artifacts"]:
         print("bench_history: %d sim round(s) collated" % rep["sim_rounds"])

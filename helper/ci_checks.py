@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""One-command runner for every static lint the repo carries (ISSUE 13
+satellite).
+
+Four lints guard cross-file invariants — the C-ABI/PARITY.md count
+(`check_abi`), blocking fetches outside runtime/syncs.py
+(`check_syncs`), raw ``jax.jit`` bypassing the xla_obs ledger
+(`check_xla_sites`) and unarmed FAULT_TABLE entries
+(`check_fault_coverage`) — but until now each had to be invoked
+separately, so a PR could green three and forget the fourth.  This
+runner invokes all of them in one process and fails if ANY fails:
+
+    python helper/ci_checks.py            # exit 0 = all lints green
+
+Each check's own ``main()`` is the single source of truth (no logic is
+duplicated here); the runner only sequences them and aggregates the
+verdict.  ``tests/test_ci_checks.py`` pins under tier-1 that the
+committed tree passes the full set through THIS entry point, so the
+one-command contract cannot silently rot.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Tuple
+
+HELPER_DIR = os.path.dirname(os.path.abspath(__file__))
+
+#: (module name, human label) — every static lint the repo has; a new
+#: lint lands by adding its row here (test_ci_checks pins membership)
+CHECKS: Tuple[Tuple[str, str], ...] = (
+    ("check_abi", "C-ABI export count vs PARITY.md"),
+    ("check_syncs", "blocking fetches outside runtime/syncs.py"),
+    ("check_xla_sites", "raw jax.jit bypassing the xla_obs ledger"),
+    ("check_fault_coverage", "FAULT_TABLE entries unarmed by any test"),
+)
+
+
+def run_all() -> Dict[str, int]:
+    """{check name: exit code} for every lint, always running all of
+    them (a later lint's verdict must not hide behind an earlier
+    failure)."""
+    if HELPER_DIR not in sys.path:
+        sys.path.insert(0, HELPER_DIR)
+    results: Dict[str, int] = {}
+    for name, _label in CHECKS:
+        mod = __import__(name)
+        try:
+            results[name] = int(mod.main([]) or 0)
+        except SystemExit as e:      # a lint that exits instead of returning
+            results[name] = int(e.code or 0)
+        except Exception as e:       # noqa: BLE001 — a crash IS a failure
+            sys.stderr.write("ci_checks: %s crashed: %s: %s\n"
+                             % (name, type(e).__name__, e))
+            results[name] = 1
+    return results
+
+
+def main(argv: List[str] = None) -> int:
+    results = run_all()
+    width = max(len(n) for n, _ in CHECKS)
+    for name, label in CHECKS:
+        rc = results[name]
+        print("ci_checks: %-*s %s  (%s)"
+              % (width, name, "OK" if rc == 0 else "FAIL rc=%d" % rc,
+                 label))
+    failed = [n for n, rc in results.items() if rc != 0]
+    if failed:
+        print("ci_checks: FAILED: %s" % ", ".join(failed))
+        return 1
+    print("ci_checks: all %d lints green" % len(CHECKS))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -13,6 +13,7 @@ to the host, where the reference-format model is assembled.
 from __future__ import annotations
 
 import functools
+import threading
 import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
@@ -36,7 +37,8 @@ from ..ops import segment as seg
 from ..ops.bundle import (BundleMap, bundle_map_from_info, decode_bin,
                           identity_bundle_map)
 from .grower import GrowerConfig, make_tree_grower
-from .grower2 import PayloadCols, make_partitioned_grower
+from .grower2 import (PayloadCols, TREE_DEVICE_FIELDS,
+                      make_partitioned_grower)
 from .pipeline import TreeAssembler
 
 K_EPSILON = 1e-15
@@ -714,6 +716,62 @@ class _FastState:
             if sample_hook is not None else None
         self._step_masked = step_masked if sample_hook is not None else None
         self._set_bag = set_bag
+        #: fused boosting-window programs keyed by (J, with_bag) — built
+        #: lazily by window_program(); survive sync-backs like the other
+        #: jitted closures
+        self._window_cache: Dict = {}
+
+    def window_program(self, J: int, with_bag: bool):
+        """One jitted, donated device program for a whole boosting window:
+        a lax.scan over J iterations whose body is EXACTLY the sequential
+        fast path's per-iteration programs inlined (`_set_bag` ->
+        `_snap_scores` -> K x `_step` through their ``__wrapped__`` seam),
+        so every scan step computes the same graph the per-tree dispatch
+        loop would — the byte-identity contract of boost_window.  Inputs:
+        payload, aux (donated), the per-step feature masks [J, F], the
+        per-step ORIGINAL-order bag masks [J, n_pad] (a dummy [J, 1] when
+        bagging is off), and the shrinkage scalar.  Outputs: the stacked
+        packed split records [J, K, ...] plus the carried payload/aux —
+        the records come back to the host in ONE `_fetch_packed`
+        transfer."""
+        key = (int(J), bool(with_bag))
+        prog = self._window_cache.get(key)
+        if prog is not None:
+            xla_obs.cache_event("gbdt.window_cache", "hit")
+            return prog
+        xla_obs.cache_event("gbdt.window_cache", "miss")
+        K = self.K
+        step_fn = self._step.__wrapped__
+        snap_fn = self._snap_scores.__wrapped__
+        bag_fn = self._set_bag.__wrapped__
+
+        def window(payload, aux, fmasks, bags, lr):
+            def step(carry, xs):
+                payload, aux = carry
+                if with_bag:
+                    payload = bag_fn(payload, xs["bag"])
+                if K > 1:
+                    payload = snap_fn(payload)
+                outs = []
+                for k in range(K):
+                    out, payload, aux = step_fn(payload, aux, xs["fmask"],
+                                                lr, jnp.int32(k))
+                    outs.append(out)
+                stacked = jax.tree_util.tree_map(
+                    lambda *a: jnp.stack(a), *outs)
+                return (payload, aux), stacked
+
+            xs = {"fmask": fmasks}
+            if with_bag:
+                xs["bag"] = bags
+            (payload, aux), recs = lax.scan(step, (payload, aux), xs,
+                                            length=J)
+            return recs, payload, aux
+
+        prog = xla_obs.jit(window, site="gbdt.window",
+                           donate_argnums=(0, 1))
+        self._window_cache[key] = prog
+        return prog
 
     def reset(self, gbdt: "GBDT") -> None:
         """(Re)build the payload from the legacy-order state — used on first
@@ -1095,6 +1153,28 @@ class GBDT:
         self._pipe_any_split = False
         self._in_flush = False
 
+        # fused boosting window (boost_window=J, ISSUE 13): one donated
+        # lax.scan program trains J iterations per dispatch; the driver
+        # below consumes the window one update() at a time (parked host
+        # trees + lazy valid-score replay), truncating to the reported
+        # iteration by exact snapshot replay when an observation point
+        # (eval, snapshot, rollback, reset_parameter) lands mid-window.
+        self._boost_window = max(1, int(getattr(config, "boost_window", 1)
+                                        or 1))
+        #: the open (still-consuming) window, or None
+        self._win: Optional[Dict] = None
+        #: fully-consumed windows whose parked trees have not all been
+        #: appended yet (drain still in flight) — strictly ordered
+        self._win_unappended: List[Dict] = []
+        #: adaptive effective window length: shrinks to the observed
+        #: truncation point when observations land mid-window, grows back
+        #: toward boost_window after consecutive clean windows
+        self._win_adapt = self._boost_window
+        self._win_clean = 0
+        #: engine.train's look-ahead hint: iterations until the next
+        #: observation point (None = unknown; adaptive length governs)
+        self._win_horizon: Optional[int] = None
+
         # deterministic per-subsystem RNG (bagging / feature sampling)
         seed = int(getattr(config, "seed", 0) or 0)
         self.bagging_rng = Random(partition_seed(seed + int(config.bagging_seed), 1))
@@ -1277,23 +1357,38 @@ class GBDT:
                 and self.train_set.num_data_padded < (1 << 31))
 
     # -- async pipeline drain ------------------------------------------------
-    def flush(self) -> None:
+    def flush(self, sync_scores: bool = False) -> None:
         """Drain the dispatch pipeline: after this returns, model.trees
-        holds every dispatched tree in dispatch order and any deferred
-        assembly error has been re-raised.  Every point that observes the
-        model or host scores calls this — metric eval, early-stop
+        holds every REPORTED iteration's trees in dispatch order and any
+        deferred assembly error has been re-raised.  Every point that
+        observes the model calls this — metric eval, early-stop
         callbacks, snapshot writes / PreemptionGuard, rollback_one_iter,
         save_model, _fast_sync_back, and the train() exit path.
+
+        `sync_scores=True` additionally settles the DEVICE training state
+        at the reported iteration: an open boosting window (boost_window
+        >= 2 ran the device ahead of the reported iteration) is truncated
+        by exact snapshot replay.  Score observers (eval rounds,
+        raw_train_score, snapshot capture, sync-back) pass True; pure
+        model-view reads (current_iteration, save_model of the trees so
+        far) keep the cheap default and never pay a truncation.
 
         If a drained iteration turned out to have no splittable leaves,
         the iterations dispatched past it are rolled back here — the
         synchronous loop would have stopped before training them."""
         if self._assembler is not None:
             self._assembler.flush()
+        self._window_append_ready()
+        if sync_scores:
+            self._window_truncate()
         if self._in_flush:
             return
         stop = self._pipe_stop_iter
         if stop is not None and self.iter > stop + 1:
+            # over-reported iterations exist; settle any open window at
+            # its consumed boundary first so the payload scores the
+            # rollback edits match the reported iteration exactly
+            self._window_truncate()
             self._in_flush = True
             try:
                 # rollback IN PLACE (payload score replay on the fast
@@ -1326,6 +1421,235 @@ class GBDT:
                             "leaves that meet the split requirements")
             self._pipe_k_seen = 0
             self._pipe_any_split = False
+
+    # -- fused boosting window (boost_window=J, ISSUE 13) --------------------
+    def _window_len(self) -> int:
+        """Effective boosting-window length for the next dispatch: the
+        configured boost_window clamped by the adaptive truncation
+        history and engine.train's observation horizon; 1 (the sequential
+        per-tree loop) whenever the config sits outside the validated
+        window envelope."""
+        J = self._boost_window
+        if J <= 1 or type(self) is not GBDT or self.mesh is not None:
+            return 1
+        if (self.objective is None
+                or self.objective.renew_tree_output_required()
+                or self._quant_enabled
+                or self.forced_schedule is not None
+                or getattr(self, "_fast_sample_hook", None) is not None
+                or self.timer.enabled
+                or self._sentinel_policy != "off"):
+            return 1
+        J = min(J, max(1, self._win_adapt))
+        if self._win_horizon is not None:
+            J = min(J, max(1, int(self._win_horizon)))
+        return J
+
+    def _window_dispatch(self, J: int) -> bool:
+        """Train J boosting iterations in ONE device dispatch: pre-draw
+        the J per-iteration host RNG decisions (feature masks, bagging
+        re-draws — the same stream positions the sequential loop would
+        consume), snapshot the window-start device state for exact
+        truncation, run the donated scan program, and hand the stacked
+        [J*K] split records to the assembler as ONE drain unit.  Only
+        iteration 0 is reported to the caller; the rest are consumed by
+        the following update() calls with zero device work."""
+        init_score = self._boost_from_average()
+        fs = self._fast_enter()
+        cfg = self.config
+        K = self.num_tree_per_iteration
+        bag_on = cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
+        it0 = self.iter
+        import copy as _copy
+        rng0 = (_copy.deepcopy(self.bagging_rng._rng.bit_generator.state),
+                _copy.deepcopy(self.feature_rng._rng.bit_generator.state),
+                self.bag_mask_host.copy(), fs._bag_dirty)
+        fmasks = np.empty((J, self.train_set.num_features
+                           + self._fmask_pad), bool)
+        bag_rows = (np.empty((J, self.train_set.num_data_padded),
+                             np.float32) if bag_on else None)
+        for j in range(J):
+            fmasks[j] = self._feature_sample_host()
+            if bag_on:
+                bag_rows[j] = self._bagging_host(it0 + j)
+        lr = self.shrinkage_rate
+        # explicit window-start copies: the scan program donates its
+        # payload/aux inputs, and truncation needs the exact start bits
+        snap = (jnp.copy(fs.payload), jnp.copy(fs.aux))
+        prog = fs.window_program(J, bag_on)
+        bag_dev = (jnp.asarray(bag_rows) if bag_on
+                   else jnp.zeros((J, 1), jnp.float32))
+        with syncs.critical_path():
+            recs, fs.payload, fs.aux = prog(fs.payload, fs.aux,
+                                            jnp.asarray(fmasks), bag_dev,
+                                            jnp.float32(lr))
+        if bag_on:
+            fs._bag_dirty = False
+        w = {"iter0": it0, "total": J, "consumed": 0, "appended": 0,
+             "recs": recs, "lr": lr, "snap": snap, "rng0": rng0,
+             "trees": [], "drained": threading.Event()}
+        self._win = w
+        telemetry.counter("lgbm_window_iterations_total").inc(J)
+        t_dispatch = time.monotonic()
+
+        def host_half():
+            host = _fetch_packed(recs, label="window_drain")
+            trees = []
+            stop_at = None
+            for j in range(J):
+                any_split = False
+                for k in range(K):
+                    one = {key: val[j, k] for key, val in host.items()}
+                    tree = self._finish_tree_host(
+                        one, init_score if j == 0 else 0.0, lr)
+                    trees.append(tree)
+                    if tree.num_leaves > 1:
+                        any_split = True
+                if not any_split and stop_at is None:
+                    stop_at = it0 + j
+            w["trees"] = trees
+            if stop_at is not None and self._pipe_stop_iter is None:
+                self._pipe_stop_iter = stop_at
+                Log.warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+            w["drained"].set()
+            telemetry.histogram("lgbm_pipeline_drain_seconds").observe(
+                time.monotonic() - t_dispatch)
+
+        if self._pipeline_depth > 0:
+            if self._assembler is None:
+                self._assembler = TreeAssembler(self._pipeline_depth)
+            self._assembler.submit(host_half, trees=J * K)
+        else:
+            host_half()
+        return self._window_consume_one()
+
+    #: grower-output fields whose [j, k] slices form the device half a
+    #: valid-set replay needs (matches _tree_device_half's tree_dev;
+    #: the tuple itself is the gbdt<->grower2 stacked-record contract)
+    _WINDOW_TREE_DEV = TREE_DEVICE_FIELDS
+
+    def _window_consume_one(self) -> bool:
+        """Report one already-trained window iteration: replay its trees
+        onto the valid scores from the stacked device records (lazily, so
+        valid state never runs ahead of the reported iteration), append
+        its parked host trees when the drain has landed, and surface the
+        sequential loop's no-split stop."""
+        w = self._win
+        j = w["consumed"]
+        K = self.num_tree_per_iteration
+        recs, lr = w["recs"], w["lr"]
+        if self.valid_sets:
+            depth_iters = max(self.grower_cfg.num_leaves - 1, 1)
+            with syncs.critical_path():
+                for k in range(K):
+                    tree_dev = {f: recs[f][j, k]
+                                for f in self._WINDOW_TREE_DEV}
+                    leaf_out = jnp.where(
+                        recs["num_leaves"][j, k] > 1,
+                        recs["leaf_value"][j, k] * jnp.float32(lr),
+                        jnp.float32(0.0))
+                    for vs in self.valid_sets:
+                        vs[3] = _traverse_update(
+                            vs[2], vs[3], leaf_out, tree_dev,
+                            self.meta_dev, self.bundle_map, depth_iters, k)
+        w["consumed"] = j + 1
+        self.iter += 1
+        finished = False
+        if w["drained"].is_set() and w["trees"]:
+            finished = all(t.num_leaves <= 1
+                           for t in w["trees"][j * K:(j + 1) * K])
+        self._window_append_ready()
+        if w["consumed"] >= w["total"]:
+            # fully consumed: the window can never truncate again — free
+            # the start snapshot now, and keep the parked trees around
+            # only until their drain lands
+            self._win = None
+            w["snap"] = None
+            if w["appended"] < w["total"] * K:
+                self._win_unappended.append(w)
+            self._win_clean += 1
+            if self._win_clean >= 2 and self._win_adapt < self._boost_window:
+                self._win_clean = 0
+                self._win_adapt = min(self._boost_window,
+                                      max(2, self._win_adapt * 2))
+        if finished and self._pipe_stop_iter is not None \
+                and self.iter > self._pipe_stop_iter:
+            self._pipe_stop_iter = None
+        return finished
+
+    def _window_append_ready(self) -> None:
+        """Append parked window trees to the model, strictly in dispatch
+        order, up to the reported (consumed) iteration.  Trees whose
+        drain has not landed stay parked — flush()'s assembler barrier
+        guarantees completeness for every observer."""
+        K = self.num_tree_per_iteration
+        while self._win_unappended:
+            w0 = self._win_unappended[0]
+            if not w0["drained"].is_set():
+                return    # strict order: later windows must wait too
+            while w0["appended"] < w0["total"] * K:
+                self.model.trees.append(w0["trees"][w0["appended"]])
+                w0["appended"] += 1
+            self._win_unappended.pop(0)
+        w = self._win
+        if w is None or not w["drained"].is_set():
+            return
+        while w["appended"] < w["consumed"] * K:
+            self.model.trees.append(w["trees"][w["appended"]])
+            w["appended"] += 1
+
+    def _window_truncate(self) -> None:
+        """Settle an open window at its consumed boundary: drop the
+        unreported parked trees, restore the window-start device payload
+        and host RNG/bag state, and replay the consumed iterations
+        through the sequential fused steps — bit-identical to a run that
+        never windowed (the scan step and `_step` trace the same graph).
+        Costs `consumed` sequential re-dispatches; the adaptive window
+        length shrinks to the observed truncation point so repeated
+        mid-window observations stop paying it."""
+        w = self._win
+        if w is None:
+            return
+        if self._assembler is not None:
+            self._assembler.flush()
+        self._window_append_ready()
+        self._win = None
+        c = w["consumed"]
+        self._win_adapt = max(1, min(self._win_adapt, c))
+        self._win_clean = 0
+        telemetry.counter("lgbm_window_truncations_total").inc()
+        fs = self._fast
+        fs.payload, fs.aux = w["snap"]
+        w["snap"] = None
+        bag_state, feat_state, bag_mask0, bag_dirty0 = w["rng0"]
+        self.bagging_rng._rng.bit_generator.state = bag_state
+        self.feature_rng._rng.bit_generator.state = feat_state
+        self.bag_mask_host = bag_mask0
+        fs._bag_dirty = bag_dirty0
+        it_end = self.iter
+        self.iter = w["iter0"]
+        lr_now = self.shrinkage_rate
+        self.shrinkage_rate = w["lr"]
+        try:
+            for _ in range(c):
+                fmask = self._feature_sample()
+                self._fast_refresh_bag(fs)
+                if fs.K > 1:
+                    fs.payload = fs._snap_scores(fs.payload)
+                for k in range(fs.K):
+                    _, fs.payload, fs.aux = fs._step(
+                        fs.payload, fs.aux, fmask, jnp.float32(w["lr"]),
+                        jnp.int32(k))
+                self.iter += 1
+        finally:
+            self.shrinkage_rate = lr_now
+            self.iter = it_end
+        # a stop discovered in the truncated (never-reported) region
+        # never happened; the continued run rediscovers it if real
+        if self._pipe_stop_iter is not None \
+                and self._pipe_stop_iter > self.iter - 1:
+            self._pipe_stop_iter = None
 
     def _tree_device_half(self, out: Dict, lr: float, masked: bool = False):
         """The half of _finish_tree the NEXT device step may depend on,
@@ -1384,7 +1708,7 @@ class GBDT:
     def _fast_sync_back(self) -> None:
         """Leave the fast path: restore original-order scores into the
         legacy score matrix.  The state object is kept for cheap re-entry."""
-        self.flush()
+        self.flush(sync_scores=True)
         if not self._fast_active:
             return
         self.score = jnp.asarray(self._fast.raw_scores())
@@ -1420,16 +1744,26 @@ class GBDT:
             self.timer.sync(fs.payload)
 
     def _train_one_iter_fast(self) -> bool:
-        if self._pipe_stop_iter is not None:
+        if self._pipe_stop_iter is not None and \
+                self.iter > self._pipe_stop_iter:
             # a drained host half found an iteration with no splittable
             # leaves; flush() rolls back anything dispatched past it and
             # this update reports finished (one-to-two updates later than
             # the synchronous loop, with an identical final model).  The
             # flag clears once reported so a caller that keeps driving
             # update() manually trains again, like the synchronous loop.
+            # (A boosting window can discover the stop AHEAD of the
+            # reported iteration — the guard keeps consuming up to it.)
             self.flush()
             self._pipe_stop_iter = None
             return True
+        if self._win is not None:
+            # an open boosting window already trained this iteration on
+            # device; reporting it is pure host bookkeeping
+            return self._window_consume_one()
+        J = self._window_len()
+        if J >= 2:
+            return self._window_dispatch(J)
         init_score = self._boost_from_average()
         fs = self._fast_enter()
         fmask = self._feature_sample()
@@ -1665,6 +1999,11 @@ class GBDT:
         engine config so they take effect on the next iteration (shared by
         Booster.reset_parameter and the reset_parameter callback)."""
         from ..config import Config
+        if self._win is not None:
+            # parameter changes are observation points: iterations the
+            # open window trained past the reported one used the OLD
+            # parameters — settle at the boundary before applying
+            self.flush(sync_scores=True)
         self.config.set(new_params)
         if any(Config.resolve_alias(k) == "learning_rate"
                for k in new_params):
@@ -1814,19 +2153,28 @@ class GBDT:
             return init
         return 0.0
 
-    def _bagging(self) -> jax.Array:
+    def _bagging_host(self, it: int) -> np.ndarray:
+        """Host half of _bagging: advance the bagging stream to iteration
+        `it` (resample when it lands on the bagging_freq grid) and return
+        the current host mask.  The window dispatcher pre-draws J steps
+        through this, so the stream position stays identical to the
+        sequential loop's."""
         cfg = self.config
         n = self.train_set.num_data
         if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
-            if self.iter % cfg.bagging_freq == 0:
+            if it % cfg.bagging_freq == 0:
                 bag_cnt = int(n * cfg.bagging_fraction)
                 idx = self.bagging_rng.sample(n, bag_cnt)
                 mask = np.zeros(self.train_set.num_data_padded, dtype=np.float32)
                 mask[idx] = 1.0
                 self.bag_mask_host = mask
+        return self.bag_mask_host
+
+    def _bagging(self) -> jax.Array:
+        mask = self._bagging_host(self.iter)
         if self.mesh is not None:
-            return jax.device_put(self.bag_mask_host, self._row_sharding)
-        return jnp.asarray(self.bag_mask_host)
+            return jax.device_put(mask, self._row_sharding)
+        return jnp.asarray(mask)
 
     def _bagging_masks(self, grads, hesss):
         """(gradient-scale mask, count mask) per row.  Plain bagging uses the
@@ -1835,7 +2183,9 @@ class GBDT:
         m = self._bagging()
         return m, m
 
-    def _feature_sample(self) -> jax.Array:
+    def _feature_sample_host(self) -> np.ndarray:
+        """Host half of _feature_sample (one per-iteration draw); the
+        window dispatcher stacks J of these into one device upload."""
         cfg = self.config
         f = self.train_set.num_features
         mask = np.zeros(f, dtype=bool)
@@ -1848,7 +2198,10 @@ class GBDT:
             # feature-parallel pads the feature axis to a shard multiple;
             # padded columns never enter split search
             mask = np.concatenate([mask, np.zeros(self._fmask_pad, bool)])
-        return jnp.asarray(mask)
+        return mask
+
+    def _feature_sample(self) -> jax.Array:
+        return jnp.asarray(self._feature_sample_host())
 
     def _renew_leaf_values_fast(self, fs: "_FastState", out: Dict,
                                 k: int) -> Optional[np.ndarray]:
@@ -2024,7 +2377,7 @@ class GBDT:
 
     # -- evaluation ----------------------------------------------------------
     def raw_train_score(self) -> np.ndarray:
-        self.flush()
+        self.flush(sync_scores=True)
         if self._fast_active:
             return self._fast.raw_scores()[:, : self.train_set.num_data]
         return syncs.device_get(
@@ -2073,8 +2426,10 @@ class GBDT:
         """(train raw, [valid raws]) for an eval round, off one packed
         transfer.  Flushing here makes every eval a pipeline barrier —
         callbacks that observe the model (early stopping bookkeeping,
-        snapshot schedules) run against a fully-assembled tree list."""
-        self.flush()
+        snapshot schedules) run against a fully-assembled tree list —
+        and settles any open boosting window at the reported iteration
+        (score observation)."""
+        self.flush(sync_scores=True)
         fs = self._fast if self._fast_active else None
         arrays: List[jax.Array] = []
         if want_train:

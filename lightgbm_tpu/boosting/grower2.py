@@ -51,6 +51,19 @@ class PayloadCols(NamedTuple):
     value: int     # per-row current-tree leaf output
 
 
+#: grower-output fields forming the DEVICE HALF of a finished tree —
+#: everything a bin-level traversal replay (gbdt._traverse_update: valid
+#: scores, DART/RF replay, rollback) consumes.  The fused boosting
+#: window slices these [j, k] planes out of its stacked [J, K, ...]
+#: record emission, so the tuple is the gbdt<->grower2 contract for
+#: scan-composed growth: grow() is pure and shape-static (jit=False
+#: composes under lax.scan through the __wrapped__ seam), and every
+#: field below must stay present in the returned tree dict.
+TREE_DEVICE_FIELDS = ("split_feature", "split_bin", "default_left",
+                      "split_is_cat", "split_cat_bitset", "left_child",
+                      "right_child")
+
+
 def make_partitioned_grower(meta: FeatureMeta, cfg: GrowerConfig,
                             num_bins_max: int, cols: PayloadCols,
                             num_features: int, jit: bool = True,

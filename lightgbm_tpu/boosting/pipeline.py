@@ -7,10 +7,14 @@ per tree was the synchronous packed fetch inside `_finish_tree`.  This
 module provides the bounded FIFO that takes that fetch (and the ~2 ms of
 host assembly behind it) off the dispatch path:
 
-* `submit(fn)` enqueues one tree's host half (packed fetch -> `Tree`
-  assembly -> `model.trees.append`) and applies backpressure: at most
-  `depth` host halves are pending-or-running, so the device can run at
-  most `depth` trees ahead of the host model.
+* `submit(fn)` enqueues one DRAIN UNIT's host half and applies
+  backpressure: at most `depth` units are pending-or-running.  A unit
+  is one tree on the per-tree fast path (packed fetch -> `Tree`
+  assembly -> `model.trees.append`); the fused boosting window
+  (boost_window=J, ISSUE 13) submits MULTI-TREE units — one packed
+  fetch draining J*K parked trees — so `trees=` tells the queue how
+  many trees a unit carries and `pending_trees` reports how far the
+  device is ahead of the host model in TREES, not units.
 * the halves run on ONE worker thread in strict submission order —
   `model.trees` grows in exactly the order the trees were dispatched,
   which is what byte-identical model files require.
@@ -27,7 +31,7 @@ from __future__ import annotations
 
 import collections
 import threading
-from typing import Callable, Deque, Optional
+from typing import Callable, Deque, Optional, Tuple
 
 from ..runtime import telemetry
 
@@ -38,22 +42,31 @@ class TreeAssembler:
     def __init__(self, depth: int):
         self.depth = max(1, int(depth))
         self._cv = threading.Condition()
-        self._fifo: Deque[Callable[[], None]] = collections.deque()
+        self._fifo: Deque[Tuple[Callable[[], None], int]] = \
+            collections.deque()
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._stopping = False
 
     @property
     def pending(self) -> int:
-        """Host halves submitted but not yet finished."""
+        """Drain units submitted but not yet finished."""
         with self._cv:
             return len(self._fifo)
 
-    def submit(self, fn: Callable[[], None]) -> None:
-        """Enqueue one host half; blocks while `depth` are already
-        pending (the in-flight one counts), bounding how far the device
-        runs ahead.  A deferred error from an earlier half re-raises
-        here rather than silently dropping trees."""
+    @property
+    def pending_trees(self) -> int:
+        """Trees carried by the pending drain units (a boosting-window
+        unit counts its whole J*K batch)."""
+        with self._cv:
+            return sum(n for _, n in self._fifo)
+
+    def submit(self, fn: Callable[[], None], trees: int = 1) -> None:
+        """Enqueue one drain unit carrying `trees` parked trees; blocks
+        while `depth` units are already pending (the in-flight one
+        counts), bounding how far the device runs ahead.  A deferred
+        error from an earlier unit re-raises here rather than silently
+        dropping trees."""
         with self._cv:
             if self._error is not None:
                 err, self._error = self._error, None
@@ -63,7 +76,7 @@ class TreeAssembler:
                 if self._error is not None:
                     err, self._error = self._error, None
                     raise err
-            self._fifo.append(fn)
+            self._fifo.append((fn, max(1, int(trees))))
             # live queue depth (ISSUE 9): how far the device is running
             # ahead of the host model right now
             telemetry.gauge("lgbm_pipeline_queue_depth").set(
@@ -82,7 +95,7 @@ class TreeAssembler:
                     self._cv.wait()
                 if not self._fifo:
                     return
-                fn = self._fifo[0]      # keep queued: in-flight counts
+                fn, _n = self._fifo[0]  # keep queued: in-flight counts
                                         # against the depth bound
             try:
                 fn()

@@ -127,6 +127,20 @@ class Config:
         # legacy/profiled/renew paths and an armed sentinel_nonfinite run
         # synchronously (docs/PERFORMANCE.md "Dispatch pipeline").
         self.pipeline_depth = 1
+        # fused boosting window (ISSUE 13): >= 2 trains that many boosting
+        # iterations per device dispatch — one jitted, donated lax.scan
+        # program runs gradient fill, per-class tree growth and the score
+        # add for J iterations, and the packed split records of all J*K
+        # trees come back in ONE transfer.  Models stay byte-identical to
+        # boost_window=1; windows truncate to the next observation point
+        # (eval round, snapshot, rollback_one_iter, reset_parameter) by
+        # exact replay from a window-start device snapshot, so the
+        # snapshot costs one extra payload+aux copy while a window is
+        # open.  Serial plain-gbdt fast path only (GOSS/DART/RF, renewal,
+        # quantized gradients, mesh learners and profiling keep the
+        # per-tree loop).  Staged default 1 (docs/PERFORMANCE.md expiry
+        # table row BOOST_WINDOW_DEFAULT).
+        self.boost_window = 1
         self._user_keys: set = set()
         self.raw_params: Dict[str, Any] = {}
         if params:

@@ -77,6 +77,14 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
                           evaluation_result_list=None)
         for cb in callbacks_before:
             cb(env)
+        eng = booster._engine
+        if eng is not None and hasattr(eng, "_win_horizon"):
+            # observation horizon for the fused boosting window: with an
+            # eval round every iteration the window must not run ahead
+            # at all; otherwise it may run to the end of training
+            eng._win_horizon = (1 if (is_valid_contain_train
+                                      or eng.valid_sets)
+                                else num_boost_round - i)
         is_finished = booster.update(fobj=fobj)
 
         # one packed device fetch per eval round (Booster.eval_round):

@@ -1017,8 +1017,9 @@ def capture_training_state(booster) -> Dict[str, Any]:
     if eng is None:
         raise RuntimeError("capture_training_state needs a training Booster")
     # snapshots observe the model AND the scores: drain the dispatch
-    # pipeline first (flush barrier contract, ISSUE 5)
-    eng.flush()
+    # pipeline first (flush barrier contract, ISSUE 5) and settle any
+    # open boosting window at the reported iteration (ISSUE 13)
+    eng.flush(sync_scores=True)
     if eng._fast_active:
         score = eng._fast.raw_scores()                      # [K, n_pad] f32
         perm = (eng._fast.host_idx().astype(np.int32)

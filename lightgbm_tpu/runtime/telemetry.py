@@ -118,6 +118,15 @@ METRIC_TABLE: Dict[str, Dict[str, Any]] = {
         "type": "histogram", "labels": (),
         "help": "Dispatch-to-append latency of one tree's deferred host "
                 "half (queue wait + packed fetch + Tree assembly)"},
+    "lgbm_window_iterations_total": {
+        "type": "counter", "labels": (),
+        "help": "Boosting iterations trained inside fused boost_window "
+                "scan dispatches (J iterations per device program)"},
+    "lgbm_window_truncations_total": {
+        "type": "counter", "labels": (),
+        "help": "Open boosting windows settled mid-window at an "
+                "observation point (eval/snapshot/rollback) by exact "
+                "snapshot replay"},
     "lgbm_ingest_rows_total": {
         "type": "counter", "labels": ("mode",),
         "help": "Rows parsed by ingest, mode=full_parse/tail_append/"

@@ -56,6 +56,7 @@ from .resilience import wallclock
 __all__ = [
     "jit", "LEDGER", "CompileLedger", "cache_event", "mark_steady",
     "set_cost_capture", "snapshot", "delta", "total_compiles", "reset",
+    "calls_snapshot", "calls_delta", "total_calls",
 ]
 
 #: compile-history entries kept per site (bounded: the ledger lives for
@@ -257,6 +258,28 @@ class CompileLedger:
         with self._lock:
             return {name: s.compiles for name, s in self._sites.items()}
 
+    def calls_snapshot(self) -> Dict[str, int]:
+        """{site: DISPATCH count} — every LedgeredJit invocation is one
+        device-program launch (inlined ``__wrapped__`` calls are part of
+        their outer program and do not count).  Diff two of these for a
+        dispatches-per-iteration attribution (BENCH_ATTRIB)."""
+        with self._lock:
+            return {name: s.calls for name, s in self._sites.items()}
+
+    def calls_delta(self, before: Dict[str, int]) -> Dict[str, int]:
+        """Per-site dispatches since `before` (only non-zero entries)."""
+        now = self.calls_snapshot()
+        out = {}
+        for name, n in now.items():
+            d = n - before.get(name, 0)
+            if d:
+                out[name] = d
+        return out
+
+    def total_calls(self) -> int:
+        with self._lock:
+            return sum(s.calls for s in self._sites.values())
+
     def delta(self, before: Dict[str, int]) -> Dict[str, int]:
         """Per-site compiles since `before` (only non-zero entries)."""
         now = self.snapshot()
@@ -400,6 +423,18 @@ def delta(before: Dict[str, int]) -> Dict[str, int]:
 
 def total_compiles() -> int:
     return LEDGER.total_compiles()
+
+
+def calls_snapshot() -> Dict[str, int]:
+    return LEDGER.calls_snapshot()
+
+
+def calls_delta(before: Dict[str, int]) -> Dict[str, int]:
+    return LEDGER.calls_delta(before)
+
+
+def total_calls() -> int:
+    return LEDGER.total_calls()
 
 
 def reset() -> None:

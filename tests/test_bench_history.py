@@ -134,6 +134,27 @@ def _write_sim(d, n, scenarios, replicas=2, duration=20.0):
     return rec
 
 
+def test_dispatches_per_iter_rise_is_flagged(tmp_path):
+    """The ISSUE 13 series is LOWER-is-better: a >10% RISE in
+    BENCH_ATTRIB's dispatches_per_iter at the same shape flags, a drop
+    (boost_window progress) never does."""
+    shape = {"value": 1.0, "n_rows": 100, "platform": "cpu"}
+    att = lambda d: {"attrib": {"per_iter": {"dispatches_per_iter": d}}}
+    _write_round(tmp_path, 1, {**shape, **att(2.0)})
+    _write_round(tmp_path, 2, {**shape, **att(0.5)})     # window win: fine
+    _write_round(tmp_path, 3, {**shape, **att(0.8)})     # 60% rise: flags
+    rep = bench_history.run(str(tmp_path))
+    assert rep["trajectory"][1]["dispatches_per_iter"] == 0.5
+    flagged = [f for f in rep["latest_regressions"]
+               if f["series"] == "dispatches_per_iter"]
+    assert len(flagged) == 1
+    assert flagged[0]["best_prior_round"] == 2
+    assert flagged[0]["higher_is_better"] is False
+    # rounds 1->2 (the improvement) never flagged
+    assert all(f["round"] != 2 for f in rep["regressions"]
+               if f["series"] == "dispatches_per_iter")
+
+
 def test_sim_artifact_schema_validates():
     good = {"artifact": "SIM_r11", "schema_version": 1, "replicas": 2,
             "duration_s": 20.0, "ok": True,

@@ -26,14 +26,23 @@ def _small_booster(n=5000):
     return bst
 
 
+PHASE_KEYS = {"grad_fill_ms", "tree_grow_ms", "score_update_ms",
+              "tree_assemble_host_ms"}
+
+
 def test_phase_times_healthy_at_reduced_scale():
     """The reduced-scale reproduction of the crashed section: one
-    piecewise iteration through every stage must produce real timings."""
+    piecewise iteration through every stage must produce real timings,
+    plus the normalized self-consistency block (ISSUE 13 satellite: the
+    piecewise absolutes can exceed sec_per_iter, so the record must
+    carry fractions that always sum to 1)."""
     out = bench.phase_times(_small_booster(), reps=1)
     assert "error" not in out, out
-    assert set(out) == {"grad_fill_ms", "tree_grow_ms", "score_update_ms",
-                        "tree_assemble_host_ms"}
-    assert all(v >= 0.0 for v in out.values())
+    assert set(out) == PHASE_KEYS | {"piecewise_total_ms", "phase_frac"}
+    assert all(out[k] >= 0.0 for k in PHASE_KEYS)
+    assert set(out["phase_frac"]) == PHASE_KEYS
+    assert abs(sum(out["phase_frac"].values()) - 1.0) < 1e-3
+    assert out["piecewise_total_ms"] >= max(out[k] for k in PHASE_KEYS)
 
 
 def test_phase_failure_names_culprit_stage():
@@ -130,6 +139,28 @@ def test_ingest_bench_record_shape():
     assert rec["bins_identical_across_paths"] is True
 
 
+def test_window_bench_record_shape():
+    """BENCH_WINDOW at toy scale (ISSUE 13): the on/off A/B must report
+    both arms' sec/iter + dispatch/fetch counts off the same booster,
+    with the window arm's dispatch and fetch counts strictly lower."""
+    env = {"BENCH_WINDOW": "4", "BENCH_WINDOW_ITERS": "8"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        rec = bench.bench_window(_small_booster(), 8)
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.update({k: v})
+    assert rec["boost_window"] == 4
+    for arm in ("on", "off"):
+        for key in ("sec_per_iter", "dispatches_per_iter",
+                    "fetches_per_iter"):
+            assert rec[arm][key] >= 0, (arm, key, rec)
+    assert rec["on"]["dispatches_per_iter"] < rec["off"]["dispatches_per_iter"]
+    assert rec["on"]["fetches_per_iter"] < rec["off"]["fetches_per_iter"]
+    assert rec["dispatch_reduction"] >= 2
+
+
 def test_fallback_reexec_preserves_every_section_toggle():
     """The CPU-fallback re-exec env pin (ISSUE 7 satellite): every
     BENCH_<SECTION> toggle — serve included — must ride
@@ -140,7 +171,8 @@ def test_fallback_reexec_preserves_every_section_toggle():
                 "BENCH_SERVE_LEAVES", "BENCH_SERVE_BATCH",
                 "BENCH_ONLINE", "BENCH_PREDICT", "BENCH_PHASES",
                 "BENCH_HIST_QUANT", "BENCH_FRONTIER_BATCH",
-                "BENCH_INGEST", "BENCH_INGEST_ROWS"):
+                "BENCH_INGEST", "BENCH_INGEST_ROWS",
+                "BENCH_WINDOW", "BENCH_WINDOW_ITERS"):
         assert key in bench.FALLBACK_SECTION_ENV, key
     import inspect
     src = inspect.getsource(bench.main)

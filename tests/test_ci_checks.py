@@ -1,0 +1,38 @@
+"""The one-command static-lint runner (helper/ci_checks.py, ISSUE 13
+satellite): the committed tree must pass EVERY lint through the single
+aggregated entry point, and the runner must keep covering all four."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "helper"))
+
+import ci_checks  # noqa: E402
+
+
+def test_runner_covers_every_lint():
+    names = [n for n, _ in ci_checks.CHECKS]
+    assert names == ["check_abi", "check_syncs", "check_xla_sites",
+                     "check_fault_coverage"]
+
+
+def test_committed_tree_passes_all_lints(capsys):
+    results = ci_checks.run_all()
+    assert set(results) == {n for n, _ in ci_checks.CHECKS}
+    assert all(rc == 0 for rc in results.values()), results
+
+
+def test_main_aggregates_verdict(monkeypatch, capsys):
+    """One red lint must fail the whole run, and every other lint must
+    still have been executed (no fail-fast hiding)."""
+    calls = []
+
+    def fake_run_all():
+        calls.extend(n for n, _ in ci_checks.CHECKS)
+        return {"check_abi": 0, "check_syncs": 2, "check_xla_sites": 0,
+                "check_fault_coverage": 0}
+
+    monkeypatch.setattr(ci_checks, "run_all", fake_run_all)
+    assert ci_checks.main([]) == 1
+    out = capsys.readouterr().out
+    assert "FAIL rc=2" in out and "check_syncs" in out

@@ -309,6 +309,60 @@ def test_sigterm_writes_final_snapshot_and_resume_is_byte_identical(
     assert (d / "c.txt").read_bytes() == (d / "a.txt").read_bytes()
 
 
+def test_sigterm_mid_window_resume_byte_identical(cli_resume_runs):
+    """ISSUE 13 window-boundary matrix: SIGTERM landing while a
+    boost_window=4 run has a window open truncates to the reported
+    iteration at the preemption boundary (exact snapshot replay), writes
+    a valid final snapshot, and resume=true reproduces the UNWINDOWED
+    uninterrupted model byte-for-byte."""
+    d, _, _, _pc = cli_resume_runs
+    common = _TRAIN_ARGS + ["data=train.tsv", "boost_window=4"]
+    r = _cli(d, common + ["output_model=w.txt"], fault="sigterm_at_iter:5")
+    assert "preempt" in (r.stdout + r.stderr).lower()
+    assert not (d / "w.txt").exists(), \
+        "a preempted run must not pretend it finished"
+    snaps = resilience.snapshot_paths(str(d / "w.txt"))
+    assert len(snaps) == 1
+    assert resilience.validate_snapshot(snaps[0][1])[0]
+    _cli(d, common + ["output_model=w.txt", "resume=true"])
+    assert (d / "w.txt").read_bytes() == (d / "a.txt").read_bytes()
+
+
+def test_window_snapshot_capture_mid_window_byte_identical():
+    """capture_training_state landing mid-window settles the open window
+    at the reported iteration (scores AND RNG streams), and both the
+    interrupted-then-restored run and the uninterrupted windowed run are
+    byte-identical to the sequential model (ISSUE 13)."""
+    X, y = _data(seed=12)
+    params = {"objective": "binary", "num_leaves": 12, "verbose": -1,
+              "seed": 5, "bagging_freq": 2, "bagging_fraction": 0.6,
+              "boost_window": 4}
+    seq = {k: v for k, v in params.items() if k != "boost_window"}
+    bst_a = lgb.Booster(dict(seq), lgb.Dataset(X, label=y))
+    for _ in range(8):
+        bst_a.update()
+    ma = bst_a.model_to_string()
+
+    bst_w = lgb.Booster(dict(params), lgb.Dataset(X, label=y))
+    snap_state = snap_model = None
+    for i in range(8):
+        bst_w.update()
+        if i + 1 == 3:            # a boost_window=4 window is open here
+            snap_state = resilience.capture_training_state(bst_w)
+            snap_model = bst_w._model.save_model_to_string()
+    assert bst_w.model_to_string() == ma
+    assert snap_model.count("Tree=") == 3, \
+        "the mid-window capture must see exactly the reported iterations"
+
+    init = GBDTModel.load_model_from_string(snap_model)
+    bst_b = lgb.Booster(dict(params), lgb.Dataset(X, label=y),
+                        init_model=init)
+    resilience.restore_training_state(bst_b, snap_state)
+    for _ in range(5):
+        bst_b.update()
+    assert bst_b.model_to_string() == ma
+
+
 def test_dart_resume_in_process_byte_identical():
     """DART's drop RNG + tree-weight ledger cross the snapshot boundary
     (the issue calls this out explicitly): resuming mid-run must replay

@@ -1,0 +1,271 @@
+"""Fused boosting window (boost_window=J, ISSUE 13).
+
+The house correctness bar: the window path — one donated lax.scan
+program per J boosting iterations, stacked [J*K] packed split records in
+one transfer, parked-tree consumption, snapshot-replay truncation at
+observation points — must produce BYTE-IDENTICAL final models to the
+sequential per-tree loop, for plain gbdt, bagging, multiclass and
+early-stop truncation, at J in {1, 2, 4}.  On top of the identity
+matrix: the steady-state zero-retrace pin stays green with windows on,
+and dispatch/fetch counts drop by the promised 1/J.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.runtime import syncs, xla_obs
+
+
+def _data(n=500, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.4 * X[:, 1]
+         + 0.3 * rng.standard_normal(n) > 0).astype(np.float64)
+    return X, y
+
+
+BASE = {"objective": "binary", "num_leaves": 15, "verbose": -1, "seed": 7}
+BAGGED = {**BASE, "bagging_freq": 2, "bagging_fraction": 0.7,
+          "feature_fraction": 0.8}
+
+
+def _train(params, X, y, rounds=8, **kw):
+    return lgb.train(dict(params), lgb.Dataset(X, label=y),
+                     num_boost_round=rounds, **kw)
+
+
+@pytest.fixture(scope="module")
+def problems():
+    """(X, y) plus the three sequential reference model strings the
+    identity matrix compares against (trained once per module)."""
+    X, y = _data()
+    rng = np.random.default_rng(1)
+    y3 = rng.integers(0, 3, len(y)).astype(np.float64)
+    refs = {
+        "gbdt": _train(BASE, X, y).model_to_string(),
+        "bagging": _train(BAGGED, X, y).model_to_string(),
+        "multiclass": _train({"objective": "multiclass", "num_class": 3,
+                              "num_leaves": 8, "verbose": -1, "seed": 7},
+                             X, y3, rounds=6).model_to_string(),
+    }
+    return X, y, y3, refs
+
+
+@pytest.mark.parametrize("J", [1, 2, 4])
+def test_identity_gbdt(problems, J):
+    X, y, _, refs = problems
+    m = _train({**BASE, "boost_window": J}, X, y)
+    assert m.model_to_string() == refs["gbdt"]
+
+
+@pytest.mark.parametrize("J", [2, 4])
+def test_identity_bagging(problems, J):
+    """Per-iteration bagging re-draws ride the window pre-draw off the
+    SAME host RNG stream the sequential loop consumes — masks, and
+    therefore models, are identical bits (freq=2 vs J=4 also exercises
+    a resample landing mid-window)."""
+    X, y, _, refs = problems
+    m = _train({**BAGGED, "boost_window": J}, X, y)
+    assert m.model_to_string() == refs["bagging"]
+
+
+@pytest.mark.parametrize("J", [2, 4])
+def test_identity_multiclass(problems, J):
+    """K trees per scan step off one pre-step score snapshot, exactly
+    like the sequential loop's snap+per-class fused steps."""
+    X, _, y3, refs = problems
+    m = _train({"objective": "multiclass", "num_class": 3, "num_leaves": 8,
+                "verbose": -1, "seed": 7, "boost_window": J}, X, y3,
+               rounds=6)
+    assert m.model_to_string() == refs["multiclass"]
+
+
+@pytest.mark.parametrize("J", [2, 4])
+def test_identity_early_stop_truncation(J):
+    """A no-split stop discovered INSIDE a window (min_data_in_leaf so
+    high that gains dry up within a few iterations) must leave exactly
+    the sequential loop's model — the stop lands through the parked-tree
+    drain, and the window iterations past it are never reported."""
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((80, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 8, "min_data_in_leaf": 35,
+         "verbose": -1, "seed": 3}
+    ref = _train(p, X, y, rounds=20)
+    win = _train({**p, "boost_window": J}, X, y, rounds=20)
+    assert win.model_to_string() == ref.model_to_string()
+    assert win.num_trees() == ref.num_trees()
+
+
+def test_truncation_mid_window_scores_and_model(problems):
+    """A raw-score observation landing mid-window truncates by exact
+    snapshot replay: the model AND the f32 training scores must equal
+    the never-windowed run's bits, and training continues correctly
+    afterwards (adaptive window shrinks instead of re-paying replay)."""
+    X, y, _, refs = problems
+    ref_b = lgb.Booster(dict(BAGGED), lgb.Dataset(X, label=y))
+    for _ in range(6):
+        ref_b.update()
+    s_ref = ref_b.model_to_string()
+    sc_ref = ref_b._engine.raw_train_score()
+
+    win_b = lgb.Booster({**BAGGED, "boost_window": 4},
+                        lgb.Dataset(X, label=y))
+    win_b.update()
+    win_b.update()
+    truncs0 = _trunc_count()
+    mid = win_b._engine.raw_train_score()          # observation point
+    assert _trunc_count() == truncs0 + 1
+    ref_mid = lgb.Booster(dict(BAGGED), lgb.Dataset(X, label=y))
+    ref_mid.update()
+    ref_mid.update()
+    assert np.array_equal(mid, ref_mid._engine.raw_train_score())
+    assert win_b._engine._win_adapt == 2            # adapted to the cut
+    for _ in range(4):
+        win_b.update()
+    assert win_b.model_to_string() == s_ref
+    assert np.array_equal(win_b._engine.raw_train_score(), sc_ref)
+
+
+def _trunc_count():
+    from lightgbm_tpu.runtime import telemetry
+    return telemetry.counter("lgbm_window_truncations_total").total()
+
+
+def test_model_view_mid_window_is_cheap_and_exact(problems):
+    """current_iteration()/model reads mid-window observe exactly the
+    reported iterations (parked trees never leak into the model) WITHOUT
+    truncating the window — the CLI's per-iteration snapshot-schedule
+    probe must not collapse windows to length 1."""
+    X, y, _, refs = problems
+    win_b = lgb.Booster({**BASE, "boost_window": 4},
+                        lgb.Dataset(X, label=y))
+    win_b.update()
+    win_b.update()
+    truncs0 = _trunc_count()
+    assert win_b.current_iteration() == 2
+    assert win_b.num_trees() == 2
+    mid_str = win_b.model_to_string()
+    assert _trunc_count() == truncs0, "model view must not truncate"
+    assert win_b._engine._win is not None, "window must stay open"
+    ref_mid = lgb.Booster(dict(BASE), lgb.Dataset(X, label=y))
+    ref_mid.update()
+    ref_mid.update()
+    assert mid_str == ref_mid.model_to_string()
+    for _ in range(6):
+        win_b.update()
+    assert win_b.model_to_string() == refs["gbdt"]
+
+
+def test_rollback_one_iter_mid_window(problems):
+    """rollback_one_iter landing mid-window: truncation settles the
+    window at the reported iteration first, then the ordinary rollback
+    runs — byte-identical to the sequential rollback."""
+    X, y, _, _refs = problems
+    ref_b = lgb.Booster(dict(BAGGED), lgb.Dataset(X, label=y))
+    for _ in range(3):
+        ref_b.update()
+    ref_b.rollback_one_iter()
+    for _ in range(3):
+        ref_b.update()
+
+    win_b = lgb.Booster({**BAGGED, "boost_window": 4},
+                        lgb.Dataset(X, label=y))
+    for _ in range(3):
+        win_b.update()
+    win_b.rollback_one_iter()
+    for _ in range(3):
+        win_b.update()
+    assert win_b.model_to_string() == ref_b.model_to_string()
+
+
+def test_reset_parameter_is_an_observation_point(problems):
+    """A learning-rate change mid-window must apply from the NEXT
+    reported iteration, exactly like the sequential loop — the window
+    that pre-trained ahead with the old rate is truncated."""
+    X, y, _, _refs = problems
+    ref_b = lgb.Booster(dict(BASE), lgb.Dataset(X, label=y))
+    ref_b.update()
+    ref_b.update()
+    ref_b.reset_parameter({"learning_rate": 0.23})
+    for _ in range(3):
+        ref_b.update()
+
+    win_b = lgb.Booster({**BASE, "boost_window": 4},
+                        lgb.Dataset(X, label=y))
+    win_b.update()
+    win_b.update()
+    win_b.reset_parameter({"learning_rate": 0.23})
+    for _ in range(3):
+        win_b.update()
+    assert win_b.model_to_string() == ref_b.model_to_string()
+
+
+def test_engine_train_with_valid_sets_disables_lookahead(problems):
+    """engine.train's horizon hint: an eval round every iteration means
+    the window must not run ahead at all — and the result is still
+    byte-identical (the window simply never engages)."""
+    X, y, _, _refs = problems
+    dv = lgb.Dataset(X[400:], label=y[400:])
+
+    def run(params):
+        return lgb.train(dict(params), lgb.Dataset(X[:400], label=y[:400]),
+                         num_boost_round=5, valid_sets=[dv],
+                         verbose_eval=False)
+
+    truncs0 = _trunc_count()
+    ref = run(BASE)
+    win = run({**BASE, "boost_window": 4})
+    assert win.model_to_string() == ref.model_to_string()
+    assert _trunc_count() == truncs0, \
+        "horizon hint must prevent mid-window truncations entirely"
+
+
+def test_window_zero_retrace_and_dispatch_reduction(problems):
+    """Steady state with windows on: N further iterations compile
+    NOTHING (the zero-retrace pin), and device-program dispatches plus
+    blocking fetches per iteration drop to <= 1/J of the sequential
+    path's."""
+    X, y, _, _refs = problems
+
+    def steady(params, iters=8):
+        bst = lgb.Booster(dict(params), lgb.Dataset(X, label=y))
+        for _ in range(4):                     # warm: compile + caches
+            bst.update()
+        bst._engine.flush()
+        c0 = xla_obs.snapshot()
+        d0 = xla_obs.calls_snapshot()
+        s0 = syncs.snapshot()
+        xla_obs.mark_steady(True)
+        try:
+            for _ in range(iters):
+                bst.update()
+            bst._engine.flush()
+        finally:
+            xla_obs.mark_steady(False)
+        return (xla_obs.delta(c0),
+                sum(xla_obs.calls_delta(d0).values()) / iters,
+                syncs.delta(s0)["total"] / iters)
+
+    retr_off, disp_off, fetch_off = steady(BASE)
+    retr_on, disp_on, fetch_on = steady({**BASE, "boost_window": 4})
+    assert retr_off == {}, retr_off
+    assert retr_on == {}, retr_on
+    assert disp_on <= disp_off / 4 + 1e-9, (disp_on, disp_off)
+    assert fetch_on <= fetch_off / 4 + 1e-9, (fetch_on, fetch_off)
+
+
+def test_window_ineligible_configs_fall_back():
+    """Configs outside the validated envelope (GOSS sampling, DART,
+    leaf renewal, profiling) train through the per-tree loop with
+    boost_window set — same models as without the flag."""
+    X, y = _data(n=300)
+    for extra in ({"boosting": "goss"},
+                  {"boosting": "dart", "drop_seed": 5},
+                  {"objective": "regression_l1"}):
+        p = {"objective": "binary", "num_leaves": 8, "verbose": -1,
+             "seed": 11, **extra}
+        yy = np.abs(y) if extra.get("objective") else y
+        ref = _train(p, X, yy, rounds=4)
+        win = _train({**p, "boost_window": 4}, X, yy, rounds=4)
+        assert win.model_to_string() == ref.model_to_string(), extra
