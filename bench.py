@@ -548,7 +548,7 @@ def bench_telemetry():
     ratio is recorded too, but timing noise makes the microbench-derived
     bound the honest assertion.  BENCH_TELEMETRY_{ROWS,ITERS} reshape."""
     import lightgbm_tpu as lgb
-    from lightgbm_tpu.runtime import telemetry
+    from lightgbm_tpu.runtime import telemetry, tracing
 
     rows = int(os.environ.get("BENCH_TELEMETRY_ROWS", 20_000))
     iters = int(os.environ.get("BENCH_TELEMETRY_ITERS", 8))
@@ -561,14 +561,18 @@ def bench_telemetry():
     bst._engine.flush()
 
     ops0 = telemetry.REGISTRY.ops
+    ev0 = tracing.ring_summary()["recorded_total"]
     t0 = time.perf_counter()
     for _ in range(iters):
         bst.update()
     bst._engine.flush()
     dt_on = time.perf_counter() - t0
     ops_per_iter = (telemetry.REGISTRY.ops - ops0) / iters
+    trace_events_per_iter = \
+        (tracing.ring_summary()["recorded_total"] - ev0) / iters
 
     prev = telemetry.set_enabled(False)
+    prev_tr = tracing.set_enabled(False)
     try:
         t0 = time.perf_counter()
         for _ in range(iters):
@@ -586,11 +590,21 @@ def bench_telemetry():
             h.observe(0.001)
             c.inc()
         call_cost_s = (time.perf_counter() - tm) / (2 * n)
+        # the trace recorder's disabled path rides the same contract
+        # (ISSUE 14): one global read + return per site
+        tm = time.perf_counter()
+        for _ in range(n):
+            tracing.instant("bench")
+            tracing.record("bench", 0, 0)
+        trace_call_cost_s = (time.perf_counter() - tm) / (2 * n)
     finally:
         telemetry.set_enabled(prev)
+        tracing.set_enabled(prev_tr)
 
     sec_per_iter_off = dt_off / iters
-    disabled_pct = (ops_per_iter * call_cost_s / sec_per_iter_off * 100
+    disabled_pct = ((ops_per_iter * call_cost_s
+                     + trace_events_per_iter * trace_call_cost_s)
+                    / sec_per_iter_off * 100
                     if sec_per_iter_off > 0 else 0.0)
     rec = {
         "rows": rows, "iters": iters,
@@ -600,15 +614,18 @@ def bench_telemetry():
         if dt_off > 0 else None,
         "ops_per_iter": round(ops_per_iter, 1),
         "disabled_call_cost_ns": round(call_cost_s * 1e9, 1),
+        "trace_events_per_iter": round(trace_events_per_iter, 1),
+        "trace_disabled_call_cost_ns": round(trace_call_cost_s * 1e9, 1),
         "disabled_path_overhead_pct": round(disabled_pct, 4),
-        "note": "disabled_path_overhead_pct = instrument call sites per "
-                "iteration x disabled per-call cost / iteration time; "
-                "asserted < 1%",
+        "note": "disabled_path_overhead_pct = (metric call sites + trace "
+                "event sites) per iteration x disabled per-call cost / "
+                "iteration time; asserted < 1%",
     }
     if disabled_pct >= 1.0:
         raise RuntimeError(
-            "telemetry disabled-path overhead %.3f%% >= 1%% of an "
-            "iteration — the instrumentation seam regressed" % disabled_pct)
+            "telemetry+tracing disabled-path overhead %.3f%% >= 1%% of "
+            "an iteration — the instrumentation seam regressed"
+            % disabled_pct)
     return rec
 
 

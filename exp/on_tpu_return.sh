@@ -205,3 +205,22 @@ BENCH_WINDOW=4 BENCH_PREDICT=0 BENCH_SERVE=0 BENCH_ONLINE=0 BENCH_INGEST=0 \
   > /tmp/bench_window_tpu.json \
   && python -c "import json; d=json.load(open('/tmp/bench_window_tpu.json')); print(json.dumps({'window': d.get('window'), 'dispatches_per_iter': d.get('attrib',{}).get('per_iter',{}).get('dispatches_per_iter')}, indent=1))" \
   || echo "   window A/B FAILED on hardware — /tmp/bench_window_tpu.json + stderr have the ledger"
+echo "=== 13. end-to-end trace capture on hardware (ISSUE 14) ==="
+echo "    (the causal counterpart of step 9's BENCH_ATTRIB averages: a"
+echo "     merged Perfetto timeline of one 2-replica prod-sim fleet on"
+echo "     the real chip — loadgen -> serving -> DEVICE batch -> drain"
+echo "     chains plus the trainer cycle -> publish -> subscriber links,"
+echo "     with every sampled request's stage sum gated against its"
+echo "     client-observed latency at one bucket width.  On hardware the"
+echo "     device_s stage is real accelerator time, so THIS is where the"
+echo "     ~90 ms/tree round trip and any p99 spike become attributable"
+echo "     per-request instead of on average.  COMMIT the artifact as"
+echo "     TRACE_r<round>.json alongside BENCH_ATTRIB; load the 'trace'"
+echo "     member in https://ui.perfetto.dev to read it.)"
+PROD_SIM_TRACE_OUT=/tmp/trace_tpu.json timeout 600 \
+  python exp/prod_sim.py /tmp/sim_trace_tpu.json --quick \
+  && python -c "import json; d=json.load(open('/tmp/trace_tpu.json')); print(json.dumps({'ok': d['ok'], 'gates': d['gates'], 'stage_sum': d['stage_sum']}, indent=1))" \
+  || echo "   trace capture FAILED — /tmp/trace_tpu.json + replica logs have the ledger"
+echo "    (ad-hoc capture on any task: LGBM_TPU_TRACE_DIR=/tmp/traces"
+echo "     python -m lightgbm_tpu task=... ; then"
+echo "     python -m lightgbm_tpu.runtime.tracing merge out.json /tmp/traces/trace_*.json)"

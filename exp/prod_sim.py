@@ -34,6 +34,7 @@ Env:    PROD_SIM_SEED, PROD_SIM_REPLICAS, PROD_SIM_DURATION
 """
 from __future__ import annotations
 
+import glob
 import json
 import math
 import os
@@ -49,9 +50,18 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from lightgbm_tpu.runtime import publish, resilience, telemetry  # noqa: E402
+from lightgbm_tpu.runtime import publish, resilience, telemetry, \
+    tracing  # noqa: E402
 
 SCHEMA_VERSION = 1
+
+#: trace-artifact schema (ISSUE 14): the merged Perfetto timeline +
+#: machine gates committed as TRACE_r*.json
+TRACE_SCHEMA_VERSION = 1
+
+#: merged-trace size bound for the committed artifact (newest slices
+#: kept; the cut is recorded, never silent)
+TRACE_MAX_EVENTS = 20000
 
 #: serving-side fault windows a replica's churn thread draws from
 #: (None = quiet step); the armed fault kills or stalls every device
@@ -198,6 +208,7 @@ def run_replica(cfg: Dict[str, Any]) -> Dict[str, Any]:
     from lightgbm_tpu.runtime.policy import AutoscaleShedPolicy
     from lightgbm_tpu.runtime.serving import ServingRuntime
 
+    tracing.set_context("replica_%s" % cfg["scenario"])
     spec = SCENARIOS[cfg["scenario"]]
     rng = np.random.default_rng(cfg["seed"])
     probe = rng.standard_normal((64, spec["n_features"]))
@@ -232,7 +243,11 @@ def run_replica(cfg: Dict[str, Any]) -> Dict[str, Any]:
                         ledger=faults)
     gen = LoadGenerator(rt, classes, shape, cfg["duration_s"], probe,
                         seed=cfg["seed"], verifier=verifier,
-                        deadline_s=float(cfg.get("deadline_s", 2.0)))
+                        deadline_s=float(cfg.get("deadline_s", 2.0)),
+                        # ISSUE 14: every 8th request is traced end to
+                        # end; the ledger's `trace` section carries the
+                        # stage-sum-vs-client-latency accounting
+                        trace_every=int(cfg.get("trace_every", 8)))
     churn.start()
     try:
         ledger = gen.run()
@@ -244,6 +259,9 @@ def run_replica(cfg: Dict[str, Any]) -> Dict[str, Any]:
     time.sleep(0.3)
     stats = rt.stats()
     rt.stop()
+    # flush this replica's flight recorder now (the atexit dump would
+    # fire too, but an explicit flush cannot be lost to a hard exit)
+    tracing.export_to_dir()
     return {
         "ledger": ledger,
         "stats": {k: stats[k] for k in
@@ -343,6 +361,21 @@ def collate_scenario(name: str, replica_records: List[Dict[str, Any]],
         }
 
     faults = sum((r["faults_injected"] for r in replica_records), [])
+    # per-request stage decomposition accounting (ISSUE 14): every
+    # sampled request's queue/gather/device/drain sum must land within
+    # one latency-bucket width of its client-observed latency
+    trace_secs = [led.get("trace") for led in ledgers
+                  if led.get("trace")]
+    trace_sec = {
+        "sampled": sum(t["sampled"] for t in trace_secs),
+        "with_stages": sum(t["with_stages"] for t in trace_secs),
+        "stage_sum_within_bucket": sum(t["stage_sum_within_bucket"]
+                                       for t in trace_secs),
+        "stage_sum_max_err_s": max(
+            (t["stage_sum_max_err_s"] for t in trace_secs
+             if t["stage_sum_max_err_s"] is not None), default=None),
+        "ok": bool(trace_secs) and all(t["ok"] for t in trace_secs),
+    } if trace_secs else None
     sec = {
         "objective": SCENARIOS[name]["objective"],
         "replicas": n_rep,
@@ -383,6 +416,8 @@ def collate_scenario(name: str, replica_records: List[Dict[str, Any]],
         sum(c["completed"] for c in led["classes"].values())
         for led in ledgers)
     sec["verified_total"] = int(sum(verify.values()))
+    if trace_sec is not None:
+        sec["trace"] = trace_sec
     wrong = sec["verification"].get("wrong_generation", 0) \
         + sec["verification"].get("mismatch", 0) \
         + sec["verification"].get("unverifiable", 0)
@@ -395,8 +430,58 @@ def collate_scenario(name: str, replica_records: List[Dict[str, Any]],
         and trainer_info.get("generations", 0) >= 2
         and min(g or 0 for g in sec["final_generations"]) >= 2
         # churn must actually have pushed traffic onto the host path
-        and (not faults or sec["served_by"]["host"] > 0))
+        and (not faults or sec["served_by"]["host"] > 0)
+        # sampled tracing ran: every stage sum within its bucket width
+        and (trace_sec is None or trace_sec["ok"]))
     return sec
+
+
+# ---------------------------------------------------------------------------
+# merged-trace verification (the TRACE_r* artifact's machine gates)
+# ---------------------------------------------------------------------------
+
+def verify_merged_trace(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Machine gates over one merged timeline (ISSUE 14 acceptance):
+
+    * ``request_chain_ok`` — some trace id carries a loadgen client
+      span AND the server-side device + drain stage slices (the
+      loadgen → serving → device batch → drain chain);
+    * ``publish_link_ok`` — some publish flow arrow starts in one
+      process (the trainer) and ends in ANOTHER (a replica's swap-in):
+      the trainer cycle → publish → subscriber link;
+    * ``cycle_spans`` / ``serve_batches`` — both sides of the system
+      actually recorded their timelines.
+    """
+    evs = doc.get("traceEvents", [])
+    by_trace: Dict[str, set] = {}
+    for e in evs:
+        t = (e.get("args") or {}).get("trace")
+        if t:
+            by_trace.setdefault(t, set()).add(str(e.get("name")))
+    request_chain = sum(
+        1 for names in by_trace.values()
+        if {"req/device", "req/drain"} <= names
+        and any(n.startswith("client request") for n in names))
+    s_pids = {e.get("id"): e.get("pid") for e in evs if e.get("ph") == "s"}
+    cross_links = sum(1 for e in evs if e.get("ph") == "f"
+                      and e.get("id") in s_pids
+                      and e.get("pid") != s_pids[e.get("id")])
+    cycles = sum(1 for e in evs
+                 if str(e.get("name", "")).startswith("cycle "))
+    batches = sum(1 for e in evs if e.get("name") == "serve batch")
+    rec = {
+        "events": len([e for e in evs if e.get("ph") != "M"]),
+        "processes": len({e.get("pid") for e in evs}),
+        "request_chains": request_chain,
+        "request_chain_ok": request_chain > 0,
+        "publish_cross_process_links": cross_links,
+        "publish_link_ok": cross_links > 0,
+        "cycle_spans": cycles,
+        "serve_batches": batches,
+    }
+    rec["ok"] = bool(rec["request_chain_ok"] and rec["publish_link_ok"]
+                     and cycles > 0 and batches > 0)
+    return rec
 
 
 # ---------------------------------------------------------------------------
@@ -420,6 +505,15 @@ def run_scenario(name: str, workdir: str, replicas: int = 2,
     env = dict(os.environ)
     env.pop("LGBM_TPU_FAULT", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # every process of the fleet self-collects its trace ring here
+    # (ISSUE 14): the trainer's cycles + publishes, each replica's
+    # requests/batches/swaps — merged below into ONE timeline
+    traces_dir = os.path.join(sdir, "traces")
+    env[tracing.TRACE_DIR_ENV] = traces_dir
+    # one causal umbrella for the scenario's whole fleet: every child's
+    # root spans parent under this context (the env-seed passthrough)
+    env[tracing.TRACEPARENT_ENV] = tracing.make_traceparent(
+        tracing.new_trace_id(), tracing.new_span_id())
 
     # -- the continuous trainer: its own process, publishing forever ------
     train_args = ["task=train_online", "data=" + data_path,
@@ -506,6 +600,18 @@ def run_scenario(name: str, workdir: str, replicas: int = 2,
         "exit_rc": trainer.returncode,
     }
     sec = collate_scenario(name, records, duration_s, trainer_info)
+    # fuse the fleet's per-process trace rings into ONE timeline and
+    # gate it: the request chain and the publish→subscriber link must
+    # both be visible in the merged view (ISSUE 14 acceptance)
+    trace_files = sorted(glob.glob(os.path.join(traces_dir, "trace_*.json")))
+    if trace_files:
+        merged_path = os.path.join(sdir, "trace_merged.json")
+        merged = tracing.merge_traces(trace_files, out_path=merged_path,
+                                      max_events=TRACE_MAX_EVENTS)
+        sec["trace_merged"] = dict(verify_merged_trace(merged),
+                                   files=len(trace_files),
+                                   file=merged_path)
+        sec["ok"] = bool(sec["ok"] and sec["trace_merged"]["ok"])
     log("prod_sim[%s]: ok=%s offered=%d p99=%.3fs staleness_p50=%.1fs "
         "capacity=%.0f rows/s/replica sheds=%s gens=%s"
         % (name, sec["ok"], sec["offered_total"],
@@ -555,10 +661,55 @@ def main(argv: List[str]) -> int:
     replicas = int(os.environ.get("PROD_SIM_REPLICAS", "2"))
     duration = float(os.environ.get("PROD_SIM_DURATION",
                                     "8" if quick else "20"))
+    trace_out = os.environ.get("PROD_SIM_TRACE_OUT")
     with tempfile.TemporaryDirectory(prefix="lgbm_prod_sim_") as wd:
         rec = run_sim(wd, scenarios=["binary"] if quick else None,
                       replicas=replicas, duration_s=duration,
                       interval_s=2.0 if quick else 3.0, seed=seed)
+        # the committed trace artifact (ISSUE 14): ONE merged Perfetto
+        # timeline (loadgen → serving → device → drain chain + trainer
+        # cycle → publish → subscriber link) with its machine gates —
+        # built while the workdir still holds the per-process rings
+        if trace_out:
+            merged_doc = None
+            gates = {}
+            for name, sec in rec["scenarios"].items():
+                tm = sec.get("trace_merged")
+                if tm is None:
+                    continue
+                gates[name] = {k: v for k, v in tm.items() if k != "file"}
+                if merged_doc is None and os.path.exists(tm["file"]):
+                    with open(tm["file"]) as fh:
+                        merged_doc = json.load(fh)
+            trace_art = {
+                "artifact": os.path.splitext(
+                    os.path.basename(trace_out))[0],
+                "schema_version": TRACE_SCHEMA_VERSION,
+                "replicas": replicas,
+                "seed": seed,
+                "gates": gates,
+                "stage_sum": {name: sec.get("trace")
+                              for name, sec in rec["scenarios"].items()},
+                "ok": bool(gates) and all(g["ok"] for g in gates.values())
+                and all((sec.get("trace") or {}).get("ok")
+                        for sec in rec["scenarios"].values()),
+                "trace": merged_doc,
+            }
+            resilience.atomic_write(trace_out,
+                                    json.dumps(trace_art) + "\n")
+            print("prod_sim: trace artifact ok=%s -> %s (%d events, "
+                  "%d processes)"
+                  % (trace_art["ok"], trace_out,
+                     (merged_doc or {}).get("otherData", {})
+                     .get("events", 0),
+                     max((g.get("processes", 0)
+                          for g in gates.values()), default=0)),
+                  flush=True)
+        for sec in rec["scenarios"].values():
+            # the merged-trace file lives in the (deleted) workdir; keep
+            # the gates, drop the dangling path from the SIM artifact
+            if "trace_merged" in sec:
+                sec["trace_merged"].pop("file", None)
     # a malformed artifact must fail loudly, not land in the repo
     from helper.bench_history import validate_sim_artifact
     problems = validate_sim_artifact(rec)

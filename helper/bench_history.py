@@ -72,13 +72,39 @@ SERIES: Tuple[Tuple[str, Tuple[str, ...], Tuple[Tuple[str, ...], ...]], ...] = (
 #: like SERIES but LOWER is better — a RISE past the threshold flags.
 #: dispatches_per_iter is BENCH_ATTRIB's device-program launch count per
 #: iteration (ISSUE 13): the boost_window collapse of the dispatch loop
-#: must not silently regress between rounds.
+#: must not silently regress between rounds.  ISSUE 14 adds the rest of
+#: the attrib decomposition (dispatch / device-wait / drain, reported in
+#: ms): the per-piece trajectory across BENCH_r*/BENCH_WINDOW_r* rounds
+#: is what tells the next hardware window WHICH piece moved.
 SERIES_LOWER: Tuple[Tuple[str, Tuple[str, ...],
                           Tuple[Tuple[str, ...], ...]], ...] = (
     ("dispatches_per_iter",
      ("attrib", "per_iter", "dispatches_per_iter"),
      (("n_rows",), ("platform",))),
+    ("attrib_dispatch_ms",
+     ("attrib", "per_iter", "dispatch_s"),
+     (("n_rows",), ("platform",))),
+    ("attrib_device_wait_ms",
+     ("attrib", "per_iter", "device_wait_s"),
+     (("n_rows",), ("platform",))),
+    ("attrib_drain_ms",
+     ("attrib", "per_iter", "drain_s"),
+     (("n_rows",), ("platform",))),
 )
+
+#: value transform per series (the attrib seconds render as ms)
+_SERIES_SCALE: Dict[str, float] = {
+    "attrib_dispatch_ms": 1000.0,
+    "attrib_device_wait_ms": 1000.0,
+    "attrib_drain_ms": 1000.0,
+}
+
+
+def _series_value(rec: Any, name: str, path: Tuple[str, ...]) -> Any:
+    v = _get(rec, path)
+    if isinstance(v, (int, float)) and name in _SERIES_SCALE:
+        return round(v * _SERIES_SCALE[name], 3)
+    return v
 
 
 def _get(d: Any, path: Tuple[str, ...]) -> Optional[Any]:
@@ -119,18 +145,26 @@ def _parse_artifact(path: str) -> Optional[Dict[str, Any]]:
 
 
 def load_rounds(repo: str = REPO) -> List[Dict[str, Any]]:
-    """Every parseable BENCH_r*.json, sorted by round number."""
+    """Every parseable BENCH_r*.json AND BENCH_WINDOW_r*.json, sorted by
+    round number.  The window A/B artifacts carry the same parsed bench
+    JSON (incl. the ``attrib`` section) at their own shape, so the
+    same-shape guard keeps them from ever being compared against the
+    full-scale rounds."""
     rounds = []
-    for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
-        m = re.search(r"BENCH_r(\d+)\.json$", path)
-        if not m:
-            continue
-        rec = _parse_artifact(path)
-        if rec is not None:
-            rec.setdefault("_round", int(m.group(1)))
-            rec["_file"] = os.path.basename(path)
-            rounds.append(rec)
-    return sorted(rounds, key=lambda r: r["_round"])
+    for stem, pattern in (("BENCH_r*.json", r"BENCH_r(\d+)\.json$"),
+                          ("BENCH_WINDOW_r*.json",
+                           r"BENCH_WINDOW_r(\d+)\.json$")):
+        for path in glob.glob(os.path.join(repo, stem)):
+            m = re.search(pattern, path)
+            if not m:
+                continue
+            rec = _parse_artifact(path)
+            if rec is not None:
+                if not rec.get("_round"):
+                    rec["_round"] = int(m.group(1))
+                rec["_file"] = os.path.basename(path)
+                rounds.append(rec)
+    return sorted(rounds, key=lambda r: (r["_round"], r["_file"]))
 
 
 def trajectory(rounds: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
@@ -144,7 +178,7 @@ def trajectory(rounds: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             "sec_per_iter": rec.get("sec_per_iter"),
         }
         for name, path, _ in SERIES + SERIES_LOWER:
-            v = _get(rec, path)
+            v = _series_value(rec, name, path)
             if v is not None:
                 row[name] = v
         rows.append(row)
@@ -163,7 +197,7 @@ def regressions(rounds: List[Dict[str, Any]],
             [s + (False,) for s in SERIES_LOWER]:
         best: Dict[Tuple, Tuple[float, int]] = {}
         for rec in rounds:
-            v = _get(rec, path)
+            v = _series_value(rec, name, path)
             if not isinstance(v, (int, float)):
                 continue
             shape = tuple(repr(_get(rec, sp)) for sp in shape_paths)

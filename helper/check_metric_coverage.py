@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Metric-coverage lint (ISSUE 14 satellite — lint #5 in ci_checks).
+
+`telemetry.METRIC_TABLE` is the single registry of every product metric
+and docs/OBSERVABILITY.md is pinned row-for-row against it — but
+nothing guaranteed a declared family is actually ARMED: a metric nobody
+instruments is worse than none (it documents an observable that has
+never once been observed — the fault-coverage-lint argument, applied to
+the instrument panel).
+
+This lint scans ``lightgbm_tpu/**/*.py`` and ``exp/*.py`` (plus
+``bench.py``, which arms the bench-only reads) for every METRIC_TABLE
+family name appearing as an INSTRUMENT CONSTRUCTOR call —
+``counter("name")`` / ``gauge("name")`` / ``histogram("name")`` with
+the name as a string literal — so the table's own declaration block
+(where every name trivially appears as a dict key) can never arm
+anything.  Every family must have at least one call site.
+
+Run standalone (``python helper/check_metric_coverage.py``; exit 1 on a
+gap) or through ``helper/ci_checks.py``; ``tests/test_ci_checks.py``
+pins the committed tree green AND the drift negative (a fabricated
+table entry IS reported).
+"""
+from __future__ import annotations
+
+import glob
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _scan_paths(repo: str) -> List[str]:
+    paths = sorted(glob.glob(os.path.join(repo, "lightgbm_tpu", "**",
+                                          "*.py"), recursive=True))
+    paths += sorted(glob.glob(os.path.join(repo, "exp", "*.py")))
+    bench = os.path.join(repo, "bench.py")
+    if os.path.exists(bench):
+        paths.append(bench)
+    return paths
+
+
+def _call_site_re(name: str) -> "re.Pattern":
+    """An arming call site: an instrument constructor taking the family
+    name as a literal — `counter("x")`, `REGISTRY.histogram('x')`,
+    `telemetry.gauge("x")` all match; a bare mention (a dict key, a
+    docstring, a scraped read of a snapshot) does not."""
+    return re.compile(
+        r"\b(?:counter|gauge|histogram)\(\s*[rbu]*['\"]%s['\"]"
+        % re.escape(name))
+
+
+def coverage(table: Optional[Dict] = None,
+             repo: str = REPO) -> Dict[str, List[str]]:
+    """{family name: [files with an arming call site]}."""
+    if table is None:
+        from lightgbm_tpu.runtime.telemetry import METRIC_TABLE
+        table = METRIC_TABLE
+    blobs = []
+    for path in _scan_paths(repo):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                blobs.append((os.path.relpath(path, repo), fh.read()))
+        except OSError:
+            continue
+    hits: Dict[str, List[str]] = {}
+    for name in table:
+        pat = _call_site_re(name)
+        hits[name] = [rel for rel, blob in blobs if pat.search(blob)]
+    return hits
+
+
+def run(table: Optional[Dict] = None, repo: str = REPO) -> List[str]:
+    """Drift problems (empty = every declared family is armed)."""
+    hits = coverage(table, repo)
+    return ["metric %r is declared in METRIC_TABLE but no instrument "
+            "call site in lightgbm_tpu/ or exp/ arms it — an observable "
+            "nobody ever observes is dead weight in the catalog" % name
+            for name, files in sorted(hits.items()) if not files]
+
+
+def main(argv=None) -> int:
+    hits = coverage()
+    problems = run()
+    for name, files in sorted(hits.items()):
+        print("%-40s %s" % (name, ", ".join(files[:3]) or "UNARMED"))
+    for p in problems:
+        print("DRIFT: %s" % p)
+    if not problems:
+        print("check_metric_coverage: OK (%d families, all armed)"
+              % len(hits))
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

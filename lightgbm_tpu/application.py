@@ -62,6 +62,18 @@ class Application:
     def __init__(self, argv: List[str]):
         self.raw_params = parse_parameters(argv)
         self.task = self.raw_params.pop("task", "train")
+        # distributed-tracing knobs (ISSUE 14): trace_dir= arms the
+        # atexit flight-recorder dump (same as $LGBM_TPU_TRACE_DIR —
+        # subprocesses inherit the env form), trace=false disables the
+        # recorder entirely (the <1% disabled-path pin covers the cost)
+        from .runtime import tracing
+        trace_dir = self.raw_params.pop("trace_dir", None)
+        if trace_dir:
+            os.environ[tracing.TRACE_DIR_ENV] = trace_dir
+        if str(self.raw_params.pop("trace", "")).lower() in ("false", "0"):
+            tracing.set_enabled(False)
+        tracing.set_context(self.task)
+        tracing.maybe_autostart()
 
     def run(self) -> None:
         if self.task in ("train", "refit"):

@@ -28,7 +28,7 @@ from ..io.dataset import BinnedDataset
 from ..models.gbdt_model import GBDTModel
 from ..models.tree import Tree
 from ..ops.split import FeatureMeta
-from ..runtime import resilience, syncs, telemetry, xla_obs
+from ..runtime import resilience, syncs, telemetry, tracing, xla_obs
 from ..utils import compat
 from ..utils.log import Log
 from ..utils.random import Random, partition_seed
@@ -1479,7 +1479,12 @@ class GBDT:
         prog = fs.window_program(J, bag_on)
         bag_dev = (jnp.asarray(bag_rows) if bag_on
                    else jnp.zeros((J, 1), jnp.float32))
-        with syncs.critical_path():
+        # the window dispatch as a named span (ISSUE 14): the J stays in
+        # the series name (telemetry.SPAN_KEEP_KEYS) — J=2 and J=4
+        # windows are different stages, and the trace slice shows which
+        # iteration paid this dispatch
+        with telemetry.span("window dispatch J=%d" % J), \
+                syncs.critical_path():
             recs, fs.payload, fs.aux = prog(fs.payload, fs.aux,
                                             jnp.asarray(fmasks), bag_dev,
                                             jnp.float32(lr))
@@ -1692,6 +1697,9 @@ class GBDT:
             self._assembler = TreeAssembler(self._pipeline_depth)
         it = self.iter
         t_dispatch = time.monotonic()
+        # dispatch mark on the causal timeline: the matching drain span
+        # lands on the assembler thread under the same iteration context
+        tracing.instant("tree dispatch", it=it, k=k)
 
         def host_half():
             host = _fetch_packed(out, label="pipeline_drain")

@@ -33,7 +33,7 @@ import collections
 import threading
 from typing import Callable, Deque, Optional, Tuple
 
-from ..runtime import telemetry
+from ..runtime import telemetry, tracing
 
 
 class TreeAssembler:
@@ -67,6 +67,12 @@ class TreeAssembler:
         counts), bounding how far the device runs ahead.  A deferred
         error from an earlier unit re-raises here rather than silently
         dropping trees."""
+        # cross-thread trace propagation (ISSUE 14): the host half runs
+        # on the worker thread but belongs to the dispatching iteration's
+        # causal chain — capture the dispatcher's context here and replay
+        # it (plus a drain span) around the deferred fn.  Disabled
+        # tracing returns fn unchanged.
+        fn = tracing.bind(fn, "assembler/drain", trees=trees)
         with self._cv:
             if self._error is not None:
                 err, self._error = self._error, None

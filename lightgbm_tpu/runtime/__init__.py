@@ -12,6 +12,7 @@ a serving runtime is actually being run.)
 from . import publish  # noqa: F401
 from . import resilience  # noqa: F401
 from . import telemetry  # noqa: F401
+from . import tracing  # noqa: F401
 from . import xla_obs  # noqa: F401
 
 #: the observability surface (ISSUE 9): `from lightgbm_tpu.runtime import
@@ -20,4 +21,5 @@ from . import xla_obs  # noqa: F401
 #: obs.METRIC_TABLE.
 obs = telemetry
 
-__all__ = ["resilience", "publish", "telemetry", "obs", "xla_obs"]
+__all__ = ["resilience", "publish", "telemetry", "obs", "tracing",
+           "xla_obs"]

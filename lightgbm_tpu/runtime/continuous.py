@@ -46,7 +46,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from . import publish, quality, resilience, syncs, telemetry, xla_obs
+from . import publish, quality, resilience, syncs, telemetry, tracing, \
+    xla_obs
 from ..utils.log import LightGBMError, Log
 
 __all__ = ["ContinuousTrainer", "OnlineParams"]
@@ -553,8 +554,12 @@ class ContinuousTrainer:
             self.wd("recover: republish generation %d" % done_cycles)
             text = resilience.snapshot_model_text(snap_path)
             if text is not None:
-                self.publisher.publish(text, meta=self._gen_meta(
-                    done_cycles, total), generation=done_cycles)
+                # the republish runs before any cycle span exists: open
+                # one so this generation's meta carries THIS process's
+                # fresh trace context like every other publish
+                with tracing.span("recover republish %d" % done_cycles):
+                    self.publisher.publish(text, meta=self._gen_meta(
+                        done_cycles, total), generation=done_cycles)
                 self.log.info("online: republished generation %d from the "
                               "snapshot", done_cycles)
         return done_cycles
@@ -572,9 +577,19 @@ class ContinuousTrainer:
         return int(latest.meta.get("cycle", latest.generation))
 
     def _gen_meta(self, cycle: int, total_iter: int) -> Dict[str, Any]:
-        return {"cycle": cycle, "total_iter": int(total_iter),
+        meta = {"cycle": cycle, "total_iter": int(total_iter),
                 "mode": self.cfg.mode, "rounds_per_cycle": self.cfg.rounds,
                 "window_rows": self.cfg.window_rows}
+        # the producing cycle's trace context rides the publish meta
+        # (ISSUE 14): a served response links back to the training cycle
+        # that made its model, across the process boundary.  A relaunch
+        # opens a FRESH trace, but every pre-kill generation keeps the
+        # dead process's context in its footer — the lineage stays
+        # linkable across preemptions.
+        tp = tracing.current_traceparent()
+        if tp is not None:
+            meta["trace"] = tp
+        return meta
 
     # -- pre-publish eval gate (ISSUE 12 stage two) --------------------------
     def _gate_split(self, X, y, q=None) -> Tuple:
@@ -714,6 +729,14 @@ class ContinuousTrainer:
         return 0
 
     def _run_cycle(self, cycle: int, producer, guard) -> None:
+        # one trace per cycle (ISSUE 14): the root span every watchdog
+        # stage close, dispatch mark and assembler drain of this cycle
+        # records under; its traceparent rides the published meta so the
+        # serving side can link responses back to this exact cycle
+        with tracing.span("cycle %d" % cycle, cycle=cycle):
+            self._run_cycle_traced(cycle, producer, guard)
+
+    def _run_cycle_traced(self, cycle: int, producer, guard) -> None:
         cfg = self.cfg
 
         # -- ingest: adopt a fresh window if the producer staged one ---------

@@ -18,6 +18,9 @@ post-mortem needs into one atomic tar with a checksummed manifest:
   mesh view when the process is part of a multi-host run);
 * **compile ledger** — `xla_obs.LEDGER.to_json()`: per-site compiles,
   wall time, last shapes, steady-state retraces;
+* **trace ring** — the ISSUE 14 flight recorder's bounded event ring as
+  Perfetto-loadable Chrome trace JSON (``trace.json``): the causal
+  timeline of the last moments before the crash;
 * **recent artifacts** — the newest ``BENCH_* / CHAOS* / MULTICHIP*``
   JSONs found next to the repo (size-capped).
 
@@ -41,7 +44,7 @@ import tarfile
 import time
 from typing import Any, Dict, List, Optional
 
-from . import resilience, telemetry, xla_obs
+from . import resilience, telemetry, tracing, xla_obs
 
 __all__ = ["collect_debug_bundle", "verify_bundle", "env_fingerprint"]
 
@@ -163,6 +166,11 @@ def collect_debug_bundle(out_dir: str = ".",
                lambda: resilience.probe_platform(deadline=probe_deadline))
     gather("metrics.json", _metrics_member)
     gather("xla_ledger.json", lambda: xla_obs.LEDGER.to_json())
+    # the trace flight recorder's ring (ISSUE 14): the causal timeline
+    # of the process's last TRACE_RING_EVENTS events, Perfetto-loadable
+    # straight out of the bundle
+    gather("trace.json", lambda: tracing.export_chrome(
+        context_name="doctor"))
 
     def _trails() -> None:
         members.update(_stage_trail_members(stage_reports))

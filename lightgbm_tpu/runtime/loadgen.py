@@ -40,8 +40,20 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from . import publish, telemetry
+from . import publish, telemetry, tracing
 from .serving import ServeRejected
+
+
+def _bucket_width_at(value: float) -> float:
+    """Width of the fixed-layout latency bucket `value` falls in — the
+    tolerance the stage-sum-vs-latency pin is allowed (one bucket)."""
+    b = telemetry.LATENCY_BUCKETS_S
+    i = 0
+    while value > b[i]:
+        i += 1
+    if math.isinf(b[i]):
+        i = len(b) - 2
+    return b[i] - (b[i - 1] if i > 0 else 0.0)
 
 __all__ = ["TrafficShape", "RequestClass", "ResponseVerifier",
            "LoadGenerator", "poisson_arrivals"]
@@ -236,7 +248,15 @@ class LoadGenerator:
                  shape: TrafficShape, duration_s: float,
                  probe: np.ndarray, seed: int = 0,
                  verifier: Optional[ResponseVerifier] = None,
-                 deadline_s: float = 2.0, waiters: int = 8):
+                 deadline_s: float = 2.0, waiters: int = 8,
+                 trace_every: int = 0):
+        """`trace_every=K` (ISSUE 14) traces every K-th offered request:
+        a fresh trace id travels to the server as the submit's
+        traceparent (the server records queue/gather/device/drain slices
+        under it), the client-side wait is recorded as the root span,
+        and the response's stage decomposition is collected into the
+        ledger's ``trace`` section with the stage-sum-vs-client-latency
+        error accounted per sample.  0 disables sampling."""
         if not classes:
             raise ValueError("LoadGenerator needs at least one RequestClass")
         self.runtime = runtime
@@ -248,6 +268,9 @@ class LoadGenerator:
         self.verifier = verifier
         self.deadline_s = float(deadline_s)
         self.waiters = max(int(waiters), 1)
+        self.trace_every = max(int(trace_every), 0)
+        self.trace_samples: List[Dict[str, Any]] = []
+        self._trace_lock = threading.Lock()
 
         self.offered: Dict[str, int] = {c.name: 0 for c in self.classes}
         self.completed: Dict[str, int] = {c.name: 0 for c in self.classes}
@@ -287,6 +310,67 @@ class LoadGenerator:
                 # queue share and undercount verification
                 self.hard_errors.append("%s: %s" % (type(e).__name__, e))
 
+    def _trace_waiter(self, req, cls: RequestClass, ctx,
+                      t_submit: float) -> None:
+        """Dedicated waiter for ONE sampled request: the client clock
+        must stop when the response ARRIVES, so a sampled request never
+        sits behind head-of-line peers in the shared waiter pool's FIFO
+        (that queueing is loadgen overhead, not observed latency)."""
+        try:
+            rec = req.wait(timeout=self.deadline_s
+                           + self.runtime.predict_deadline_s + 10.0)
+        except BaseException:       # noqa: BLE001 — sheds/errors are the
+            return                  # shared pool's ledger, not a sample
+        self._record_trace_sample(rec, req, cls, ctx, t_submit)
+
+    def _record_trace_sample(self, rec, req, cls: RequestClass,
+                             ctx, t_submit: float) -> None:
+        """Close one sampled request's client-side root span and account
+        its server stage decomposition against the CLIENT-observed
+        latency (the acceptance pin: stage sum within one bucket width).
+
+        Client-observed latency = submit call to response READY, both on
+        the client's own clock reads: ``t_submit`` is taken before the
+        submit call, and readiness is the request's completion stamp
+        (``enqueued + latency_s`` on the same monotonic clock — what a
+        TCP client's socket read would see modulo the wire).  The
+        further gap until this waiter thread actually WAKES is recorded
+        separately as ``delivery_s``: on an oversubscribed host (the
+        1-core CI box) the scheduler's wake-up delay is real, but it is
+        client-runtime noise, not server time — folding it into the pin
+        would make the gate flake exactly where the decomposition is
+        most precise (sub-10 ms requests)."""
+        t_wake = time.monotonic()
+        t_ready = req.enqueued + rec.latency_s
+        client_latency = max(t_ready - t_submit, 0.0)
+        tracing.record("client request %s" % cls.name,
+                       int(t_submit * 1e9),
+                       int(client_latency * 1e9),
+                       trace=ctx[0], span_id=ctx[1],
+                       cls=cls.name, served_by=rec.served_by,
+                       generation=rec.generation,
+                       model_trace=rec.model_trace)
+        stage_sum = round(sum(rec.stages.values()), 6) if rec.stages \
+            else None
+        sample = {
+            "cls": cls.name,
+            "client_latency_s": round(client_latency, 6),
+            "server_latency_s": rec.latency_s,
+            "delivery_s": round(max(t_wake - t_ready, 0.0), 6),
+            "stages": dict(rec.stages),
+            "stage_sum_s": stage_sum,
+            "stage_sum_err_s": round(abs(stage_sum - client_latency), 6)
+            if stage_sum is not None else None,
+            "bucket_width_s": _bucket_width_at(client_latency),
+            "served_by": rec.served_by,
+            "generation": rec.generation,
+            "trace": tracing.make_traceparent(*ctx),
+            "model_trace": rec.model_trace,
+        }
+        with self._trace_lock:
+            if len(self.trace_samples) < 512:
+                self.trace_samples.append(sample)
+
     def _record_shed(self, cls: RequestClass, e: ServeRejected) -> None:
         reasons = self.shed[cls.name]
         reasons[e.reason] = reasons.get(e.reason, 0) + 1
@@ -316,9 +400,10 @@ class LoadGenerator:
                 for i in range(self.waiters)]
         for t in pool:
             t.start()
+        trace_threads: List[threading.Thread] = []
         offered = telemetry.counter("lgbm_loadgen_offered_total")
         t0 = time.monotonic()
-        for off, ci, idx in zip(arrivals, cls_idx, row_idx):
+        for i, (off, ci, idx) in enumerate(zip(arrivals, cls_idx, row_idx)):
             cls = self.classes[ci]
             now = time.monotonic() - t0
             if off > now:
@@ -329,18 +414,41 @@ class LoadGenerator:
                 self.max_lag_s = max(self.max_lag_s, now - off)
             self.offered[cls.name] += 1
             offered.inc(cls=cls.name)
+            # sampled tracing (ISSUE 14): every K-th request gets a
+            # fresh trace id that travels to the server as traceparent
+            ctx = None
+            tp = None
+            if self.trace_every and i % self.trace_every == 0 \
+                    and tracing.enabled():
+                trace_threads = [t for t in trace_threads if t.is_alive()]
+                if len(trace_threads) < 256:   # bound the waiter spawn
+                    ctx = (tracing.new_trace_id(), tracing.new_span_id())
+                    tp = tracing.make_traceparent(*ctx)
+            t_submit = time.monotonic()
             try:
                 req = self.runtime.submit(self.probe[idx],
                                           deadline_s=self.deadline_s,
                                           model_id=cls.model_id,
-                                          priority=cls.priority)
+                                          priority=cls.priority,
+                                          traceparent=tp)
             except ServeRejected as e:
                 self._record_shed(cls, e)
                 continue
+            if ctx is not None:
+                # a dedicated waiter per sampled request: its client
+                # clock stops at response arrival, not at its turn in
+                # the shared pool's FIFO
+                tt = threading.Thread(target=self._trace_waiter,
+                                      args=(req, cls, ctx, t_submit),
+                                      name="loadgen-trace", daemon=True)
+                tt.start()
+                trace_threads.append(tt)
             q.put((req, idx, cls))
         for _ in pool:
             q.put(None)
         for t in pool:
+            t.join(timeout=60)
+        for t in trace_threads:
             t.join(timeout=60)
         return self.ledger()
 
@@ -360,6 +468,26 @@ class LoadGenerator:
             "hard_errors": self.hard_errors[:10],
             "classes": {},
         }
+        if self.trace_every:
+            with self._trace_lock:
+                samples = list(self.trace_samples)
+            errs = [s["stage_sum_err_s"] for s in samples
+                    if s["stage_sum_err_s"] is not None]
+            within = [s for s in samples
+                      if s["stage_sum_err_s"] is not None
+                      and s["stage_sum_err_s"] <= s["bucket_width_s"]]
+            out["trace"] = {
+                "trace_every": self.trace_every,
+                "sampled": len(samples),
+                "with_stages": len(errs),
+                "stage_sum_within_bucket": len(within),
+                "stage_sum_max_err_s": round(max(errs), 6) if errs
+                else None,
+                # the acceptance pin: EVERY sampled request's stage sum
+                # lands within one bucket width of its client latency
+                "ok": bool(samples) and len(within) == len(errs) > 0,
+                "samples": samples[:64],
+            }
         for c in self.classes:
             shed = sum(self.shed[c.name].values())
             out["classes"][c.name] = {

@@ -40,7 +40,7 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import resilience
+from . import resilience, tracing
 
 __all__ = ["ModelPublisher", "ModelSubscriber", "PublishedModel",
            "NoValidGeneration", "generation_paths", "validate_generation",
@@ -273,6 +273,14 @@ class ModelPublisher:
         resilience.maybe_die_at_publish(self._publish_count)
         self._write_manifest(gen, path, body)
         self._prune()
+        # source end of the publish→subscriber flow arrow (ISSUE 14):
+        # the flow id is derived from what BOTH ends read out of the
+        # meta footer, so a subscriber in another process computes the
+        # same id at swap-in and the merged timeline draws the link
+        tracing.flow_start(
+            "publish gen=%d" % gen,
+            tracing.flow_id(full_meta.get("trace") or "no-trace", gen),
+            generation=gen, trace=full_meta.get("trace"))
         return PublishedModel(gen, path, model_text, full_meta)
 
     def _write_manifest(self, gen: int, path: str, body: str) -> None:

@@ -62,12 +62,12 @@ import socketserver
 import sys
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from . import policy as policy_mod
-from . import publish, resilience, telemetry, xla_obs
+from . import publish, resilience, telemetry, tracing, xla_obs
 from ..utils.log import Log
 
 __all__ = ["ServingRuntime", "ServingServer", "ServeRejected",
@@ -112,10 +112,12 @@ class ServeResult:
     produced them, and how they were served."""
 
     __slots__ = ("values", "generation", "model_id", "served_by",
-                 "latency_s", "compiled")
+                 "latency_s", "compiled", "stages", "model_trace")
 
     def __init__(self, values: np.ndarray, generation: int, model_id: str,
-                 served_by: str, latency_s: float, compiled: bool = False):
+                 served_by: str, latency_s: float, compiled: bool = False,
+                 stages: Optional[Dict[str, float]] = None,
+                 model_trace: Optional[str] = None):
         self.values = values
         self.generation = generation
         self.model_id = model_id
@@ -125,6 +127,15 @@ class ServeResult:
         # xla_obs ledger moved during the dispatch) — first-batch latency
         # outliers become attributable instead of mysterious
         self.compiled = compiled
+        # per-request latency decomposition (ISSUE 14): queue_wait /
+        # batch_gather / device / drain seconds, measured on the SAME
+        # clock as latency_s so the stage sum is pinned against the
+        # client-observed number (tests + the sim artifact gate on it)
+        self.stages = stages or {}
+        # traceparent of the training cycle that produced the serving
+        # generation (from the publish meta footer) — the response's
+        # backlink into the trainer's timeline
+        self.model_trace = model_trace
 
 
 class _Request:
@@ -132,10 +143,11 @@ class _Request:
 
     __slots__ = ("model_id", "X", "n_rows", "deadline", "enqueued",
                  "done", "result", "rejection", "error", "priority",
-                 "label")
+                 "label", "trace", "t_batched")
 
     def __init__(self, model_id: str, X: np.ndarray, deadline: float,
-                 priority: int = 0, label: Optional[np.ndarray] = None):
+                 priority: int = 0, label: Optional[np.ndarray] = None,
+                 trace: Optional[Tuple[str, str]] = None):
         self.model_id = model_id
         self.X = X
         self.n_rows = int(X.shape[0])
@@ -145,6 +157,11 @@ class _Request:
         # online feedback loop): per-row labels feed the canary policy's
         # live error signal — never the prediction itself
         self.label = label
+        # parsed client traceparent (ISSUE 14): requests that carry one
+        # get their queue/gather/device/drain stages recorded as trace
+        # events under the CLIENT's trace id
+        self.trace = trace
+        self.t_batched: Optional[float] = None
         self.enqueued = time.monotonic()
         self.done = threading.Event()
         self.result: Optional[ServeResult] = None
@@ -503,6 +520,15 @@ class ServingRuntime:
         with self._stats_lock:
             self._stats["swaps"] += 1
         telemetry.counter("lgbm_serve_swaps_total").inc()
+        # sink end of the publish→subscriber flow arrow (ISSUE 14): the
+        # flow id re-derives from the SAME meta fields the publisher
+        # used, so the merged timeline links this swap back to the
+        # training cycle that produced the generation
+        tracing.flow_end(
+            "model swap gen=%d" % generation,
+            tracing.flow_id(meta.get("trace") or "no-trace", generation),
+            model=model_id, generation=generation,
+            producer_trace=meta.get("trace"))
         with self._wd_lock:
             self.wd.annotate("last_swap", {
                 "model": model_id, "generation": generation,
@@ -562,6 +588,12 @@ class ServingRuntime:
                              "(%s); host path serves it", model_id,
                              rec.generation, e)
         self._canary[model_id] = entry
+        tracing.flow_end(
+            "canary load gen=%d" % rec.generation,
+            tracing.flow_id(rec.meta.get("trace") or "no-trace",
+                            rec.generation),
+            model=model_id, generation=rec.generation,
+            producer_trace=rec.meta.get("trace"))
         start = self._policy_for(model_id).note_start(rec.generation)
         with self._wd_lock:
             self.wd.annotate("canary_start", dict(
@@ -696,7 +728,7 @@ class ServingRuntime:
     # -- request surface -----------------------------------------------------
     def submit(self, data, deadline_s: Optional[float] = None,
                model_id: str = "default", priority: int = 0,
-               label=None) -> _Request:
+               label=None, traceparent: Optional[str] = None) -> _Request:
         """Admit one request (a feature row [F] or small matrix [B, F]).
         Raises `ServeRejected` IMMEDIATELY when the queue is full or the
         server is stopped — shedding at admission is the backpressure
@@ -714,7 +746,13 @@ class ServingRuntime:
 
         `label` optionally carries the request's ground-truth outcome
         (per row): it never influences the prediction — it feeds the
-        canary policy's live error signal (ISSUE 12)."""
+        canary policy's live error signal (ISSUE 12).
+
+        `traceparent` (ISSUE 14) attaches the client's trace context:
+        the server records this request's queue_wait / batch_gather /
+        device / drain stages as trace events under the client's trace
+        id, and the response's stage decomposition rides `ServeResult.
+        stages`.  A malformed value is dropped, never rejected."""
         X = np.atleast_2d(np.asarray(data, dtype=np.float64))
         deadline = time.monotonic() + (self.default_deadline_s
                                        if deadline_s is None
@@ -723,7 +761,9 @@ class ServingRuntime:
         prio = min(max(int(priority), 0), P - 1)
         req = _Request(model_id, X, deadline, priority=prio,
                        label=None if label is None
-                       else np.asarray(label, dtype=np.float64))
+                       else np.asarray(label, dtype=np.float64),
+                       trace=tracing.parse_traceparent(traceparent)
+                       if traceparent else tracing.thread_context())
         with self._cond:
             if self._stopped or not self._started:
                 raise ServeRejected("shutdown", retryable=False,
@@ -839,6 +879,7 @@ class ServingRuntime:
                             keep.append(req)
                             continue
                         self._queued_by_model[req.model_id] -= 1
+                        req.t_batched = now      # queue_wait ends here
                         batch.append(req)
                         rows += req.n_rows
                     self._queue.extendleft(reversed(keep))
@@ -902,7 +943,11 @@ class ServingRuntime:
                     seconds=0)
         c0 = xla_obs.total_compiles()
         t_dispatch = time.monotonic()
-        values, served_by = self._serve_path(entry, X)
+        with tracing.span("serve batch", model=model_id,
+                          generation=entry.generation,
+                          rows=int(X.shape[0]), requests=len(batch)):
+            values, served_by = self._serve_path(entry, X)
+        t_values = time.monotonic()
         if canary is not None:
             pol = self._policy_for(model_id)
             decisions = pol.observe(
@@ -942,13 +987,41 @@ class ServingRuntime:
         lat_hist = telemetry.histogram("lgbm_serve_latency_seconds")
         completed = telemetry.counter("lgbm_serve_requests_total")
         by_class = telemetry.counter("lgbm_serve_class_requests_total")
+        model_trace = entry.meta.get("trace")
         s = 0
         for req in batch:
             e = s + req.n_rows
             latency = round(now - req.enqueued, 6)
+            # per-request decomposition on the SAME clock as latency_s:
+            # queue_wait ends at the batcher pop, batch_gather at the
+            # dispatch, device at the values, drain at completion — the
+            # four stages PARTITION [enqueued, now], so their sum equals
+            # the latency to rounding (the acceptance pin)
+            t_b = req.t_batched if req.t_batched is not None else t_dispatch
+            stages = {
+                "queue_wait_s": round(max(t_b - req.enqueued, 0.0), 6),
+                "batch_gather_s": round(max(t_dispatch - t_b, 0.0), 6),
+                "device_s": round(max(t_values - t_dispatch, 0.0), 6),
+                "drain_s": round(max(now - t_values, 0.0), 6),
+            }
             req.result = ServeResult(values[s:e], entry.generation,
                                      model_id, served_by, latency,
-                                     compiled=compiled)
+                                     compiled=compiled, stages=stages,
+                                     model_trace=model_trace)
+            if req.trace is not None:
+                # the request's stages as slices under the CLIENT's trace
+                # id — the cross-thread/cross-process half of the causal
+                # timeline (only requests that carry a context pay this)
+                marks = ((req.enqueued, t_b, "req/queue_wait"),
+                         (t_b, t_dispatch, "req/batch_gather"),
+                         (t_dispatch, t_values, "req/device"),
+                         (t_values, now, "req/drain"))
+                for a, b, nm in marks:
+                    tracing.record(nm, int(a * 1e9),
+                                   int(max(b - a, 0.0) * 1e9),
+                                   trace=req.trace[0], parent=req.trace[1],
+                                   served_by=served_by,
+                                   generation=entry.generation)
             req.done.set()
             s = e
             # the registry histogram IS the serving latency ledger: the
@@ -1101,9 +1174,11 @@ class ServingRuntime:
 class _Handler(socketserver.StreamRequestHandler):
     """JSON-lines protocol: one request object per line, one response
     object per line.  Requests: ``{"features": [...], "model": "id",
-    "deadline_s": 2.0, "raw": false}`` or ``{"cmd": "stats"}``.
-    Responses: ``{"values": [...], "generation": N, "served_by": ...,
-    "latency_s": ...}`` or a `ServeRejected.to_dict()` rejection."""
+    "deadline_s": 2.0, "traceparent": "00-..-..-01"}`` or
+    ``{"cmd": "stats"}``.  Responses: ``{"values": [...],
+    "generation": N, "served_by": ..., "latency_s": ..., "stages":
+    {queue_wait_s, batch_gather_s, device_s, drain_s}, "model_trace":
+    ...}`` or a `ServeRejected.to_dict()` rejection."""
 
     def handle(self) -> None:
         rt: ServingRuntime = self.server.runtime    # type: ignore[attr-defined]
@@ -1122,13 +1197,19 @@ class _Handler(socketserver.StreamRequestHandler):
                         model_id=msg.get("model", "default"),
                         priority=int(msg.get("priority", 0)),
                         label=msg.get("label"),
+                        # cross-process context propagation (ISSUE 14):
+                        # the wire carries the client's traceparent
+                        traceparent=msg.get("traceparent"),
                     ).wait(timeout=rt.default_deadline_s
                            + rt.predict_deadline_s + 10.0)
                     out = {"values": np.asarray(rec.values).tolist(),
                            "generation": rec.generation,
                            "served_by": rec.served_by,
                            "latency_s": rec.latency_s,
-                           "compiled": rec.compiled}
+                           "compiled": rec.compiled,
+                           "stages": rec.stages}
+                    if rec.model_trace:
+                        out["model_trace"] = rec.model_trace
             except ServeRejected as e:
                 out = e.to_dict()
             except Exception as e:           # noqa: BLE001 — wire error

@@ -59,13 +59,20 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import tracing
 from .resilience import atomic_write, wallclock
+
+# every process that touches the metrics registry also arms the trace
+# flight-recorder's atexit dump when $LGBM_TPU_TRACE_DIR is set — the
+# fleet self-collects (ISSUE 14)
+tracing.maybe_autostart()
 
 __all__ = [
     "METRIC_TABLE", "LATENCY_BUCKETS_S", "OVERFLOW_LABEL",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "set_enabled", "enabled", "counter", "gauge", "histogram",
-    "span", "record_span", "normalize_span_name", "count_sync",
+    "span", "record_span", "normalize_span_name", "SPAN_KEEP_KEYS",
+    "count_sync",
     "MetricsServer", "start_http_server",
     "MetricsFileWriter", "maybe_start_file_export", "write_snapshot_now",
     "snapshot", "render_prometheus", "profile_hook", "reset",
@@ -833,35 +840,64 @@ def count_sync(label: str, critical: bool) -> None:
 
 _DIGITS = re.compile(r"\d+")
 
+#: ``key=<digits>`` pairs whose digits SURVIVE normalization: these are
+#: bounded product parameters (the boost-window length, the pipeline
+#: depth) whose value IS the series identity — collapsing them merged
+#: e.g. the J=2 and J=4 window-dispatch stages into one metric series
+#: (ISSUE 14 satellite).  Unbounded identifiers (cycle/gen/rows counts)
+#: stay normalized: only keys listed here escape, so cardinality stays
+#: bounded by the small set of legal values those knobs take.
+SPAN_KEEP_KEYS: Tuple[str, ...] = ("J", "depth", "window", "K")
+
+#: one alternation, tried left to right: a ``key=value`` token for an
+#: allowlisted key is consumed whole (and kept verbatim); any other
+#: digit run collapses to ``N``.
+_NORM = re.compile(r"\b(?:%s)=\d{1,4}\b|\d+" % "|".join(SPAN_KEEP_KEYS))
+
 
 def normalize_span_name(name: str, max_len: int = 80) -> str:
     """Digit runs -> ``N`` and a hard length cap, so per-cycle /
     per-batch stage names ("cycle 17: train", "batch ... rows=512")
-    collapse to a bounded family of span names."""
-    return _DIGITS.sub("N", name)[:max_len]
+    collapse to a bounded family of span names — EXCEPT ``key=value``
+    digits for the `SPAN_KEEP_KEYS` product parameters, which stay
+    distinguishable ("window dispatch J=4" vs "J=2" are different
+    stages, not two samples of one)."""
+    return _NORM.sub(lambda m: m.group(0) if "=" in m.group(0) else "N",
+                     name)[:max_len]
 
 
-def record_span(name: str, dur_s: float, status: str = "ok") -> None:
+def record_span(name: str, dur_s: float, status: str = "ok",
+                trace: bool = True) -> None:
     """One completed span on the shared clock.  The stage-trail watchdog
-    calls this at every stage close."""
+    calls this at every stage close.  The RAW name also lands in the
+    trace flight recorder (`trace=False` for callers that already
+    recorded the trace event themselves — the `span` context manager)."""
     if not _enabled:
         return
     key = normalize_span_name(name)
     REGISTRY.histogram("lgbm_span_seconds").observe(max(dur_s, 0.0),
                                                     span=key)
     REGISTRY.counter("lgbm_spans_total").inc(span=key, status=status)
+    if trace:
+        now = time.monotonic_ns()
+        dur_ns = int(max(dur_s, 0.0) * 1e9)
+        tracing.record(name, now - dur_ns, dur_ns, status=status)
 
 
 @contextlib.contextmanager
 def span(name: str):
-    """Context-manager span: records duration + ok/error status."""
+    """Context-manager span: records duration + ok/error status into the
+    registry AND opens a causal trace span (children recorded inside the
+    scope parent under it; ISSUE 14)."""
     t0 = time.monotonic()
     try:
-        yield
+        with tracing.span(name):
+            yield
     except BaseException:
-        record_span(name, time.monotonic() - t0, status="error")
+        record_span(name, time.monotonic() - t0, status="error",
+                    trace=False)
         raise
-    record_span(name, time.monotonic() - t0, status="ok")
+    record_span(name, time.monotonic() - t0, status="ok", trace=False)
 
 
 # ---------------------------------------------------------------------------
@@ -880,7 +916,10 @@ def train_iteration():
     profile_hook("train").tick()
     s0 = syncs.snapshot()
     t0 = time.monotonic()
-    yield
+    # one causal slice per boosting iteration: dispatch marks and the
+    # assembler drain hand-off recorded inside parent under it
+    with tracing.span("train/iteration"):
+        yield
     dt = time.monotonic() - t0
     d = syncs.delta(s0)
     REGISTRY.histogram("lgbm_train_iteration_seconds").observe(dt)

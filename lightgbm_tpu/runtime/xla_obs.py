@@ -50,7 +50,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from . import telemetry
+from . import telemetry, tracing
 from .resilience import wallclock
 
 __all__ = [
@@ -211,6 +211,14 @@ class CompileLedger:
             wall_s, site=rec.name)
         telemetry.counter("lgbm_program_cache_events_total").inc(
             site=rec.name, event="compile")
+        # the compile as a slice on its own Perfetto row (ISSUE 14): the
+        # flight recorder's merged timeline shows WHICH request/cycle was
+        # stalled behind which site's trace+compile
+        now_ns = time.monotonic_ns()
+        dur_ns = int(wall_s * 1e9)
+        tracing.record("xla compile %s" % rec.name, now_ns - dur_ns,
+                       dur_ns, track="xla compile", site=rec.name,
+                       delta=sig_delta(prev, sig))
         if self._steady:
             delta_s = sig_delta(prev, sig)
             event = {"site": rec.name, "delta": delta_s,
@@ -219,6 +227,9 @@ class CompileLedger:
                 self.retraces.append(event)
             telemetry.counter("lgbm_xla_retraces_total").inc(
                 site=rec.name, delta=delta_s)
+            tracing.instant("xla RETRACE %s" % rec.name,
+                            track="xla compile", site=rec.name,
+                            delta=delta_s)
 
     # -- python-side cache events --------------------------------------------
     def cache_event(self, site: str, event: str, n: int = 1) -> None:

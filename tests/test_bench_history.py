@@ -26,16 +26,29 @@ def _write_round(d, n, parsed=None, tail=""):
 
 
 def test_committed_fixtures_collate_clean():
+    # r01–r05 plus the BENCH_WINDOW_r13 window A/B (ISSUE 14: the
+    # attrib decomposition collates across BOTH artifact families)
     rep = bench_history.run(REPO)
-    assert rep["rounds"] == 5
-    assert len(rep["trajectory"]) == 5
+    assert rep["rounds"] == 6
+    assert len(rep["trajectory"]) == 6
     latest = rep["trajectory"][-1]
-    assert latest["round"] == 5
+    assert latest["round"] == 13
+    assert latest["file"] == "BENCH_WINDOW_r13.json"
     # values come from the fixtures, not thin air
-    fix = json.load(open(os.path.join(REPO, "BENCH_r05.json")))["parsed"]
+    fix = json.load(open(os.path.join(REPO,
+                                      "BENCH_WINDOW_r13.json")))["parsed"]
     assert latest["iters_per_sec"] == fix["value"]
-    assert latest["vs_baseline"] == fix["vs_baseline"]
-    # the acceptance gate: the regression check runs clean on r01–r05
+    # the attrib series landed, in ms, from the committed artifact
+    attr = fix["attrib"]["per_iter"]
+    assert latest["dispatches_per_iter"] == attr["dispatches_per_iter"]
+    assert latest["attrib_dispatch_ms"] == \
+        round(attr["dispatch_s"] * 1000, 3)
+    assert latest["attrib_drain_ms"] == round(attr["drain_s"] * 1000, 3)
+    r5 = [r for r in rep["trajectory"] if r["round"] == 5][0]
+    fix5 = json.load(open(os.path.join(REPO, "BENCH_r05.json")))["parsed"]
+    assert r5["iters_per_sec"] == fix5["value"]
+    assert r5["vs_baseline"] == fix5["vs_baseline"]
+    # the acceptance gate: the regression check runs clean as committed
     assert rep["latest_regressions"] == [], rep["latest_regressions"]
 
 
@@ -44,7 +57,7 @@ def test_cli_exits_zero_on_committed_fixtures():
         [sys.executable, os.path.join(REPO, "helper", "bench_history.py")],
         capture_output=True, text=True)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "5 round(s) collated" in r.stdout
+    assert "6 round(s) collated" in r.stdout
 
 
 def test_synthetic_regression_is_flagged(tmp_path):
@@ -153,6 +166,32 @@ def test_dispatches_per_iter_rise_is_flagged(tmp_path):
     # rounds 1->2 (the improvement) never flagged
     assert all(f["round"] != 2 for f in rep["regressions"]
                if f["series"] == "dispatches_per_iter")
+
+
+def test_attrib_time_series_collate_in_ms_and_rise_flags(tmp_path):
+    """ISSUE 14 satellite: the attrib dispatch/device-wait/drain pieces
+    collate (in ms) and a >10% rise at the same shape flags — the
+    per-piece trajectory across BENCH_r*/BENCH_WINDOW_r* is what tells
+    the next hardware window WHICH piece moved."""
+    shape = {"value": 1.0, "n_rows": 100, "platform": "cpu"}
+
+    def att(dispatch, wait, drain):
+        return {"attrib": {"per_iter": {"dispatch_s": dispatch,
+                                        "device_wait_s": wait,
+                                        "drain_s": drain}}}
+    _write_round(tmp_path, 1, {**shape, **att(0.100, 0.020, 0.010)})
+    (tmp_path / "BENCH_WINDOW_r02.json").write_text(json.dumps(
+        {"parsed": {**shape, **att(0.050, 0.019, 0.010)}}))  # better: fine
+    _write_round(tmp_path, 3, {**shape, **att(0.080, 0.045, 0.010)})
+    rep = bench_history.run(str(tmp_path))
+    rows = {r["file"]: r for r in rep["trajectory"]}
+    assert rows["BENCH_WINDOW_r02.json"]["attrib_dispatch_ms"] == 50.0
+    assert rows["BENCH_r03.json"]["attrib_device_wait_ms"] == 45.0
+    flagged = {f["series"] for f in rep["latest_regressions"]}
+    # dispatch rose 60% vs the window round's 50ms, device-wait rose
+    # >100% vs round 2's 19ms; drain never moved
+    assert {"attrib_dispatch_ms", "attrib_device_wait_ms"} <= flagged
+    assert "attrib_drain_ms" not in flagged
 
 
 def test_sim_artifact_schema_validates():

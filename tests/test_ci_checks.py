@@ -1,6 +1,6 @@
 """The one-command static-lint runner (helper/ci_checks.py, ISSUE 13
 satellite): the committed tree must pass EVERY lint through the single
-aggregated entry point, and the runner must keep covering all four."""
+aggregated entry point, and the runner must keep covering all five."""
 import os
 import sys
 
@@ -13,7 +13,7 @@ import ci_checks  # noqa: E402
 def test_runner_covers_every_lint():
     names = [n for n, _ in ci_checks.CHECKS]
     assert names == ["check_abi", "check_syncs", "check_xla_sites",
-                     "check_fault_coverage"]
+                     "check_fault_coverage", "check_metric_coverage"]
 
 
 def test_committed_tree_passes_all_lints(capsys):
@@ -30,7 +30,7 @@ def test_main_aggregates_verdict(monkeypatch, capsys):
     def fake_run_all():
         calls.extend(n for n, _ in ci_checks.CHECKS)
         return {"check_abi": 0, "check_syncs": 2, "check_xla_sites": 0,
-                "check_fault_coverage": 0}
+                "check_fault_coverage": 0, "check_metric_coverage": 0}
 
     monkeypatch.setattr(ci_checks, "run_all", fake_run_all)
     assert ci_checks.main([]) == 1

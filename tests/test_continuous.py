@@ -234,6 +234,36 @@ def test_online_preempt_resume_rejoins_schedule_byte_identical(online_runs):
     assert (churn / "m.txt").read_bytes() == (base / "m.txt").read_bytes()
 
 
+def test_trace_context_durable_across_preemption(online_runs):
+    """ISSUE 14 satellite: a SIGTERM-relaunched `task=train_online`
+    resumes with a FRESH trace, while every generation published before
+    the kill keeps the dead process's trace context in its meta footer —
+    so a served response can link back to the exact cycle that made its
+    model across any number of preemptions."""
+    from lightgbm_tpu.runtime import tracing
+    _, churn, _, r_pre, r_resume = online_runs
+    assert r_pre.returncode == 0 and r_resume.returncode == 0
+    sub = publish.ModelSubscriber(str(churn / "m.txt.pub"))
+    metas = {}
+    for gen, path in publish.generation_paths(str(churn / "m.txt.pub")):
+        with open(path) as fh:
+            metas[gen] = publish._split_validate(fh.read())[1]
+    assert set(metas) == {1, 2, 3}
+    ctxs = {}
+    for gen, meta in metas.items():
+        # every publish — pre-kill, post-relaunch, and any republish —
+        # carries a PARSEABLE trace context
+        assert "trace" in meta, "generation %d has no trace meta" % gen
+        ctx = tracing.parse_traceparent(meta["trace"])
+        assert ctx is not None, meta["trace"]
+        ctxs[gen] = ctx
+    # each cycle is its own trace — relaunch or not, ids never repeat
+    assert len({c[0] for c in ctxs.values()}) == 3
+    # the subscriber resolves the link for the newest generation too
+    rec = sub.resolve()
+    assert tracing.parse_traceparent(rec.meta["trace"]) == ctxs[3]
+
+
 def test_ingest_producer_tail_append_never_reparses_old_rows(tmp_path):
     """ISSUE 8 fix pin: when the data file only GROWS, the ingest
     producer parses exactly the appended tail — rows outside the new

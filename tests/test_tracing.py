@@ -1,0 +1,371 @@
+"""End-to-end distributed tracing (ISSUE 14): the flight-recorder ring,
+context propagation across threads and processes, the Chrome-trace /
+merge exporters, the serving stage decomposition pin, and the satellite
+fixes (span-name digit normalization, concurrent-writer integrity)."""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.runtime import telemetry, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_ring():
+    tracing.reset()
+    yield
+    tracing.reset()
+
+
+# ---------------------------------------------------------------------------
+# ids + traceparent
+# ---------------------------------------------------------------------------
+
+def test_traceparent_roundtrip_and_malformed():
+    t, s = tracing.new_trace_id(), tracing.new_span_id()
+    assert len(t) == 32 and len(s) == 16
+    assert tracing.parse_traceparent(tracing.make_traceparent(t, s)) == (t, s)
+    for bad in (None, "", "garbage", "00-short-short-01", 42,
+                "00-" + "0" * 32 + "-" + "0" * 16 + "-01",     # zero ids
+                "00-" + "z" * 32 + "-" + "f" * 16 + "-01"):    # non-hex
+        assert tracing.parse_traceparent(bad) is None
+
+    ids = {tracing.new_span_id() for _ in range(1000)}
+    assert len(ids) == 1000                    # unique id stream
+
+
+# ---------------------------------------------------------------------------
+# spans, context, export
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_parent_child_and_export():
+    with tracing.span("root", foo=1) as root_ctx:
+        assert tracing.current() == root_ctx
+        assert tracing.parse_traceparent(
+            tracing.current_traceparent()) == root_ctx
+        with tracing.span("child"):
+            tracing.instant("mark", k="v")
+    assert tracing.current() is None           # stack unwound
+
+    doc = tracing.export_chrome()
+    by_name = {e["name"]: e for e in doc["traceEvents"]
+               if e["ph"] in ("X", "i")}
+    root, child = by_name["root"], by_name["child"]
+    assert child["args"]["trace"] == root["args"]["trace"]
+    assert child["args"]["parent"] == root["args"]["span"]
+    assert by_name["mark"]["args"]["trace"] == root["args"]["trace"]
+    assert root["args"]["foo"] == 1
+    # timestamps are ABSOLUTE unix microseconds (the merge contract)
+    assert abs(root["ts"] / 1e6 - time.time()) < 300
+    assert root["dur"] >= child["dur"] >= 0
+
+
+def test_span_error_status():
+    with pytest.raises(RuntimeError):
+        with tracing.span("boom"):
+            raise RuntimeError("x")
+    ev = [e for e in tracing.export_chrome()["traceEvents"]
+          if e.get("name") == "boom"][0]
+    assert ev["args"]["status"] == "error"
+
+
+def test_attach_and_bind_carry_context_across_threads():
+    seen = {}
+    with tracing.span("dispatcher") as ctx:
+        captured = tracing.context()
+
+        def worker():
+            with tracing.attach(captured):
+                seen["inside"] = tracing.current()
+            seen["outside"] = tracing.current()
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen["inside"] == ctx and seen["outside"] is None
+
+    # bind(): the assembler hand-off seam — runs fn under the captured
+    # context AND records a span for the invocation
+    with tracing.span("iteration") as it_ctx:
+        fn = tracing.bind(lambda: tracing.current(), "drain", trees=2)
+    out = {}
+    t = threading.Thread(target=lambda: out.setdefault("ctx", fn()))
+    t.start()
+    t.join()
+    assert out["ctx"][0] == it_ctx[0]          # same trace id
+    drain = [e for e in tracing.export_chrome()["traceEvents"]
+             if e.get("name") == "drain"][0]
+    assert drain["args"]["trace"] == it_ctx[0]
+    assert drain["args"]["parent"] == it_ctx[1]
+    assert drain["args"]["trees"] == 2
+
+
+def test_process_root_from_env(monkeypatch):
+    t, s = tracing.new_trace_id(), tracing.new_span_id()
+    monkeypatch.setenv(tracing.TRACEPARENT_ENV,
+                       tracing.make_traceparent(t, s))
+    tracing.reset()                            # re-read the env seed
+    assert tracing.process_root() == (t, s)
+    with tracing.span("rooted"):
+        pass
+    ev = [e for e in tracing.export_chrome()["traceEvents"]
+          if e.get("name") == "rooted"][0]
+    # a root span opened with no explicit context parents under the env
+    assert ev["args"]["trace"] == t and ev["args"]["parent"] == s
+
+
+def test_disabled_path_records_nothing_and_bind_is_identity():
+    prev = tracing.set_enabled(False)
+    try:
+        tracing.instant("x")
+        tracing.record("x", 0, 0)
+        tracing.flow_start("x", 1)
+        tracing.counter_event("x", 1.0)
+        with tracing.span("x") as ctx:
+            assert ctx is None
+        fn = lambda: 1                          # noqa: E731
+        assert tracing.bind(fn, "name") is fn
+    finally:
+        tracing.set_enabled(prev)
+    assert tracing.ring_summary()["recorded_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: concurrent ring writers never tear or mis-order an export
+# ---------------------------------------------------------------------------
+
+def test_concurrent_writers_no_torn_or_out_of_order_events(monkeypatch):
+    monkeypatch.setattr(tracing, "_RING", tracing._Ring(1024))
+    threads, per = 6, 300
+
+    def work(i):
+        for j in range(per):
+            with tracing.span("w%d" % i, j=j):
+                tracing.instant("m%d" % i)
+    ts = [threading.Thread(target=work, args=(i,)) for i in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    summary = tracing.ring_summary()
+    assert summary["recorded_total"] == threads * per * 2
+    # bounded: the ring holds the newest `capacity`, the rest counted
+    assert summary["events"] == 1024
+    assert summary["dropped"] == threads * per * 2 - 1024
+    doc = tracing.export_chrome()
+    evs = [e for e in doc["traceEvents"] if e["ph"] in ("X", "i")]
+    # no torn event: every record is structurally complete
+    for e in evs:
+        assert e["name"] and isinstance(e["ts"], float)
+        if e["ph"] == "X":
+            assert e["dur"] >= 0 and "span" in e["args"]
+    # export order is globally monotonic (sorted on the shared clock)
+    stamps = [e["ts"] for e in evs]
+    assert stamps == sorted(stamps)
+    assert doc["otherData"]["dropped"] == summary["dropped"]
+
+
+# ---------------------------------------------------------------------------
+# merge
+# ---------------------------------------------------------------------------
+
+def test_merge_traces_fuses_processes_onto_one_timeline(tmp_path):
+    with tracing.span("a"):
+        pass
+    p1 = str(tmp_path / "one.json")
+    tracing.export_chrome(p1, context_name="one")
+    tracing.reset()
+    with tracing.span("b"):
+        pass
+    p2 = str(tmp_path / "two.json")
+    tracing.export_chrome(p2, context_name="two")
+
+    out = str(tmp_path / "merged.json")
+    doc = tracing.merge_traces([p1, p2], out_path=out)
+    on_disk = json.load(open(out))
+    assert on_disk["otherData"]["merged_from"] == \
+        doc["otherData"]["merged_from"]
+    # each source landed on its own pid slot with a {host,pid} name
+    names = [e for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert {e["pid"] for e in names} == {1, 2}
+    assert all("pid=" in e["args"]["name"] for e in names)
+    body = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+    stamps = [e["ts"] for e in body]
+    assert stamps == sorted(stamps)
+    # the size bound cuts oldest-first and records the cut
+    capped = tracing.merge_traces([p1, p2], max_events=1)
+    assert capped["otherData"]["events"] == 1
+    assert capped["otherData"]["truncated_oldest"] == len(body) - 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: span-name digit normalization keeps product keys
+# ---------------------------------------------------------------------------
+
+def test_normalize_keeps_product_keys_distinguishable():
+    n = telemetry.normalize_span_name
+    # bounded product parameters survive: J=2 and J=4 are DIFFERENT
+    # stages, not two samples of one (the pre-fix rewrite merged them)
+    assert n("window dispatch J=4") == "window dispatch J=4"
+    assert n("window dispatch J=2") != n("window dispatch J=4")
+    assert n("depth=2 drain") == "depth=2 drain"
+    # unbounded identifiers still collapse (cardinality stays bounded)
+    assert n("cycle 17: train") == n("cycle 991: train") == "cycle N: train"
+    assert n("batch model=default gen=12 rows=512") == \
+        "batch model=default gen=N rows=N"
+    assert n("online stage/cycle 3: publish") == \
+        "online stage/cycle N: publish"
+    assert n("recover: republish generation 7") == \
+        "recover: republish generation N"
+    # every registered watchdog-stage shape in the tree stays bounded:
+    # a name made only of digits+keys cannot exceed the length cap
+    assert len(n("x" * 500)) <= 80
+
+
+def test_window_dispatch_span_series_distinct_by_J():
+    telemetry.record_span("window dispatch J=2", 0.01)
+    telemetry.record_span("window dispatch J=4", 0.02)
+    snap = telemetry.snapshot()
+    spans = {s["labels"]["span"]
+             for s in snap["metrics"]["lgbm_span_seconds"]["series"]}
+    assert {"window dispatch J=2", "window dispatch J=4"} <= spans
+
+
+def test_record_span_lands_in_ring_with_raw_name():
+    telemetry.record_span("cycle 42: publish", 0.05)
+    evs = [e for e in tracing.export_chrome()["traceEvents"]
+           if e.get("name") == "cycle 42: publish"]
+    assert len(evs) == 1 and evs[0]["dur"] == pytest.approx(50_000, rel=0.1)
+
+
+# ---------------------------------------------------------------------------
+# serving integration: stage decomposition + request/publish links
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def _tiny_model_text():
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((200, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=2)
+    return bst._model.save_model_to_string()
+
+
+def test_serving_stage_sum_pins_to_latency_and_links(tmp_path,
+                                                     _tiny_model_text):
+    from lightgbm_tpu.runtime import publish
+    from lightgbm_tpu.runtime.serving import ServingRuntime
+
+    pub_dir = str(tmp_path / "pub")
+    pub = publish.ModelPublisher(pub_dir)
+    with tracing.span("cycle 1") as cycle_ctx:
+        cycle_tp = tracing.current_traceparent()
+        pub.publish(_tiny_model_text, meta={"trace": cycle_tp})
+
+    rng = np.random.default_rng(1)
+    rt = ServingRuntime(publish_dir=pub_dir, params={"verbose": -1},
+                        batch_window_s=0.001, poll_interval_s=0.05)
+    rt.start()
+    try:
+        deadline = time.monotonic() + 60
+        while rt.generation() is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert rt.generation() == 1
+        ctx = (tracing.new_trace_id(), tracing.new_span_id())
+        rec = rt.submit(rng.standard_normal((3, 4)),
+                        traceparent=tracing.make_traceparent(*ctx)) \
+            .wait(timeout=60)
+        # the four stages PARTITION [enqueued, completed]: their sum is
+        # the server latency to rounding — the acceptance contract the
+        # sim gates at one bucket width against the CLIENT clock
+        assert set(rec.stages) == {"queue_wait_s", "batch_gather_s",
+                                   "device_s", "drain_s"}
+        assert sum(rec.stages.values()) == \
+            pytest.approx(rec.latency_s, abs=1e-4)
+        # the response links back to the producing cycle's trace
+        assert rec.model_trace == cycle_tp
+        # an un-traced request still gets its decomposition
+        rec2 = rt.submit(rng.standard_normal((1, 4))).wait(timeout=60)
+        assert sum(rec2.stages.values()) == \
+            pytest.approx(rec2.latency_s, abs=1e-4)
+    finally:
+        rt.stop()
+
+    evs = tracing.export_chrome()["traceEvents"]
+    # server-side stage slices recorded under the CLIENT's trace id
+    req_ev = [e for e in evs if str(e.get("name", "")).startswith("req/")
+              and e["args"]["trace"] == ctx[0]]
+    assert {e["name"] for e in req_ev} == \
+        {"req/queue_wait", "req/batch_gather", "req/device", "req/drain"}
+    assert all(e["args"]["parent"] == ctx[1] for e in req_ev)
+    # publish (flow start) and swap-in (flow end) share one arrow id —
+    # the trainer cycle -> publish -> subscriber link of the acceptance
+    starts = [e for e in evs if e["ph"] == "s"]
+    ends = [e for e in evs if e["ph"] == "f"]
+    assert starts and ends
+    assert {e["id"] for e in starts} & {e["id"] for e in ends}
+    # the publish-side event belongs to the cycle's trace
+    assert any(e.get("args", {}).get("trace") == cycle_ctx[0]
+               for e in starts)
+    assert any(e.get("name") == "serve batch" for e in evs)
+
+
+def test_doctor_bundle_carries_trace_ring(tmp_path):
+    from lightgbm_tpu.runtime import doctor
+    with tracing.span("pre-crash work"):
+        pass
+    rec = doctor.collect_debug_bundle(out_dir=str(tmp_path), probe=False)
+    names = [m["name"] for m in rec["manifest"]["members"]]
+    assert "trace.json" in names
+    import tarfile
+    with tarfile.open(rec["path"]) as tar:
+        member = [m for m in tar.getmembers()
+                  if m.name.endswith("trace.json")][0]
+        doc = json.loads(tar.extractfile(member).read().decode())
+    assert any(e.get("name") == "pre-crash work"
+               for e in doc["traceEvents"])
+
+
+def test_export_to_dir_and_autostart_env(tmp_path, monkeypatch):
+    with tracing.span("flushed"):
+        pass
+    path = tracing.export_to_dir(str(tmp_path / "traces"))
+    assert path and os.path.exists(path)
+    assert "trace_" in os.path.basename(path)
+    doc = json.load(open(path))
+    assert any(e.get("name") == "flushed" for e in doc["traceEvents"])
+    # autostart only arms when the env var is set
+    monkeypatch.delenv(tracing.TRACE_DIR_ENV, raising=False)
+    monkeypatch.setattr(tracing, "_atexit_armed", False)
+    assert tracing.maybe_autostart() is False
+    monkeypatch.setenv(tracing.TRACE_DIR_ENV, str(tmp_path / "traces"))
+    assert tracing.maybe_autostart() is True
+
+
+# ---------------------------------------------------------------------------
+# satellite: the metric-coverage lint (lint #5)
+# ---------------------------------------------------------------------------
+
+def test_metric_coverage_lint_green_and_drift_negative():
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "helper"))
+    import check_metric_coverage as lint
+    assert lint.run() == []
+    # drift negative: a fabricated family with no call site IS reported
+    table = dict(telemetry.METRIC_TABLE)
+    table["lgbm_totally_unarmed_metric"] = {
+        "type": "counter", "labels": (), "help": "x"}
+    problems = lint.run(table=table)
+    assert len(problems) == 1
+    assert "lgbm_totally_unarmed_metric" in problems[0]
+    # the declaration block itself can never arm a family: the name
+    # appears in telemetry.py as a dict key, yet it is still reported
+    hits = lint.coverage(table=table)
+    assert hits["lgbm_totally_unarmed_metric"] == []
