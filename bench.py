@@ -48,6 +48,11 @@ FALLBACK_SECTION_ENV = (
     "BENCH_TELEMETRY", "BENCH_TELEMETRY_ROWS", "BENCH_TELEMETRY_ITERS",
     "BENCH_ATTRIB", "BENCH_ATTRIB_ITERS",
     "BENCH_WINDOW", "BENCH_WINDOW_ITERS",
+    "BENCH_COLDSTART", "BENCH_COLDSTART_TIMEOUT",
+    # the warm-start cache seam itself must survive the fallback re-exec:
+    # a window that armed $LGBM_TPU_COMPILE_CACHE must not silently run
+    # the CPU fallback cold (the hermetic whitelist drops the env)
+    "LGBM_TPU_COMPILE_CACHE",
 )
 
 #: most recent bench measured on REAL TPU hardware (updated by hand after
@@ -629,6 +634,39 @@ def bench_telemetry():
     return rec
 
 
+def bench_coldstart():
+    """BENCH_COLDSTART=1 (default off — it spawns ~8 fresh python+jax
+    processes): the warm-start measurement harness (ISSUE 15) at quick
+    scale — time-to-ready and time-to-first-verified-response for cold
+    vs persistent-cache vs manifest-prewarm serving starts, the
+    trainer's first-iteration startup overhead cold vs warm cache, and
+    the replica-join-mid-run timing.  The committed BENCH_COLD_r*.json
+    artifact comes from ``python exp/bench_coldstart.py --artifact ...``
+    (full scale); this section embeds the same record at reduced scale
+    so every bench run trends it."""
+    import subprocess
+    import tempfile
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "exp", "bench_coldstart.py")
+    timeout = int(os.environ.get("BENCH_COLDSTART_TIMEOUT", "900"))
+    out = os.path.join(tempfile.gettempdir(),
+                       "bench_coldstart_%d.json" % os.getpid())
+    try:
+        r = subprocess.run([sys.executable, script, "--quick",
+                            "--out", out],
+                           timeout=timeout, capture_output=True, text=True)
+        with open(out) as fh:
+            rec = json.load(fh)
+        if r.returncode != 0:
+            rec["note_rc"] = "harness exited rc=%d" % r.returncode
+        return rec
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+
+
 def bench_attrib(bst, measure_iters):
     """BENCH_ATTRIB: device-time and cost attribution (ISSUE 10) — the
     decomposition `vs_baseline` was missing.  Per iteration on the SAME
@@ -971,6 +1009,10 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
     # batch runs export the registry through the atomic JSON-lines file
     # when $LGBM_TPU_METRICS_FILE is set (ISSUE 9)
     _telemetry.maybe_start_file_export("bench")
+    # persistent-compile-cache seam (ISSUE 15): a window that armed
+    # $LGBM_TPU_COMPILE_CACHE reuses every prior step's programs
+    from lightgbm_tpu.runtime import warmup as _warmup
+    _warmup.maybe_enable_from_env()
 
     # every bench stage runs under a named soft deadline: a hang dies as
     # a StageTimeout naming its stage (caught by main()'s rung handler,
@@ -1244,6 +1286,24 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
                                   "above is unaffected"}
             stage("ingest bench FAILED (diagnostics only)")
 
+    # warm-start harness (BENCH_COLDSTART=1 enables; off by default —
+    # it spawns fresh python+jax subprocesses).  Guarded — a failure is
+    # recorded, never fatal to the headline.
+    coldstart_rec = None
+    if os.environ.get("BENCH_COLDSTART", "0") == "1":
+        try:
+            coldstart_rec = bench_coldstart()
+            stage("coldstart done (train overhead %sx, join %.2fs)"
+                  % (coldstart_rec.get("speedup", {}).get(
+                      "train_startup_overhead_cold_over_warm"),
+                     coldstart_rec.get("replica_join", {}).get(
+                         "join_to_first_response_s", -1)))
+        except Exception as e:
+            coldstart_rec = {"error": "%s: %s" % (type(e).__name__, e),
+                             "note": "coldstart harness failed; headline "
+                                     "result above is unaffected"}
+            stage("coldstart FAILED (diagnostics only)")
+
     # telemetry overhead A/B (BENCH_TELEMETRY=0 skips): registry on vs
     # off on one booster + the <1% disabled-path assertion.  Guarded —
     # a failure is recorded, never fatal to the headline.
@@ -1325,6 +1385,8 @@ def run(n_rows, n_test, num_leaves, measure_iters, n_feat=28, max_bin=255):
         result["ingest"] = ingest_rec
     if telemetry_rec is not None:
         result["telemetry"] = telemetry_rec
+    if coldstart_rec is not None:
+        result["coldstart"] = coldstart_rec
     if hist_quant is not None:
         result["hist_quant"] = hist_quant
     if STAGED_REPORT is not None:

@@ -3,6 +3,13 @@
 # not be hardware-tested while it was down, then takes a bench reading.
 set -e -o pipefail
 cd "$(dirname "$0")/.."
+# ISSUE 15: ONE persistent XLA program cache across the whole window, so
+# steps 1-13 stop recompiling each other's programs (every python entry
+# point honors this env; the fingerprinted subdir keys backend + jax
+# version + staged flags, so the step-1b flag flips get their own cache
+# instead of poisoning this one).
+export LGBM_TPU_COMPILE_CACHE="${LGBM_TPU_COMPILE_CACHE:-$HOME/.cache/lgbm_tpu_compile_cache}"
+echo "compile cache armed: $LGBM_TPU_COMPILE_CACHE"
 echo "=== 0. resilience: watchdogged dryrun + platform health (ISSUE 4) ==="
 echo "   (exp/dryrun.py probes the real platform with a short deadline,"
 echo "    records a degradation_event if the tunnel is dead, and runs the"
@@ -224,3 +231,21 @@ PROD_SIM_TRACE_OUT=/tmp/trace_tpu.json timeout 600 \
 echo "    (ad-hoc capture on any task: LGBM_TPU_TRACE_DIR=/tmp/traces"
 echo "     python -m lightgbm_tpu task=... ; then"
 echo "     python -m lightgbm_tpu.runtime.tracing merge out.json /tmp/traces/trace_*.json)"
+echo "=== 14. warm-start bench on hardware (ISSUE 15) ==="
+echo "    (the whole window above ran under \$LGBM_TPU_COMPILE_CACHE, so"
+echo "     steps 2+ already reused step 1's programs — doctor bundles"
+echo "     carry warmup_status.json with the hit/miss ledger.  This step"
+echo "     books the ON-HARDWARE cold-start numbers: serving time-to-"
+echo "     ready / time-to-first-verified-response for cold vs cache vs"
+echo "     manifest-prewarm starts (on a tunneled TPU every compile is a"
+echo "     multi-second round trip, so the serving ratios — trend-only"
+echo "     on CPU — are real here), the trainer's fused-step startup"
+echo "     overhead cold vs warm, and the replica-join-mid-run timing"
+echo "     the autoscaler needs.  Byte-identity + zero-retrace are hard"
+echo "     gates.  COMMIT the artifact as BENCH_COLD_r<round>.json;"
+echo "     helper/bench_history.py schema-gates it and flags >10%"
+echo "     startup regressions.)"
+BENCH_COLDSTART_PLATFORM=tpu timeout 900 \
+  python exp/bench_coldstart.py --artifact /tmp/bench_cold_tpu.json \
+  && python -c "import json; d=json.load(open('/tmp/bench_cold_tpu.json')); print(json.dumps({'ok': d['ok'], 'speedup': d['speedup'], 'join_s': d['replica_join']['join_to_first_response_s']}, indent=1))" \
+  || echo "   coldstart bench FAILED on hardware — /tmp/bench_cold_tpu.json + child logs in the tempdir have the ledger"

@@ -51,7 +51,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from lightgbm_tpu.runtime import publish, resilience, telemetry, \
-    tracing  # noqa: E402
+    tracing, warmup  # noqa: E402
 
 SCHEMA_VERSION = 1
 
@@ -505,6 +505,10 @@ def run_scenario(name: str, workdir: str, replicas: int = 2,
     env = dict(os.environ)
     env.pop("LGBM_TPU_FAULT", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # one persistent compile cache for the whole fleet (ISSUE 15): the
+    # trainer and every replica share compiled programs instead of each
+    # paying the cold compile (the fingerprinted subdir keeps it safe)
+    env.setdefault(warmup.CACHE_ENV, os.path.join(workdir, "compile_cache"))
     # every process of the fleet self-collects its trace ring here
     # (ISSUE 14): the trainer's cycles + publishes, each replica's
     # requests/batches/swaps — merged below into ONE timeline

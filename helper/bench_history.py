@@ -369,6 +369,173 @@ def quality_regressions(rounds: List[Dict[str, Any]],
 
 
 # ---------------------------------------------------------------------------
+# cold-start artifacts (BENCH_COLD_r*.json, ISSUE 15)
+# ---------------------------------------------------------------------------
+
+#: (series name, artifact-relative path, higher_is_better) — every
+#: startup series is lower-is-better: time-to-ready and
+#: join-to-first-response regressing past the threshold flags.
+COLD_SERIES: Tuple[Tuple[str, Tuple[str, ...], bool], ...] = (
+    ("coldstart_ready_manifest_s",
+     ("modes", "manifest", "time_to_ready_s"), False),
+    ("coldstart_first_response_manifest_s",
+     ("modes", "manifest", "time_to_first_response_s"), False),
+    ("join_to_first_response_s",
+     ("replica_join", "join_to_first_response_s"), False),
+    ("train_startup_overhead_warm_s",
+     ("train", "warm", "startup_overhead_s"), False),
+)
+
+_COLD_MODE_REQUIRED = (
+    ("time_to_ready_s", (int, float)),
+    ("time_to_first_response_s", (int, float)),
+    ("verified", bool),
+    ("steady_retraces", int),
+    ("pred_sha256", str),
+)
+
+
+def validate_coldstart_artifact(rec: Any) -> List[str]:
+    """Schema problems of one BENCH_COLD artifact (empty = valid).  The
+    hard gates ride the schema: unverified responses, steady-state
+    retraces, or non-identical predictions across start modes make the
+    artifact INVALID, not just slow."""
+    problems: List[str] = []
+    if not isinstance(rec, dict):
+        return ["artifact is not a JSON object"]
+    if not str(rec.get("artifact", "")).startswith("BENCH_COLD_"):
+        problems.append("artifact name %r does not start with BENCH_COLD_"
+                        % rec.get("artifact"))
+    if not isinstance(rec.get("schema_version"), int):
+        problems.append("schema_version missing or not an int")
+    if not isinstance(rec.get("ok"), bool):
+        problems.append("ok flag missing")
+    modes = rec.get("modes")
+    if not isinstance(modes, dict):
+        problems.append("modes missing")
+        return problems
+    for mode in ("cold", "cache", "manifest"):
+        sec = modes.get(mode)
+        if not isinstance(sec, dict):
+            problems.append("mode %r missing" % mode)
+            continue
+        for key, typ in _COLD_MODE_REQUIRED:
+            if not isinstance(sec.get(key), typ):
+                problems.append("mode %r: %s missing or wrong type"
+                                % (mode, key))
+        if sec.get("verified") is False:
+            problems.append("mode %r: response was NOT byte-verified "
+                            "against the offline predictor" % mode)
+        if sec.get("steady_retraces"):
+            problems.append("mode %r: steady-state retraces recorded "
+                            "(the zero-retrace pin must hold under every "
+                            "start mode)" % mode)
+    if rec.get("predictions_identical") is not True:
+        problems.append("predictions_identical must be true — start "
+                        "modes changed the served bytes")
+    train = rec.get("train")
+    if not isinstance(train, dict):
+        problems.append("train section missing")
+    else:
+        for mode in ("cold", "warm"):
+            sec = train.get(mode)
+            if not isinstance(sec, dict) or not isinstance(
+                    sec.get("startup_overhead_s"), (int, float)):
+                problems.append("train %r: startup_overhead_s missing"
+                                % mode)
+        if train.get("model_identical") is not True:
+            problems.append("train: model_identical must be true — the "
+                            "persistent cache changed the trained bits")
+    join = rec.get("replica_join")
+    if join is not None:
+        if not isinstance(join.get("join_to_first_response_s"),
+                          (int, float)):
+            problems.append("replica_join: join_to_first_response_s "
+                            "missing")
+        if join.get("verified") is not True:
+            problems.append("replica_join: first response was not "
+                            "byte-verified")
+    return problems
+
+
+def load_coldstart_rounds(repo: str = REPO):
+    """(valid BENCH_COLD rounds sorted, problems of invalid ones)."""
+    rounds: List[Dict[str, Any]] = []
+    problems: List[str] = []
+    for path in glob.glob(os.path.join(repo, "BENCH_COLD_r*.json")):
+        m = re.search(r"BENCH_COLD_r(\d+)\.json$", path)
+        if not m:
+            continue
+        base = os.path.basename(path)
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError) as e:
+            problems.append("%s: unreadable (%s)" % (base, e))
+            continue
+        bad = validate_coldstart_artifact(rec)
+        if bad:
+            problems.append("%s: %s" % (base, "; ".join(bad)))
+            continue
+        rec["_round"] = int(m.group(1))
+        rec["_file"] = base
+        rounds.append(rec)
+    return sorted(rounds, key=lambda r: r["_round"]), problems
+
+
+def coldstart_trajectory(rounds: List[Dict[str, Any]]
+                         ) -> List[Dict[str, Any]]:
+    rows = []
+    for rec in rounds:
+        row: Dict[str, Any] = {
+            "round": rec["_round"], "platform": rec.get("platform"),
+            "n_trees": rec.get("n_trees"), "ok": rec.get("ok"),
+            "coldstart_ready_cold_s": _get(
+                rec, ("modes", "cold", "time_to_ready_s")),
+            "ready_speedup": _get(
+                rec, ("speedup", "ready_cold_over_manifest")),
+        }
+        for name, path, _ in COLD_SERIES:
+            v = _get(rec, path)
+            if v is not None:
+                row[name] = v
+        rows.append(row)
+    return rows
+
+
+def coldstart_regressions(rounds: List[Dict[str, Any]],
+                          threshold: float = REGRESSION_THRESHOLD
+                          ) -> List[Dict[str, Any]]:
+    """Rounds whose startup series ROSE > threshold vs the best prior
+    round at the same (platform, n_trees) shape."""
+    flags: List[Dict[str, Any]] = []
+    for name, path, higher_better in COLD_SERIES:
+        best: Dict[Tuple, Tuple[float, int]] = {}
+        for rec in rounds:
+            v = _get(rec, path)
+            if not isinstance(v, (int, float)):
+                continue
+            shape = (repr(rec.get("platform")), repr(rec.get("n_trees")))
+            prior = best.get(shape)
+            if prior is not None and prior[0] > 0:
+                worse = (v < prior[0] * (1.0 - threshold) if higher_better
+                         else v > prior[0] * (1.0 + threshold))
+                if worse:
+                    flags.append({
+                        "round": rec["_round"], "series": name,
+                        "value": v, "best_prior": prior[0],
+                        "best_prior_round": prior[1],
+                        "change_pct": round((v / prior[0] - 1.0) * 100, 1),
+                        "shape": shape,
+                    })
+            better = (prior is None or
+                      (v > prior[0] if higher_better else v < prior[0]))
+            if better:
+                best[shape] = (float(v), rec["_round"])
+    return sorted(flags, key=lambda f: (f["round"], f["series"]))
+
+
+# ---------------------------------------------------------------------------
 # production-sim artifacts (SIM_r*.json, ISSUE 11)
 # ---------------------------------------------------------------------------
 
@@ -538,7 +705,17 @@ def run(repo: str = REPO,
     q_rounds, q_problems = load_quality_rounds(repo)
     q_flags = quality_regressions(q_rounds, threshold)
     q_latest = q_rounds[-1]["_round"] if q_rounds else None
+    c_rounds, c_problems = load_coldstart_rounds(repo)
+    c_flags = coldstart_regressions(c_rounds, threshold)
+    c_latest = c_rounds[-1]["_round"] if c_rounds else None
     return {"rounds": len(rounds),
+            "coldstart_rounds": len(c_rounds),
+            "coldstart_latest_round": c_latest,
+            "coldstart_trajectory": coldstart_trajectory(c_rounds),
+            "coldstart_regressions": c_flags,
+            "coldstart_latest_regressions": [f for f in c_flags
+                                             if f["round"] == c_latest],
+            "invalid_coldstart_artifacts": c_problems,
             "latest_round": latest,
             "trajectory": trajectory(rounds),
             "regressions": flags,
@@ -613,11 +790,32 @@ def main(argv=None) -> int:
                      f["best_prior"]))
         for p in rep["invalid_quality_artifacts"]:
             print("INVALID QUALITY ARTIFACT: %s" % p)
+    if rep["coldstart_rounds"] or rep["invalid_coldstart_artifacts"]:
+        print("bench_history: %d coldstart round(s) collated"
+              % rep["coldstart_rounds"])
+        c_cols = ["round", "platform", "coldstart_ready_manifest_s",
+                  "join_to_first_response_s",
+                  "train_startup_overhead_warm_s", "ok"]
+        print("  ".join("%-13s" % c for c in c_cols))
+        for row in rep["coldstart_trajectory"]:
+            print("  ".join("%-13s" % (row.get(c, "-"),) for c in c_cols))
+        for f in rep["coldstart_regressions"]:
+            kind = ("COLDSTART REGRESSION"
+                    if f["round"] == rep["coldstart_latest_round"]
+                    else "historical coldstart regression")
+            print("%s: round %d %s = %s moved %+.1f%% vs round %d's %s"
+                  % (kind, f["round"], f["series"], f["value"],
+                     f["change_pct"], f["best_prior_round"],
+                     f["best_prior"]))
+        for p in rep["invalid_coldstart_artifacts"]:
+            print("INVALID COLDSTART ARTIFACT: %s" % p)
     failed = bool(rep["latest_regressions"]
                   or rep["sim_latest_regressions"]
                   or rep["invalid_sim_artifacts"]
                   or rep["quality_latest_regressions"]
-                  or rep["invalid_quality_artifacts"])
+                  or rep["invalid_quality_artifacts"]
+                  or rep["coldstart_latest_regressions"]
+                  or rep["invalid_coldstart_artifacts"])
     if not failed:
         print("bench_history: OK (latest round has no >%.0f%% regression)"
               % (REGRESSION_THRESHOLD * 100))

@@ -74,6 +74,16 @@ class Application:
             tracing.set_enabled(False)
         tracing.set_context(self.task)
         tracing.maybe_autostart()
+        # persistent-compile-cache seam (ISSUE 15): compile_cache_dir=
+        # (same as $LGBM_TPU_COMPILE_CACHE) wires jax's persistent
+        # compilation cache to a fingerprinted subdirectory before any
+        # task compiles; zero-cost (no jax import) when neither is set
+        from .runtime import warmup
+        cache_dir = self.raw_params.pop("compile_cache_dir", None)
+        if cache_dir:
+            warmup.enable_compile_cache(cache_dir)
+        else:
+            warmup.maybe_enable_from_env()
 
     def run(self) -> None:
         if self.task in ("train", "refit"):

@@ -13,6 +13,7 @@ from . import publish  # noqa: F401
 from . import resilience  # noqa: F401
 from . import telemetry  # noqa: F401
 from . import tracing  # noqa: F401
+from . import warmup  # noqa: F401
 from . import xla_obs  # noqa: F401
 
 #: the observability surface (ISSUE 9): `from lightgbm_tpu.runtime import
@@ -22,4 +23,4 @@ from . import xla_obs  # noqa: F401
 obs = telemetry
 
 __all__ = ["resilience", "publish", "telemetry", "obs", "tracing",
-           "xla_obs"]
+           "warmup", "xla_obs"]

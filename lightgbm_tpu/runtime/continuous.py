@@ -47,7 +47,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from . import publish, quality, resilience, syncs, telemetry, tracing, \
-    xla_obs
+    warmup, xla_obs
 from ..utils.log import LightGBMError, Log
 
 __all__ = ["ContinuousTrainer", "OnlineParams"]
@@ -111,6 +111,11 @@ class OnlineParams:
         self.gate_holdout = float(p.pop("publish_gate_holdout", 0.2))
         gm = p.pop("publish_gate_metric", None)
         self.gate_metric = str(gm) if gm else None
+        # warm start (ISSUE 15): a relaunch whose publish dir carries a
+        # matching shape manifest precompiles the fused-step family
+        # BEFORE the first cycle slot (online_prewarm=false opts out)
+        self.prewarm = str(p.pop("online_prewarm", "true")
+                           ).lower() not in ("false", "0")
         self.train_params = p
         if not self.data:
             raise LightGBMError("train_online needs data=<file>")
@@ -637,6 +642,9 @@ class ContinuousTrainer:
     # -- the loop ------------------------------------------------------------
     def run(self) -> int:
         cfg = self.cfg
+        # persistent-compile-cache seam (ISSUE 15): honor
+        # $LGBM_TPU_COMPILE_CACHE before the first cycle compiles
+        warmup.maybe_enable_from_env()
         guard = resilience.PreemptionGuard(cfg.output_model,
                                            retention=cfg.snapshot_retention,
                                            log=self.log)
@@ -687,6 +695,11 @@ class ContinuousTrainer:
             resilience.atomic_write(self._state_path,
                                     json.dumps(state, indent=1))
 
+        # warm start (ISSUE 15): a relaunch compiles the fused-step
+        # family NOW — during the dead time before the first slot —
+        # instead of inside cycle 1's budget
+        self._maybe_prewarm(X, y, q)
+
         cycle = done + 1
         while cfg.cycles <= 0 or cycle <= cfg.cycles:
             self._stage(cycle, "wait for slot", seconds=0)
@@ -727,6 +740,65 @@ class ContinuousTrainer:
         self.log.info("online: target of %d cycles reached; final model "
                       "saved to %s", cfg.cycles, cfg.output_model)
         return 0
+
+    # -- warm start (ISSUE 15): manifest prewarm + manifest export ----------
+    def _maybe_prewarm(self, X, y, q) -> None:
+        """Relaunch prewarm: when the publish dir's ``warmup.json``
+        carries a ``train_online`` section whose program-shape signature
+        matches THIS configuration, train ONE iteration on a THROWAWAY
+        booster over the same window — every fused-step program the real
+        loop needs compiles (or loads from the persistent cache) before
+        the first cycle slot, and the live booster's state is untouched,
+        so published generations stay byte-identical (the test_continuous
+        schedule-rejoin pins now run over this path).  Any mismatch or
+        failure degrades to a cold first cycle, counted in
+        ``lgbm_warmup_total{kind="train_online",outcome}``."""
+        if not self.cfg.prewarm or self.cfg.interval_s <= 0:
+            # interval 0 = no slot wait to hide the prewarm in: the
+            # first cycle starts immediately, so prewarming would only
+            # delay it (schedule-free bench/test runs keep today's cost)
+            return
+        t0 = time.monotonic()
+        outcome = "legacy"
+        try:
+            sec, reason = warmup.read_manifest(self.cfg.publish_dir,
+                                               "train_online")
+            if sec is None:
+                outcome = "manifest_" + reason
+            else:
+                outcome = warmup.classify_train_section(
+                    sec, params=self.cfg.train_params,
+                    n_features=int(X.shape[1]))
+                if outcome == "ok":
+                    self.wd("prewarm: compile from manifest")
+                    throwaway = self._build_booster(X, y, q)
+                    throwaway.update()
+                    throwaway._drain()
+                    outcome = "manifest_ok"
+        except BaseException as e:   # noqa: BLE001 — never block the loop
+            outcome = "error"
+            self.log.warning("online: manifest prewarm failed (%s); "
+                             "first cycle runs cold", e)
+        dt = time.monotonic() - t0
+        warmup.record_prewarm("train_online", outcome, dt)
+        self.wd.annotate("prewarm", {"outcome": outcome,
+                                     "seconds": round(dt, 4)})
+        if outcome == "manifest_ok":
+            self.log.info("online: fused-step family prewarmed from the "
+                          "manifest in %.2fs (before the first slot)", dt)
+
+    def _export_manifest(self, cycle: int) -> None:
+        """Publish this trainer's shape manifest alongside the cycle's
+        generation: the program-shape signature + the jit sites the
+        ledger saw compile.  Best effort — a manifest failure must never
+        fail a published cycle."""
+        try:
+            n_feat = int(self._booster._model.max_feature_idx) + 1
+            self.publisher.publish_manifest(
+                "train_online", warmup.build_train_section(
+                    self.cfg.train_params, n_feat, generation=cycle))
+        except Exception as e:       # noqa: BLE001 — best effort
+            self.log.warning("online: warmup-manifest export failed: %s", e)
 
     def _run_cycle(self, cycle: int, producer, guard) -> None:
         # one trace per cycle (ISSUE 14): the root span every watchdog
@@ -845,6 +917,10 @@ class ContinuousTrainer:
         telemetry.counter("lgbm_online_cycles_total").inc(status="ok")
         self.wd.annotate("publish_latency_s",
                          round(time.monotonic() - t_pub, 4))
+        # the warm-start shape manifest rides every publish (ISSUE 15):
+        # a relaunch — or a fresh serving replica — reads it to compile
+        # before its first real work
+        self._export_manifest(cycle)
         self.log.info("online: cycle %d published generation %d (%s)",
                       cycle, rec.generation, os.path.basename(rec.path))
 

@@ -44,7 +44,7 @@ import tarfile
 import time
 from typing import Any, Dict, List, Optional
 
-from . import resilience, telemetry, tracing, xla_obs
+from . import resilience, telemetry, tracing, warmup, xla_obs
 
 __all__ = ["collect_debug_bundle", "verify_bundle", "env_fingerprint"]
 
@@ -166,6 +166,10 @@ def collect_debug_bundle(out_dir: str = ".",
                lambda: resilience.probe_platform(deadline=probe_deadline))
     gather("metrics.json", _metrics_member)
     gather("xla_ledger.json", lambda: xla_obs.LEDGER.to_json())
+    # warm-start state (ISSUE 15): persistent compile-cache dir /
+    # fingerprint / hit-miss-evict counts — the first question a slow
+    # cold start gets asked
+    gather("warmup_status.json", warmup.cache_status)
     # the trace flight recorder's ring (ISSUE 14): the causal timeline
     # of the process's last TRACE_RING_EVENTS events, Perfetto-loadable
     # straight out of the bundle

@@ -44,7 +44,8 @@ from . import resilience, tracing
 
 __all__ = ["ModelPublisher", "ModelSubscriber", "PublishedModel",
            "NoValidGeneration", "generation_paths", "validate_generation",
-           "read_rollback_marker", "mark_rollback", "rejection_paths"]
+           "read_rollback_marker", "mark_rollback", "rejection_paths",
+           "WARMUP_MANIFEST"]
 
 _META_PREFIX = "!publish_meta="
 _CHECKSUM_PREFIX = "!publish_checksum=sha256:"
@@ -58,6 +59,12 @@ MANIFEST = "MANIFEST.json"
 #: relaunched subscriber reads it before its first resolve, and
 #: concurrent readers all see one consistent bad-set.
 ROLLBACK_MARKER = "ROLLBACK.json"
+#: checksummed shape manifest published alongside the generations
+#: (ISSUE 15, runtime/warmup.py): what shape buckets and jit sites this
+#: lineage's producers/consumers actually compiled.  Like the rollback
+#: marker it is its own atomic non-generation file — pruning never
+#: touches it and concurrent readers can never observe it torn.
+WARMUP_MANIFEST = "warmup.json"
 
 
 class NoValidGeneration(RuntimeError):
@@ -295,6 +302,15 @@ class ModelPublisher:
         resilience.atomic_write(os.path.join(self.pub_dir, MANIFEST),
                                 json.dumps(manifest, indent=1))
 
+    def publish_manifest(self, kind: str, section: Dict[str, Any]) -> str:
+        """Publish one role's warm-start shape manifest alongside the
+        generations (ISSUE 15): `runtime/warmup.py` merges the section
+        into the dir's checksummed ``warmup.json`` atomically, so a
+        fresh consumer can precompile the lineage's real shapes before
+        admitting traffic.  Returns the manifest path."""
+        from . import warmup
+        return warmup.write_manifest(self.pub_dir, kind, section)
+
     def record_rejection(self, model_text: str, gate: Dict[str, Any],
                          cycle: int) -> str:
         """Persist a gate-REJECTED candidate for the audit trail (ISSUE
@@ -372,6 +388,13 @@ class ModelSubscriber:
 
     def unpin(self) -> None:
         self._pin = None
+
+    def read_warmup(self, kind: str):
+        """(warm-start manifest section, reason) for this publish dir —
+        the consumer half of the ISSUE 15 seam (see
+        `ModelPublisher.publish_manifest`)."""
+        from . import warmup
+        return warmup.read_manifest(self.pub_dir, kind)
 
     @property
     def pinned_generation(self) -> Optional[int]:

@@ -67,7 +67,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from . import policy as policy_mod
-from . import publish, resilience, telemetry, tracing, xla_obs
+from . import publish, resilience, telemetry, tracing, warmup, xla_obs
 from ..utils.log import Log
 
 __all__ = ["ServingRuntime", "ServingServer", "ServeRejected",
@@ -287,6 +287,8 @@ class ServingRuntime:
                  policy=None,
                  canary_fraction: float = 0.0,
                  canary_policy=None,
+                 prewarm_manifest: bool = True,
+                 export_manifest: bool = True,
                  log=Log):
         """`publish_dir` subscribes the default model to a PR 6 publish
         directory; `models` maps model_id -> publish_dir for
@@ -318,7 +320,18 @@ class ServingRuntime:
         candidate lands.  Sustained health PROMOTES the canary to
         incumbent.  At the default `canary_fraction=0` every new
         generation swaps in directly — byte-identical to the pre-canary
-        behavior."""
+        behavior.
+
+        ISSUE 15 warm-start knobs: with `prewarm_manifest` (default on)
+        a fresh runtime reads the newest ``warmup.json`` shape manifest
+        from each publish dir and precompiles the row buckets it names
+        BEFORE ``/healthz`` reports ready and before admission opens; a
+        torn/stale/absent/shape-mismatched manifest degrades to the
+        legacy smallest-bucket prewarm (counted in
+        ``lgbm_warmup_total{outcome}``) — it never blocks serving.
+        `export_manifest` (default on) publishes the buckets THIS
+        process actually compiled back to the publish dir at stop, so
+        the next replica starts warm."""
         self.log = log
         self._params = dict(params or {})
         self._raw_score = bool(raw_score)
@@ -360,6 +373,15 @@ class ServingRuntime:
                       for mid, d in self._dirs.items()}
         self._entries: Dict[str, _ModelEntry] = {}
         self._entries_lock = threading.Lock()
+
+        self.prewarm_manifest = bool(prewarm_manifest)
+        self.export_manifest = bool(export_manifest)
+        self.prewarm_events: List[Dict[str, Any]] = []
+        #: readiness gate (ISSUE 15): set once start() has finished the
+        #: prewarm pass — /healthz reports 503 and submit() sheds with
+        #: reason "warming" until then, so a replica never admits a
+        #: request it would answer with a cold compile
+        self._ready = threading.Event()
 
         self._queue: "collections.deque[_Request]" = collections.deque()
         self._cond = threading.Condition()
@@ -417,9 +439,15 @@ class ServingRuntime:
         if self._started:
             return self
         self._started = True
+        # persistent-compile-cache seam (ISSUE 15): honor
+        # $LGBM_TPU_COMPILE_CACHE before the first model load compiles
+        warmup.maybe_enable_from_env()
         if self._metrics_port_req is not None:
+            # /healthz answers 503 "warming" until the prewarm pass
+            # below finishes — prewarm-before-admit, visible to LBs
             self.metrics_server = telemetry.start_http_server(
-                self._metrics_port_req)
+                self._metrics_port_req,
+                health_provider=self._ready.is_set)
             self.log.info("serve: /metrics on port %d",
                           self.metrics_server.port)
         with self._wd_lock:
@@ -441,6 +469,12 @@ class ServingRuntime:
             self._swap_in("default", self._static, generation=0, meta={})
         for mid in self._dirs:
             self._poll_model(mid)       # best effort; poller keeps trying
+        # prewarm-before-admit (ISSUE 15): precompile the shape buckets
+        # the lineage's manifest names BEFORE readiness opens.  Bounded
+        # and guarded — a bad manifest degrades to the smallest-bucket
+        # prewarm _swap_in already did, never blocks serving.
+        self._prewarm_start()
+        self._ready.set()
         self._executor = self._spawn_executor()
         self._batcher = threading.Thread(target=self._batcher_loop,
                                          name="serve-batcher", daemon=True)
@@ -474,6 +508,16 @@ class ServingRuntime:
                                           priority=req.priority)
             req.done.set()
             self._count_rejection("shutdown", priority=req.priority)
+        # publish this process's observed shape buckets so the NEXT
+        # replica of the lineage starts warm (ISSUE 15); best effort —
+        # shutdown must never fail on a read-only publish dir
+        if self.export_manifest:
+            for mid in list(self._dirs):
+                try:
+                    self.export_warmup_manifest(mid)
+                except Exception as e:    # noqa: BLE001 — best effort
+                    self.log.warning("serve: warmup-manifest export for "
+                                     "%s failed: %s", mid, e)
         if self._executor is not None:
             self._executor.submit(None)
         for t in (self._batcher, self._poller, self._policy_thread):
@@ -558,6 +602,102 @@ class ServingRuntime:
         if can is not None and can.generation == rec.generation:
             return
         self._canary_in(model_id, rec)
+
+    # -- warm start (ISSUE 15): manifest prewarm + manifest export ----------
+    def _prewarm_start(self) -> None:
+        """Read each publish dir's ``warmup.json`` and precompile the
+        row buckets it names, BEFORE `_ready` opens.  Every attempt —
+        manifest-driven or degraded — is counted in
+        ``lgbm_warmup_total{kind="serving",outcome}``; a degradation
+        means the legacy smallest-bucket prewarm from `_swap_in` is all
+        this replica starts with, exactly the pre-ISSUE-15 behavior."""
+        if not self.prewarm_manifest:
+            return
+        for mid, pub_dir in self._dirs.items():
+            t0 = time.monotonic()
+            entry = self._entries.get(mid)
+            outcome, buckets = "legacy", []
+            try:
+                sec, reason = warmup.read_manifest(pub_dir, "serving")
+                if sec is None:
+                    outcome = "manifest_" + reason
+                elif entry is None:
+                    # nothing resolved yet (racing the very first
+                    # publish): the poller's later swap-in prewarms
+                    outcome = "no_model"
+                else:
+                    outcome = warmup.classify_serving_section(
+                        sec, num_features=entry.num_features,
+                        newest_generation=entry.generation)
+                    if outcome == "ok":
+                        buckets = self._prewarm_buckets(
+                            entry, sec["row_buckets"])
+                        outcome = "manifest_ok"
+            except Exception as e:      # noqa: BLE001 — never block serving
+                outcome = "error"
+                self.log.warning("serve: manifest prewarm of %s failed "
+                                 "(%s); legacy prewarm serves", mid, e)
+            dt = time.monotonic() - t0
+            warmup.record_prewarm("serving", outcome, dt)
+            event = {"model": mid, "outcome": outcome,
+                     "buckets": buckets, "seconds": round(dt, 4),
+                     "wallclock": resilience.wallclock()}
+            self.prewarm_events.append(event)
+            with self._wd_lock:
+                self.wd.annotate("prewarm", event)
+            if outcome == "manifest_ok":
+                self.log.info("serve: %s prewarmed %d manifest bucket(s) "
+                              "in %.3fs before admission", mid,
+                              len(buckets), dt)
+
+    def _prewarm_buckets(self, entry: _ModelEntry,
+                         buckets: List[int]) -> List[int]:
+        """Dispatch one zero batch per manifest row bucket through the
+        device path, so the bucketed programs compile (or load from the
+        persistent cache) before the first real request.  Bounded: at
+        most MAX_PREWARM_BUCKETS, each clamped to the micro-batch bucket
+        ceiling; a failing bucket is skipped (the host path still
+        serves), never fatal."""
+        cap = max(self.max_batch_rows, 16)
+        todo = sorted({min(int(b), cap) for b in buckets
+                       if isinstance(b, int) and b > 0})
+        done: List[int] = []
+        for b in todo[:warmup.MAX_PREWARM_BUCKETS]:
+            c0 = xla_obs.total_compiles()
+            try:
+                entry.booster.predict(
+                    np.zeros((b, entry.num_features)),
+                    raw_score=self._raw_score, device=True)
+            except BaseException as e:   # noqa: BLE001 — degraded path
+                self.log.warning("serve: prewarm of bucket %d failed "
+                                 "(%s); skipping", b, e)
+                continue
+            compiles = xla_obs.total_compiles() - c0
+            xla_obs.cache_event("serving.prewarm",
+                                "compile" if compiles else "hit",
+                                max(compiles, 1))
+            done.append(b)
+        return done
+
+    def export_warmup_manifest(self, model_id: str = "default"
+                               ) -> Optional[str]:
+        """Publish the row buckets THIS process actually compiled (from
+        the xla_obs ledger) as the publish dir's ``serving`` manifest
+        section.  No-op (returns None) when the model has no publish dir
+        or no bucket ever compiled — an empty export must not clobber a
+        useful manifest."""
+        pub_dir = self._dirs.get(model_id)
+        entry = self._entries.get(model_id)
+        if not pub_dir or entry is None:
+            return None
+        buckets = warmup.serving_row_buckets(
+            num_features=entry.num_features)
+        if not buckets:
+            return None
+        return publish.ModelPublisher(pub_dir).publish_manifest(
+            "serving", warmup.build_serving_section(
+                num_features=entry.num_features, row_buckets=buckets,
+                generation=entry.generation))
 
     # -- canary + automatic rollback (ISSUE 12 stage three) -----------------
     def _policy_for(self, model_id: str) -> policy_mod.CanaryPolicy:
@@ -725,6 +865,12 @@ class ServingRuntime:
         """The live /metrics port (None unless metrics_port= was given)."""
         return self.metrics_server.port if self.metrics_server else None
 
+    @property
+    def ready(self) -> bool:
+        """True once the prewarm pass finished and admission opened
+        (what /healthz reports)."""
+        return self._ready.is_set()
+
     # -- request surface -----------------------------------------------------
     def submit(self, data, deadline_s: Optional[float] = None,
                model_id: str = "default", priority: int = 0,
@@ -769,6 +915,14 @@ class ServingRuntime:
                 raise ServeRejected("shutdown", retryable=False,
                                     detail="runtime not serving",
                                     priority=prio)
+            if not self._ready.is_set():
+                # admission opens only after the prewarm pass (ISSUE
+                # 15): retryable — the client's bounded backoff lands
+                # after readiness instead of paying the cold compile
+                self._count_rejection("warming", priority=prio)
+                raise ServeRejected(
+                    "warming", retryable=True, priority=prio,
+                    detail="prewarm in progress; retry shortly")
             if self._shed_low and prio == P - 1:
                 self._count_rejection("load_shed", priority=prio)
                 raise ServeRejected(
@@ -1150,6 +1304,8 @@ class ServingRuntime:
             st["canary_policy"] = {mid: p.state() for mid, p
                                    in self._canary_policies.items()}
             st["rollback_events"] = list(self.rollback_events)
+        st["ready"] = self._ready.is_set()
+        st["prewarm_events"] = list(self.prewarm_events)
         st["degradation_events"] = list(self.degradation_events)
         st["recovery_events"] = list(self.recovery_events)
         if self.start_degradation is not None:

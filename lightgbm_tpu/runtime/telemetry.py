@@ -257,6 +257,23 @@ METRIC_TABLE: Dict[str, Dict[str, Any]] = {
         "help": "Serving micro-batches routed while a canary window is "
                 "open, kind=canary/incumbent (the canary-fraction "
                 "accounting the chaos artifact scrapes)"},
+    "lgbm_warmup_total": {
+        "type": "counter", "labels": ("kind", "outcome"),
+        "help": "Prewarm attempts by role (kind=serving/train_online) "
+                "and outcome: manifest_ok, or the degradation to the "
+                "legacy prewarm (manifest_missing/manifest_torn/"
+                "manifest_stale/manifest_invalid/shape_mismatch/error) "
+                "(runtime/warmup.py)"},
+    "lgbm_warmup_seconds": {
+        "type": "histogram", "labels": ("kind",),
+        "help": "Wall time of one prewarm pass (manifest read + bucket "
+                "precompiles before readiness opens)"},
+    "lgbm_compile_cache_events_total": {
+        "type": "counter", "labels": ("event",),
+        "help": "Persistent XLA compilation-cache traffic, event=hit "
+                "(compile loaded from disk)/miss (fresh compile wrote an "
+                "entry)/evict (LRU sweep past the size budget) "
+                "(runtime/warmup.py seam over jax_compilation_cache_dir)"},
 }
 
 # ---------------------------------------------------------------------------
@@ -940,11 +957,18 @@ class MetricsServer:
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: Optional[MetricsRegistry] = None,
-                 snapshot_provider: Optional[Any] = None):
+                 snapshot_provider: Optional[Any] = None,
+                 health_provider: Optional[Any] = None):
         """`snapshot_provider`: optional zero-arg callable returning a
         snapshot dict (e.g. `mesh_snapshot` on process 0 of a multi-host
         run) — when given, /metrics and /metrics.json serve ITS view
-        (with {host} labels) instead of the local registry."""
+        (with {host} labels) instead of the local registry.
+
+        `health_provider`: optional zero-arg callable; while it returns
+        falsy, ``/healthz`` answers 503 ``warming`` instead of 200
+        ``ok`` — the serving runtime's prewarm-before-admit readiness
+        gate (ISSUE 15): a load balancer never routes to a replica that
+        would pay a compile on its first real batch."""
         import http.server
 
         reg = registry if registry is not None else REGISTRY
@@ -952,6 +976,7 @@ class MetricsServer:
         class _Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self) -> None:            # noqa: N802 — stdlib API
                 path = self.path.split("?", 1)[0]
+                status = 200
                 if path == "/metrics":
                     if snapshot_provider is not None:
                         body = render_prometheus_from_snapshot(
@@ -965,13 +990,20 @@ class MetricsServer:
                     body = (json.dumps(snap) + "\n").encode("utf-8")
                     ctype = "application/json"
                 elif path == "/healthz":
-                    body = b"ok\n"
+                    healthy = True
+                    if health_provider is not None:
+                        try:
+                            healthy = bool(health_provider())
+                        except Exception:   # noqa: BLE001 — gate, not crash
+                            healthy = False
+                    body = b"ok\n" if healthy else b"warming\n"
+                    status = 200 if healthy else 503
                     ctype = "text/plain"
                 else:
                     self.send_response(404)
                     self.end_headers()
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -1002,9 +1034,11 @@ class MetricsServer:
 
 
 def start_http_server(port: int = 0, host: str = "127.0.0.1",
-                      registry: Optional[MetricsRegistry] = None
+                      registry: Optional[MetricsRegistry] = None,
+                      health_provider: Optional[Any] = None
                       ) -> MetricsServer:
-    return MetricsServer(port=port, host=host, registry=registry)
+    return MetricsServer(port=port, host=host, registry=registry,
+                         health_provider=health_provider)
 
 
 # ---------------------------------------------------------------------------

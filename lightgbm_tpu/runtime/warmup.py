@@ -1,0 +1,519 @@
+"""Warm-start subsystem: persistent program cache + shape manifests.
+
+Every process in the fleet used to pay cold XLA compilation on startup:
+serving replicas compiled before their first real batch, `train_online`
+relaunches recompiled the whole fused-step family after SIGTERM, and
+each step of `exp/on_tpu_return.sh` re-lowered the same ~45 `xla_obs`
+sites inside a scarce hardware window.  This module makes startup a
+measured, optimized quantity — LightGBM's own "bin once, reuse the
+binary cache" design (PAPER.md §L2) applied to compiled programs:
+
+* **Persistent compilation cache seam** — `enable_compile_cache(base)`
+  (CLI ``compile_cache_dir=`` / ``$LGBM_TPU_COMPILE_CACHE``) wires
+  ``jax_compilation_cache_dir`` to a FINGERPRINTED subdirectory of the
+  requested base: the fingerprint keys the requested backend, the jax
+  version, the staged-kernel flag set, and the host CPU feature flags
+  (XLA:CPU entries embed AOT machine code; loading one compiled on a
+  different host can die of SIGILL — the same argument
+  ``__graft_entry__._hermetic_cpu_env`` makes for the dryrun cache,
+  which stays self-contained because it runs before this package is
+  importable).  A stale or cross-version cache can therefore never
+  poison results: a different stack simply lands in a different
+  subdirectory and runs cold.  The cache is size-budgeted
+  (``$LGBM_TPU_COMPILE_CACHE_MB``, default 512): an LRU sweep by mtime
+  evicts the oldest entries past the budget.  Per-compile hit/miss
+  classification (did this compile load from disk or write a fresh
+  entry?) rides the `xla_obs` compile observer into
+  ``lgbm_compile_cache_events_total{event}`` AND the compile ledger
+  (site ``warmup.persistent_cache``), so doctor bundles and BENCH
+  records carry the cache's behavior.
+
+* **Shape manifests** — serving and the continuous trainer export the
+  shape buckets and jit sites they actually compiled (straight from the
+  `xla_obs` ledger) as a checksummed ``warmup.json`` published
+  atomically ALONGSIDE model generations in the publish directory
+  (`ModelPublisher.publish_manifest` / `ModelSubscriber.read_warmup`
+  are the publish.py seam).  The file holds one section per kind
+  (``serving`` / ``train_online``) merged read-modify-atomic-write, so
+  the trainer and N serving replicas all land without clobbering each
+  other; it is not a ``gen_`` file, so retention pruning never touches
+  it and concurrent readers can never observe a torn manifest (atomic
+  rename — test-pinned under publish/prune churn).
+
+* **Prewarm classification** — `classify_serving_section` /
+  `classify_train_section` decide whether a manifest is trustworthy for
+  THIS process (torn / stale-generation / shape-mismatched manifests
+  degrade to the legacy smallest-bucket prewarm — never block serving),
+  and `record_prewarm` counts every prewarm attempt in
+  ``lgbm_warmup_total{kind,outcome}`` + ``lgbm_warmup_seconds{kind}``.
+
+No jax at module scope — the CLI entry and platform-free subscribers
+import this; jax loads only when a cache dir is actually being enabled.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import telemetry, xla_obs
+from .resilience import atomic_write, wallclock
+
+__all__ = [
+    "CACHE_ENV", "CACHE_BUDGET_ENV", "MANIFEST_NAME",
+    "MANIFEST_SCHEMA_VERSION",
+    "cache_fingerprint", "enable_compile_cache", "maybe_enable_from_env",
+    "sweep_cache", "cache_status",
+    "write_manifest", "read_manifest", "manifest_path",
+    "build_serving_section", "build_train_section", "params_sig",
+    "classify_serving_section", "classify_train_section",
+    "serving_row_buckets", "record_prewarm",
+]
+
+#: base directory of the persistent compilation cache (the fingerprinted
+#: subdir is created under it); CLI spelling: ``compile_cache_dir=``
+CACHE_ENV = "LGBM_TPU_COMPILE_CACHE"
+
+#: size budget of ONE fingerprinted subdirectory, in MB (LRU sweep by
+#: mtime past it; 0 disables the sweep)
+CACHE_BUDGET_ENV = "LGBM_TPU_COMPILE_CACHE_MB"
+DEFAULT_BUDGET_MB = 512
+
+#: the shape manifest published alongside model generations.  Not a
+#: ``gen_`` file: `publish.generation_paths` never lists it and
+#: `ModelPublisher._prune` never unlinks it.
+MANIFEST_NAME = "warmup.json"
+MANIFEST_SCHEMA_VERSION = 1
+
+#: serving prewarm never compiles more than this many manifest buckets
+#: (a runaway manifest must not stall readiness indefinitely)
+MAX_PREWARM_BUCKETS = 8
+
+#: sanity bound on a manifest row bucket (2^22 rows is far past any
+#: serving batch); anything outside [1, this] marks the manifest invalid
+MAX_BUCKET_ROWS = 1 << 22
+
+_lock = threading.Lock()
+_STATE: Dict[str, Any] = {
+    "enabled": False, "dir": None, "fingerprint": None,
+    "hits": 0, "misses": 0, "evictions": 0, "budget_mb": None,
+    "dir_sig": None,
+}
+
+
+# ---------------------------------------------------------------------------
+# fingerprint
+# ---------------------------------------------------------------------------
+
+def _host_fingerprint() -> str:
+    """Short stable hash of this host's CPU feature flags (XLA:CPU cache
+    entries embed AOT machine code — a different host gets a cold cache
+    instead of a SIGILL)."""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            flags = next((ln for ln in fh if ln.startswith("flags")), "")
+    except OSError:
+        flags = ""
+    import platform
+    blob = (flags + "|" + platform.machine()).encode()
+    return hashlib.sha256(blob).hexdigest()[:8]
+
+
+def _staged_flags_sig() -> str:
+    """Hash of the staged-kernel flag set AND its current values: a flag
+    flip (exp/flip_validated.py) compiles different programs, so it gets
+    its own cache subdirectory instead of poisoning the old one."""
+    try:
+        from ..ops import pallas_segment as pseg
+        pairs = sorted((name, bool(getattr(pseg, flag, False)))
+                       for name, flag in pseg.STAGED_FLAGS.items())
+    except Exception:    # noqa: BLE001 — a broken kernel import stays cold
+        pairs = [("nostaged", False)]
+    return hashlib.sha256(repr(pairs).encode()).hexdigest()[:8]
+
+
+def _requested_backend() -> str:
+    """The REQUESTED platform string, without initializing a backend:
+    jax.config's jax_platforms when jax is already imported, else the
+    JAX_PLATFORMS env var, else "default"."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            p = jax.config.jax_platforms
+            if p:
+                return str(p)
+        except Exception:    # noqa: BLE001 — config attr moved
+            pass
+    return os.environ.get("JAX_PLATFORMS") or "default"
+
+
+def cache_fingerprint() -> str:
+    """Identity of the compiled-program universe this process inhabits:
+    ``<backend>-jax<version>-<staged8>-<host8>``.  Two processes share a
+    cache subdirectory iff every component matches."""
+    import jax
+    backend = _requested_backend().replace(os.sep, "_").replace(",", "+")
+    return "%s-jax%s-%s-%s" % (backend, jax.__version__,
+                               _staged_flags_sig(), _host_fingerprint())
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache
+# ---------------------------------------------------------------------------
+
+def enable_compile_cache(base_dir: Optional[str] = None,
+                         budget_mb: Optional[int] = None,
+                         min_compile_s: float = 0.0) -> Optional[str]:
+    """Wire jax's persistent compilation cache to the fingerprinted
+    subdirectory of `base_dir` (default: ``$LGBM_TPU_COMPILE_CACHE``;
+    returns None — and touches nothing — when neither is set).
+
+    Threshold 0 persists even sub-second programs so a warm start
+    recompiles NOTHING; the size budget keeps the subdirectory bounded
+    (oldest-mtime eviction).  Idempotent per (process, dir).  Returns
+    the fingerprinted cache directory."""
+    base = base_dir if base_dir else os.environ.get(CACHE_ENV)
+    if not base:
+        return None
+    fp = cache_fingerprint()
+    cdir = os.path.join(os.path.expanduser(base), fp)
+    with _lock:
+        if _STATE["enabled"] and _STATE["dir"] == cdir:
+            return cdir
+    os.makedirs(cdir, exist_ok=True)
+    import jax
+    jax.config.update("jax_compilation_cache_dir", cdir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_s))
+    if budget_mb is None:
+        budget_mb = int(os.environ.get(CACHE_BUDGET_ENV, DEFAULT_BUDGET_MB))
+    with _lock:
+        _STATE.update(enabled=True, dir=cdir, fingerprint=fp,
+                      budget_mb=int(budget_mb),
+                      dir_sig=_dir_sig(cdir))
+    # per-compile hit/miss classification rides the compile ledger's
+    # observer seam (xla_obs must not import warmup — the observer is
+    # registered, not imported)
+    xla_obs.set_compile_observer(_compile_observer)
+    sweep_cache()
+    return cdir
+
+
+def maybe_enable_from_env() -> Optional[str]:
+    """`enable_compile_cache()` iff ``$LGBM_TPU_COMPILE_CACHE`` is set —
+    zero-cost (no jax import) when it is not.  Every service entry point
+    (CLI tasks, ServingRuntime.start, ContinuousTrainer.run, bench)
+    calls this once."""
+    if not os.environ.get(CACHE_ENV):
+        return None
+    return enable_compile_cache()
+
+
+def _dir_sig(cdir: str) -> Optional[Tuple[int, int]]:
+    """O(1) change signature of the cache directory: (mtime_ns, nlink)
+    of the dir itself — a new cache entry bumps the dir mtime.  Stat of
+    ONE inode, never a listing: the observer runs on every compile and
+    the suite-wide cache holds thousands of entries."""
+    try:
+        st = os.stat(cdir)
+        return (st.st_mtime_ns, st.st_nlink)
+    except OSError:
+        return None
+
+
+def _compile_observer(site: str, wall_s: float) -> None:
+    """Runs after every ledgered compile: a compile that wrote a NEW
+    cache entry (the dir signature moved) ran cold (miss); one that did
+    not load its executable from disk (hit).  Exact at the service
+    default persist-threshold 0, where every fresh compile writes an
+    entry; with a higher threshold (the test suite) sub-threshold
+    compiles classify as hits — stats, never correctness."""
+    with _lock:
+        cdir = _STATE["dir"] if _STATE["enabled"] else None
+        prev = _STATE["dir_sig"]
+    if cdir is None:
+        return
+    sig = _dir_sig(cdir)
+    with _lock:
+        event = "miss" if sig != prev else "hit"
+        _STATE["dir_sig"] = sig
+        _STATE["hits" if event == "hit" else "misses"] += 1
+    telemetry.counter("lgbm_compile_cache_events_total").inc(event=event)
+    xla_obs.cache_event("warmup.persistent_cache", event)
+
+
+def sweep_cache(budget_mb: Optional[int] = None) -> int:
+    """LRU sweep of the active cache directory: evict oldest-mtime
+    entries until the directory fits the budget.  Returns the number of
+    entries evicted (0 when disabled or under budget)."""
+    with _lock:
+        cdir = _STATE["dir"] if _STATE["enabled"] else None
+        if budget_mb is None:
+            budget_mb = _STATE["budget_mb"] or DEFAULT_BUDGET_MB
+    if cdir is None or budget_mb <= 0:
+        return 0
+    entries: List[Tuple[float, int, str]] = []
+    try:
+        for name in os.listdir(cdir):
+            p = os.path.join(cdir, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, st.st_size, p))
+    except OSError:
+        return 0
+    total = sum(e[1] for e in entries)
+    budget = int(budget_mb) << 20
+    evicted = 0
+    for mtime, size, p in sorted(entries):
+        if total <= budget:
+            break
+        try:
+            os.unlink(p)
+        except OSError:
+            continue
+        total -= size
+        evicted += 1
+        telemetry.counter("lgbm_compile_cache_events_total").inc(
+            event="evict")
+    if evicted:
+        with _lock:
+            _STATE["evictions"] += evicted
+            _STATE["dir_sig"] = _dir_sig(cdir)   # re-baseline after unlinks
+    return evicted
+
+
+def cache_status() -> Dict[str, Any]:
+    """Machine-readable cache state (the doctor-bundle member)."""
+    with _lock:
+        st = {k: _STATE[k] for k in ("enabled", "dir", "fingerprint",
+                                     "hits", "misses", "evictions",
+                                     "budget_mb")}
+    files, total = 0, 0
+    if st["dir"]:
+        try:
+            for name in os.listdir(st["dir"]):
+                try:
+                    total += os.path.getsize(os.path.join(st["dir"], name))
+                    files += 1
+                except OSError:
+                    continue
+        except OSError:
+            pass
+    st["files"] = files
+    st["bytes"] = total
+    return st
+
+
+def _reset_for_tests() -> None:
+    """Test seam: forget the enable state (jax config is left as-is)."""
+    with _lock:
+        _STATE.update(enabled=False, dir=None, fingerprint=None,
+                      hits=0, misses=0, evictions=0, budget_mb=None,
+                      dir_sig=None)
+
+
+# ---------------------------------------------------------------------------
+# shape manifests (warmup.json in the publish dir)
+# ---------------------------------------------------------------------------
+
+def manifest_path(pub_dir: str) -> str:
+    return os.path.join(pub_dir, MANIFEST_NAME)
+
+
+def _doc_checksum(doc: Dict[str, Any]) -> str:
+    payload = json.dumps({"schema_version": doc.get("schema_version"),
+                          "sections": doc.get("sections")},
+                         sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def _read_doc(pub_dir: str) -> Tuple[Optional[Dict[str, Any]], str]:
+    """(manifest document, reason): reason is "ok", "missing" (no file)
+    or "torn" (unparseable / checksum-invalid / wrong schema).  The
+    atomic write discipline means "torn" only ever describes a file
+    written by something that is not this seam."""
+    try:
+        with open(manifest_path(pub_dir), "rb") as fh:
+            text = fh.read().decode("utf-8", "replace")
+    except OSError:
+        return None, "missing"
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        return None, "torn"
+    if not isinstance(doc, dict) \
+            or not isinstance(doc.get("sections"), dict) \
+            or doc.get("schema_version") != MANIFEST_SCHEMA_VERSION \
+            or doc.get("checksum") != _doc_checksum(doc):
+        return None, "torn"
+    return doc, "ok"
+
+
+def write_manifest(pub_dir: str, kind: str,
+                   section: Dict[str, Any]) -> str:
+    """Merge one kind's section into the publish dir's manifest
+    (read-merge-atomic-write, the `mark_rollback` pattern: the trainer
+    and N serving replicas can all publish their sections concurrently
+    and every writer's section lands).  Returns the path."""
+    doc, _ = _read_doc(pub_dir)
+    sections = dict((doc or {}).get("sections", {}))
+    sections[str(kind)] = dict(section)
+    out = {"schema_version": MANIFEST_SCHEMA_VERSION, "sections": sections}
+    out["checksum"] = _doc_checksum(out)
+    path = manifest_path(pub_dir)
+    os.makedirs(pub_dir, exist_ok=True)
+    atomic_write(path, json.dumps(out, indent=1) + "\n")
+    return path
+
+
+def read_manifest(pub_dir: str, kind: str
+                  ) -> Tuple[Optional[Dict[str, Any]], str]:
+    """(section, reason) for one kind: reason "ok", "missing" (no file
+    or no such section) or "torn"."""
+    doc, reason = _read_doc(pub_dir)
+    if doc is None:
+        return None, reason
+    sec = doc["sections"].get(str(kind))
+    if not isinstance(sec, dict):
+        return None, "missing"
+    return sec, "ok"
+
+
+def _ledger_sites(limit: int = 32) -> List[str]:
+    """Site names the compile ledger saw compile in THIS process — the
+    manifest's provenance trail ("what did this role actually build")."""
+    snap = xla_obs.snapshot()
+    return sorted(name for name, n in snap.items() if n > 0)[:limit]
+
+
+def build_serving_section(num_features: int, row_buckets: List[int],
+                          generation: Optional[int] = None
+                          ) -> Dict[str, Any]:
+    return {
+        "kind": "serving",
+        "num_features": int(num_features),
+        "row_buckets": sorted({int(b) for b in row_buckets}),
+        "generation": int(generation) if generation is not None else None,
+        "fingerprint": _safe_fingerprint(),
+        "created": wallclock(),
+        "sites": _ledger_sites(),
+    }
+
+
+def params_sig(params: Dict[str, Any], n_features: int) -> Dict[str, Any]:
+    """The program-shape-determining parameter subset: two training
+    processes with equal signatures compile the same fused-step family
+    on a same-width window."""
+    p = params or {}
+    return {
+        "objective": str(p.get("objective", "regression")),
+        "num_class": int(p.get("num_class", 1)),
+        "num_leaves": int(p.get("num_leaves", 31)),
+        "max_bin": int(p.get("max_bin", 255)),
+        "boost_window": int(p.get("boost_window", 1)),
+        "n_features": int(n_features),
+    }
+
+
+def build_train_section(params: Dict[str, Any], n_features: int,
+                        generation: Optional[int] = None
+                        ) -> Dict[str, Any]:
+    return {
+        "kind": "train_online",
+        "params_sig": params_sig(params, n_features),
+        "generation": int(generation) if generation is not None else None,
+        "fingerprint": _safe_fingerprint(),
+        "created": wallclock(),
+        "sites": _ledger_sites(),
+    }
+
+
+def _safe_fingerprint() -> Optional[str]:
+    try:
+        return cache_fingerprint()
+    except Exception:    # noqa: BLE001 — provenance only, never a blocker
+        return None
+
+
+def classify_serving_section(sec: Dict[str, Any],
+                             num_features: Optional[int],
+                             newest_generation: Optional[int]) -> str:
+    """"ok" when the manifest's buckets can be trusted for this model;
+    otherwise the degradation outcome the metrics count:
+
+    * ``manifest_invalid`` — buckets missing/malformed/absurd;
+    * ``manifest_stale`` — written for a DIFFERENT generation whose
+      shape no longer matches (the lineage moved on; its buckets
+      describe a model this replica is not serving);
+    * ``shape_mismatch`` — written for this very generation yet the
+      feature width disagrees (a corrupt or foreign manifest).
+
+    Buckets are shape-keyed, not generation-keyed, so an old-generation
+    manifest whose feature width still matches stays "ok" — that is the
+    common steady-state case."""
+    buckets = sec.get("row_buckets")
+    if not isinstance(buckets, list) or not buckets \
+            or not all(isinstance(b, int) and 0 < b <= MAX_BUCKET_ROWS
+                       for b in buckets):
+        return "manifest_invalid"
+    nf = sec.get("num_features")
+    if num_features is not None and nf != num_features:
+        gen = sec.get("generation")
+        if isinstance(gen, int) and newest_generation is not None \
+                and gen != newest_generation:
+            return "manifest_stale"
+        return "shape_mismatch"
+    return "ok"
+
+
+def classify_train_section(sec: Dict[str, Any],
+                           params: Dict[str, Any],
+                           n_features: int) -> str:
+    """"ok" when the manifest was written by a training process whose
+    program-shape signature matches THIS one (same fused-step family —
+    prewarming pays off); "shape_mismatch" otherwise."""
+    sig = sec.get("params_sig")
+    if not isinstance(sig, dict):
+        return "manifest_invalid"
+    return "ok" if sig == params_sig(params, n_features) \
+        else "shape_mismatch"
+
+
+def serving_row_buckets(num_features: Optional[int] = None) -> List[int]:
+    """Row buckets the tree-parallel predictor ACTUALLY compiled in this
+    process, read straight from the xla_obs ledger (the compile history
+    of site ``predictor.tree_parallel`` records each trace's abstract
+    shapes — the X argument is ``f32[rows,features]``)."""
+    import re
+    rec = xla_obs.LEDGER.register("predictor.tree_parallel")
+    sigs: List[List[str]] = [list(h.get("signature", []))
+                             for h in rec.history]
+    if rec.last_sig:
+        sigs.append(list(rec.last_sig))
+    pat = re.compile(r"^f32\[(\d+),(\d+)\]$")
+    buckets = set()
+    for sig in sigs:
+        for entry in sig:
+            m = pat.match(entry)
+            if not m:
+                continue
+            rows, feats = int(m.group(1)), int(m.group(2))
+            if num_features is not None and feats != num_features:
+                continue
+            buckets.add(rows)
+    return sorted(buckets)
+
+
+def record_prewarm(kind: str, outcome: str, seconds: float) -> None:
+    """Count one prewarm attempt: every path — manifest-driven, degraded
+    to legacy, or errored — lands in ``lgbm_warmup_total{kind,outcome}``
+    so the fleet's warm-start behavior is scrapeable."""
+    telemetry.counter("lgbm_warmup_total").inc(kind=kind, outcome=outcome)
+    telemetry.histogram("lgbm_warmup_seconds").observe(
+        max(float(seconds), 0.0), kind=kind)

@@ -57,6 +57,7 @@ __all__ = [
     "jit", "LEDGER", "CompileLedger", "cache_event", "mark_steady",
     "set_cost_capture", "snapshot", "delta", "total_compiles", "reset",
     "calls_snapshot", "calls_delta", "total_calls",
+    "set_compile_observer",
 ]
 
 #: compile-history entries kept per site (bounded: the ledger lives for
@@ -163,6 +164,10 @@ class CompileLedger:
         self._tls = threading.local()
         self._steady = False
         self._cost_capture = False
+        #: post-compile observer (runtime/warmup.py's persistent-cache
+        #: hit/miss classifier registers here — warmup imports this
+        #: module, never the reverse)
+        self._observer: Optional[Callable[[str, float], None]] = None
         #: steady-state violations: {site, delta, wallclock, wall_s}
         self.retraces: List[Dict[str, Any]] = []
 
@@ -230,6 +235,12 @@ class CompileLedger:
             tracing.instant("xla RETRACE %s" % rec.name,
                             track="xla compile", site=rec.name,
                             delta=delta_s)
+        obs = self._observer
+        if obs is not None:
+            try:
+                obs(rec.name, wall_s)
+            except Exception:    # noqa: BLE001 — never the compile's problem
+                pass
 
     # -- python-side cache events --------------------------------------------
     def cache_event(self, site: str, event: str, n: int = 1) -> None:
@@ -258,6 +269,13 @@ class CompileLedger:
         prev = self._cost_capture
         self._cost_capture = bool(on)
         return prev
+
+    def set_compile_observer(self, fn: Optional[Callable[[str, float],
+                                                         None]]) -> None:
+        """Register the post-compile observer (one per process; None
+        unregisters).  Called with (site, wall_s) AFTER each compile is
+        recorded; an observer exception is swallowed."""
+        self._observer = fn
 
     # -- read side -----------------------------------------------------------
     def total_compiles(self) -> int:
@@ -450,3 +468,8 @@ def total_calls() -> int:
 
 def reset() -> None:
     LEDGER.reset()
+
+
+def set_compile_observer(fn: Optional[Callable[[str, float], None]]
+                         ) -> None:
+    LEDGER.set_compile_observer(fn)

@@ -18,14 +18,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 import pytest
 
-# persistent XLA compilation cache: repeated pytest runs skip recompiles
-import jax
-jax.config.update("jax_compilation_cache_dir", "/tmp/lgbtpu_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 # The session environment pins JAX_PLATFORMS=axon (the TPU tunnel), so tests
 # force the 8-device virtual CPU mesh via jax.config.  Set
 # LGBTPU_TEST_PLATFORM=tpu (or axon) to run the suite on real hardware.
+import jax
 jax.config.update("jax_platforms", os.environ.get("LGBTPU_TEST_PLATFORM", "cpu"))
+# persistent XLA compilation cache through the product seam (ISSUE 15):
+# repeated pytest runs skip recompiles, and the fingerprinted subdir
+# (backend + jax version + staged flags + host CPU) means a jax upgrade
+# or cross-environment run can never load a stale cache entry — the old
+# flat /tmp/lgbtpu_jax_cache was shared across jax versions.
+from lightgbm_tpu.runtime import warmup
+# min_compile_s=1.0: the suite compiles thousands of tiny programs —
+# persisting only >=1s compiles (the pre-seam behavior) keeps the wall
+# time flat while the expensive programs still carry across runs.
+# Services keep the seam default of 0 (a warm start recompiles nothing).
+warmup.enable_compile_cache(
+    os.environ.get(warmup.CACHE_ENV, "/tmp/lgbtpu_jax_cache"),
+    min_compile_s=1.0)
 
 def pytest_configure(config):
     # tier-1 runs with -m 'not slow'; slow covers multi-process launches

@@ -334,3 +334,90 @@ def test_quality_artifact_schema_gates(tmp_path):
     rep = bench_history.run(str(tmp_path))
     assert rep["invalid_quality_artifacts"]
     assert rep["quality_rounds"] == 0
+
+
+# ---------------------------------------------------------------------------
+# cold-start artifacts (BENCH_COLD_r*.json, ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def _cold_mode(ready=0.25, first=0.3, sha="a" * 64):
+    return {"time_to_ready_s": ready, "time_to_first_response_s": first,
+            "verified": True, "steady_retraces": 0, "pred_sha256": sha,
+            "served_by": "device"}
+
+
+def _cold_rec(n=15, manifest_ready=0.25, join=1.7, warm_overhead=0.7,
+              platform="cpu", n_trees=100, **over):
+    rec = {
+        "artifact": "BENCH_COLD_r%02d" % n, "schema_version": 1,
+        "platform": platform, "n_trees": n_trees, "ok": True,
+        "modes": {"cold": _cold_mode(0.9, 1.2),
+                  "cache": _cold_mode(0.3, 0.4),
+                  "manifest": _cold_mode(manifest_ready, manifest_ready)},
+        "train": {"cold": {"startup_overhead_s": 2.5},
+                  "warm": {"startup_overhead_s": warm_overhead},
+                  "model_identical": True},
+        "predictions_identical": True,
+        "replica_join": {"join_to_first_response_s": join,
+                         "verified": True},
+    }
+    rec.update(over)
+    return rec
+
+
+def _write_cold(d, n, rec):
+    (d / ("BENCH_COLD_r%02d.json" % n)).write_text(json.dumps(rec))
+
+
+def test_committed_coldstart_artifact_validates():
+    path = os.path.join(REPO, "BENCH_COLD_r15.json")
+    rec = json.load(open(path))
+    assert bench_history.validate_coldstart_artifact(rec) == []
+    assert rec["ok"] is True
+    # the acceptance bar: warm-start >= 2x faster than cold startup
+    assert rec["speedup"]["train_startup_overhead_cold_over_warm"] >= 2.0
+
+
+def test_coldstart_trajectory_and_rise_flags(tmp_path):
+    """Every startup series is lower-is-better: a >10% rise in
+    join-to-first-response or warm startup overhead flags the latest
+    round; same-shape rounds only."""
+    _write_cold(tmp_path, 15, _cold_rec(15, join=1.5, warm_overhead=0.6))
+    _write_cold(tmp_path, 16, _cold_rec(16, join=2.5, warm_overhead=0.9))
+    rep = bench_history.run(str(tmp_path))
+    assert rep["coldstart_rounds"] == 2
+    assert rep["invalid_coldstart_artifacts"] == []
+    flagged = {f["series"] for f in rep["coldstart_latest_regressions"]}
+    assert "join_to_first_response_s" in flagged
+    assert "train_startup_overhead_warm_s" in flagged
+    # improvements never flag; cross-shape rounds never compared
+    for p in tmp_path.glob("BENCH_COLD_r*.json"):
+        p.unlink()
+    _write_cold(tmp_path, 15, _cold_rec(15, join=2.5))
+    _write_cold(tmp_path, 16, _cold_rec(16, join=1.0, n_trees=40))
+    rep = bench_history.run(str(tmp_path))
+    assert rep["coldstart_latest_regressions"] == []
+
+
+def test_coldstart_schema_gates(tmp_path):
+    # an unverified mode is INVALID, as are steady-state retraces, a
+    # prediction divergence across start modes, or changed trained bits
+    bad = _cold_rec()
+    bad["modes"]["cache"]["verified"] = False
+    assert any("byte-verified" in p
+               for p in bench_history.validate_coldstart_artifact(bad))
+    bad2 = _cold_rec()
+    bad2["modes"]["manifest"]["steady_retraces"] = 2
+    assert any("zero-retrace" in p
+               for p in bench_history.validate_coldstart_artifact(bad2))
+    bad3 = _cold_rec(predictions_identical=False)
+    assert any("predictions_identical" in p
+               for p in bench_history.validate_coldstart_artifact(bad3))
+    bad4 = _cold_rec()
+    bad4["train"]["model_identical"] = False
+    assert any("trained bits" in p
+               for p in bench_history.validate_coldstart_artifact(bad4))
+    _write_cold(tmp_path, 15, bad4)
+    rep = bench_history.run(str(tmp_path))
+    assert rep["invalid_coldstart_artifacts"]
+    assert rep["coldstart_rounds"] == 0
