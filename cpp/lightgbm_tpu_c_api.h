@@ -183,6 +183,49 @@ int LGBM_BoosterPredictForMatSingleRowFast(FastConfigHandle fast_config,
 
 int LGBM_FastConfigFree(FastConfigHandle fast_config);
 
+/* Binary serving wire protocol (ISSUE 16 data plane; runtime/wire.py).
+ *
+ * Little-endian length-prefixed frames over TCP or a Unix-domain
+ * socket: a fixed 40-byte header, then payload_len payload bytes whose
+ * CRC32 (zlib polynomial) is in the header.  Requests carry n_rows x
+ * n_cols float32 features (payload_len == n_rows * n_cols * 4);
+ * responses carry a 32-byte meta block (generation int64; latency,
+ * queue_wait, batch_gather, device, drain float32; served_by,
+ * compiled uint8; 2 pad) then n_rows x n_cols float32 predictions;
+ * rejections carry retry_after_s float32, retryable uint8, reserved
+ * uint8, reason_len uint16, then the reason bytes.
+ *
+ * The canonical field layout below is pinned token-for-token against
+ * the Python HEADER_FIELDS tuple by helper/check_wire_abi.py (field
+ * names + struct(3) format codes) — edit both together or the lint
+ * fails the build.
+ *
+ * WIRE_FRAME_FIELDS: magic:4s version:B msg_type:B dtype:B flags:B
+ *   model_id:16s n_rows:I n_cols:I payload_len:I crc32:I
+ */
+#define LGBM_WIRE_MAGIC "LGBW"
+#define LGBM_WIRE_VERSION (1)
+#define LGBM_WIRE_MSG_REQUEST (1)
+#define LGBM_WIRE_MSG_RESPONSE (2)
+#define LGBM_WIRE_MSG_REJECT (3)
+#define LGBM_WIRE_DTYPE_F32 (0)
+#define LGBM_WIRE_HEADER_SIZE (40)
+
+#pragma pack(push, 1)
+typedef struct LGBMWireFrameHeader {
+  char magic[4];        /* "LGBW" */
+  uint8_t version;      /* LGBM_WIRE_VERSION */
+  uint8_t msg_type;     /* LGBM_WIRE_MSG_* */
+  uint8_t dtype;        /* LGBM_WIRE_DTYPE_F32 */
+  uint8_t flags;        /* reserved, 0 */
+  char model_id[16];    /* NUL-padded model id */
+  uint32_t n_rows;      /* rows in the feature/value matrix */
+  uint32_t n_cols;      /* feature count (req) / outputs (resp) */
+  uint32_t payload_len; /* bytes following the header */
+  uint32_t crc32;       /* zlib CRC32 of the payload */
+} LGBMWireFrameHeader;
+#pragma pack(pop)
+
 /* Sparse (CSR) prediction: indptr[nindptr] row offsets (int32 or int64 by
  * indptr_type using the C_API_DTYPE_* int codes below), indices[nelem]
  * column ids, data[nelem] values.  Absent entries are 0.0 (missing-zero

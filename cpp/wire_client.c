@@ -1,0 +1,498 @@
+/* Compiled reference client for the binary serving wire protocol
+ * (ISSUE 16): proves the data plane from OUTSIDE Python at production
+ * rates, with no dependency on capi.py or any Python tooling.
+ *
+ * Two modes:
+ *
+ *   wire_client tcp HOST PORT --probes F32FILE --ncols N [options]
+ *   wire_client uds SOCKPATH  --probes F32FILE --ncols N [options]
+ *       Closed-loop socket load: --conns threads each own one
+ *       connection and send one LGBM_WIRE request frame per probe row
+ *       batch, reading the response frame back (CRC-verified both
+ *       ways).  Rejection frames count separately and their
+ *       retry_after_s hint is honored (--no-backoff hammers through
+ *       rejections instead — the offered-load overload phase).  With
+ *       --expect FILE (float32, probe-rows x n_out) and --expect-gen G
+ *       every response whose generation == G is byte-compared against
+ *       the expected values.
+ *
+ *   wire_client fastconfig LIBPATH MODELFILE --probes F32FILE --ncols N
+ *       In-process single-row ABI: dlopen lib_lightgbm_tpu.so, FastInit
+ *       once, then drive LGBM_BoosterPredictForMatSingleRowFast in a
+ *       closed loop — the compiled-caller contract of the C API.
+ *
+ * Emits one JSON line on stdout (exp/bench_wire.py parses it).
+ * Plain C99; crc32 is computed locally (zlib polynomial) so the binary
+ * links against nothing beyond pthread/dl/m.
+ */
+#define _POSIX_C_SOURCE 200809L
+#include <errno.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+#include <dlfcn.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+
+#include "lightgbm_tpu_c_api.h"
+
+#define MAX_PAYLOAD (1 << 26)
+#define MAX_LAT 2000000
+
+/* ---------------------------------------------------------------- crc32 */
+static uint32_t crc_table[256];
+
+static void crc_init(void) {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+}
+
+static uint32_t crc32_buf(const uint8_t *p, size_t n) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++)
+    c = crc_table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+/* ------------------------------------------------------------- plumbing */
+static double now_s(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
+
+static int read_full(int fd, void *buf, size_t n) {
+  uint8_t *p = (uint8_t *)buf;
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = read(fd, p + got, n - got);
+    if (r <= 0) return -1;
+    got += (size_t)r;
+  }
+  return 0;
+}
+
+static int write_full(int fd, const void *buf, size_t n) {
+  const uint8_t *p = (const uint8_t *)buf;
+  size_t put = 0;
+  while (put < n) {
+    ssize_t w = write(fd, p + put, n - put);
+    if (w <= 0) return -1;
+    put += (size_t)w;
+  }
+  return 0;
+}
+
+static int connect_tcp(const char *host, int port) {
+  char portstr[16];
+  snprintf(portstr, sizeof portstr, "%d", port);
+  struct addrinfo hints, *res = NULL;
+  memset(&hints, 0, sizeof hints);
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(host, portstr, &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (struct addrinfo *ai = res; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd >= 0) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  }
+  return fd;
+}
+
+static int connect_uds(const char *path) {
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  struct sockaddr_un sa;
+  memset(&sa, 0, sizeof sa);
+  sa.sun_family = AF_UNIX;
+  strncpy(sa.sun_path, path, sizeof sa.sun_path - 1);
+  if (connect(fd, (struct sockaddr *)&sa, sizeof sa) != 0) {
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+/* --------------------------------------------------------- socket bench */
+typedef struct {
+  int is_uds;
+  const char *host;
+  const char *path;
+  int port;
+  const char *model_id;
+  const float *probes;   /* [n_probes * ncols] */
+  long n_probes;
+  int ncols;
+  int rows;              /* rows per request frame */
+  const float *expect;   /* [n_probes * n_out] or NULL */
+  int n_out;
+  long expect_gen;       /* only verify responses from this generation */
+  int no_backoff;        /* overload mode: ignore retry_after_s hints */
+  volatile int *stop;
+  /* outputs */
+  long sent, completed, rejected, errors, checked, mismatch;
+  double *lat;           /* seconds, up to MAX_LAT/conns each */
+  long lat_cap, lat_n;
+} worker_t;
+
+static void put_header(uint8_t *h, uint8_t msg_type, const char *model_id,
+                       uint32_t n_rows, uint32_t n_cols,
+                       const uint8_t *payload, uint32_t payload_len) {
+  LGBMWireFrameHeader *hdr = (LGBMWireFrameHeader *)h;
+  memcpy(hdr->magic, LGBM_WIRE_MAGIC, 4);
+  hdr->version = LGBM_WIRE_VERSION;
+  hdr->msg_type = msg_type;
+  hdr->dtype = LGBM_WIRE_DTYPE_F32;
+  hdr->flags = 0;
+  memset(hdr->model_id, 0, sizeof hdr->model_id);
+  size_t id_len = strlen(model_id);
+  if (id_len > sizeof hdr->model_id) id_len = sizeof hdr->model_id;
+  memcpy(hdr->model_id, model_id, id_len); /* NUL-padded, not a C string */
+  hdr->n_rows = n_rows;
+  hdr->n_cols = n_cols;
+  hdr->payload_len = payload_len;
+  hdr->crc32 = crc32_buf(payload, payload_len);
+}
+
+static void *worker(void *arg) {
+  worker_t *w = (worker_t *)arg;
+  int fd = w->is_uds ? connect_uds(w->path) : connect_tcp(w->host, w->port);
+  if (fd < 0) {
+    w->errors++;
+    return NULL;
+  }
+  uint32_t req_payload = (uint32_t)(w->rows * w->ncols) * 4u;
+  uint8_t *frame = (uint8_t *)malloc(LGBM_WIRE_HEADER_SIZE + req_payload);
+  uint8_t *resp = (uint8_t *)malloc(MAX_PAYLOAD);
+  long probe = 0;
+  while (!*w->stop) {
+    /* gather `rows` consecutive probe rows (wrapping) into the frame */
+    float *dst = (float *)(frame + LGBM_WIRE_HEADER_SIZE);
+    for (int r = 0; r < w->rows; r++) {
+      long idx = (probe + r) % w->n_probes;
+      memcpy(dst + (size_t)r * w->ncols, w->probes + idx * w->ncols,
+             (size_t)w->ncols * 4);
+    }
+    put_header(frame, LGBM_WIRE_MSG_REQUEST, w->model_id,
+               (uint32_t)w->rows, (uint32_t)w->ncols,
+               frame + LGBM_WIRE_HEADER_SIZE, req_payload);
+    double t0 = now_s();
+    if (write_full(fd, frame, LGBM_WIRE_HEADER_SIZE + req_payload) != 0) {
+      w->errors++;
+      break;
+    }
+    w->sent++;
+    LGBMWireFrameHeader rh;
+    if (read_full(fd, &rh, sizeof rh) != 0) {
+      w->errors++;
+      break;
+    }
+    if (memcmp(rh.magic, LGBM_WIRE_MAGIC, 4) != 0 ||
+        rh.version != LGBM_WIRE_VERSION || rh.payload_len > MAX_PAYLOAD) {
+      w->errors++;
+      break;
+    }
+    if (read_full(fd, resp, rh.payload_len) != 0) {
+      w->errors++;
+      break;
+    }
+    if (crc32_buf(resp, rh.payload_len) != rh.crc32) {
+      w->errors++;
+      break;
+    }
+    double dt = now_s() - t0;
+    if (rh.msg_type == LGBM_WIRE_MSG_RESPONSE) {
+      w->completed++;
+      if (w->lat_n < w->lat_cap) w->lat[w->lat_n++] = dt;
+      if (w->expect && rh.n_rows == (uint32_t)w->rows &&
+          rh.n_cols == (uint32_t)w->n_out) {
+        /* resp meta block: generation is the leading int64 */
+        int64_t gen;
+        memcpy(&gen, resp, 8);
+        if (gen == (int64_t)w->expect_gen) {
+          const float *vals = (const float *)(resp + 32);
+          for (int r = 0; r < w->rows; r++) {
+            long idx = (probe + r) % w->n_probes;
+            w->checked++;
+            if (memcmp(vals + (size_t)r * w->n_out,
+                       w->expect + idx * w->n_out,
+                       (size_t)w->n_out * 4) != 0)
+              w->mismatch++;
+          }
+        }
+      }
+    } else if (rh.msg_type == LGBM_WIRE_MSG_REJECT) {
+      w->rejected++;
+      float retry_after = 0.0f;
+      uint8_t retryable = 0;
+      if (rh.payload_len >= 8) {
+        memcpy(&retry_after, resp, 4);
+        retryable = resp[4];
+      }
+      if (!retryable) break;
+      if (w->no_backoff) continue;  /* offered-load phase: hammer */
+      if (retry_after > 0.0f) {
+        struct timespec ts = {(time_t)retry_after,
+                              (long)((retry_after - (float)(time_t)retry_after)
+                                     * 1e9f)};
+        nanosleep(&ts, NULL);
+      }
+    } else {
+      w->errors++;
+      break;
+    }
+    probe = (probe + w->rows) % w->n_probes;
+  }
+  free(frame);
+  free(resp);
+  close(fd);
+  return NULL;
+}
+
+static int cmp_double(const void *a, const void *b) {
+  double x = *(const double *)a, y = *(const double *)b;
+  return (x > y) - (x < y);
+}
+
+static float *load_f32(const char *path, long *out_n) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  long bytes = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  float *buf = (float *)malloc((size_t)bytes);
+  if (fread(buf, 1, (size_t)bytes, f) != (size_t)bytes) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  fclose(f);
+  *out_n = bytes / 4;
+  return buf;
+}
+
+static int run_socket(int argc, char **argv, int is_uds) {
+  const char *host = NULL, *path = NULL;
+  int port = 0, arg = 2;
+  if (is_uds) {
+    path = argv[arg++];
+  } else {
+    host = argv[arg++];
+    port = atoi(argv[arg++]);
+  }
+  const char *probes_path = NULL, *expect_path = NULL;
+  const char *model_id = "default";
+  int conns = 4, ncols = 0, rows = 1, n_out = 1, no_backoff = 0;
+  long expect_gen = -1;
+  double secs = 5.0;
+  for (; arg < argc; arg++) {
+    if (!strcmp(argv[arg], "--probes")) probes_path = argv[++arg];
+    else if (!strcmp(argv[arg], "--expect")) expect_path = argv[++arg];
+    else if (!strcmp(argv[arg], "--expect-gen")) expect_gen = atol(argv[++arg]);
+    else if (!strcmp(argv[arg], "--ncols")) ncols = atoi(argv[++arg]);
+    else if (!strcmp(argv[arg], "--n-out")) n_out = atoi(argv[++arg]);
+    else if (!strcmp(argv[arg], "--rows")) rows = atoi(argv[++arg]);
+    else if (!strcmp(argv[arg], "--conns")) conns = atoi(argv[++arg]);
+    else if (!strcmp(argv[arg], "--secs")) secs = atof(argv[++arg]);
+    else if (!strcmp(argv[arg], "--model")) model_id = argv[++arg];
+    else if (!strcmp(argv[arg], "--no-backoff")) no_backoff = 1;
+    else { fprintf(stderr, "unknown arg %s\n", argv[arg]); return 2; }
+  }
+  if (!probes_path || ncols <= 0) {
+    fprintf(stderr, "--probes FILE and --ncols N are required\n");
+    return 2;
+  }
+  long n_vals = 0;
+  float *probes = load_f32(probes_path, &n_vals);
+  if (!probes || n_vals % ncols) {
+    fprintf(stderr, "bad probes file %s\n", probes_path);
+    return 2;
+  }
+  long n_probes = n_vals / ncols;
+  float *expect = NULL;
+  if (expect_path) {
+    long en = 0;
+    expect = load_f32(expect_path, &en);
+    if (!expect || en != n_probes * n_out) {
+      fprintf(stderr, "expect file size mismatch (%ld vs %ld)\n", en,
+              n_probes * n_out);
+      return 2;
+    }
+  }
+  volatile int stop = 0;
+  worker_t *ws = (worker_t *)calloc((size_t)conns, sizeof(worker_t));
+  pthread_t *tids = (pthread_t *)calloc((size_t)conns, sizeof(pthread_t));
+  long cap = MAX_LAT / (conns > 0 ? conns : 1);
+  for (int i = 0; i < conns; i++) {
+    ws[i] = (worker_t){.is_uds = is_uds, .host = host, .path = path,
+                       .port = port, .model_id = model_id,
+                       .probes = probes, .n_probes = n_probes,
+                       .ncols = ncols, .rows = rows, .expect = expect,
+                       .n_out = n_out, .expect_gen = expect_gen,
+                       .no_backoff = no_backoff, .stop = &stop,
+                       .lat = (double *)malloc((size_t)cap * sizeof(double)),
+                       .lat_cap = cap};
+    pthread_create(&tids[i], NULL, worker, &ws[i]);
+  }
+  double t0 = now_s();
+  struct timespec tick = {0, 10000000L};
+  while (now_s() - t0 < secs) nanosleep(&tick, NULL);
+  stop = 1;
+  for (int i = 0; i < conns; i++) pthread_join(tids[i], NULL);
+  double elapsed = now_s() - t0;
+
+  long sent = 0, completed = 0, rejected = 0, errors = 0, checked = 0,
+       mismatch = 0, lat_n = 0;
+  for (int i = 0; i < conns; i++) {
+    sent += ws[i].sent;
+    completed += ws[i].completed;
+    rejected += ws[i].rejected;
+    errors += ws[i].errors;
+    checked += ws[i].checked;
+    mismatch += ws[i].mismatch;
+    lat_n += ws[i].lat_n;
+  }
+  double *lat = (double *)malloc((size_t)(lat_n > 0 ? lat_n : 1)
+                                 * sizeof(double));
+  long k = 0;
+  for (int i = 0; i < conns; i++)
+    for (long j = 0; j < ws[i].lat_n; j++) lat[k++] = ws[i].lat[j];
+  qsort(lat, (size_t)lat_n, sizeof(double), cmp_double);
+  double p50 = lat_n ? lat[(long)(0.50 * (double)(lat_n - 1))] : 0.0;
+  double p99 = lat_n ? lat[(long)(0.99 * (double)(lat_n - 1))] : 0.0;
+  printf("{\"mode\":\"%s\",\"conns\":%d,\"rows\":%d,\"elapsed_s\":%.3f,"
+         "\"sent\":%ld,\"completed\":%ld,\"rejected\":%ld,\"errors\":%ld,"
+         "\"verify_checked\":%ld,\"verify_mismatch\":%ld,"
+         "\"req_per_sec\":%.1f,\"rows_per_sec\":%.1f,"
+         "\"p50_ms\":%.4f,\"p99_ms\":%.4f}\n",
+         is_uds ? "uds" : "tcp", conns, rows, elapsed, sent, completed,
+         rejected, errors, checked, mismatch,
+         (double)completed / elapsed, (double)(completed * rows) / elapsed,
+         p50 * 1e3, p99 * 1e3);
+  return (errors > 0 || completed == 0 || mismatch > 0) ? 1 : 0;
+}
+
+/* ------------------------------------------------------ fastconfig mode */
+typedef int (*create_fn)(const char *, int *, BoosterHandle *);
+typedef int (*nclass_fn)(BoosterHandle, int *);
+typedef int (*fastinit_fn)(BoosterHandle, int, int, int32_t, const char *,
+                           int, FastConfigHandle *);
+typedef int (*fast_fn)(FastConfigHandle, const void *, int64_t *, double *);
+typedef int (*fastfree_fn)(FastConfigHandle);
+typedef const char *(*err_fn)(void);
+
+static int run_fastconfig(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: wire_client fastconfig LIB MODEL --probes F "
+                    "--ncols N [--secs S]\n");
+    return 2;
+  }
+  const char *lib_path = argv[2], *model_path = argv[3];
+  const char *probes_path = NULL;
+  int ncols = 0;
+  double secs = 5.0;
+  for (int arg = 4; arg < argc; arg++) {
+    if (!strcmp(argv[arg], "--probes")) probes_path = argv[++arg];
+    else if (!strcmp(argv[arg], "--ncols")) ncols = atoi(argv[++arg]);
+    else if (!strcmp(argv[arg], "--secs")) secs = atof(argv[++arg]);
+    else { fprintf(stderr, "unknown arg %s\n", argv[arg]); return 2; }
+  }
+  if (!probes_path || ncols <= 0) {
+    fprintf(stderr, "--probes FILE and --ncols N are required\n");
+    return 2;
+  }
+  long n_vals = 0;
+  float *probes = load_f32(probes_path, &n_vals);
+  if (!probes || n_vals % ncols) {
+    fprintf(stderr, "bad probes file %s\n", probes_path);
+    return 2;
+  }
+  long n_probes = n_vals / ncols;
+
+  void *lib = dlopen(lib_path, RTLD_NOW | RTLD_LOCAL);
+  if (!lib) {
+    fprintf(stderr, "dlopen %s: %s\n", lib_path, dlerror());
+    return 1;
+  }
+  create_fn create = (create_fn)dlsym(lib, "LGBM_BoosterCreateFromModelfile");
+  nclass_fn nclass = (nclass_fn)dlsym(lib, "LGBM_BoosterGetNumClasses");
+  fastinit_fn finit =
+      (fastinit_fn)dlsym(lib, "LGBM_BoosterPredictForMatSingleRowFastInit");
+  fast_fn fast = (fast_fn)dlsym(lib, "LGBM_BoosterPredictForMatSingleRowFast");
+  fastfree_fn ffree = (fastfree_fn)dlsym(lib, "LGBM_FastConfigFree");
+  err_fn lasterr = (err_fn)dlsym(lib, "LGBM_GetLastError");
+  if (!create || !nclass || !finit || !fast || !ffree) {
+    fprintf(stderr, "missing ABI symbols in %s\n", lib_path);
+    return 1;
+  }
+  BoosterHandle booster = NULL;
+  int n_iters = 0;
+  if (create(model_path, &n_iters, &booster) != 0) {
+    fprintf(stderr, "load failed: %s\n", lasterr ? lasterr() : "?");
+    return 1;
+  }
+  int num_class = 1;
+  nclass(booster, &num_class);
+  FastConfigHandle fc = NULL;
+  if (finit(booster, C_API_PREDICT_NORMAL, C_API_DTYPE_FLOAT32,
+            (int32_t)ncols, "", -1, &fc) != 0) {
+    fprintf(stderr, "FastInit failed: %s\n", lasterr ? lasterr() : "?");
+    return 1;
+  }
+  double *out = (double *)malloc((size_t)num_class * sizeof(double));
+  double checksum = 0.0;
+  long calls = 0, errors = 0;
+  double t0 = now_s();
+  while (now_s() - t0 < secs) {
+    const float *row = probes + (calls % n_probes) * ncols;
+    int64_t out_len = 0;
+    if (fast(fc, row, &out_len, out) != 0 || out_len != num_class) {
+      errors++;
+      break;
+    }
+    checksum += out[0];
+    calls++;
+  }
+  double elapsed = now_s() - t0;
+  ffree(fc);
+  printf("{\"mode\":\"fastconfig\",\"num_iterations\":%d,"
+         "\"num_class\":%d,\"calls\":%ld,\"errors\":%ld,"
+         "\"elapsed_s\":%.3f,\"req_per_sec\":%.1f,\"checksum\":%.6f}\n",
+         n_iters, num_class, calls, errors, elapsed,
+         (double)calls / elapsed, checksum);
+  return (errors > 0 || calls == 0) ? 1 : 0;
+}
+
+int main(int argc, char **argv) {
+  crc_init();
+  if (argc < 2) {
+    fprintf(stderr,
+            "usage: wire_client tcp HOST PORT ... | uds PATH ... | "
+            "fastconfig LIB MODEL ...\n");
+    return 2;
+  }
+  if (!strcmp(argv[1], "tcp") && argc >= 4) return run_socket(argc, argv, 0);
+  if (!strcmp(argv[1], "uds") && argc >= 3) return run_socket(argc, argv, 1);
+  if (!strcmp(argv[1], "fastconfig")) return run_fastconfig(argc, argv);
+  fprintf(stderr, "unknown mode %s\n", argv[1]);
+  return 2;
+}

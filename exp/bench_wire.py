@@ -1,0 +1,538 @@
+#!/usr/bin/env python
+"""BENCH_WIRE: wire-speed serving data-plane harness (ISSUE 16).
+
+Measures the same model served through every front end the runtime
+carries, at equal byte-verified correctness — every counted response is
+compared against the offline predictor for its reported generation and
+serving path, so a fast-but-wrong plane can never produce a valid
+artifact:
+
+* **json_tcp** — the JSON-lines TCP front end (PR 7): one utf-8 JSON
+  object per request/response line.  The baseline the tentpole is
+  measured against.
+* **binary_tcp / binary_uds** — the ISSUE 16 length-prefixed binary
+  frame protocol (runtime/wire.py) over TCP and over a Unix-domain
+  socket: 40-byte header + raw float32 payload, CRC-checked, gathered
+  zero-copy into per-connection receive buckets and admitted without a
+  float64 conversion (`submit_view`).
+* **c_client_uds / c_fastconfig** — the compiled reference client
+  (cpp/wire_client.c) driving the UDS socket protocol and the
+  in-process `LGBM_BoosterPredictForMatSingleRowFast` ABI: proof from
+  OUTSIDE Python, with client-side CRC + byte verification.
+* **offered** — an open-throttle overload phase against a deliberately
+  small admission queue: clients hammer without honoring backoff so
+  the OFFERED rate (completed + rejected frames) exceeds the
+  acceptance bar while every rejection stays a machine-readable frame;
+  the p99 of the requests that did complete is recorded under that
+  load.
+* **predictor** — the flattened branchless device engine measured
+  directly (f64 vs f32 response surfaces vs int8-quantized leaves)
+  with the quantization error vs the f64 host path, feeding the
+  `LEAF_QUANT_VALIDATED` expiry row in docs/PERFORMANCE.md.
+
+Gates (all must hold or the artifact is INVALID):
+  binary_uds_ge_5x_json   best binary UDS req/s >= 5x JSON req/s
+  offered_ge_10k          offered phase >= 10k req/s on this host
+  c_client_green          compiled client rc 0, zero mismatches
+  zero_mismatches         no sampled response anywhere disagreed
+
+Usage:
+    python exp/bench_wire.py [--quick] [--out OUT.json]
+    python exp/bench_wire.py --artifact BENCH_WIRE_r16.json
+
+Env knobs: BENCH_WIRE_TREES/LEAVES/FEAT (model shape, default
+40/31/28 — small enough that the plane, not predict, is measured),
+BENCH_WIRE_SECS (per-phase seconds, default 5), BENCH_WIRE_CONNS
+(closed-loop connections, default 8), BENCH_WIRE_ROWS (rows per
+request, default 512 — bulk-scoring frames where zero-copy pays).
+
+The artifact is schema-validated (`helper.bench_history.
+validate_wire_artifact`) before it is written and collated by
+`helper/bench_history.py` under the same >10% same-shape regression
+flags as every other bench family."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from lightgbm_tpu.basic import Booster                     # noqa: E402
+from lightgbm_tpu.runtime import wire                      # noqa: E402
+from lightgbm_tpu.runtime.serving import (ServingRuntime,  # noqa: E402
+                                          ServingServer)
+
+SCHEMA_VERSION = 1
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def _summary(lat_s: List[float], completed: int, rejected: int,
+             mismatches: int, elapsed: float, rows: int) -> Dict[str, Any]:
+    lat = sorted(lat_s)
+    return {
+        "req_per_sec": round(completed / elapsed, 1),
+        "rows_per_sec": round(completed * rows / elapsed, 1),
+        "p50_ms": round(_pct(lat, 0.50) * 1e3, 4),
+        "p99_ms": round(_pct(lat, 0.99) * 1e3, 4),
+        "completed": completed, "rejected": rejected,
+        "verified": True,                # every response was compared...
+        "prediction_mismatches": mismatches,   # ...and THIS many failed
+    }
+
+
+class _Refs:
+    """Offline predictions per serving path, float32 response surface.
+    Device-served responses must match the device engine's f64 surface
+    downcast; host-degraded responses the host engine's."""
+
+    def __init__(self, booster: Booster, probes: np.ndarray):
+        X = np.asarray(probes, np.float64)
+        self.device = np.asarray(
+            booster.predict(X, device=True), np.float64).reshape(
+                len(probes), -1).astype(np.float32)
+        self.host = np.asarray(
+            booster.predict(X), np.float64).reshape(
+                len(probes), -1).astype(np.float32)
+        self.n_out = self.device.shape[1]
+
+    def check(self, start: int, vals: np.ndarray, served_by: str) -> int:
+        """Number of mismatched rows for a window starting at probe
+        row `start` (wrapping)."""
+        ref = self.device if served_by == "device" else self.host
+        n = len(vals)
+        idx = (start + np.arange(n)) % len(ref)
+        want = ref[idx]
+        got = np.asarray(vals, np.float32).reshape(n, -1)
+        return int(np.sum(~np.all(got == want, axis=1)))
+
+
+def _closed_loop(n_conns: int, secs: float, make_worker) -> Dict[str, Any]:
+    """Run n_conns worker threads for secs; each worker returns
+    (completed, rejected, mismatches, [latencies])."""
+    stop = threading.Event()
+    out: List[Optional[tuple]] = [None] * n_conns
+    ths = []
+    for i in range(n_conns):
+        th = threading.Thread(target=make_worker(i, stop, out), daemon=True)
+        ths.append(th)
+    t0 = time.monotonic()
+    for th in ths:
+        th.start()
+    time.sleep(secs)
+    stop.set()
+    for th in ths:
+        th.join(timeout=30)
+    elapsed = time.monotonic() - t0
+    completed = rejected = mismatches = 0
+    lat: List[float] = []
+    for rec in out:
+        if rec is None:
+            continue
+        completed += rec[0]
+        rejected += rec[1]
+        mismatches += rec[2]
+        lat.extend(rec[3])
+    return {"completed": completed, "rejected": rejected,
+            "mismatches": mismatches, "lat": lat, "elapsed": elapsed}
+
+
+def bench_json_tcp(port: int, probes: np.ndarray, refs: _Refs,
+                   conns: int, rows: int, secs: float) -> Dict[str, Any]:
+    # requests pre-encoded outside the loop: the measured path is the
+    # server's decode/encode + the response parse, not client dumps()
+    reqs = []
+    for s in range(0, len(probes) - rows + 1, rows):
+        reqs.append((s, (json.dumps(
+            {"features": probes[s:s + rows].tolist()}) + "\n").encode()))
+
+    def make_worker(i, stop, out):
+        def work():
+            comp = rej = mis = 0
+            lat: List[float] = []
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=30) as sk:
+                sk.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                f = sk.makefile("rwb")
+                k = i % len(reqs)
+                while not stop.is_set():
+                    start, payload = reqs[k]
+                    k = (k + 1) % len(reqs)
+                    t0 = time.monotonic()
+                    f.write(payload)
+                    f.flush()
+                    resp = json.loads(f.readline())
+                    lat_s = time.monotonic() - t0
+                    if "values" in resp:
+                        comp += 1
+                        lat.append(lat_s)
+                        mis += refs.check(
+                            start, np.asarray(resp["values"], np.float32),
+                            resp.get("served_by", "device"))
+                    else:
+                        rej += 1
+            out[i] = (comp, rej, mis, lat)
+        return work
+
+    r = _closed_loop(conns, secs, make_worker)
+    return _summary(r["lat"], r["completed"], r["rejected"],
+                    r["mismatches"], r["elapsed"], rows)
+
+
+def bench_binary(address, probes: np.ndarray, refs: _Refs, conns: int,
+                 rows: int, secs: float) -> Dict[str, Any]:
+    frames = []
+    for s in range(0, len(probes) - rows + 1, rows):
+        frames.append((s, wire.pack_request(probes[s:s + rows])))
+
+    def make_worker(i, stop, out):
+        def work():
+            comp = rej = mis = 0
+            lat: List[float] = []
+            with wire.WireClient(address, timeout=30) as c:
+                k = i % len(frames)
+                while not stop.is_set():
+                    start, frame = frames[k]
+                    k = (k + 1) % len(frames)
+                    t0 = time.monotonic()
+                    c._sock.sendall(frame)
+                    got = wire.read_frame(c._rfile)
+                    lat_s = time.monotonic() - t0
+                    resp = wire.unpack_response(*got)
+                    if "values" in resp:
+                        comp += 1
+                        lat.append(lat_s)
+                        mis += refs.check(start, resp["values"],
+                                          resp["served_by"])
+                    else:
+                        rej += 1
+            out[i] = (comp, rej, mis, lat)
+        return work
+
+    r = _closed_loop(conns, secs, make_worker)
+    return _summary(r["lat"], r["completed"], r["rejected"],
+                    r["mismatches"], r["elapsed"], rows)
+
+
+def bench_offered(uds_path: str, workdir: str, probes: np.ndarray,
+                  refs: _Refs, conns: int,
+                  secs: float) -> Dict[str, Any]:
+    """Open-throttle single-row overload via the compiled client's
+    `--no-backoff` mode: clients deliberately ignore retry_after_s
+    hints so the OFFERED rate (completed + rejected frames) probes the
+    admission plane's ceiling; every rejection must still arrive as a
+    machine-readable frame (a torn/garbled one would break the client's
+    frame loop and count as an error).  p50/p99 are over the requests
+    that completed under that load, still byte-verified."""
+    probes_f = os.path.join(workdir, "probes.f32")
+    expect_f = os.path.join(workdir, "expect.f32")
+    if not os.path.exists(probes_f):
+        probes.astype(np.float32).tofile(probes_f)
+        refs.device.tofile(expect_f)
+    client = os.path.join(REPO, "cpp", "wire_client")
+    if not os.path.exists(client):
+        subprocess.run(["make", "-C", os.path.join(REPO, "cpp"),
+                        "wire_client"], capture_output=True)
+    cmd = [client, "uds", uds_path,
+           "--probes", probes_f, "--expect", expect_f, "--expect-gen",
+           "0", "--ncols", str(probes.shape[1]), "--n-out",
+           str(refs.n_out), "--rows", "1", "--conns", str(conns),
+           "--secs", str(secs), "--no-backoff"]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=secs * 4 + 60)
+    if proc.returncode != 0:
+        return {"rc": proc.returncode, "offered_per_sec": 0.0,
+                "verified": False, "prediction_mismatches": 0,
+                "error": (proc.stderr or proc.stdout).strip()[-300:]}
+    r = json.loads(proc.stdout.strip().splitlines()[-1])
+    return {
+        "offered_per_sec": round(
+            (r["completed"] + r["rejected"]) / r["elapsed_s"], 1),
+        "completed_per_sec": round(r["completed"] / r["elapsed_s"], 1),
+        "completed": r["completed"], "rejected": r["rejected"],
+        "errors": r["errors"],
+        "p50_ms": r["p50_ms"], "p99_ms": r["p99_ms"],
+        "conns": conns, "client": "wire_client --no-backoff",
+        "verified": r["verify_checked"] > 0 and r["errors"] == 0,
+        "prediction_mismatches": r["verify_mismatch"],
+    }
+
+
+def bench_c_client(uds_path: str, workdir: str, probes: np.ndarray,
+                   refs: _Refs, model_file: str, conns: int, rows: int,
+                   secs: float) -> Dict[str, Any]:
+    """The compiled reference client: socket mode (byte-verifying
+    against --expect) and the in-process FastConfig single-row ABI."""
+    cpp = os.path.join(REPO, "cpp")
+    build = subprocess.run(["make", "-C", cpp, "wire_client"],
+                           capture_output=True, text=True)
+    out: Dict[str, Any] = {"build_rc": build.returncode}
+    if build.returncode != 0:
+        out["error"] = (build.stderr or build.stdout).strip()[-500:]
+        return out
+    probes_f = os.path.join(workdir, "probes.f32")
+    expect_f = os.path.join(workdir, "expect.f32")
+    probes.astype(np.float32).tofile(probes_f)
+    refs.device.tofile(expect_f)
+    cmd = [os.path.join(cpp, "wire_client"), "uds", uds_path,
+           "--probes", probes_f, "--expect", expect_f, "--expect-gen",
+           "0", "--ncols", str(probes.shape[1]), "--n-out",
+           str(refs.n_out), "--rows", str(rows), "--conns", str(conns),
+           "--secs", str(secs)]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=secs * 4 + 60)
+    out["socket_rc"] = proc.returncode
+    try:
+        sock = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        out["error"] = (proc.stderr or proc.stdout).strip()[-500:]
+        return out
+    out.update({
+        "req_per_sec": sock["req_per_sec"],
+        "rows_per_sec": sock["rows_per_sec"],
+        "p50_ms": sock["p50_ms"], "p99_ms": sock["p99_ms"],
+        "completed": sock["completed"], "rejected": sock["rejected"],
+        "errors": sock["errors"],
+        "verified": sock["verify_checked"] > 0,
+        "verify_checked": sock["verify_checked"],
+        "prediction_mismatches": sock["verify_mismatch"],
+    })
+    # FastConfig mode needs the dependency-free base lib
+    lib = os.path.join(cpp, "lib_lightgbm_tpu.so")
+    if not os.path.exists(lib):
+        libb = subprocess.run(["make", "-C", cpp, "lib_lightgbm_tpu.so"],
+                              capture_output=True, text=True)
+        if libb.returncode != 0:
+            out["fastconfig"] = {"skipped": "lib build failed"}
+            return out
+    fcmd = [os.path.join(cpp, "wire_client"), "fastconfig", lib,
+            model_file, "--probes", probes_f, "--ncols",
+            str(probes.shape[1]), "--secs", str(max(2, int(secs // 2)))]
+    fproc = subprocess.run(fcmd, capture_output=True, text=True,
+                           timeout=secs * 4 + 60)
+    try:
+        fc = json.loads(fproc.stdout.strip().splitlines()[-1])
+        out["fastconfig"] = {
+            "rc": fproc.returncode,
+            "req_per_sec": fc["req_per_sec"], "calls": fc["calls"],
+            "errors": fc["errors"], "checksum": fc["checksum"],
+            # single-row host ABI: correctness rides the checksum and
+            # the ABI's own byte-parity pins (tests/test_capi.py)
+            "verified": fproc.returncode == 0 and fc["errors"] == 0,
+            "prediction_mismatches": 0 if fproc.returncode == 0 else 1,
+        }
+    except (ValueError, IndexError):
+        out["fastconfig"] = {"rc": fproc.returncode, "error":
+                             (fproc.stderr or fproc.stdout).strip()[-300:]}
+    return out
+
+
+def bench_predictor(booster: Booster, probes: np.ndarray,
+                    secs: float) -> Dict[str, Any]:
+    """The flattened branchless engine, engine-level: f64 vs f32
+    response surfaces vs int8-quantized leaves, plus the quantization
+    error that the LEAF_QUANT_VALIDATED expiry row gates on."""
+    from lightgbm_tpu.models.device_predictor import DevicePredictor
+    X = np.asarray(probes, np.float64)
+    host = np.asarray(booster.predict(X, raw_score=True),
+                      np.float64).reshape(len(X), -1)
+    out: Dict[str, Any] = {}
+    for label, kw, out_dtype in (
+            ("f64", {}, np.float64),
+            ("f32", {}, np.float32),
+            ("int8", {"leaf_quant": "int8"}, np.float32)):
+        dp = DevicePredictor(booster._model, **kw)
+        vals = dp.predict_raw(X, out_dtype=out_dtype)    # warm the trace
+        n = 0
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < secs:
+            dp.predict_raw(X, out_dtype=out_dtype)
+            n += 1
+        dt = time.monotonic() - t0
+        out["%s_rows_per_sec" % label] = round(n * len(X) / dt, 1)
+        if label == "int8":
+            err = float(np.max(np.abs(
+                np.asarray(vals, np.float64).reshape(host.shape) - host)))
+            out["int8_max_abs_err_vs_f64_host"] = round(err, 8)
+    from lightgbm_tpu.models import device_predictor as dpr
+    out["leaf_quant_validated_flag"] = bool(dpr.LEAF_QUANT_VALIDATED)
+    return out
+
+
+def run(quick: bool = False, workdir: Optional[str] = None
+        ) -> Dict[str, Any]:
+    import tempfile
+    import bench
+    workdir = workdir or tempfile.mkdtemp(prefix="bench_wire_")
+    # default profile: a serving-shape ensemble small enough that the
+    # DATA PLANE, not the predict dispatch, is what the closed loop
+    # measures (at 100x63 predict is ~6.5us/row on this class of host
+    # and both planes converge on it; the plane difference is then
+    # invisible no matter how fast the wire is).  BENCH_WIRE_TREES=100
+    # BENCH_WIRE_LEAVES=63 reshapes it for predict-bound runs.
+    n_trees = int(os.environ.get("BENCH_WIRE_TREES", 40))
+    leaves = int(os.environ.get("BENCH_WIRE_LEAVES", 31))
+    feat = int(os.environ.get("BENCH_WIRE_FEAT", 28))
+    secs = float(os.environ.get("BENCH_WIRE_SECS", 2 if quick else 5))
+    conns = int(os.environ.get("BENCH_WIRE_CONNS", 4 if quick else 8))
+    rows = int(os.environ.get("BENCH_WIRE_ROWS", 512))
+    if quick:
+        n_trees, leaves = min(n_trees, 20), min(leaves, 15)
+
+    model = bench.synth_serving_model(n_trees, leaves, feat, seed=7)
+    model_str = model.save_model_to_string()
+    model_file = os.path.join(workdir, "model.txt")
+    model.save_model(model_file)
+    booster = Booster(model_str=model_str)
+    rng = np.random.default_rng(0)
+    probes = rng.standard_normal((max(256, rows * 2), feat)
+                                 ).astype(np.float32)
+    refs = _Refs(booster, probes)
+
+    rec: Dict[str, Any] = {
+        "artifact": None, "schema_version": SCHEMA_VERSION,
+        "platform": str(os.environ.get("JAX_PLATFORMS") or "default"),
+        "model": {"n_trees": n_trees, "num_leaves": leaves,
+                  "n_feat": feat, "n_out": refs.n_out},
+        "rows_per_request": rows, "conns": conns,
+        "phase_secs": secs, "paths": {},
+    }
+
+    def _wait_ready(rt, timeout=120.0):
+        t0 = time.monotonic()
+        while not rt._ready.is_set():
+            if time.monotonic() - t0 > timeout:
+                raise RuntimeError("runtime never became ready")
+            time.sleep(0.05)
+
+    # ---- closed-loop serving phases: one runtime, three front ends
+    uds_path = os.path.join(workdir, "wire.sock")
+    with ServingRuntime(model_str=model_str, batch_window_s=0.0,
+                        max_queue=2048, max_batch_rows=4096,
+                        response_dtype="float32") as rt:
+        _wait_ready(rt)
+        jsrv = ServingServer(rt)
+        tsrv = wire.WireTCPServer(rt, port=0)
+        usrv = wire.WireUnixServer(rt, path=uds_path)
+        for s in (jsrv, tsrv, usrv):
+            threading.Thread(target=s.serve_forever, daemon=True).start()
+        try:
+            print("bench_wire: json_tcp...", file=sys.stderr, flush=True)
+            rec["paths"]["json_tcp"] = bench_json_tcp(
+                jsrv.port, probes, refs, conns, rows, secs)
+            print("bench_wire: binary_tcp...", file=sys.stderr, flush=True)
+            rec["paths"]["binary_tcp"] = bench_binary(
+                ("127.0.0.1", tsrv.port), probes, refs, conns, rows, secs)
+            print("bench_wire: binary_uds...", file=sys.stderr, flush=True)
+            rec["paths"]["binary_uds"] = bench_binary(
+                uds_path, probes, refs, conns, rows, secs)
+            print("bench_wire: c_client...", file=sys.stderr, flush=True)
+            rec["paths"]["c_client_uds"] = bench_c_client(
+                uds_path, workdir, probes, refs, model_file, conns, rows,
+                secs)
+        finally:
+            for s in (jsrv, tsrv, usrv):
+                s.shutdown()
+                s.server_close()
+    fc = rec["paths"]["c_client_uds"].pop("fastconfig", None)
+    if isinstance(fc, dict) and "req_per_sec" in fc:
+        rec["paths"]["c_fastconfig"] = fc
+
+    # ---- offered overload phase: small queue, open throttle
+    print("bench_wire: offered...", file=sys.stderr, flush=True)
+    uds2 = os.path.join(workdir, "wire_offered.sock")
+    with ServingRuntime(model_str=model_str, batch_window_s=0.0,
+                        max_queue=8, max_batch_rows=4096,
+                        response_dtype="float32") as rt2:
+        _wait_ready(rt2)
+        osrv = wire.WireUnixServer(rt2, path=uds2)
+        threading.Thread(target=osrv.serve_forever, daemon=True).start()
+        try:
+            rec["offered"] = bench_offered(
+                uds2, workdir, probes, refs, conns=96, secs=secs)
+        finally:
+            osrv.shutdown()
+            osrv.server_close()
+
+    # ---- engine-level predictor phase
+    print("bench_wire: predictor...", file=sys.stderr, flush=True)
+    rec["predictor"] = bench_predictor(booster, probes,
+                                       secs=max(1.0, secs / 2))
+
+    # ---- gates
+    jrps = rec["paths"]["json_tcp"]["req_per_sec"]
+    uds_rps = rec["paths"]["binary_uds"]["req_per_sec"]
+    c_rps = rec["paths"]["c_client_uds"].get("req_per_sec", 0.0)
+    best_uds = max(uds_rps, c_rps)
+    rec["speedup"] = {
+        "binary_uds_over_json": round(best_uds / jrps, 2) if jrps else 0.0,
+        "binary_tcp_over_json": round(
+            rec["paths"]["binary_tcp"]["req_per_sec"] / jrps, 2)
+        if jrps else 0.0,
+    }
+    all_mis = sum(int(p.get("prediction_mismatches") or 0)
+                  for p in rec["paths"].values())
+    all_mis += int(rec["offered"].get("prediction_mismatches") or 0)
+    c = rec["paths"]["c_client_uds"]
+    rec["gates"] = {
+        "binary_uds_ge_5x_json": bool(best_uds >= 5.0 * jrps),
+        "offered_ge_10k": bool(
+            rec["offered"]["offered_per_sec"] >= 10_000.0),
+        "c_client_green": bool(
+            c.get("build_rc") == 0 and c.get("socket_rc") == 0
+            and c.get("errors") == 0
+            and c.get("verify_checked", 0) > 0
+            and c.get("prediction_mismatches") == 0),
+        "zero_mismatches": bool(all_mis == 0),
+    }
+    rec["ok"] = all(rec["gates"].values())
+    return rec
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--artifact", default=None,
+                    help="write BENCH_WIRE_rNN.json at the repo root "
+                         "(schema-validated first)")
+    args = ap.parse_args(argv)
+    rec = run(quick=args.quick)
+    if args.artifact:
+        name = os.path.basename(args.artifact)
+        rec["artifact"] = name[:-len(".json")] if name.endswith(".json") \
+            else name
+    else:
+        rec["artifact"] = "BENCH_WIRE_adhoc"
+    sys.path.insert(0, os.path.join(REPO, "helper"))
+    from bench_history import validate_wire_artifact
+    problems = validate_wire_artifact(rec)
+    out_path = args.artifact or args.out
+    if out_path:
+        from lightgbm_tpu.runtime import resilience
+        resilience.atomic_write(out_path, json.dumps(rec, indent=1) + "\n")
+    print(json.dumps(rec))
+    if problems:
+        for p in problems:
+            print("INVALID ARTIFACT: %s" % p, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -249,3 +249,19 @@ BENCH_COLDSTART_PLATFORM=tpu timeout 900 \
   python exp/bench_coldstart.py --artifact /tmp/bench_cold_tpu.json \
   && python -c "import json; d=json.load(open('/tmp/bench_cold_tpu.json')); print(json.dumps({'ok': d['ok'], 'speedup': d['speedup'], 'join_s': d['replica_join']['join_to_first_response_s']}, indent=1))" \
   || echo "   coldstart bench FAILED on hardware — /tmp/bench_cold_tpu.json + child logs in the tempdir have the ledger"
+echo "=== 15. wire-speed data plane on hardware (ISSUE 16) ==="
+echo "    (the CPU-committed BENCH_WIRE_r16.json proved the binary"
+echo "     plane >=5x the JSON plane and >=10k offered req/s with a"
+echo "     compiled-C client byte-verifying every response — but on"
+echo "     CPU the device_s stage competes with the handlers for the"
+echo "     same core.  On hardware the predict dispatch leaves the"
+echo "     host, so the closed-loop rates here are the real serving"
+echo "     envelope: raise BENCH_WIRE_TREES/LEAVES to production shape"
+echo "     (predict no longer drowns the plane) and expect the binary"
+echo "     paths to pull further ahead.  COMMIT the artifact as"
+echo "     BENCH_WIRE_r<round>.json; helper/bench_history.py"
+echo "     schema-gates it and flags >10% same-shape regressions.)"
+timeout 900 \
+  python exp/bench_wire.py --out /tmp/bench_wire_tpu.json \
+  && python -c "import json; d=json.load(open('/tmp/bench_wire_tpu.json')); print(json.dumps({'ok': d['ok'], 'speedup': d['speedup'], 'offered_per_sec': d['offered']['offered_per_sec'], 'gates': d['gates']}, indent=1))" \
+  || echo "   wire bench FAILED on hardware — /tmp/bench_wire_tpu.json + stderr have the ledger"

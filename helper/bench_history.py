@@ -27,6 +27,14 @@ rollback counts carried in the trajectory and the canary detection
 window (batches-to-rollback, lower is better) under the same >10 %
 regression-flag treatment.
 
+ISSUE 16 adds the wire data-plane artifacts (``BENCH_WIRE_r*.json``
+from exp/bench_wire.py): request rates per path (JSON/TCP vs binary
+TCP vs binary UDS vs the compiled C client, higher is better) and the
+binary/offered p99 tails (lower is better) under the same same-shape
+>10 % treatment, behind a schema gate that makes an unverified
+response or any JSON-vs-binary prediction mismatch an INVALID
+artifact — throughput at wrong answers is not throughput.
+
 Artifact shape (bench): the driver wraps each round's bench stdout as
 ``{"n": round, "rc": ..., "parsed": <bench JSON>, "tail": ...}``; when
 ``parsed`` is missing the last JSON-looking line of ``tail`` is tried.
@@ -536,6 +544,173 @@ def coldstart_regressions(rounds: List[Dict[str, Any]],
 
 
 # ---------------------------------------------------------------------------
+# wire data-plane artifacts (BENCH_WIRE_r*.json, ISSUE 16)
+# ---------------------------------------------------------------------------
+
+#: (series name, artifact-relative path, higher_is_better) — request
+#: rates are higher-better, tail latency lower-better.  Shape key is
+#: (platform, rows_per_request, conns, n_trees): a 1-row round must
+#: never be compared against an 8-row round.
+WIRE_SERIES: Tuple[Tuple[str, Tuple[str, ...], bool], ...] = (
+    ("json_req_per_sec", ("paths", "json_tcp", "req_per_sec"), True),
+    ("binary_tcp_req_per_sec",
+     ("paths", "binary_tcp", "req_per_sec"), True),
+    ("binary_uds_req_per_sec",
+     ("paths", "binary_uds", "req_per_sec"), True),
+    ("c_client_req_per_sec",
+     ("paths", "c_client_uds", "req_per_sec"), True),
+    ("fastconfig_req_per_sec",
+     ("paths", "c_fastconfig", "req_per_sec"), True),
+    ("binary_uds_p99_ms", ("paths", "binary_uds", "p99_ms"), False),
+    ("offered_p99_ms", ("offered", "p99_ms"), False),
+)
+
+#: keys every socket-path section must carry; `verified` false or a
+#: nonzero mismatch count is an INVALID artifact, not a slow one —
+#: throughput at wrong answers is not throughput.
+_WIRE_PATH_REQUIRED = (
+    ("req_per_sec", (int, float)),
+    ("verified", bool),
+    ("prediction_mismatches", int),
+)
+
+
+def validate_wire_artifact(rec: Any) -> List[str]:
+    """Schema problems of one BENCH_WIRE artifact (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(rec, dict):
+        return ["artifact is not a JSON object"]
+    if not str(rec.get("artifact", "")).startswith("BENCH_WIRE_"):
+        problems.append("artifact name %r does not start with BENCH_WIRE_"
+                        % rec.get("artifact"))
+    if not isinstance(rec.get("schema_version"), int):
+        problems.append("schema_version missing or not an int")
+    if not isinstance(rec.get("ok"), bool):
+        problems.append("ok flag missing")
+    paths = rec.get("paths")
+    if not isinstance(paths, dict) or not paths:
+        problems.append("paths missing or empty")
+        return problems
+    for pname in ("json_tcp", "binary_tcp", "binary_uds"):
+        sec = paths.get(pname)
+        if not isinstance(sec, dict):
+            problems.append("path %r missing" % pname)
+            continue
+        for key, typ in _WIRE_PATH_REQUIRED:
+            if not isinstance(sec.get(key), typ):
+                problems.append("path %r: %s missing or wrong type"
+                                % (pname, key))
+        if sec.get("verified") is False:
+            problems.append("path %r: responses were NOT byte-verified "
+                            "against the offline predictor" % pname)
+        if sec.get("prediction_mismatches"):
+            problems.append("path %r: %s prediction mismatch(es) — the "
+                            "wire bytes disagreed with the offline "
+                            "predictor" % (pname,
+                                           sec["prediction_mismatches"]))
+    for pname, sec in paths.items():
+        if isinstance(sec, dict) and sec.get("prediction_mismatches"):
+            if not any(pname in p for p in problems):
+                problems.append("path %r: %s prediction mismatch(es)"
+                                % (pname, sec["prediction_mismatches"]))
+    offered = rec.get("offered")
+    if not isinstance(offered, dict) or not isinstance(
+            offered.get("offered_per_sec"), (int, float)):
+        problems.append("offered section missing offered_per_sec")
+    gates = rec.get("gates")
+    if not isinstance(gates, dict):
+        problems.append("gates section missing")
+    else:
+        for g, val in sorted(gates.items()):
+            if val is not True:
+                problems.append("gate %r did not hold" % g)
+    return problems
+
+
+def load_wire_rounds(repo: str = REPO):
+    """(valid BENCH_WIRE rounds sorted, problems of invalid ones)."""
+    rounds: List[Dict[str, Any]] = []
+    problems: List[str] = []
+    for path in glob.glob(os.path.join(repo, "BENCH_WIRE_r*.json")):
+        m = re.search(r"BENCH_WIRE_r(\d+)\.json$", path)
+        if not m:
+            continue
+        base = os.path.basename(path)
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError) as e:
+            problems.append("%s: unreadable (%s)" % (base, e))
+            continue
+        bad = validate_wire_artifact(rec)
+        if bad:
+            problems.append("%s: %s" % (base, "; ".join(bad)))
+            continue
+        rec["_round"] = int(m.group(1))
+        rec["_file"] = base
+        rounds.append(rec)
+    return sorted(rounds, key=lambda r: r["_round"]), problems
+
+
+def _wire_shape(rec: Dict[str, Any]) -> Tuple:
+    return (repr(rec.get("platform")),
+            repr(rec.get("rows_per_request")),
+            repr(rec.get("conns")),
+            repr(_get(rec, ("model", "n_trees"))))
+
+
+def wire_trajectory(rounds: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    rows = []
+    for rec in rounds:
+        row: Dict[str, Any] = {
+            "round": rec["_round"], "platform": rec.get("platform"),
+            "rows_per_request": rec.get("rows_per_request"),
+            "conns": rec.get("conns"), "ok": rec.get("ok"),
+            "speedup_binary_uds_over_json": _get(
+                rec, ("speedup", "binary_uds_over_json")),
+            "offered_per_sec": _get(rec, ("offered", "offered_per_sec")),
+        }
+        for name, path, _ in WIRE_SERIES:
+            v = _get(rec, path)
+            if v is not None:
+                row[name] = v
+        rows.append(row)
+    return rows
+
+
+def wire_regressions(rounds: List[Dict[str, Any]],
+                     threshold: float = REGRESSION_THRESHOLD
+                     ) -> List[Dict[str, Any]]:
+    """Rounds whose wire series moved > threshold the WRONG way vs the
+    best prior round at the same shape."""
+    flags: List[Dict[str, Any]] = []
+    for name, path, higher_better in WIRE_SERIES:
+        best: Dict[Tuple, Tuple[float, int]] = {}
+        for rec in rounds:
+            v = _get(rec, path)
+            if not isinstance(v, (int, float)):
+                continue
+            shape = _wire_shape(rec)
+            prior = best.get(shape)
+            if prior is not None and prior[0] > 0:
+                worse = (v < prior[0] * (1.0 - threshold) if higher_better
+                         else v > prior[0] * (1.0 + threshold))
+                if worse:
+                    flags.append({
+                        "round": rec["_round"], "series": name,
+                        "value": v, "best_prior": prior[0],
+                        "best_prior_round": prior[1],
+                        "change_pct": round((v / prior[0] - 1.0) * 100, 1),
+                        "shape": shape,
+                    })
+            better = (prior is None or
+                      (v > prior[0] if higher_better else v < prior[0]))
+            if better:
+                best[shape] = (float(v), rec["_round"])
+    return sorted(flags, key=lambda f: (f["round"], f["series"]))
+
+
+# ---------------------------------------------------------------------------
 # production-sim artifacts (SIM_r*.json, ISSUE 11)
 # ---------------------------------------------------------------------------
 
@@ -708,7 +883,17 @@ def run(repo: str = REPO,
     c_rounds, c_problems = load_coldstart_rounds(repo)
     c_flags = coldstart_regressions(c_rounds, threshold)
     c_latest = c_rounds[-1]["_round"] if c_rounds else None
+    w_rounds, w_problems = load_wire_rounds(repo)
+    w_flags = wire_regressions(w_rounds, threshold)
+    w_latest = w_rounds[-1]["_round"] if w_rounds else None
     return {"rounds": len(rounds),
+            "wire_rounds": len(w_rounds),
+            "wire_latest_round": w_latest,
+            "wire_trajectory": wire_trajectory(w_rounds),
+            "wire_regressions": w_flags,
+            "wire_latest_regressions": [f for f in w_flags
+                                        if f["round"] == w_latest],
+            "invalid_wire_artifacts": w_problems,
             "coldstart_rounds": len(c_rounds),
             "coldstart_latest_round": c_latest,
             "coldstart_trajectory": coldstart_trajectory(c_rounds),
@@ -809,13 +994,33 @@ def main(argv=None) -> int:
                      f["best_prior"]))
         for p in rep["invalid_coldstart_artifacts"]:
             print("INVALID COLDSTART ARTIFACT: %s" % p)
+    if rep["wire_rounds"] or rep["invalid_wire_artifacts"]:
+        print("bench_history: %d wire round(s) collated"
+              % rep["wire_rounds"])
+        w_cols = ["round", "json_req_per_sec", "binary_uds_req_per_sec",
+                  "speedup_binary_uds_over_json", "offered_p99_ms", "ok"]
+        print("  ".join("%-13s" % c for c in w_cols))
+        for row in rep["wire_trajectory"]:
+            print("  ".join("%-13s" % (row.get(c, "-"),) for c in w_cols))
+        for f in rep["wire_regressions"]:
+            kind = ("WIRE REGRESSION"
+                    if f["round"] == rep["wire_latest_round"]
+                    else "historical wire regression")
+            print("%s: round %d %s = %s moved %+.1f%% vs round %d's %s"
+                  % (kind, f["round"], f["series"], f["value"],
+                     f["change_pct"], f["best_prior_round"],
+                     f["best_prior"]))
+        for p in rep["invalid_wire_artifacts"]:
+            print("INVALID WIRE ARTIFACT: %s" % p)
     failed = bool(rep["latest_regressions"]
                   or rep["sim_latest_regressions"]
                   or rep["invalid_sim_artifacts"]
                   or rep["quality_latest_regressions"]
                   or rep["invalid_quality_artifacts"]
                   or rep["coldstart_latest_regressions"]
-                  or rep["invalid_coldstart_artifacts"])
+                  or rep["invalid_coldstart_artifacts"]
+                  or rep["wire_latest_regressions"]
+                  or rep["invalid_wire_artifacts"])
     if not failed:
         print("bench_history: OK (latest round has no >%.0f%% regression)"
               % (REGRESSION_THRESHOLD * 100))

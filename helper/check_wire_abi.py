@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Wire-frame ABI drift lint (ISSUE 16 satellite, lint #6).
+
+The binary serving data plane has two independent definitions of the
+40-byte frame header: the Python ``HEADER_FIELDS`` tuple in
+``lightgbm_tpu/runtime/wire.py`` (the servers and the Python client)
+and the ``WIRE_FRAME_FIELDS:`` token line + packed
+``LGBMWireFrameHeader`` struct in ``cpp/lightgbm_tpu_c_api.h`` (the
+compiled reference client and any external caller).  A field added,
+renamed, reordered or re-typed on one side only would produce frames
+the other side misparses — silently, because both sides still "work"
+against themselves.  This lint pins the two layouts field-for-field:
+
+1. the header's ``WIRE_FRAME_FIELDS:`` tokens (``name:fmt`` pairs, in
+   order) must equal the Python ``HEADER_FIELDS`` tuple exactly —
+   names AND struct(3) format codes, compared tokenized so comment
+   re-wrapping cannot fake agreement;
+2. the Python layout must pack to exactly the size the header's
+   ``LGBM_WIRE_HEADER_SIZE`` macro promises (40);
+3. ``make -C cpp wire_client`` must succeed — the compiled client is
+   part of the contract, and a header edit that breaks its build is
+   drift even if the token line still matches.
+
+Run standalone (``python helper/check_wire_abi.py``; exit 1 on drift)
+or through ``helper/ci_checks.py``; ``tests/test_ci_checks.py`` pins a
+negative (a doctored header MUST fail) so the comparator cannot rot
+into a no-op.  Set ``CHECK_WIRE_ABI_NO_BUILD=1`` to skip the compile
+step (used by the pure-text negative tests).
+"""
+from __future__ import annotations
+
+import os
+import re
+import struct
+import subprocess
+import sys
+from typing import List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HEADER = os.path.join(REPO, "cpp", "lightgbm_tpu_c_api.h")
+WIRE = os.path.join(REPO, "lightgbm_tpu", "runtime", "wire.py")
+
+#: the C header's canonical token line: "WIRE_FRAME_FIELDS:" then
+#: whitespace-separated name:fmt tokens, possibly wrapped over several
+#: comment lines (continuation lines start with "*").
+_C_BLOCK_RE = re.compile(
+    r"WIRE_FRAME_FIELDS:\s*((?:[\w]+:[\w]+[ \t]*|\n\s*\*\s*)+)")
+_TOKEN_RE = re.compile(r"(\w+):(\w+)")
+
+#: Python side: the ("name", "fmt") pairs of the HEADER_FIELDS tuple.
+#: Matched textually (not imported) so the lint needs no jax and sees
+#: exactly what is committed.
+_PY_PAIR_RE = re.compile(r"\(\s*\"(\w+)\"\s*,\s*\"(\w+)\"\s*\)")
+_SIZE_MACRO_RE = re.compile(r"#define\s+LGBM_WIRE_HEADER_SIZE\s*\((\d+)\)")
+
+
+def c_header_fields(header_text: str) -> List[Tuple[str, str]]:
+    m = _C_BLOCK_RE.search(header_text)
+    if not m:
+        return []
+    return _TOKEN_RE.findall(m.group(1))
+
+
+def py_header_fields(wire_text: str) -> List[Tuple[str, str]]:
+    m = re.search(r"HEADER_FIELDS[^=]*=\s*\((.*?)\n\)", wire_text,
+                  re.DOTALL)
+    if not m:
+        return []
+    return _PY_PAIR_RE.findall(m.group(1))
+
+
+def run(header_text: str = None, wire_text: str = None,
+        build: bool = True) -> List[str]:
+    """Returns the list of drift problems (empty = clean)."""
+    problems: List[str] = []
+    if header_text is None:
+        with open(HEADER) as fh:
+            header_text = fh.read()
+    if wire_text is None:
+        with open(WIRE) as fh:
+            wire_text = fh.read()
+
+    c_fields = c_header_fields(header_text)
+    py_fields = py_header_fields(wire_text)
+    if not c_fields:
+        problems.append("no WIRE_FRAME_FIELDS token line found in the C "
+                        "header")
+    if not py_fields:
+        problems.append("no HEADER_FIELDS tuple found in runtime/wire.py")
+    if c_fields and py_fields and c_fields != py_fields:
+        for i in range(max(len(c_fields), len(py_fields))):
+            c = c_fields[i] if i < len(c_fields) else None
+            p = py_fields[i] if i < len(py_fields) else None
+            if c != p:
+                problems.append(
+                    "frame header field %d drifted: C header says %s, "
+                    "wire.py says %s" % (i, c and "%s:%s" % c,
+                                         p and "%s:%s" % p))
+
+    if py_fields:
+        fmt = "<" + "".join(f for _n, f in py_fields)
+        try:
+            size = struct.calcsize(fmt)
+        except struct.error as e:
+            size = -1
+            problems.append("HEADER_FIELDS does not form a valid struct "
+                            "format (%s): %s" % (fmt, e))
+        m = _SIZE_MACRO_RE.search(header_text)
+        if not m:
+            problems.append("LGBM_WIRE_HEADER_SIZE macro missing from the "
+                            "C header")
+        elif size >= 0 and int(m.group(1)) != size:
+            problems.append(
+                "LGBM_WIRE_HEADER_SIZE is %s but the Python layout packs "
+                "to %d bytes" % (m.group(1), size))
+
+    if build and not os.environ.get("CHECK_WIRE_ABI_NO_BUILD"):
+        proc = subprocess.run(
+            ["make", "-C", os.path.join(REPO, "cpp"), "wire_client"],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+            problems.append("make -C cpp wire_client failed (rc=%d): %s"
+                            % (proc.returncode,
+                               "; ".join(tail[-3:]) or "no output"))
+    return problems
+
+
+def main(argv=None) -> int:
+    problems = run()
+    fields = c_header_fields(open(HEADER).read())
+    print("check_wire_abi: %d frame header fields, C header vs wire.py"
+          % len(fields))
+    for p in problems:
+        print("DRIFT: %s" % p)
+    if not problems:
+        print("check_wire_abi: OK")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,13 +2,15 @@
 """One-command runner for every static lint the repo carries (ISSUE 13
 satellite).
 
-Five lints guard cross-file invariants — the C-ABI/PARITY.md count
+Six lints guard cross-file invariants — the C-ABI/PARITY.md count
 (`check_abi`), blocking fetches outside runtime/syncs.py
 (`check_syncs`), raw ``jax.jit`` bypassing the xla_obs ledger
 (`check_xla_sites`), unarmed FAULT_TABLE entries
-(`check_fault_coverage`) and unarmed METRIC_TABLE families
-(`check_metric_coverage`, ISSUE 14) — but until now each had to be
-invoked separately, so a PR could green four and forget the fifth.
+(`check_fault_coverage`), unarmed METRIC_TABLE families
+(`check_metric_coverage`, ISSUE 14) and the binary wire-frame header
+layout pinned C-vs-Python (`check_wire_abi`, ISSUE 16) — but until
+now each had to be invoked separately, so a PR could green five and
+forget the sixth.
 This runner invokes all of them in one process and fails if ANY fails:
 
     python helper/ci_checks.py            # exit 0 = all lints green
@@ -36,6 +38,8 @@ CHECKS: Tuple[Tuple[str, str], ...] = (
     ("check_fault_coverage", "FAULT_TABLE entries unarmed by any test"),
     ("check_metric_coverage",
      "METRIC_TABLE families unarmed by any instrument call site"),
+    ("check_wire_abi",
+     "binary wire-frame header layout C header vs runtime/wire.py"),
 )
 
 

@@ -341,7 +341,10 @@ class Application:
         `predict_deadline`, `serve_poll_interval`, `breaker_cooldown`,
         `serve_raw_score`, `metrics_port` (GET /metrics Prometheus
         endpoint; 0 = ephemeral, printed on stdout — see
-        docs/OBSERVABILITY.md), and the ISSUE-12 canary knobs
+        docs/OBSERVABILITY.md), the ISSUE-16 binary data-plane knobs
+        `serve_wire_port` / `serve_wire_uds` / `serve_response_dtype`
+        (docs/SERVING.md wire-protocol section), and the ISSUE-12
+        canary knobs
         `canary_fraction` (0 = off) with `canary_min_samples`,
         `canary_patience`, `canary_error_ratio`, `canary_error_margin`,
         `canary_latency_ratio`, `canary_promote_after`
@@ -358,6 +361,14 @@ class Application:
         host = params.pop("serve_host", "127.0.0.1")
         port = int(params.pop("serve_port", 0) or 0)
         metrics_port = params.pop("metrics_port", None)
+        # ISSUE 16 binary data plane: serve_wire_port (0 = ephemeral)
+        # opens the length-prefixed binary frame protocol beside the
+        # JSON front end; serve_wire_uds=/path serves the same frames
+        # over a Unix-domain socket; serve_response_dtype=float32 halves
+        # the response payloads (exact downcast of the f64 surface)
+        wire_port = params.pop("serve_wire_port", None)
+        wire_uds = params.pop("serve_wire_uds", None)
+        response_dtype = params.pop("serve_response_dtype", None) or None
         # ISSUE 12 canary knobs: canary_fraction=F routes F of batches
         # to each newly published generation until the CanaryPolicy
         # promotes it or rolls the fleet back (docs/RESILIENCE.md)
@@ -379,7 +390,7 @@ class Application:
             metrics_port=int(metrics_port) if metrics_port is not None
             else None,
             publish_dir=publish_dir, model_file=input_model,
-            params=params,
+            params=params, response_dtype=response_dtype,
             raw_score=str(params.pop("serve_raw_score", "")).lower()
             in ("true", "1"),
             max_queue=int(params.pop("serve_queue", 256)),
@@ -392,6 +403,14 @@ class Application:
             probe_platform_on_start=True, log=Log)
         runtime.start()
         server = ServingServer(runtime, host=host, port=port)
+        wire_servers = []
+        if wire_port is not None:
+            from .runtime.wire import WireTCPServer
+            wire_servers.append(WireTCPServer(runtime, host=host,
+                                              port=int(wire_port or 0)))
+        if wire_uds:
+            from .runtime.wire import WireUnixServer
+            wire_servers.append(WireUnixServer(runtime, path=str(wire_uds)))
         stop_evt = _threading.Event()
 
         def _stop(signum, frame):
@@ -411,12 +430,23 @@ class Application:
         # supervisors that asked for an ephemeral port
         print("serving %s on %s:%d" % (publish_dir or input_model,
                                        host, server.port), flush=True)
+        for wsrv in wire_servers:
+            _threading.Thread(target=wsrv.serve_forever,
+                              daemon=True).start()
+            if getattr(wsrv, "wire_path_label", "") == "uds":
+                print("wire (uds) on %s" % wire_uds, flush=True)
+            else:
+                print("wire (tcp) on %s:%d" % (host, wsrv.port),
+                      flush=True)
         if runtime.metrics_port is not None:
             print("metrics on %s:%d" % (host, runtime.metrics_port),
                   flush=True)
         try:
             server.serve_forever(poll_interval=0.2)
         finally:
+            for wsrv in wire_servers:
+                wsrv.shutdown()
+                wsrv.server_close()
             server.server_close()
             runtime.stop()
             sys.stderr.write("serve: final stats: %s\n"

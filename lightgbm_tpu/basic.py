@@ -812,12 +812,21 @@ class Booster:
                 pred_early_stop: bool = False, pred_early_stop_freq: int = 10,
                 pred_early_stop_margin: float = 10.0,
                 device: bool = False, start_iteration: int = 0,
+                out_dtype=None, leaf_quant: Optional[str] = None,
                 **kwargs) -> np.ndarray:
         """device=True runs the jitted tree-parallel inference engine
         (models/device_predictor.py: f32 thresholds, categorical bitsets
         on device, shape-bucketed program cache, micro-batched transfer)
         instead of the exact f64 host traversal — the throughput path
-        for large matrices."""
+        for large matrices.
+
+        ISSUE 16 serving knobs (device path only): `out_dtype=
+        np.float32` fetches and returns float32 — half the D2H bytes,
+        and exactly the float64 answer `.astype(float32)` (output
+        transforms still run in f64 on the exact upcast).  `leaf_quant=
+        "int8"` opts into the int8-quantized leaf table; when the
+        staged `device_predictor.LEAF_QUANT_VALIDATED` flag is ON it
+        becomes the default (pass leaf_quant="none" to opt out)."""
         self._drain()
         X = _to_2d_float(data, getattr(self, "pandas_categorical", None))
         if pred_leaf:
@@ -828,19 +837,27 @@ class Booster:
         # truncate sums identically (gbdt_model.early_stop_mode)
         early = self._model.early_stop_mode(pred_early_stop)
         if device:
-            from .models.device_predictor import DevicePredictor
+            from .models import device_predictor as dpr
+            lq = leaf_quant
+            if lq is None and dpr.LEAF_QUANT_VALIDATED:
+                lq = "int8"            # staged default once validated
+            if lq in ("none", "float32"):
+                lq = None              # explicit opt-out of the staged flag
             end = self._model.num_prediction_iterations(start_iteration,
                                                         num_iteration)
             key = (start_iteration, end, len(self._model.trees),
-                   getattr(self, "_model_version", 0))
+                   getattr(self, "_model_version", 0), lq)
             if getattr(self, "_dev_pred_key", None) != key:
-                self._dev_predictor = DevicePredictor(
-                    self._model, start_iteration, num_iteration)
+                self._dev_predictor = dpr.DevicePredictor(
+                    self._model, start_iteration, num_iteration,
+                    leaf_quant=lq)
                 self._dev_pred_key = key
             raw = self._dev_predictor.predict_raw(
                 X, early_stop=early,
                 early_stop_freq=pred_early_stop_freq,
-                early_stop_margin=pred_early_stop_margin)
+                early_stop_margin=pred_early_stop_margin,
+                out_dtype=np.float32 if np.dtype(out_dtype or np.float64)
+                == np.float32 else np.float64)
             return self._finish_predict(raw, raw_score, num_iteration,
                                         start_iteration)
         raw = self._model.predict_raw(X, start_iteration=start_iteration,
@@ -854,18 +871,27 @@ class Booster:
     def _finish_predict(self, raw: np.ndarray, raw_score: bool,
                         num_iteration: int = -1,
                         start_iteration: int = 0) -> np.ndarray:
+        # f32 raw scores (ISSUE 16 out_dtype path): run the output
+        # transform in f64 on the EXACT upcast, then downcast — so the
+        # f32 surface equals the f64 surface .astype(float32), bit for
+        # bit, and transform math never degrades
+        f32 = raw.dtype == np.float32
+        if f32:
+            raw = raw.astype(np.float64)
         if raw.shape[1] == 1:
             raw = raw[:, 0]
         if raw_score:
-            return raw
-        if self._model.average_output:
+            out = raw
+        elif self._model.average_output:
             # averaged pre-converted outputs; no ConvertOutput on top
             # (gbdt_prediction.cpp Predict, average_output_ branch)
-            return raw / self._model.num_prediction_iterations(
+            out = raw / self._model.num_prediction_iterations(
                 start_iteration, num_iteration)
-        if self._objective is None:
-            return raw
-        return self._objective.convert_output(raw)
+        elif self._objective is None:
+            out = raw
+        else:
+            out = self._objective.convert_output(raw)
+        return out.astype(np.float32) if f32 else out
 
     def refit(self, data, label, weight=None, group=None,
               decay_rate: Optional[float] = None) -> "Booster":

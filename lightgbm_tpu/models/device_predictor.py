@@ -7,9 +7,22 @@ thresholds for device throughput.  Design (see docs/PERFORMANCE.md
 
 - **Tree-parallel traversal.**  All T trees advance one level per step
   over a `[N, T]` node frontier: every gather is batched over the tree
-  axis (flat `[T * nodes]` arrays indexed by `node + tree_offset`), so
-  one loop trip touches N x T cells instead of the old per-tree
-  `lax.scan` whose T x (L-1) serialized steps dominated wall clock.
+  axis, so one loop trip touches N x T cells instead of the old
+  per-tree `lax.scan` whose T x (L-1) serialized steps dominated wall
+  clock.
+- **Flattened branchless node table (ISSUE 16).**  Internal nodes and
+  leaves live in ONE absolute-index table of `(L-1) + L` slots per
+  tree; child pointers are pre-resolved to absolute flat ids at pack
+  time and leaves are self-loops, so the traversal body is exactly
+  gather -> compare -> pick child — no sign test, no per-step offset
+  add, no active-row mask.  Rows that reach a leaf early just keep
+  re-gathering their leaf slot; the final value fetch is one gather on
+  the already-absolute frontier.
+- **Optional int8 leaf values (ISSUE 16, staged).**  With
+  `leaf_quant="int8"` the leaf table is stored int8 with a per-tree f32
+  scale (PR 2's stochastic rounding, `ops/quantize.py`) and dequantized
+  only at the final gather — the value table shrinks 4x.  Staged behind
+  `LEAF_QUANT_VALIDATED` (default OFF = byte-identical f32 leaves).
 - **Depth-bounded loop.**  The loop runs `max leaf depth` trips — for
   leaf-wise 255-leaf trees typically 20-40, not the worst-case
   `num_leaves - 1 = 254` the scan engine used.  Rows/trees that reach a
@@ -47,6 +60,15 @@ from ..runtime import resilience, xla_obs
 
 _K_ZERO_THRESHOLD = 1e-35
 MISSING_NONE, MISSING_ZERO, MISSING_NAN = 0, 1, 2
+
+#: staged flag (ISSUE 16): int8-quantized leaf values in the device
+#: predictor.  OFF -> Booster.predict(device=True) is byte-identical to
+#: the f32-leaf engine.  ON -> DevicePredictor defaults to
+#: leaf_quant="int8": leaves stored int8 with a per-tree scale
+#: (ops/quantize.py stochastic rounding), dequantized at the final
+#: gather — the leaf table shrinks 4x at a pinned tolerance vs the f64
+#: host reference.  Expiry row: docs/PERFORMANCE.md staged-flag table.
+LEAF_QUANT_VALIDATED = False
 
 #: bumped once per (re)trace of the tree-parallel program — the shape
 #: bucket policy is pinned by asserting how this moves across calls
@@ -132,6 +154,60 @@ def pack_trees(trees, num_leaves_cap: int):
     return out, depth
 
 
+def _flatten_packed(packed, leaf_quant: Optional[str] = None):
+    """Flatten [T, L-1]/[T, L] packed trees into one branchless node
+    table of S = (L-1) + L slots per tree (internal nodes first, then
+    leaves).  Child pointers are pre-resolved to ABSOLUTE flat indices
+    (internal child c -> base + c, leaf ~c -> base + NI + c) and every
+    leaf slot is a self-loop (left = right = itself, threshold +inf),
+    so the traversal loop needs no sign test, no offset arithmetic and
+    no active-row mask.  With leaf_quant="int8" the value table is
+    stored int8 with a per-tree f32 scale (stochastic rounding, same
+    max-scaling convention as ops/quantize.quantize_pair)."""
+    feat, thr = packed["feat"], packed["thr"]
+    T, NI = feat.shape
+    L = packed["leaf"].shape[1]
+    S = NI + L
+    base = (np.arange(T, dtype=np.int32) * S)[:, None]
+    out = {"feat": np.zeros((T, S), np.int32),
+           "thr": np.full((T, S), np.inf, np.float32),
+           "miss": np.zeros((T, S), np.int32),
+           "dleft": np.zeros((T, S), bool)}
+    out["feat"][:, :NI] = feat
+    out["thr"][:, :NI] = thr
+    out["miss"][:, :NI] = packed["miss"]
+    out["dleft"][:, :NI] = packed["dleft"]
+    self_idx = base + np.arange(S, dtype=np.int32)[None, :]
+    for name in ("left", "right"):
+        dst = self_idx.copy()
+        c = packed[name]
+        dst[:, :NI] = np.where(c >= 0, c, NI + ~c) + base
+        out[name] = dst
+    value = np.zeros((T, S), np.float32)
+    value[:, NI:] = packed["leaf"]
+    if leaf_quant == "int8":
+        from ..ops.quantize import stochastic_round
+        amax = np.abs(value).max(axis=1)
+        # per-tree max-scaling; an all-zero tree gets scale 1 so the
+        # division is always finite (quantize_pair's convention)
+        scale = (np.where(amax > 0, amax, 127.0) / 127.0).astype(
+            np.float32)
+        q = stochastic_round(jnp.asarray(value / scale[:, None]),
+                             jax.random.PRNGKey(0), -127.0, 127.0)
+        out["value_q"] = np.asarray(q, np.int8)
+        out["scale"] = scale
+    else:
+        out["value"] = value
+    if "catw" in packed:
+        W = packed["catw"].shape[-1]
+        is_cat = np.zeros((T, S), bool)
+        is_cat[:, :NI] = packed["is_cat"]
+        catw = np.zeros((T, S, W), np.uint32)
+        catw[:, :NI] = packed["catw"]
+        out["is_cat"], out["catw"] = is_cat, catw
+    return out
+
+
 @functools.partial(xla_obs.jit, site="predictor.tree_parallel",
                    static_argnames=("num_class", "depth_iters",
                                     "early_mode", "early_freq"))
@@ -141,10 +217,13 @@ def _predict_tree_parallel(arrs, X, margin, *, num_class: int,
     global _TRACE_COUNT
     _TRACE_COUNT += 1
     N = X.shape[0]
-    T, NI = arrs["feat"].shape
+    T, S = arrs["feat"].shape
     K = num_class
 
-    # flat [T * NI] views: one gather serves every tree at once
+    # flat [T * S] views over the branchless node table: internal nodes
+    # and leaves share one absolute index space, child pointers are
+    # pre-resolved flat ids and leaves self-loop, so the body is just
+    # gather -> compare -> pick child
     feat = arrs["feat"].reshape(-1)
     thr = arrs["thr"].reshape(-1)
     miss = arrs["miss"].reshape(-1)
@@ -155,20 +234,18 @@ def _predict_tree_parallel(arrs, X, margin, *, num_class: int,
     if has_cat:
         is_cat = arrs["is_cat"].reshape(-1)
         W = arrs["catw"].shape[-1]
-        catw = arrs["catw"].reshape(-1)          # [T * NI * W]
-    offs = (jnp.arange(T, dtype=jnp.int32) * NI)[None, :]    # [1, T]
+        catw = arrs["catw"].reshape(-1)          # [T * S * W]
 
-    def body(_, node):
-        nd = jnp.maximum(node, 0) + offs                     # [N, T]
-        f = feat[nd]
-        fv = jnp.take_along_axis(X, f, axis=1)               # [N, T]
-        mt = miss[nd]
+    def body(_, node):                           # node: [N, T] flat ids
+        f = feat[node]
+        fv = jnp.take_along_axis(X, f, axis=1)   # [N, T]
+        mt = miss[node]
         is_nan = jnp.isnan(fv)
         fv2 = jnp.where(is_nan & (mt != MISSING_NAN), 0.0, fv)
         missing = ((mt == MISSING_ZERO) &
                    (jnp.abs(fv2) <= _K_ZERO_THRESHOLD)) | \
                   ((mt == MISSING_NAN) & is_nan)
-        go_left = jnp.where(missing, dleft[nd], fv2 <= thr[nd])
+        go_left = jnp.where(missing, dleft[node], fv2 <= thr[node])
         if has_cat:
             # tree.h CategoricalDecision: NaN -> right (missing NaN) or
             # category 0; negative / beyond the node's bitset -> right
@@ -176,22 +253,25 @@ def _predict_tree_parallel(arrs, X, margin, *, num_class: int,
                            jnp.where(mt == MISSING_NAN, -1.0, 0.0), fv)
             in_range = jnp.isfinite(iv) & (iv >= 0) & (iv < W * 32.0)
             v = jnp.clip(iv, 0.0, W * 32.0 - 1.0).astype(jnp.int32)
-            word = catw[nd * W + (v >> 5)]
+            word = catw[node * W + (v >> 5)]
             bit = (word >> (v & 31).astype(jnp.uint32)) & jnp.uint32(1)
-            go_left = jnp.where(is_cat[nd],
+            go_left = jnp.where(is_cat[node],
                                 in_range & (bit == 1), go_left)
-        child = jnp.where(go_left, left[nd], right[nd])
-        return jnp.where(node >= 0, child, node)
+        return jnp.where(go_left, left[node], right[node])
 
-    node0 = jnp.zeros((N, T), jnp.int32)
-    node = lax.fori_loop(0, depth_iters, body, node0) \
-        if depth_iters else node0
-    # children encode leaves as ~leaf, so stump/padded trees (whose
-    # children are all -1 = ~0) land on leaf 0 with no special case
-    leaf_idx = ~jnp.minimum(node, -1)
-    L = arrs["leaf"].shape[1]
-    leaf_offs = (jnp.arange(T, dtype=jnp.int32) * L)[None, :]
-    vals = arrs["leaf"].reshape(-1)[leaf_idx + leaf_offs]    # [N, T]
+    # roots are each tree's internal slot 0; one trip minimum so a
+    # single-leaf tree (root's children point at its leaf 0 slot) still
+    # lands on a value slot
+    roots = (jnp.arange(T, dtype=jnp.int32) * S)[None, :]
+    node = lax.fori_loop(0, max(depth_iters, 1), body,
+                         jnp.broadcast_to(roots, (N, T)))
+    if "value_q" in arrs:
+        # staged int8 leaves: dequantize at the final gather only (one
+        # int8 gather + a per-tree scale multiply)
+        vals = (arrs["value_q"].reshape(-1)[node].astype(jnp.float32)
+                * arrs["scale"][None, :])
+    else:
+        vals = arrs["value"].reshape(-1)[node]   # [N, T]
 
     # per-class reduction: trees are iteration-major, tree t -> class t%K
     iters = T // K
@@ -284,14 +364,23 @@ class DevicePredictor:
 
     def __init__(self, model, start_iteration: int = 0,
                  num_iteration: int = -1,
-                 batch_rows: Optional[int] = None):
+                 batch_rows: Optional[int] = None,
+                 leaf_quant: Optional[str] = None):
+        if leaf_quant not in (None, "int8"):
+            raise ValueError("leaf_quant must be None or 'int8', got %r"
+                             % (leaf_quant,))
         k = model.num_tree_per_iteration
         end = model.num_prediction_iterations(start_iteration, num_iteration)
         trees = model.trees[start_iteration * k:
                             (start_iteration + end) * k]
         L = max((t.num_leaves for t in trees), default=2)
         packed, depth = pack_trees(trees, L)
-        self._arrs = {kk: jnp.asarray(v) for kk, v in packed.items()}
+        self.leaf_quant = leaf_quant
+        # host copy of the per-tree layout for the legacy scan engine
+        # (A/B reference); the device holds only the flat table
+        self._packed = packed
+        flat = _flatten_packed(packed, leaf_quant)
+        self._arrs = {kk: jnp.asarray(v) for kk, v in flat.items()}
         self.num_class = k
         self.depth_iters = depth
         self.num_trees = len(trees)
@@ -329,10 +418,15 @@ class DevicePredictor:
                     early_stop_freq: int = 10,
                     early_stop_margin: float = 10.0,
                     batch_hook: Optional[Callable[[int, int], None]] = None,
-                    ) -> np.ndarray:
+                    out_dtype=np.float64) -> np.ndarray:
         """Raw margin scores [N, num_class].  early_stop: None, 'binary'
         or 'multiclass' (same truncated-sum semantics as the host
         predictor's vectorized early stop).
+
+        `out_dtype=np.float32` fetches the device result without the
+        f64 upcast — half the D2H bytes (ISSUE 16's serving fast path).
+        The engine computes in f32 either way, so the f32 surface equals
+        the f64 surface `.astype(float32)` exactly.
 
         `batch_hook(i, n_batches)` fires before each micro-batch dispatch
         — the batch-boundary seam the serving runtime builds on: faults
@@ -348,7 +442,7 @@ class DevicePredictor:
         freq = max(int(early_stop_freq), 1)
         if early_stop not in ("binary", "multiclass"):
             early_stop = None
-        out = np.empty((N, self.num_class), np.float64)
+        out = np.empty((N, self.num_class), out_dtype)
 
         bs = self.batch_rows
         slices = [(s, min(s + bs, N)) for s in range(0, N, bs)] or [(0, 0)]
@@ -367,20 +461,20 @@ class DevicePredictor:
             yb = self._run(xb, early_stop, freq, early_stop_margin)
             if pending is not None:
                 (ps, pe), py = pending
-                out[ps:pe] = np.asarray(py, np.float64)[: pe - ps]
+                out[ps:pe] = np.asarray(py, out_dtype)[: pe - ps]
             pending = ((s, e), yb)
         (ps, pe), py = pending
-        out[ps:pe] = np.asarray(py, np.float64)[: pe - ps]
+        out[ps:pe] = np.asarray(py, out_dtype)[: pe - ps]
         return out
 
     def predict_raw_scan(self, X: np.ndarray) -> np.ndarray:
         """The pre-PR scan engine, for A/B benchmarking only (numeric
         models; no bucketing, no micro-batching — the old behavior)."""
-        if "catw" in self._arrs:
+        if "catw" in self._packed:
             raise ValueError("the legacy scan engine has no categorical "
                              "support")
         X = jnp.asarray(self._check_width(X))
-        arrs = {kk: self._arrs[kk] for kk in
+        arrs = {kk: jnp.asarray(self._packed[kk]) for kk in
                 ("feat", "thr", "miss", "dleft", "left", "right", "leaf")}
         out = _predict_packed_scan(arrs, X, num_class=self.num_class,
                                    depth_iters=self._scan_depth_iters)

@@ -81,7 +81,8 @@ class ServeRejected(RuntimeError):
 
     def __init__(self, reason: str, retryable: bool = True,
                  detail: str = "", queue_depth: Optional[int] = None,
-                 priority: Optional[int] = None):
+                 priority: Optional[int] = None,
+                 retry_after_s: Optional[float] = None):
         super().__init__("request rejected (%s%s)%s"
                          % (reason, ", retryable" if retryable else "",
                             ": " + detail if detail else ""))
@@ -93,6 +94,12 @@ class ServeRejected(RuntimeError):
         # is machine-readable WITH its class, so a client and the sim's
         # per-class shed-rate ledger never have to guess
         self.priority = priority
+        # Retry-After-style backoff hint in seconds (ISSUE 16): rides
+        # both the JSON rejection dict and the binary rejection frame;
+        # `predict()` and the wire client raise their jittered delay to
+        # it, so the server can slow a thundering herd without a new
+        # round trip.  None = no hint.
+        self.retry_after_s = retry_after_s
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"error": "rejected", "reason": self.reason,
@@ -104,7 +111,17 @@ class ServeRejected(RuntimeError):
             d["queue_depth"] = self.queue_depth
         if self.priority is not None:
             d["priority"] = self.priority
+        if self.retry_after_s is not None:
+            d["retry_after_s"] = self.retry_after_s
         return d
+
+
+def retry_delay(base_delay: float, hint: Optional[float]) -> float:
+    """The client-side sleep for one retryable rejection: the jittered
+    backoff schedule's delay, raised to the server's Retry-After hint
+    when the rejection carries a larger one (never lowered — the jitter
+    is what breaks retry synchronization)."""
+    return max(float(base_delay), float(hint or 0.0))
 
 
 class ServeResult:
@@ -272,6 +289,7 @@ class ServingRuntime:
                  models: Optional[Dict[str, str]] = None,
                  params: Optional[Dict[str, Any]] = None,
                  raw_score: bool = False,
+                 response_dtype: Optional[str] = None,
                  max_queue: int = 256,
                  max_batch_rows: int = 4096,
                  batch_window_s: float = 0.002,
@@ -335,6 +353,16 @@ class ServingRuntime:
         self.log = log
         self._params = dict(params or {})
         self._raw_score = bool(raw_score)
+        # ISSUE 16: response_dtype="float32" serves f32 values — the
+        # device fetch moves half the bytes (D2H shrinks 2×) and the
+        # result equals the f64 answer .astype(float32) exactly (the
+        # device computes in f32; the fetch dtype only changes the
+        # upcast).  Default None keeps the legacy f64 surface.
+        if response_dtype not in (None, "float32", "float64"):
+            raise ValueError("response_dtype must be None, 'float32' or "
+                             "'float64', got %r" % (response_dtype,))
+        self._out_dtype = (np.float32 if response_dtype == "float32"
+                           else None)
         self.max_queue = int(max_queue)
         self.max_batch_rows = int(max_batch_rows)
         self.batch_window_s = float(batch_window_s)
@@ -384,6 +412,14 @@ class ServingRuntime:
         self._ready = threading.Event()
 
         self._queue: "collections.deque[_Request]" = collections.deque()
+        # batch-gather arena (ISSUE 16): preallocated per-bucket request
+        # buffers keyed (row-bucket, cols, dtype) that multi-request
+        # batches are gathered into instead of np.concatenate.  Only the
+        # single batcher thread writes it, and a batch is fully consumed
+        # (dispatched + drained) before the next one is gathered, so one
+        # buffer per bucket serves the runtime's whole lifetime — zero
+        # steady-state gather allocation.
+        self._arena: Dict[Tuple[int, int, str], np.ndarray] = {}
         self._cond = threading.Condition()
         self._stopped = False
         self._started = False
@@ -900,6 +936,28 @@ class ServingRuntime:
         id, and the response's stage decomposition rides `ServeResult.
         stages`.  A malformed value is dropped, never rejected."""
         X = np.atleast_2d(np.asarray(data, dtype=np.float64))
+        return self._submit_array(X, deadline_s, model_id, priority,
+                                  label, traceparent)
+
+    def submit_view(self, X: np.ndarray,
+                    deadline_s: Optional[float] = None,
+                    model_id: str = "default",
+                    priority: int = 0) -> _Request:
+        """Zero-copy admission for the binary data plane (ISSUE 16):
+        `X` must already be a 2-D float matrix — typically a float32
+        VIEW of a wire receive buffer — and is queued AS IS: no dtype
+        conversion, no copy, no per-request allocation.  The caller owns
+        the aliased buffer and must not reuse it until the request
+        completes (the wire handler's one-frame-in-flight protocol
+        guarantees this).  Same admission contract as `submit`."""
+        if X.ndim != 2:
+            X = np.atleast_2d(X)
+        return self._submit_array(X, deadline_s, model_id, priority,
+                                  None, None)
+
+    def _submit_array(self, X: np.ndarray, deadline_s: Optional[float],
+                      model_id: str, priority: int, label,
+                      traceparent: Optional[str]) -> _Request:
         deadline = time.monotonic() + (self.default_deadline_s
                                        if deadline_s is None
                                        else float(deadline_s))
@@ -922,12 +980,13 @@ class ServingRuntime:
                 self._count_rejection("warming", priority=prio)
                 raise ServeRejected(
                     "warming", retryable=True, priority=prio,
+                    retry_after_s=0.1,
                     detail="prewarm in progress; retry shortly")
             if self._shed_low and prio == P - 1:
                 self._count_rejection("load_shed", priority=prio)
                 raise ServeRejected(
                     "load_shed", retryable=True, priority=prio,
-                    queue_depth=len(self._queue),
+                    queue_depth=len(self._queue), retry_after_s=0.1,
                     detail="policy shed mode active for the lowest class")
             quota = self.quotas.get(model_id)
             if quota is not None and self._queued_by_model[model_id] >= \
@@ -935,7 +994,7 @@ class ServingRuntime:
                 self._count_rejection("quota_exceeded", priority=prio)
                 raise ServeRejected(
                     "quota_exceeded", retryable=True, priority=prio,
-                    queue_depth=len(self._queue),
+                    queue_depth=len(self._queue), retry_after_s=0.05,
                     detail="model %r is at its quota (%d queued >= %.0f%% "
                            "of the queue)" % (model_id,
                                               self._queued_by_model[model_id],
@@ -945,7 +1004,7 @@ class ServingRuntime:
                 self._count_rejection("queue_full", priority=prio)
                 raise ServeRejected(
                     "queue_full", retryable=True, priority=prio,
-                    queue_depth=len(self._queue),
+                    queue_depth=len(self._queue), retry_after_s=0.05,
                     detail="class p%d reservation is %d slots" % (prio,
                                                                   limit))
             self._queue.append(req)
@@ -963,7 +1022,9 @@ class ServingRuntime:
                 label=None) -> ServeResult:
         """Blocking client helper: submit + wait, with bounded jittered
         retry on RETRYABLE rejections (queue_full under a load spike,
-        no_model while the first generation lands)."""
+        no_model while the first generation lands).  A rejection that
+        carries a `retry_after_s` hint raises the jittered delay to it
+        (ISSUE 16) — same contract as the binary `wire.WireClient`."""
         delays = resilience.backoff_delays(max(attempts, 1), base=0.05,
                                            cap=0.5, seed=seed)
         deadline = (self.default_deadline_s if deadline_s is None
@@ -981,11 +1042,38 @@ class ServingRuntime:
                 if not e.retryable:
                     raise
                 if a < len(delays):
-                    time.sleep(delays[a])
+                    time.sleep(retry_delay(delays[a], e.retry_after_s))
         assert last is not None
         raise last
 
     # -- the batcher ---------------------------------------------------------
+    def _gather_batch(self, batch: List[_Request]) -> np.ndarray:
+        """Rows of a multi-request batch, gathered into the preallocated
+        per-bucket arena (no np.concatenate allocation).  A mixed
+        float32/float64 batch — wire and JSON requests for the same
+        model — gathers as float64 (the f32→f64 upcast is exact, and the
+        device path casts to f32 anyway)."""
+        if len(batch) == 1:
+            return batch[0].X
+        rows = sum(r.n_rows for r in batch)
+        cols = int(batch[0].X.shape[1])
+        dtype = batch[0].X.dtype
+        for r in batch[1:]:
+            if r.X.dtype != dtype:
+                dtype = np.dtype(np.float64)
+                break
+        bucket = max(1 << max(rows - 1, 1).bit_length(), 16)
+        key = (bucket, cols, dtype.str)
+        arena = self._arena.get(key)
+        if arena is None:
+            arena = self._arena[key] = np.empty((bucket, cols), dtype)
+        out = arena[:rows]
+        s = 0
+        for r in batch:
+            out[s:s + r.n_rows] = r.X
+            s += r.n_rows
+        return out
+
     def _reject(self, req: _Request, reason: str, retryable: bool = True,
                 detail: str = "") -> None:
         req.rejection = ServeRejected(reason, retryable=retryable,
@@ -1089,8 +1177,7 @@ class ServingRuntime:
             if kind == "canary":
                 with self._stats_lock:
                     self._stats["canary_batches"] += 1
-        X = (batch[0].X if len(batch) == 1
-             else np.concatenate([r.X for r in batch], axis=0))
+        X = self._gather_batch(batch)
         with self._wd_lock:
             self.wd("batch model=%s gen=%d rows=%d"
                     % (model_id, entry.generation, X.shape[0]),
@@ -1196,8 +1283,10 @@ class ServingRuntime:
         """One device dispatch under a deadline.  A dispatch that blows
         it is abandoned (the executor thread may be wedged; a fresh one
         takes over) and surfaces as `StageTimeout` for the breaker."""
+        kw = ({"out_dtype": self._out_dtype}
+              if self._out_dtype is not None else {})
         job = _Job(lambda: entry.booster.predict(
-            X, raw_score=self._raw_score, device=True))
+            X, raw_score=self._raw_score, device=True, **kw))
         self._executor.submit(job)
         if not job.done.wait(self.predict_deadline_s):
             job.abandoned = True
@@ -1218,8 +1307,14 @@ class ServingRuntime:
                 return self._device_predict(entry, X), "device"
             except BaseException as e:       # noqa: BLE001 — degrade
                 self._trip_breaker(entry, e)
-        return entry.booster.predict(X, raw_score=self._raw_score,
-                                     device=False), "host"
+        values = entry.booster.predict(X, raw_score=self._raw_score,
+                                       device=False)
+        if self._out_dtype is not None:
+            # the host fallback serves the same surface dtype as the
+            # device path, so a breaker flip never changes the response
+            # schema mid-stream
+            values = np.asarray(values, self._out_dtype)
+        return values, "host"
 
     def _device_allowed(self, entry: _ModelEntry) -> bool:
         b = self._breaker
@@ -1327,6 +1422,11 @@ class ServingRuntime:
 # TCP front end (task=serve)
 # ---------------------------------------------------------------------------
 
+#: one encoder for every response — `json.dumps` builds a fresh
+#: JSONEncoder per call, measurable at serving rates (ISSUE 16 fix)
+_JSON_ENCODER = json.JSONEncoder(separators=(",", ":"))
+
+
 class _Handler(socketserver.StreamRequestHandler):
     """JSON-lines protocol: one request object per line, one response
     object per line.  Requests: ``{"features": [...], "model": "id",
@@ -1372,7 +1472,8 @@ class _Handler(socketserver.StreamRequestHandler):
                 out = {"error": "bad_request",
                        "detail": "%s: %s" % (type(e).__name__, e)}
             try:
-                self.wfile.write((json.dumps(out) + "\n").encode("utf-8"))
+                self.wfile.write((_JSON_ENCODER.encode(out)
+                                  + "\n").encode("utf-8"))
                 self.wfile.flush()
             except OSError:
                 return                       # client went away

@@ -177,6 +177,15 @@ METRIC_TABLE: Dict[str, Dict[str, Any]] = {
     "lgbm_serve_recoveries_total": {
         "type": "counter", "labels": (),
         "help": "Probe-based recoveries host->device"},
+    "lgbm_serve_bytes_total": {
+        "type": "counter", "labels": ("path", "dir"),
+        "help": "Binary wire-plane bytes moved (headers + payloads), "
+                "path=tcp/uds, dir=rx/tx"},
+    "lgbm_serve_frames_total": {
+        "type": "counter", "labels": ("outcome",),
+        "help": "Binary wire frames by outcome: completed/rejected or "
+                "the torn-frame class (truncated_header/short_payload/"
+                "bad_crc/bad_magic/bad_version/bad_dtype/oversized)"},
     "lgbm_span_seconds": {
         "type": "histogram", "labels": ("span",),
         "help": "Named span durations (watchdog stage closes land here; "

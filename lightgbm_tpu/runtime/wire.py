@@ -1,0 +1,497 @@
+"""Binary zero-copy serving data plane (ISSUE 16 tentpole).
+
+The JSON-lines front end (`serving.ServingServer`) parses one JSON
+object per request — fine for chaos soaks, hopeless at the 10k-100k
+req/s the ROADMAP's north star implies: at serving rates the JSON
+decode, the per-request `np.asarray(..., float64)` copy and the
+response re-encode dominate the wall clock, not the predict.  This
+module is the wire-speed path beside it:
+
+* **Length-prefixed binary frames.**  A fixed 40-byte little-endian
+  header (magic, version, msg type, dtype, flags, NUL-padded model id,
+  row/col counts, payload length, CRC32) followed by a float32
+  row-major feature payload.  The layout is mirrored field-for-field by
+  ``cpp/lightgbm_tpu_c_api.h`` (``WIRE_FRAME_FIELDS`` /
+  ``LGBMWireFrameHeader``) and ``helper/check_wire_abi.py`` lints the
+  two against each other token-for-token, so a compiled C caller and
+  this module can never silently disagree.
+* **Zero-copy request path.**  Each connection owns a small pool of
+  preallocated per-bucket receive buffers; the payload is read with
+  ``readinto`` straight into the bucket buffer and submitted as a
+  NUMPY VIEW of those bytes (`ServingRuntime.submit_view`) — no
+  per-request allocation, no float64 conversion, no JSON on the hot
+  path.  The serving batcher gathers views into its own preallocated
+  per-bucket batch buffer, so steady-state serving allocates nothing
+  per request.  (One frame is in flight per connection — the
+  request/response protocol is serial per socket — so the buffer the
+  view aliases is never reused before the response is written.)
+* **Response/rejection frames with JSON parity.**  Responses carry the
+  generation, served_by, compiled flag and the full ISSUE 14 ``stages``
+  partition (queue_wait/batch_gather/device/drain) as a fixed meta
+  block before the float32 values, so tracing and byte-verification
+  against the offline predictor work exactly as on the JSON path.
+  Rejections are machine-readable frames carrying the reason string,
+  the retryable bit and a Retry-After-style backoff hint in seconds.
+* **Torn-frame robustness.**  Truncated header, short payload, bad
+  magic/version/dtype, bad CRC and oversized row counts each produce a
+  machine-readable retryable rejection frame — never a hung connection,
+  never an unbounded read (the declared payload length is bounded
+  BEFORE any payload byte is read).  Only a CRC failure keeps the
+  connection open (the frame boundary is still trustworthy); every
+  other torn class closes it after the rejection is written, because a
+  byte stream that lied about its framing cannot be resynchronized.
+
+Served over both TCP (`WireTCPServer`) and a Unix-domain socket
+(`WireUnixServer`, `task=serve serve_wire_uds=...`) — same frames, same
+runtime, same bounded admission queue as the JSON path.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import struct
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import telemetry
+
+__all__ = ["HEADER_FIELDS", "HEADER_FMT", "HEADER_SIZE", "MAGIC",
+           "VERSION", "MSG_REQUEST", "MSG_RESPONSE", "MSG_REJECT",
+           "DTYPE_F32", "pack_request", "pack_response", "pack_reject",
+           "read_frame", "WireFrameError", "WireTCPServer",
+           "WireUnixServer", "WireClient"]
+
+#: the canonical header layout — ``helper/check_wire_abi.py`` pins this
+#: tuple token-for-token against the ``WIRE_FRAME_FIELDS`` comment in
+#: ``cpp/lightgbm_tpu_c_api.h``; edit both together or the lint fails
+HEADER_FIELDS: Tuple[Tuple[str, str], ...] = (
+    ("magic", "4s"),
+    ("version", "B"),
+    ("msg_type", "B"),
+    ("dtype", "B"),
+    ("flags", "B"),
+    ("model_id", "16s"),
+    ("n_rows", "I"),
+    ("n_cols", "I"),
+    ("payload_len", "I"),
+    ("crc32", "I"),
+)
+HEADER_FMT = "<" + "".join(fmt for _name, fmt in HEADER_FIELDS)
+HEADER_SIZE = struct.calcsize(HEADER_FMT)          # 40 bytes
+_HEADER = struct.Struct(HEADER_FMT)
+
+MAGIC = b"LGBW"
+VERSION = 1
+MSG_REQUEST, MSG_RESPONSE, MSG_REJECT = 1, 2, 3
+DTYPE_F32 = 0                                      # the only wire dtype
+
+#: response meta block, written BEFORE the float32 values payload:
+#: generation (i64), latency_s, queue_wait_s, batch_gather_s, device_s,
+#: drain_s (f32 — the ISSUE 14 stage partition, same clock as latency),
+#: served_by (0=host 1=device), compiled (0/1), 2 pad bytes
+RESP_META_FMT = "<qfffffBBxx"
+RESP_META_SIZE = struct.calcsize(RESP_META_FMT)    # 32 bytes
+_RESP_META = struct.Struct(RESP_META_FMT)
+
+#: rejection meta block: retry_after_s (f32 backoff hint, 0 = none),
+#: retryable (0/1), reserved, reason_len (u16), then reason utf-8 bytes
+REJ_META_FMT = "<fBBH"
+REJ_META_SIZE = struct.calcsize(REJ_META_FMT)      # 8 bytes
+_REJ_META = struct.Struct(REJ_META_FMT)
+
+#: hard bound on a frame's DECLARED payload before any payload byte is
+#: read — the "never an unbounded read" contract.  Row counts are
+#: additionally bounded by the server's max_rows_per_frame.
+MAX_PAYLOAD = 1 << 26                              # 64 MiB
+MAX_COLS = 1 << 16
+
+
+class WireFrameError(RuntimeError):
+    """A frame the server (or client) refused to parse.  `reason` is the
+    machine-readable torn-frame class; `fatal` frames desynchronize the
+    byte stream and close the connection after the rejection frame."""
+
+    def __init__(self, reason: str, detail: str = "", fatal: bool = True,
+                 retry_after_s: float = 0.0):
+        super().__init__("%s%s" % (reason, ": " + detail if detail else ""))
+        self.reason = reason
+        self.fatal = fatal
+        self.retry_after_s = retry_after_s
+
+
+def _pad_model_id(model_id: str) -> bytes:
+    raw = model_id.encode("utf-8")[:16]
+    return raw + b"\x00" * (16 - len(raw))
+
+
+def _unpad_model_id(raw: bytes) -> str:
+    return raw.rstrip(b"\x00").decode("utf-8", "replace") or "default"
+
+
+def pack_header(msg_type: int, model_id: str, n_rows: int, n_cols: int,
+                payload: bytes, flags: int = 0) -> bytes:
+    return _HEADER.pack(MAGIC, VERSION, msg_type, DTYPE_F32, flags,
+                        _pad_model_id(model_id), n_rows, n_cols,
+                        len(payload), zlib.crc32(payload) & 0xFFFFFFFF)
+
+
+def pack_request(X: np.ndarray, model_id: str = "default") -> bytes:
+    """One request frame from a [B, F] float32 matrix (cast if needed)."""
+    X = np.ascontiguousarray(np.atleast_2d(X), np.float32)
+    payload = X.tobytes()
+    return pack_header(MSG_REQUEST, model_id, X.shape[0], X.shape[1],
+                       payload) + payload
+
+
+def pack_response(values: np.ndarray, generation: int, model_id: str,
+                  served_by: str, latency_s: float,
+                  stages: Dict[str, float], compiled: bool) -> bytes:
+    """One response frame: RESP_META + float32 values.  n_rows/n_cols
+    describe the VALUES matrix; payload_len additionally covers the meta
+    block, so framing never depends on interpreting the payload."""
+    vals = np.ascontiguousarray(np.atleast_2d(values), np.float32)
+    meta = _RESP_META.pack(
+        int(generation), float(latency_s),
+        float(stages.get("queue_wait_s", 0.0)),
+        float(stages.get("batch_gather_s", 0.0)),
+        float(stages.get("device_s", 0.0)),
+        float(stages.get("drain_s", 0.0)),
+        1 if served_by == "device" else 0, 1 if compiled else 0)
+    payload = meta + vals.tobytes()
+    return pack_header(MSG_RESPONSE, model_id, vals.shape[0],
+                       vals.shape[1], payload) + payload
+
+
+def pack_reject(reason: str, retryable: bool = True,
+                retry_after_s: float = 0.0,
+                model_id: str = "default") -> bytes:
+    """One machine-readable rejection frame (the binary twin of
+    `ServeRejected.to_dict()`), carrying the Retry-After backoff hint."""
+    rb = reason.encode("utf-8")[:1024]
+    payload = _REJ_META.pack(max(float(retry_after_s), 0.0),
+                             1 if retryable else 0, 0, len(rb)) + rb
+    return pack_header(MSG_REJECT, model_id, 0, 0, payload) + payload
+
+
+def unpack_response(header: Tuple, payload: bytes) -> Dict[str, Any]:
+    """Decode a response/reject payload into the JSON-path dict shape —
+    the parity surface the verification harnesses compare on."""
+    (_magic, _ver, msg_type, _dtype, _flags, model_raw, n_rows, n_cols,
+     _plen, _crc) = header
+    if msg_type == MSG_REJECT:
+        retry_after, retryable, _resv, rlen = _REJ_META.unpack_from(payload)
+        reason = payload[REJ_META_SIZE:REJ_META_SIZE + rlen].decode(
+            "utf-8", "replace")
+        return {"error": "rejected", "reason": reason,
+                "retryable": bool(retryable),
+                "retry_after_s": round(float(retry_after), 6)}
+    if msg_type != MSG_RESPONSE:
+        raise WireFrameError("unexpected_msg_type", str(msg_type))
+    (gen, latency, qw, bg, dv, dr, served_dev, compiled) = \
+        _RESP_META.unpack_from(payload)
+    vals = np.frombuffer(payload, np.float32, count=n_rows * n_cols,
+                         offset=RESP_META_SIZE).reshape(n_rows, n_cols)
+    return {"values": vals, "generation": int(gen),
+            "model": _unpad_model_id(model_raw),
+            "served_by": "device" if served_dev else "host",
+            "latency_s": float(latency), "compiled": bool(compiled),
+            "stages": {"queue_wait_s": float(qw),
+                       "batch_gather_s": float(bg),
+                       "device_s": float(dv), "drain_s": float(dr)}}
+
+
+# ---------------------------------------------------------------------------
+# frame reader (shared by server handler and client)
+# ---------------------------------------------------------------------------
+
+def _read_exact_into(rfile, view: memoryview) -> int:
+    """Fill `view` from the buffered reader; returns bytes actually read
+    (short only at EOF).  Bounded by len(view) — never an unbounded
+    read."""
+    got = 0
+    while got < len(view):
+        n = rfile.readinto(view[got:])
+        if not n:
+            break
+        got += n
+    return got
+
+
+def read_frame(rfile, buffers: Optional["_BucketBuffers"] = None,
+               max_rows: int = 1 << 20,
+               expect: Optional[int] = None):
+    """Read one frame: (header tuple, payload).  With `buffers`, the
+    payload lands in a preallocated per-bucket buffer and `payload` is a
+    memoryview of it (zero-copy); otherwise a fresh bytes object.
+
+    Returns None at clean EOF (no bytes).  Raises `WireFrameError` for
+    every torn-frame class; the DECLARED payload length is validated
+    against the header's own row/col counts and the hard bounds BEFORE
+    any payload byte is read."""
+    head = bytearray(HEADER_SIZE)
+    got = _read_exact_into(rfile, memoryview(head))
+    if got == 0:
+        return None
+    if got < HEADER_SIZE:
+        raise WireFrameError("truncated_header",
+                             "%d of %d header bytes" % (got, HEADER_SIZE))
+    hdr = _HEADER.unpack(bytes(head))
+    (magic, version, msg_type, dtype, _flags, _model, n_rows, n_cols,
+     payload_len, crc) = hdr
+    if magic != MAGIC:
+        raise WireFrameError("bad_magic", repr(bytes(magic)))
+    if version != VERSION:
+        raise WireFrameError("bad_version", str(version))
+    if dtype != DTYPE_F32:
+        raise WireFrameError("bad_dtype", str(dtype))
+    if expect is not None and msg_type != expect:
+        raise WireFrameError("unexpected_msg_type", str(msg_type))
+    if payload_len > MAX_PAYLOAD or n_cols > MAX_COLS:
+        raise WireFrameError("oversized",
+                             "payload_len=%d n_cols=%d" % (payload_len,
+                                                           n_cols))
+    if msg_type == MSG_REQUEST:
+        if n_rows > max_rows:
+            raise WireFrameError(
+                "oversized", "n_rows=%d > max_rows_per_frame=%d"
+                % (n_rows, max_rows), retry_after_s=0.0)
+        if n_rows < 1 or n_cols < 1 or payload_len != n_rows * n_cols * 4:
+            raise WireFrameError(
+                "bad_frame", "payload_len=%d does not match %dx%d float32"
+                % (payload_len, n_rows, n_cols))
+    if buffers is not None:
+        buf = buffers.get(payload_len)
+        view = memoryview(buf)[:payload_len]
+        got = _read_exact_into(rfile, view)
+    else:
+        raw = bytearray(payload_len)
+        view = memoryview(raw)
+        got = _read_exact_into(rfile, view)
+    if got < payload_len:
+        raise WireFrameError("short_payload",
+                             "%d of %d payload bytes" % (got, payload_len))
+    if zlib.crc32(view) & 0xFFFFFFFF != crc:
+        # the frame BOUNDARY is intact (payload_len was honored), so the
+        # stream can keep going — the only retry-in-place torn class
+        raise WireFrameError("bad_crc", fatal=False)
+    return hdr, view if buffers is not None else bytes(raw)
+
+
+class _BucketBuffers:
+    """Per-connection pool of preallocated receive buffers, keyed by the
+    power-of-two byte bucket — repeated frames of similar size reuse ONE
+    allocation, and the numpy view handed to the runtime aliases it."""
+
+    __slots__ = ("_bufs",)
+
+    _MIN = 1 << 10
+
+    def __init__(self):
+        self._bufs: Dict[int, bytearray] = {}
+
+    def get(self, nbytes: int) -> bytearray:
+        bucket = max(self._MIN, 1 << max(int(nbytes) - 1, 1).bit_length())
+        buf = self._bufs.get(bucket)
+        if buf is None:
+            buf = self._bufs[bucket] = bytearray(bucket)
+        return buf
+
+
+# ---------------------------------------------------------------------------
+# servers
+# ---------------------------------------------------------------------------
+
+class _WireHandler(socketserver.StreamRequestHandler):
+    """One thread per connection, strict request/response (one frame in
+    flight per socket): the zero-copy receive buffer is safe to reuse
+    once the response is on the wire."""
+
+    def handle(self) -> None:
+        server = self.server                      # type: ignore[assignment]
+        rt = server.runtime
+        path = server.wire_path_label
+        bytes_total = telemetry.counter("lgbm_serve_bytes_total")
+        frames_total = telemetry.counter("lgbm_serve_frames_total")
+        buffers = _BucketBuffers()
+        from .serving import ServeRejected
+        while True:
+            try:
+                frame = read_frame(self.rfile, buffers,
+                                   max_rows=server.max_rows_per_frame,
+                                   expect=MSG_REQUEST)
+            except WireFrameError as e:
+                frames_total.inc(outcome=e.reason)
+                out = pack_reject(e.reason, retryable=True,
+                                  retry_after_s=e.retry_after_s)
+                self._send(out, bytes_total, path)
+                if e.fatal:
+                    return                        # stream desynchronized
+                continue
+            except OSError:
+                return
+            if frame is None:
+                return                            # clean EOF
+            hdr, payload = frame
+            (_m, _v, _t, _d, _f, model_raw, n_rows, n_cols, plen,
+             _crc) = hdr
+            bytes_total.inc(HEADER_SIZE + plen, path=path, dir="rx")
+            model_id = _unpad_model_id(model_raw)
+            # the zero-copy hand-off: a float32 VIEW of the receive
+            # buffer rides the queue; no per-request numpy allocation
+            X = np.frombuffer(payload, np.float32,
+                              count=n_rows * n_cols).reshape(n_rows,
+                                                             n_cols)
+            try:
+                rec = rt.submit_view(X, model_id=model_id).wait(
+                    timeout=rt.default_deadline_s
+                    + rt.predict_deadline_s + 10.0)
+                # response values are always [n_rows, n_outputs] on the
+                # wire (a squeezed 1-class vector reshapes, multiclass
+                # passes through)
+                vals = np.asarray(rec.values)
+                out = pack_response(vals.reshape(n_rows, -1),
+                                    rec.generation, model_id,
+                                    rec.served_by, rec.latency_s,
+                                    rec.stages, rec.compiled)
+                frames_total.inc(outcome="completed")
+            except ServeRejected as e:
+                out = pack_reject(e.reason, retryable=e.retryable,
+                                  retry_after_s=e.retry_after_s or 0.0,
+                                  model_id=model_id)
+                frames_total.inc(outcome="rejected")
+            except Exception as e:                # noqa: BLE001 — wire error
+                out = pack_reject("bad_request", retryable=False,
+                                  model_id=model_id)
+                rt.log.warning("wire: request failed: %s: %s",
+                               type(e).__name__, e)
+                frames_total.inc(outcome="rejected")
+            if not self._send(out, bytes_total, path):
+                return
+
+    def _send(self, out: bytes, bytes_total, path: str) -> bool:
+        try:
+            self.wfile.write(out)
+            self.wfile.flush()
+        except OSError:
+            return False                          # client went away
+        bytes_total.inc(len(out), path=path, dir="tx")
+        return True
+
+
+class WireTCPServer(socketserver.ThreadingTCPServer):
+    """Binary-frame TCP front end over a `ServingRuntime` — the same
+    bounded admission queue as the JSON `ServingServer`, so admission
+    control stays global across both planes."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+    wire_path_label = "tcp"
+
+    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0,
+                 max_rows_per_frame: Optional[int] = None):
+        self.runtime = runtime
+        self.max_rows_per_frame = int(max_rows_per_frame
+                                      or runtime.max_batch_rows)
+        super().__init__((host, port), _WireHandler)
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+
+class WireUnixServer(socketserver.ThreadingUnixStreamServer):
+    """Binary-frame Unix-domain-socket front end: same frames as TCP,
+    minus the TCP/loopback stack — the lowest-latency local data plane
+    (the BENCH_WIRE headline path)."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+    wire_path_label = "uds"
+
+    def __init__(self, runtime, path: str,
+                 max_rows_per_frame: Optional[int] = None):
+        self.runtime = runtime
+        self.uds_path = path
+        self.max_rows_per_frame = int(max_rows_per_frame
+                                      or runtime.max_batch_rows)
+        if os.path.exists(path):
+            os.unlink(path)
+        super().__init__(path, _WireHandler)
+
+    def server_close(self) -> None:
+        super().server_close()
+        try:
+            os.unlink(self.uds_path)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class WireClient:
+    """Blocking binary-protocol client (one frame in flight).  `predict`
+    retries retryable rejections with the server's Retry-After hint —
+    the binary twin of `ServingRuntime.predict`'s backoff contract."""
+
+    def __init__(self, address, timeout: float = 30.0):
+        """`address`: ("host", port) for TCP or "/path/to.sock" for a
+        Unix-domain socket."""
+        if isinstance(address, (tuple, list)):
+            self._sock = socket.create_connection(tuple(address),
+                                                  timeout=timeout)
+            self._sock.setsockopt(socket.IPPROTO_TCP,
+                                  socket.TCP_NODELAY, 1)
+        else:
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.settimeout(timeout)
+            self._sock.connect(address)
+        self._rfile = self._sock.makefile("rb")
+        self._buffers = _BucketBuffers()
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+        finally:
+            self._sock.close()
+
+    def __enter__(self) -> "WireClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def request_once(self, X: np.ndarray,
+                     model_id: str = "default") -> Dict[str, Any]:
+        """One round trip; returns the decoded response dict (values as
+        a float32 view valid until the NEXT call on this client)."""
+        self._sock.sendall(pack_request(X, model_id))
+        frame = read_frame(self._rfile, self._buffers)
+        if frame is None:
+            raise WireFrameError("connection_closed")
+        hdr, payload = frame
+        return unpack_response(hdr, bytes(payload))
+
+    def predict(self, X: np.ndarray, model_id: str = "default",
+                attempts: int = 3) -> Dict[str, Any]:
+        last: Optional[Dict[str, Any]] = None
+        for a in range(max(attempts, 1)):
+            out = self.request_once(X, model_id)
+            if "error" not in out:
+                return out
+            last = out
+            if not out.get("retryable"):
+                break
+            if a + 1 < max(attempts, 1):
+                # honor the server's Retry-After hint, floor 10 ms
+                time.sleep(max(float(out.get("retry_after_s") or 0.0),
+                               0.01))
+        assert last is not None
+        raise WireFrameError("rejected", last.get("reason", ""),
+                             fatal=False)

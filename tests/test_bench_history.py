@@ -421,3 +421,90 @@ def test_coldstart_schema_gates(tmp_path):
     rep = bench_history.run(str(tmp_path))
     assert rep["invalid_coldstart_artifacts"]
     assert rep["coldstart_rounds"] == 0
+
+
+# ---------------------------------------------------------------------------
+# wire data-plane artifacts (BENCH_WIRE_r*.json, ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def _wire_path(req=1000.0, p99=2.0, verified=True, mismatch=0):
+    return {"req_per_sec": req, "rows_per_sec": req * 8, "p50_ms": 1.0,
+            "p99_ms": p99, "completed": 100, "rejected": 0,
+            "verified": verified, "prediction_mismatches": mismatch}
+
+
+def _wire_rec(round_n=16, json_rps=500.0, uds_rps=4000.0, **over):
+    rec = {
+        "artifact": "BENCH_WIRE_r%02d" % round_n, "schema_version": 1,
+        "round": round_n, "platform": "cpu", "rows_per_request": 8,
+        "conns": 4, "model": {"n_trees": 100, "num_leaves": 63,
+                              "n_feat": 28, "n_out": 1},
+        "paths": {"json_tcp": _wire_path(json_rps),
+                  "binary_tcp": _wire_path(uds_rps * 0.9),
+                  "binary_uds": _wire_path(uds_rps),
+                  "c_client_uds": _wire_path(uds_rps * 0.95)},
+        "offered": {"offered_per_sec": 12000.0, "p99_ms": 5.0,
+                    "verified": True, "prediction_mismatches": 0},
+        "speedup": {"binary_uds_over_json": uds_rps / json_rps},
+        "gates": {"binary_uds_ge_5x_json": True, "offered_ge_10k": True,
+                  "c_client_green": True, "zero_mismatches": True},
+        "ok": True,
+    }
+    rec.update(over)
+    return rec
+
+
+def _write_wire(tmp_path, n, rec):
+    (tmp_path / ("BENCH_WIRE_r%02d.json" % n)).write_text(json.dumps(rec))
+
+
+def test_wire_artifact_validates_and_collates(tmp_path):
+    assert bench_history.validate_wire_artifact(_wire_rec()) == []
+    _write_wire(tmp_path, 16, _wire_rec())
+    rep = bench_history.run(str(tmp_path))
+    assert rep["wire_rounds"] == 1
+    assert rep["invalid_wire_artifacts"] == []
+    row = rep["wire_trajectory"][0]
+    assert row["binary_uds_req_per_sec"] == 4000.0
+    assert row["speedup_binary_uds_over_json"] == 8.0
+
+
+def test_wire_schema_gates(tmp_path):
+    """Unverified responses, any prediction mismatch, or a failed gate
+    make the artifact INVALID — never a merely slow round."""
+    bad = _wire_rec()
+    bad["paths"]["binary_uds"]["verified"] = False
+    assert any("byte-verified" in p
+               for p in bench_history.validate_wire_artifact(bad))
+    bad2 = _wire_rec()
+    bad2["paths"]["json_tcp"]["prediction_mismatches"] = 3
+    assert any("mismatch" in p
+               for p in bench_history.validate_wire_artifact(bad2))
+    bad3 = _wire_rec()
+    bad3["gates"]["binary_uds_ge_5x_json"] = False
+    assert any("gate" in p
+               for p in bench_history.validate_wire_artifact(bad3))
+    # mismatches in OPTIONAL paths (the C client) also invalidate
+    bad4 = _wire_rec()
+    bad4["paths"]["c_client_uds"]["prediction_mismatches"] = 1
+    assert any("c_client_uds" in p
+               for p in bench_history.validate_wire_artifact(bad4))
+    _write_wire(tmp_path, 16, bad)
+    rep = bench_history.run(str(tmp_path))
+    assert rep["invalid_wire_artifacts"] and rep["wire_rounds"] == 0
+
+
+def test_wire_regression_flags_same_shape_only(tmp_path):
+    _write_wire(tmp_path, 16, _wire_rec(16, uds_rps=4000.0))
+    _write_wire(tmp_path, 17, _wire_rec(17, uds_rps=3000.0))  # -25%: flags
+    rep = bench_history.run(str(tmp_path))
+    assert any(f["series"] == "binary_uds_req_per_sec"
+               for f in rep["wire_latest_regressions"])
+    # a different shape (1-row frames) is never compared
+    for p in tmp_path.glob("BENCH_WIRE_r*.json"):
+        p.unlink()
+    _write_wire(tmp_path, 16, _wire_rec(16, uds_rps=4000.0))
+    _write_wire(tmp_path, 17, _wire_rec(17, uds_rps=300.0,
+                                        rows_per_request=1))
+    rep = bench_history.run(str(tmp_path))
+    assert rep["wire_latest_regressions"] == []

@@ -1,6 +1,6 @@
 """The one-command static-lint runner (helper/ci_checks.py, ISSUE 13
 satellite): the committed tree must pass EVERY lint through the single
-aggregated entry point, and the runner must keep covering all five."""
+aggregated entry point, and the runner must keep covering all six."""
 import os
 import sys
 
@@ -8,12 +8,14 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "helper"))
 
 import ci_checks  # noqa: E402
+import check_wire_abi  # noqa: E402
 
 
 def test_runner_covers_every_lint():
     names = [n for n, _ in ci_checks.CHECKS]
     assert names == ["check_abi", "check_syncs", "check_xla_sites",
-                     "check_fault_coverage", "check_metric_coverage"]
+                     "check_fault_coverage", "check_metric_coverage",
+                     "check_wire_abi"]
 
 
 def test_committed_tree_passes_all_lints(capsys):
@@ -30,9 +32,44 @@ def test_main_aggregates_verdict(monkeypatch, capsys):
     def fake_run_all():
         calls.extend(n for n, _ in ci_checks.CHECKS)
         return {"check_abi": 0, "check_syncs": 2, "check_xla_sites": 0,
-                "check_fault_coverage": 0, "check_metric_coverage": 0}
+                "check_fault_coverage": 0, "check_metric_coverage": 0,
+                "check_wire_abi": 0}
 
     monkeypatch.setattr(ci_checks, "run_all", fake_run_all)
     assert ci_checks.main([]) == 1
     out = capsys.readouterr().out
     assert "FAIL rc=2" in out and "check_syncs" in out
+
+
+def test_wire_abi_clean_on_committed_tree():
+    assert check_wire_abi.run(build=False) == []
+
+
+def test_wire_abi_catches_header_drift():
+    """The comparator must be a real comparator: doctoring one side's
+    field list (rename, re-type, reorder) has to produce drift."""
+    with open(check_wire_abi.HEADER) as fh:
+        header = fh.read()
+    with open(check_wire_abi.WIRE) as fh:
+        wire = fh.read()
+    # rename a field on the C side only
+    doctored = header.replace("n_rows:I", "num_rows:I")
+    assert doctored != header
+    assert any("drifted" in p
+               for p in check_wire_abi.run(doctored, wire, build=False))
+    # re-type a field on the Python side only
+    doctored = wire.replace('("n_cols", "I")', '("n_cols", "H")')
+    assert doctored != wire
+    problems = check_wire_abi.run(header, doctored, build=False)
+    assert any("drifted" in p for p in problems)
+    # ...and the size macro stops matching the doctored Python layout
+    assert any("LGBM_WIRE_HEADER_SIZE" in p for p in problems)
+
+
+def test_wire_abi_requires_token_line_and_size_macro():
+    with open(check_wire_abi.WIRE) as fh:
+        wire = fh.read()
+    problems = check_wire_abi.run("/* no wire block at all */", wire,
+                                  build=False)
+    assert any("WIRE_FRAME_FIELDS" in p for p in problems)
+    assert any("LGBM_WIRE_HEADER_SIZE" in p for p in problems)

@@ -248,3 +248,81 @@ def test_engine_predict_entry(tmp_path):
                                rtol=RTOL, atol=ATOL)
     np.testing.assert_array_equal(lgb.predict(bst, X, device=False),
                                   bst.predict(X))
+
+
+# -- int8 leaf quantization + float32 response surfaces (ISSUE 16) -----------
+
+def test_leaf_quant_default_off_and_byte_identical():
+    """The staged flag ships OFF, and an explicit opt-out is
+    byte-identical to the plain device path — quantization can never
+    leak into default results before its hardware window."""
+    assert dpr.LEAF_QUANT_VALIDATED is False
+    bst, X = _train("binary", rounds=8)
+    plain = bst.predict(X, device=True)
+    assert np.array_equal(bst.predict(X, device=True, leaf_quant="none"),
+                          plain)
+    assert np.array_equal(bst.predict(X, device=True,
+                                      leaf_quant="float32"), plain)
+
+
+def test_leaf_quant_int8_parity_within_quant_grid():
+    """Opt-in int8 leaves: error vs the f64 host path is bounded by the
+    quantization grid itself (one step of each tree's scale, summed —
+    stochastic rounding moves a leaf at most one grid step)."""
+    bst, X = _train("binary", rounds=8)
+    import jax.numpy as jnp
+    dp = DevicePredictor(bst._model, leaf_quant="int8")
+    assert "value_q" in dp._arrs and dp._arrs["value_q"].dtype == jnp.int8
+    host = bst.predict(X, raw_score=True)
+    q = dp.predict_raw(X)[:, 0]
+    leaf = np.asarray(dp._packed["leaf"], np.float64)
+    amax = np.abs(leaf).max(axis=1)
+    bound = float(np.where(amax > 0, amax, 127.0).sum() / 127.0)
+    err = float(np.max(np.abs(q - host)))
+    assert err <= bound, (err, bound)
+    assert err > 0.0          # it really is the quantized path
+    # transformed predictions ride the same bound through the sigmoid
+    # (|sigmoid'| <= 1/4)
+    qp = bst.predict(X, device=True, leaf_quant="int8")
+    assert float(np.max(np.abs(qp - bst.predict(X)))) <= bound / 4 + 1e-12
+
+
+def test_leaf_quant_flag_flips_default(monkeypatch):
+    """LEAF_QUANT_VALIDATED=True makes int8 the device default while
+    leaf_quant="none" still opts back to byte-identical full precision
+    — the expiry-row flip is a one-line change, pre-tested here."""
+    bst, X = _train("binary", rounds=6)
+    plain = bst.predict(X, device=True)
+    explicit = bst.predict(X, device=True, leaf_quant="int8")
+    monkeypatch.setattr(dpr, "LEAF_QUANT_VALIDATED", True)
+    bst._device_predictors = {}
+    assert np.array_equal(bst.predict(X, device=True), explicit)
+    assert np.array_equal(bst.predict(X, device=True, leaf_quant="none"),
+                          plain)
+    monkeypatch.undo()
+    bst._device_predictors = {}
+
+
+def test_f32_response_surface_is_exact_downcast():
+    """out_dtype=float32 halves the D2H transfer but must not change
+    the math: the f32 surface is the f64 surface's astype(float32),
+    bit for bit, for raw and transformed predictions."""
+    bst, X = _train("binary", rounds=6)
+    for kw in ({}, {"raw_score": True}):
+        f64 = np.asarray(bst.predict(X, device=True, **kw))
+        f32 = np.asarray(bst.predict(X, device=True,
+                                     out_dtype=np.float32, **kw))
+        assert f32.dtype == np.float32
+        assert np.array_equal(f32, f64.astype(np.float32))
+
+
+def test_f32_surface_multiclass_and_quant_compose():
+    bst, X = _train("multiclass", num_class=3, rounds=5)
+    f64 = np.asarray(bst.predict(X, device=True))
+    f32 = np.asarray(bst.predict(X, device=True, out_dtype=np.float32))
+    assert f32.shape == f64.shape and f32.dtype == np.float32
+    assert np.array_equal(f32, f64.astype(np.float32))
+    q32 = np.asarray(bst.predict(X, device=True, out_dtype=np.float32,
+                                 leaf_quant="int8"))
+    assert q32.dtype == np.float32
+    assert np.allclose(q32, f64, atol=0.05)

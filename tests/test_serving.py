@@ -501,3 +501,186 @@ def test_full_chaos_serve_soak(tmp_path, clean_fault_env):
     assert rec["ok"], rec
     assert rec["degradations"] > 0 and rec["recoveries"] > 0
     assert rec["served_by"]["host"] > 0 and rec["served_by"]["device"] > 0
+
+
+# ---------------------------------------------------------------------------
+# binary wire data plane (ISSUE 16): zero-copy frames over TCP + UDS
+# ---------------------------------------------------------------------------
+
+def _wire_pair(rt, tmp_path):
+    from lightgbm_tpu.runtime import wire
+    srv = wire.WireTCPServer(rt, port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    uds_path = str(tmp_path / "wire.sock")
+    usrv = wire.WireUnixServer(rt, uds_path)
+    threading.Thread(target=usrv.serve_forever, daemon=True).start()
+    return srv, usrv, uds_path
+
+
+def test_wire_roundtrip_matches_json_path_byte_for_byte(tmp_path):
+    """The tentpole parity gate: the same probe through the JSON front
+    end and through both binary sockets must yield the same float32
+    bytes, with generation + stage partitions carried on every path."""
+    from lightgbm_tpu.runtime import wire
+    text = _synth_model(seed=13)
+    probe = np.random.default_rng(9).standard_normal((5, 6)).astype(
+        np.float32)
+    with ServingRuntime(model_str=text, batch_window_s=0.0,
+                        response_dtype="float32") as rt:
+        jsrv = ServingServer(rt)
+        threading.Thread(target=jsrv.serve_forever, daemon=True).start()
+        srv, usrv, uds_path = _wire_pair(rt, tmp_path)
+        try:
+            with socket.create_connection(("127.0.0.1", jsrv.port),
+                                          timeout=10) as s:
+                f = s.makefile("rw")
+                f.write(json.dumps({"features": probe.tolist()}) + "\n")
+                f.flush()
+                jresp = json.loads(f.readline())
+            jvals = np.asarray(jresp["values"], np.float32)
+            for address in (("127.0.0.1", srv.port), uds_path):
+                with wire.WireClient(address) as c:
+                    out = c.predict(probe)
+                assert out["generation"] == jresp["generation"]
+                assert out["served_by"] in ("device", "host")
+                assert set(out["stages"]) == {"queue_wait_s",
+                                              "batch_gather_s",
+                                              "device_s", "drain_s"}
+                assert out["values"].dtype == np.float32
+                got = out["values"].reshape(jvals.shape)
+                assert np.array_equal(got, jvals), address
+        finally:
+            for s2 in (jsrv, srv, usrv):
+                s2.shutdown()
+                s2.server_close()
+
+
+def test_wire_torn_frames_reject_machine_readably(tmp_path):
+    """Torn input never hangs the server or triggers an unbounded read:
+    every malformed frame class yields a machine-readable rejection
+    frame, and only an intact-boundary CRC failure keeps the
+    connection; the rest close it."""
+    import struct
+    import zlib
+    from lightgbm_tpu.runtime import wire
+    text = _synth_model(seed=14)
+    with ServingRuntime(model_str=text, batch_window_s=0.0) as rt:
+        srv, usrv, uds_path = _wire_pair(rt, tmp_path)
+
+        def raw():
+            s = socket.create_connection(("127.0.0.1", srv.port),
+                                         timeout=10)
+            return s, s.makefile("rb")
+
+        def read_reject(rf):
+            frame = wire.read_frame(rf)
+            assert frame is not None
+            hdr, payload = frame
+            rej = wire.unpack_response(hdr, payload)
+            assert rej.get("error") == "rejected"
+            return rej
+        try:
+            # truncated header: reject then close
+            s, rf = raw()
+            s.sendall(wire.pack_request(np.zeros((1, 6), np.float32))[:17])
+            s.shutdown(socket.SHUT_WR)
+            rej = read_reject(rf)
+            assert rej["reason"] == "truncated_header"
+            assert rej["retryable"] is True
+            assert rf.read(1) == b""      # server closed the connection
+            s.close()
+
+            # short payload: reject then close
+            s, rf = raw()
+            good = wire.pack_request(np.ones((2, 6), np.float32))
+            s.sendall(good[:-8])
+            s.shutdown(socket.SHUT_WR)
+            rej = read_reject(rf)
+            assert rej["reason"] == "short_payload"
+            assert rf.read(1) == b""
+            s.close()
+
+            # bad CRC: frame boundary intact -> reject, connection LIVES
+            s, rf = raw()
+            bad = bytearray(wire.pack_request(np.ones((2, 6), np.float32)))
+            bad[-1] ^= 0xFF
+            s.sendall(bytes(bad))
+            rej = read_reject(rf)
+            assert rej["reason"] == "bad_crc" and rej["retryable"] is True
+            s.sendall(good)               # same connection still serves
+            frame = wire.read_frame(rf)
+            assert frame is not None
+            out = wire.unpack_response(*frame)
+            assert "values" in out and out["values"].shape == (2, 1)
+            s.close()
+
+            # oversized row count: rejected from the header alone,
+            # BEFORE any payload-sized read can be provoked
+            s, rf = raw()
+            hdr = wire.pack_header(wire.MSG_REQUEST, "default",
+                                   n_rows=2 ** 31, n_cols=6,
+                                   payload=b"\0" * 24)
+            s.sendall(hdr + b"\0" * 24)
+            rej = read_reject(rf)
+            assert rej["reason"] == "oversized"
+            assert rej["retryable"] is True
+            assert rf.read(1) == b""
+            s.close()
+
+            # bad magic: not our protocol, reject + close
+            s, rf = raw()
+            s.sendall(b"GET / HTTP/1.1\r\n" + b"\0" * 64)
+            rej = read_reject(rf)
+            assert rej["reason"] == "bad_magic"
+            s.close()
+        finally:
+            for s2 in (srv, usrv):
+                s2.shutdown()
+                s2.server_close()
+
+
+def test_wire_reject_frames_carry_backoff_hints():
+    """Binary rejections carry the same Retry-After-style hint the JSON
+    path reports, and predict()-style retry loops honor it."""
+    from lightgbm_tpu.runtime import wire
+    from lightgbm_tpu.runtime.serving import retry_delay
+    frame = wire.pack_reject("queue_full", retryable=True,
+                             retry_after_s=0.25)
+    hdr, body = wire.read_frame(__import__("io").BytesIO(frame))
+    rej = wire.unpack_response(hdr, body)
+    assert rej["reason"] == "queue_full"
+    assert rej["retryable"] is True
+    assert rej["retry_after_s"] == pytest.approx(0.25)
+    # the hint only ever LENGTHENS the client's own schedule
+    assert retry_delay(0.05, rej["retry_after_s"]) == pytest.approx(0.25)
+    assert retry_delay(0.5, rej["retry_after_s"]) == pytest.approx(0.5)
+    assert retry_delay(0.5, None) == pytest.approx(0.5)
+    # and the runtime's shed rejections actually carry one
+    e = ServeRejected("queue_full", retryable=True, retry_after_s=0.05)
+    assert e.to_dict()["retry_after_s"] == pytest.approx(0.05)
+
+
+def test_submit_view_serves_f32_without_conversion(tmp_path):
+    """submit_view() admits a float32 view as-is (no f64 copy) and the
+    batcher's gather arena is reused across batches rather than
+    reallocated per request."""
+    text = _synth_model(seed=15)
+    probe = np.random.default_rng(10).standard_normal((4, 6)).astype(
+        np.float32)
+    with ServingRuntime(model_str=text, batch_window_s=0.0) as rt:
+        ref = np.asarray(rt.predict(np.asarray(probe, np.float64)).values)
+        rec = rt.submit_view(probe).wait(timeout=30)
+        assert np.allclose(np.asarray(rec.values, np.float64), ref,
+                           rtol=1e-6, atol=1e-7)
+        # arena reuse: same (bucket, cols, dtype) key -> same buffer
+        class _Req:
+            def __init__(self, X):
+                self.X = X
+                self.n_rows = X.shape[0]
+        b1 = [_Req(probe[:2]), _Req(probe[2:])]
+        g1 = rt._gather_batch(b1)
+        base1 = g1.base if g1.base is not None else g1
+        g2 = rt._gather_batch(b1)
+        base2 = g2.base if g2.base is not None else g2
+        assert base1 is base2
+        assert g1.dtype == np.float32
