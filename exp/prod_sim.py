@@ -25,12 +25,24 @@ bar is the chaos-soak bar, continuously applied: zero wrong-generation
 responses and byte-identity of every completed response against the
 offline predictor for the generation it reports.
 
+ISSUE 17 adds ``--fleet``: the ELASTIC variant of the same harness — a
+`FleetController` (runtime/fleet.py) autoscales replica subprocesses
+against a p99 SLO under >=10x the r11 offered load, across a
+120-tenant model zoo with bounded LRU residency, `die_at_spawn` +
+mid-run SIGKILL churn, shed strictly as the last resort.  Artifact:
+``SIM_r17.json``; runbook: docs/PRODSIM.md "Autoscaler runbook".
+
 Usage:  python exp/prod_sim.py [artifact.json] [--quick]
         (default artifact: SIM_r11.json at the repo root; --quick runs
         the reduced binary-only smoke the tier-1 test uses)
+        python exp/prod_sim.py [artifact.json] --fleet [--quick]
+        (elastic-fleet scenarios -> SIM_r17.json; --quick runs the
+        short diurnal-only smoke, gates not expected to pass at that
+        duration)
         python exp/prod_sim.py --replica <cfg.json> <out.json>
         (internal: one serving replica + load generator)
-Env:    PROD_SIM_SEED, PROD_SIM_REPLICAS, PROD_SIM_DURATION
+Env:    PROD_SIM_SEED, PROD_SIM_REPLICAS, PROD_SIM_DURATION,
+        PROD_SIM_LOAD_SCALE (--fleet: scales every shape's rps)
 """
 from __future__ import annotations
 
@@ -650,6 +662,365 @@ def run_sim(workdir: str, scenarios: Optional[List[str]] = None,
     return out
 
 
+# ---------------------------------------------------------------------------
+# elastic-fleet scenarios (ISSUE 17): SLO-driven autoscaling at 10x the
+# r11 offered load, with a model-zoo tenant mix and fault churn killing
+# replicas mid-scale-up
+# ---------------------------------------------------------------------------
+
+#: r11's committed offered_rps_mean for the binary scenario — the
+#: baseline the >=10x fleet-load gate measures against (SIM_r11.json)
+R11_OFFERED_RPS_MEAN = 149.75
+
+#: registered model-zoo tenants per replica (bounded residency holds
+#: only `max_resident` of them loaded; the rest page in on demand)
+FLEET_TENANTS = 120
+
+#: tenants that actually receive bulk traffic — more than
+#: `max_resident` minus the default lineage, so LRU page-in/evict churn
+#: runs for the whole scenario
+FLEET_HOT_TENANTS = 8
+
+FLEET_SCENARIOS: Dict[str, Dict[str, Any]] = {
+    "fleet_diurnal": {
+        "objective": "binary", "n_features": 8,
+        "shape": {"kind": "diurnal", "base_rps": 700, "peak_rps": 2600},
+        # the FIRST scale-up dies during its prewarm, before /healthz
+        # ever answers ready — the relaunch path on the most expensive
+        # death window (armed for every replica; only the matching
+        # fleet spawn ordinal dies)
+        "fault": "die_at_spawn:2",
+    },
+    "fleet_bursty": {
+        "objective": "binary", "n_features": 8,
+        # base leaves ONE replica slack between bursts (pressure breaks
+        # per burst instead of fusing bursts into one long episode);
+        # the burst itself saturates the whole box
+        "shape": {"kind": "bursty", "base_rps": 800, "peak_rps": 3800},
+        # the SECOND scale-up dies mid-prewarm: bursty's first episode
+        # rides on one base replica, so killing spawn 2 would fuse the
+        # burst and the relaunch into one fault-stretched episode the
+        # reaction gate can't attribute to the autoscaler
+        "fault": "die_at_spawn:3",
+    },
+}
+
+
+def _train_fleet_model(workdir: str, spec: Dict[str, Any],
+                       seed: int) -> str:
+    """One small real booster, trained once per sim run — every zoo
+    tenant publishes the SAME text, so the byte-verifier's
+    generation->reference map stays unambiguous across tenants."""
+    from lightgbm_tpu.basic import Booster, Dataset
+    path = os.path.join(workdir, "fleet_model.txt")
+    if os.path.exists(path):
+        with open(path) as fh:
+            return fh.read()
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((600, spec["n_features"]))
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(np.float64)
+    ds = Dataset(X, label=y, params={"verbose": -1})
+    bst = Booster(params={"objective": "binary", "num_leaves": 15,
+                          "verbose": -1}, train_set=ds)
+    for _ in range(3):
+        bst.update()
+    text = bst.model_to_string()
+    resilience.atomic_write(path, text)
+    return text
+
+
+def _publish_zoo(sdir: str, text: str) -> Dict[str, str]:
+    """default + FLEET_TENANTS published model dirs (generation 1
+    each); returns the model_id -> dir map the replica spec registers."""
+    models: Dict[str, str] = {}
+    for mid in ["default"] + ["t%03d" % i for i in range(FLEET_TENANTS)]:
+        d = os.path.join(sdir, "zoo", mid)
+        publish.ModelPublisher(d).publish(text)
+        models[mid] = d
+    return models
+
+
+class _ReplicaKiller(threading.Thread):
+    """SIGKILL one READY replica partway through the run — the abrupt
+    fleet-level death (no drain, no final snapshot scrape) the
+    controller must absorb with a relaunch."""
+
+    def __init__(self, controller, at_s: float, ledger: List[str]):
+        super().__init__(name="sim-replica-killer", daemon=True)
+        self.controller = controller
+        self.at_s = at_s
+        self.ledger = ledger
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        if self._halt.wait(self.at_s):
+            return
+        with self.controller._lock:         # noqa: SLF001 — sim harness
+            ready = [h for h in self.controller.replicas
+                     if h.ready and not h.retiring]
+            if not ready:
+                return
+            victim = max(ready, key=lambda h: h.spawned_mono)
+            try:
+                victim.proc.kill()
+                self.ledger.append("sigkill:%s" % victim.name)
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._halt.set()
+
+
+def collate_fleet_scenario(name: str, ledger: Dict[str, Any],
+                           fleet: Dict[str, Any],
+                           snaps: List[Dict[str, Any]],
+                           duration_s: float) -> Dict[str, Any]:
+    """One fleet scenario's artifact section: loadgen ledger (client
+    side — completions and byte-verification verdicts) + controller
+    report (scale events, reactions, replica-seconds) + the replicas'
+    last scraped registry snapshots (latency/staleness/residency)."""
+    spec = FLEET_SCENARIOS[name]
+    verification = {k: int(v) for k, v in
+                    (ledger.get("verification") or {}).items()}
+    completed = sum(c["completed"] for c in ledger["classes"].values())
+    verified = int(sum(verification.values()))
+    ok_verified = verification.get("ok", 0)
+    residency = _sum_counter(snaps, "lgbm_serve_residency_events_total",
+                             by="event")
+    rows = _sum_counter(snaps, "lgbm_serve_rows_total").get("_total", 0.0)
+    replica_s = float(fleet.get("replica_seconds") or 0.0)
+    reactions = list(fleet.get("reactions_s") or [])
+    reaction_max = max(reactions) if reactions else None
+    shed_on_decisions = [d for d in (fleet.get("events") or [])
+                         if d["action"] == "shed_on"]
+    # shed is last resort: every shed_on grant must land while the
+    # policy target is pinned at max_replicas
+    tl = fleet.get("timeline") or []
+    max_replicas = int(fleet["policy"]["max_replicas"])
+
+    def _target_at(t_s: float) -> Optional[int]:
+        at = None
+        for row in tl:
+            if row["t_s"] <= t_s:
+                at = row["target"]
+        return at
+
+    shed_only_at_max = all(
+        (_target_at(e["t_s"]) or max_replicas) >= max_replicas
+        for e in shed_on_decisions)
+    spawn_to_ready = [e["spawn_to_ready_s"]
+                      for e in (fleet.get("events") or [])
+                      if e["action"] == "ready"]
+    offered_x = (ledger["offered_rps_mean"] / R11_OFFERED_RPS_MEAN
+                 if R11_OFFERED_RPS_MEAN else 0.0)
+    wrong = verification.get("wrong_generation", 0) \
+        + verification.get("mismatch", 0) \
+        + verification.get("unverifiable", 0)
+    sec: Dict[str, Any] = {
+        "objective": spec["objective"],
+        "replicas": max_replicas,
+        "duration_s": duration_s,
+        "shape": ledger["shape"],
+        "offered_total": int(ledger["offered_total"]),
+        "offered_rps_mean": ledger["offered_rps_mean"],
+        "max_lag_s": ledger["max_lag_s"],
+        "latency_s": _quantiles(_hist_state(snaps,
+                                            "lgbm_serve_latency_seconds")),
+        "staleness_s": _quantiles(_hist_state(
+            snaps, "lgbm_serve_staleness_seconds")),
+        "capacity_rows_per_sec_per_replica": round(
+            rows / max(replica_s, 1e-9), 2),
+        "classes": ledger["classes"],
+        "verification": verification,
+        "non_machine_readable_rejections":
+            ledger["non_machine_readable_rejections"],
+        "hard_errors": ledger["hard_errors"][:10],
+        "served_by": dict(ledger["served_by"]),
+        "loadgen_completed": completed,
+        "verified_total": verified,
+        "fleet": {
+            "min_replicas": int(fleet["policy"]["min_replicas"]),
+            "max_replicas": max_replicas,
+            "scale_ups": int(fleet["scale_ups"]),
+            "scale_downs": int(fleet["scale_downs"]),
+            "relaunches": int(fleet["relaunches"]),
+            "replica_seconds": round(replica_s, 3),
+            "replica_seconds_per_million_verified": round(
+                replica_s * 1e6 / ok_verified, 1) if ok_verified else None,
+            "reactions_s": reactions,
+            "scale_up_reaction_s_max": reaction_max,
+            "spawn_to_ready_s": spawn_to_ready,
+            "offered_x_r11": round(offered_x, 2),
+            "shed_only_at_max": bool(shed_only_at_max),
+            "shed_grants": len(shed_on_decisions),
+            "faults_injected": fleet.get("faults_injected", []),
+            "residency": {k: int(v) for k, v in residency.items()},
+            "events": [e for e in (fleet.get("events") or [])
+                       if e["action"] != "ready"],
+            "timeline": tl,
+        },
+    }
+    sec["ok"] = bool(
+        ok_verified > 0
+        and wrong == 0
+        and verified == completed
+        and not sec["hard_errors"]
+        and sec["non_machine_readable_rejections"] == 0
+        and sec["fleet"]["scale_ups"] >= 2
+        and sec["fleet"]["scale_downs"] >= 1
+        and sec["fleet"]["relaunches"] >= 1
+        and (reaction_max is not None and reaction_max <= 15.0)
+        and shed_only_at_max
+        and offered_x >= 10.0)
+    return sec
+
+
+def run_fleet_scenario(name: str, workdir: str, duration_s: float = 40.0,
+                       seed: int = 17, max_replicas: int = 4,
+                       load_scale: float = 1.0,
+                       log=print) -> Dict[str, Any]:
+    """One elastic-fleet scenario end to end: zoo publish -> controller
+    (min 1, max `max_replicas` replicas) -> verified open-loop load at
+    >=10x r11 through the binary wire -> fault churn (die_at_spawn on
+    the first scale-up + SIGKILL of a ready replica) -> collate."""
+    from lightgbm_tpu.runtime.fleet import FleetClient, FleetController
+    from lightgbm_tpu.runtime.loadgen import (LoadGenerator, RequestClass,
+                                              ResponseVerifier)
+    from lightgbm_tpu.runtime.policy import FleetScalePolicy
+
+    spec = FLEET_SCENARIOS[name]
+    sdir = os.path.join(workdir, name)
+    os.makedirs(sdir, exist_ok=True)
+    text = _train_fleet_model(workdir, spec, seed)
+    models = _publish_zoo(sdir, text)
+
+    # one persistent compile cache for the whole fleet (ISSUE 15): the
+    # first replica pays the compile, every later spawn starts warm —
+    # the seam that makes spawn-to-ready ~2 s
+    os.environ.setdefault(warmup.CACHE_ENV,
+                          os.path.join(workdir, "compile_cache"))
+    replica_spec = {
+        "models": models,
+        "params": {"verbose": -1},
+        "response_dtype": "float32",
+        "max_queue": 256,
+        # the per-replica capacity knob: 8 rows per device dispatch
+        # bounds one replica's throughput, so added replicas add real
+        # capacity (and the autoscaler has something to scale)
+        "max_batch_rows": 8,
+        "batch_window_s": 0.002,
+        "predict_deadline_s": 5.0,
+        "poll_interval_s": 0.1,
+        "priority_levels": 3,
+        "quotas": {"default": 0.6, "*": 0.2},
+        "max_resident": 6,
+        "shed_policy": True,
+        "shed_high": 0.85, "shed_low": 0.5, "shed_patience": 4,
+    }
+    # high watermark sits BELOW the p2 class reservation cutoff (bulk
+    # sheds at depth_frac 1/3): the fleet scales before the lowest
+    # class starts shedding, and sheds only once replicas are maxed
+    # the p99 SLO budgets one model-zoo page-in (the bulk tenants LRU-
+    # cycle through max_resident slots all run, so the steady-state p99
+    # rides the page-in wait, not pure queueing — an SLO below that
+    # floor would read permanent pressure no replica count can clear)
+    # the low watermark sits ABOVE the page-in depth floor (~0.10 —
+    # queued requests waiting on zoo page-ins keep that much depth at
+    # ANY replica count), or the trough would never read as slack
+    policy = FleetScalePolicy(
+        min_replicas=1, max_replicas=max_replicas,
+        slo_p99_s=0.3, high_watermark=0.25, low_watermark=0.15,
+        patience=3, scale_down_patience=6, interval_s=0.5)
+    ctl = FleetController(
+        os.path.join(sdir, "fleet"), replica_spec, policy=policy,
+        interval_s=0.5, spawn_grace_s=60.0,
+        env={"LGBM_TPU_FAULT": spec["fault"], "JAX_PLATFORMS": "cpu"})
+    faults: List[str] = [spec["fault"]]
+    ctl.start()
+    ctl.wait_ready(1, timeout=120)
+
+    rng = np.random.default_rng(seed)
+    probe = rng.standard_normal((64, spec["n_features"]))
+    shape_cfg = dict(spec["shape"])
+    for k in ("base_rps", "peak_rps"):
+        shape_cfg[k] = shape_cfg[k] * load_scale
+    shape = _make_shape(shape_cfg, duration_s)
+    hot = ["t%03d" % i for i in range(FLEET_HOT_TENANTS)]
+    classes = [RequestClass("gold", priority=0, weight=1.0, rows=1),
+               RequestClass("silver", priority=1, weight=2.0, rows=2)]
+    classes += [RequestClass("bulk-%s" % mid, priority=2, model_id=mid,
+                             weight=3.0 / len(hot), rows=4)
+                for mid in hot]
+    # wire responses are float32 — verify against the SAME
+    # deterministic narrowing of the exact f64 reference
+    verifier = ResponseVerifier(probe, pub_dir=models["default"],
+                                params={"verbose": -1},
+                                value_dtype=np.float32)
+    cli = FleetClient(ctl, workers=96, predict_deadline_s=5.0,
+                      request_timeout_s=10.0)
+    gen = LoadGenerator(cli, classes, shape, duration_s, probe,
+                        seed=seed, verifier=verifier, deadline_s=2.0,
+                        waiters=16, trace_every=0)
+    killer = _ReplicaKiller(ctl, at_s=duration_s * 0.55, ledger=faults)
+    killer.start()
+    try:
+        ledger = gen.run()
+    finally:
+        killer.stop()
+        cli.close()
+    # cooldown: zero offered load while the controller keeps ticking —
+    # the contraction half of elasticity (slack streak -> shed grant
+    # returned -> scale-downs) needs a guaranteed trough to land in,
+    # and the timeline should show the fleet actually letting go
+    time.sleep(10.0)
+    # final scrape before teardown so the artifact's histograms carry
+    # the whole run (dead replicas keep their LAST scraped snapshot)
+    snaps = []
+    with ctl._lock:                          # noqa: SLF001 — sim harness
+        for h in ctl.replicas + ctl.retired:
+            if h.last_snapshot is not None:
+                snaps.append(h.last_snapshot)
+    fleet = ctl.stop()
+    fleet["faults_injected"] = faults
+    sec = collate_fleet_scenario(name, ledger, fleet, snaps, duration_s)
+    fl = sec["fleet"]
+    log("prod_sim[%s]: ok=%s offered=%.0f rps (%.1fx r11) ups=%d "
+        "downs=%d relaunches=%d reaction_max=%s spawn_ready=%s "
+        "rs/1Mverified=%s resident_events=%s"
+        % (name, sec["ok"], sec["offered_rps_mean"], fl["offered_x_r11"],
+           fl["scale_ups"], fl["scale_downs"], fl["relaunches"],
+           fl["scale_up_reaction_s_max"],
+           ["%.2f" % s for s in fl["spawn_to_ready_s"]],
+           fl["replica_seconds_per_million_verified"],
+           fl["residency"]))
+    return sec
+
+
+def run_fleet_sim(workdir: str, scenarios: Optional[List[str]] = None,
+                  duration_s: float = 40.0, seed: int = 17,
+                  max_replicas: int = 4, load_scale: float = 1.0,
+                  log=print) -> Dict[str, Any]:
+    t0 = time.monotonic()
+    out: Dict[str, Any] = {
+        "artifact": "SIM_r17",
+        "schema_version": SCHEMA_VERSION,
+        "t_start": resilience.wallclock(),
+        "replicas": max_replicas,
+        "duration_s": duration_s,
+        "seed": seed,
+        "r11_offered_rps_mean": R11_OFFERED_RPS_MEAN,
+        "scenarios": {},
+    }
+    for name in (scenarios or list(FLEET_SCENARIOS)):
+        out["scenarios"][name] = run_fleet_scenario(
+            name, workdir, duration_s=duration_s, seed=seed,
+            max_replicas=max_replicas, load_scale=load_scale, log=log)
+    out["elapsed_s"] = round(time.monotonic() - t0, 1)
+    out["ok"] = bool(out["scenarios"]) and all(
+        s["ok"] for s in out["scenarios"].values())
+    return out
+
+
 def main(argv: List[str]) -> int:
     if len(argv) > 1 and argv[1] == "--replica":
         with open(argv[2]) as fh:
@@ -658,6 +1029,31 @@ def main(argv: List[str]) -> int:
         resilience.atomic_write(argv[3], json.dumps(rec))
         return 0
     import tempfile
+    if "--fleet" in argv:
+        # ISSUE 17: the elastic-fleet sim — autoscaling controller +
+        # model-zoo replicas at >=10x the r11 offered load
+        args = [a for a in argv[1:] if not a.startswith("--")]
+        artifact = args[0] if args else os.path.join(REPO, "SIM_r17.json")
+        quick = "--quick" in argv
+        seed = int(os.environ.get("PROD_SIM_SEED", "17"))
+        duration = float(os.environ.get("PROD_SIM_DURATION",
+                                        "12" if quick else "40"))
+        load_scale = float(os.environ.get("PROD_SIM_LOAD_SCALE", "1.0"))
+        scenarios = ["fleet_diurnal"] if quick else None
+        with tempfile.TemporaryDirectory(prefix="lgbm_fleet_sim_") as wd:
+            rec = run_fleet_sim(wd, scenarios=scenarios,
+                                duration_s=duration, seed=seed,
+                                load_scale=load_scale)
+        from helper.bench_history import validate_sim_artifact
+        problems = validate_sim_artifact(rec)
+        if problems:
+            print("prod_sim: INVALID artifact: %s" % "; ".join(problems))
+            return 2
+        resilience.atomic_write(artifact, json.dumps(rec, indent=1) + "\n")
+        print("prod_sim: ok=%s scenarios=%s elapsed=%.0fs artifact=%s"
+              % (rec["ok"], ",".join(rec["scenarios"]), rec["elapsed_s"],
+                 artifact), flush=True)
+        return 0 if rec["ok"] else 1
     quick = "--quick" in argv
     args = [a for a in argv[1:] if not a.startswith("--")]
     artifact = args[0] if args else os.path.join(REPO, "SIM_r11.json")

@@ -720,6 +720,13 @@ SIM_SERIES: Tuple[Tuple[str, Tuple[str, ...], bool], ...] = (
     ("staleness_p50_s", ("staleness_s", "p50"), False),
     ("capacity_rows_per_sec_per_replica",
      ("capacity_rows_per_sec_per_replica",), True),
+    # elastic-fleet efficiency (ISSUE 17): cost per verified outcome
+    # and how fast added capacity clears an SLO breach — both lower-
+    # better; absent from pre-fleet artifacts and silently skipped
+    ("fleet_replica_s_per_1M_verified",
+     ("fleet", "replica_seconds_per_million_verified"), False),
+    ("fleet_scale_up_reaction_s",
+     ("fleet", "scale_up_reaction_s_max"), False),
 )
 
 #: scenario keys every SIM artifact must carry with these types; the
@@ -778,6 +785,15 @@ def validate_sim_artifact(rec: Any) -> List[str]:
                 if key not in cls:
                     problems.append("scenario %r: class %r misses %s"
                                     % (name, cname, key))
+        # the fleet correctness gate (ISSUE 17): every completed
+        # response must carry a verification verdict — a gap means the
+        # byte-verifier silently skipped responses, which voids the
+        # artifact's zero-mismatch claim
+        vt, lc = sec.get("verified_total"), sec.get("loadgen_completed")
+        if isinstance(vt, int) and isinstance(lc, int) and vt != lc:
+            problems.append("scenario %r: verified_total %d != "
+                            "loadgen_completed %d (unverified "
+                            "completions)" % (name, vt, lc))
     return problems
 
 

@@ -161,12 +161,19 @@ class ResponseVerifier:
     def __init__(self, probe: np.ndarray, pub_dir: Optional[str] = None,
                  texts: Optional[Dict[int, str]] = None,
                  params: Optional[Dict[str, Any]] = None,
-                 raw_score: bool = False):
+                 raw_score: bool = False,
+                 value_dtype: Optional[type] = None):
+        """`value_dtype=np.float32` verifies a float32 serving surface
+        (the binary wire plane / ``response_dtype="float32"``): the
+        reference stays the exact f64 offline predict, narrowed with the
+        SAME deterministic cast the server applies — still byte-identity,
+        just in the narrower lane (ISSUE 16/17)."""
         self.probe = np.asarray(probe, dtype=np.float64)
         self.pub_dir = pub_dir
         self.texts: Dict[int, str] = dict(texts or {})
         self.params = dict(params or {})
         self.raw_score = bool(raw_score)
+        self.value_dtype = value_dtype
         self._refs: Dict[int, Dict[str, np.ndarray]] = {}
         self._lock = threading.Lock()
 
@@ -233,8 +240,12 @@ class ResponseVerifier:
         if refs is None:
             return "wrong_generation"
         ref = refs.get(result.served_by)
-        if ref is None or not np.array_equal(np.asarray(result.values),
-                                             ref[idx]):
+        if ref is None:
+            return "mismatch"
+        expect = ref[idx]
+        if self.value_dtype is not None:
+            expect = expect.astype(self.value_dtype)
+        if not np.array_equal(np.asarray(result.values), expect):
             return "mismatch"
         return "ok"
 
@@ -271,6 +282,7 @@ class LoadGenerator:
         self.trace_every = max(int(trace_every), 0)
         self.trace_samples: List[Dict[str, Any]] = []
         self._trace_lock = threading.Lock()
+        self._ledger_lock = threading.Lock()
 
         self.offered: Dict[str, int] = {c.name: 0 for c in self.classes}
         self.completed: Dict[str, int] = {c.name: 0 for c in self.classes}
@@ -297,13 +309,20 @@ class LoadGenerator:
                 except ServeRejected as e:
                     self._record_shed(cls, e)
                     continue
-                self.completed[cls.name] += 1
-                self.served_by[rec.served_by] = \
-                    self.served_by.get(rec.served_by, 0) + 1
-                if self.verifier is not None:
-                    verdict = self.verifier.verify(rec, idx)
-                    self.verify_counts[verdict] = \
-                        self.verify_counts.get(verdict, 0) + 1
+                verdict = (self.verifier.verify(rec, idx)
+                           if self.verifier is not None else None)
+                # one lock around the ledger counters: the waiters'
+                # unlocked read-modify-writes used to lose updates under
+                # preemption, so verified_total could drift from
+                # completed — an equality validate_sim_artifact rejects
+                with self._ledger_lock:
+                    self.completed[cls.name] += 1
+                    self.served_by[rec.served_by] = \
+                        self.served_by.get(rec.served_by, 0) + 1
+                    if verdict is not None:
+                        self.verify_counts[verdict] = \
+                            self.verify_counts.get(verdict, 0) + 1
+                if verdict is not None:
                     verified.inc(result=verdict)
             except BaseException as e:       # noqa: BLE001 — a waiter
                 # must NEVER die silently: a dead waiter would strand its
@@ -372,8 +391,9 @@ class LoadGenerator:
                 self.trace_samples.append(sample)
 
     def _record_shed(self, cls: RequestClass, e: ServeRejected) -> None:
-        reasons = self.shed[cls.name]
-        reasons[e.reason] = reasons.get(e.reason, 0) + 1
+        with self._ledger_lock:
+            reasons = self.shed[cls.name]
+            reasons[e.reason] = reasons.get(e.reason, 0) + 1
         d = e.to_dict()
         # the machine-readability contract: retryable flag, a reason,
         # and (ISSUE 11) the priority class the shed applied to

@@ -41,7 +41,7 @@ from typing import Any, Dict, List, Optional
 from . import telemetry
 from .resilience import wallclock
 
-__all__ = ["AutoscaleShedPolicy", "CanaryPolicy"]
+__all__ = ["AutoscaleShedPolicy", "CanaryPolicy", "FleetScalePolicy"]
 
 
 class AutoscaleShedPolicy:
@@ -87,6 +87,11 @@ class AutoscaleShedPolicy:
 
         self.window_s = self.min_window_s
         self.shed_active = False
+        # ISSUE 17: a fleet controller that can still ADD REPLICAS revokes
+        # this permission — shedding is the last resort, latched only once
+        # the fleet is at max_replicas.  Single-replica deployments keep
+        # the PR 11 behavior (always allowed).
+        self.shed_allowed = True
         self._above = 0
         self._below = 0
         self.decisions: List[Dict[str, Any]] = []
@@ -114,7 +119,7 @@ class AutoscaleShedPolicy:
                 self.window_s = min(self.window_s * self.widen_factor,
                                     self.max_window_s)
                 out.append(self._decide("widen", depth_frac))
-            if not self.shed_active:
+            if not self.shed_active and self.shed_allowed:
                 self.shed_active = True
                 out.append(self._decide("shed_on", depth_frac))
         elif self._below >= self.patience:
@@ -143,8 +148,144 @@ class AutoscaleShedPolicy:
             1.0 if self.shed_active else 0.0)
         return rec
 
+    def allow_shed(self, allowed: bool) -> List[Dict[str, Any]]:
+        """Grant or revoke the shed permission (ISSUE 17: the fleet
+        controller grants it only at max replicas).  Revoking while shed
+        is latched releases it immediately — a replica must not keep
+        dropping its lowest class when the fleet has capacity to add."""
+        self.shed_allowed = bool(allowed)
+        if not self.shed_allowed and self.shed_active:
+            self.shed_active = False
+            return [self._decide("shed_off", 0.0)]
+        return []
+
     def state(self) -> Dict[str, Any]:
         return {"window_s": self.window_s, "shed_active": self.shed_active,
+                "shed_allowed": self.shed_allowed,
+                "decisions": len(self.decisions)}
+
+
+class FleetScalePolicy:
+    """Hysteresis state machine over FLEET load: queue-depth fraction and
+    windowed p99 latency (scraped from every replica's metrics registry)
+    in, replica-count targets out (ISSUE 17).
+
+    Same contract as `AutoscaleShedPolicy` — pure, clock-free, pinnable:
+
+    * **Pressure** is mean queue-depth fraction above ``high_watermark``
+      OR windowed p99 above ``slo_p99_s``; **slack** is depth below
+      ``low_watermark`` AND p99 back under the SLO.  Anything in between
+      is the deadband and resets both streak counters (the no-flap
+      guarantee, pinned in tests/test_prodsim.py).
+    * ``patience`` consecutive pressure samples raise ``target`` by one
+      replica (capped at ``max_replicas``); ``scale_down_patience``
+      consecutive slack samples lower it (floored at ``min_replicas``).
+      Scale-down defaults to 2x the scale-up patience: capacity is
+      cheap to keep for a few seconds and expensive to miss.
+    * **Shed is the last resort**: only when ``target`` is pinned at
+      ``max_replicas`` and pressure persists does the controller latch
+      ``shed_on`` — the `FleetController` then grants the per-replica
+      `AutoscaleShedPolicy` its shed permission.  On slack the shed
+      latch releases BEFORE any replica is retired.
+
+    Decision records carry the acting sample's evidence (depth fraction,
+    p99, target) and land in ``lgbm_policy_decisions_total{action}``
+    like every other policy decision.
+    """
+
+    def __init__(self,
+                 min_replicas: int = 1,
+                 max_replicas: int = 4,
+                 slo_p99_s: float = 0.5,
+                 high_watermark: float = 0.5,
+                 low_watermark: float = 0.15,
+                 patience: int = 3,
+                 scale_down_patience: Optional[int] = None,
+                 interval_s: float = 0.5):
+        if not (1 <= int(min_replicas) <= int(max_replicas)):
+            raise ValueError("need 1 <= min_replicas <= max_replicas, got"
+                             " %r / %r" % (min_replicas, max_replicas))
+        if not (0.0 <= low_watermark < high_watermark <= 1.0):
+            raise ValueError("need 0 <= low_watermark < high_watermark <= 1,"
+                             " got %r / %r" % (low_watermark, high_watermark))
+        if slo_p99_s <= 0.0:
+            raise ValueError("slo_p99_s must be > 0, got %r" % (slo_p99_s,))
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.slo_p99_s = float(slo_p99_s)
+        self.high_watermark = float(high_watermark)
+        self.low_watermark = float(low_watermark)
+        self.patience = max(int(patience), 1)
+        self.scale_down_patience = (self.patience * 2
+                                    if scale_down_patience is None
+                                    else max(int(scale_down_patience), 1))
+        self.interval_s = float(interval_s)
+
+        self.target = self.min_replicas
+        self.shed_latched = False
+        self._above = 0
+        self._below = 0
+        self.decisions: List[Dict[str, Any]] = []
+
+    # -- the state machine ---------------------------------------------------
+    def observe(self, depth_frac: float,
+                p99_s: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Feed one fleet sample (mean replica queue-depth fraction and
+        the windowed p99 across replicas; None p99 = no completions in
+        the window, judged on depth alone).  Returns the decision
+        records this sample triggered ([] for hold)."""
+        depth_frac = float(depth_frac)
+        slo_breach = p99_s is not None and float(p99_s) > self.slo_p99_s
+        pressure = depth_frac > self.high_watermark or slo_breach
+        slack = depth_frac < self.low_watermark and not slo_breach
+        out: List[Dict[str, Any]] = []
+        if pressure:
+            self._above += 1
+            self._below = 0
+        elif slack:
+            self._below += 1
+            self._above = 0
+        else:
+            self._above = 0
+            self._below = 0
+            return out
+        if self._above >= self.patience:
+            self._above = 0
+            if self.target < self.max_replicas:
+                self.target += 1
+                out.append(self._decide("scale_up", depth_frac, p99_s))
+            elif not self.shed_latched:
+                # at max replicas with pressure still rising: the ONLY
+                # remaining lever is admission — latch fleet-wide shed
+                self.shed_latched = True
+                out.append(self._decide("shed_on", depth_frac, p99_s))
+        elif self._below >= self.scale_down_patience:
+            self._below = 0
+            if self.shed_latched:
+                # give admission back before retiring any capacity
+                self.shed_latched = False
+                out.append(self._decide("shed_off", depth_frac, p99_s))
+            elif self.target > self.min_replicas:
+                self.target -= 1
+                out.append(self._decide("scale_down", depth_frac, p99_s))
+        return out
+
+    def _decide(self, action: str, depth_frac: float,
+                p99_s: Optional[float]) -> Dict[str, Any]:
+        rec = {"event": "fleet_decision", "action": action,
+               "target": self.target, "shed_latched": self.shed_latched,
+               "depth_frac": round(float(depth_frac), 4),
+               "p99_s": None if p99_s is None else round(float(p99_s), 6),
+               "wallclock": wallclock()}
+        self.decisions.append(rec)
+        telemetry.counter("lgbm_policy_decisions_total").inc(action=action)
+        return rec
+
+    def state(self) -> Dict[str, Any]:
+        return {"target": self.target, "shed_latched": self.shed_latched,
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas,
+                "slo_p99_s": self.slo_p99_s,
                 "decisions": len(self.decisions)}
 
 

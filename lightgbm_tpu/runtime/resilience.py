@@ -73,7 +73,8 @@ __all__ = [
     "fault_arg", "fault_active", "maybe_die_or_preempt",
     "maybe_probe_hang_seconds", "maybe_corrupt_snapshot",
     "maybe_inject_nan", "maybe_slow_stage", "maybe_torn_publish",
-    "maybe_die_at_publish", "maybe_fail_predict", "DevicePredictFault",
+    "maybe_die_at_publish", "maybe_die_at_spawn", "maybe_fail_predict",
+    "DevicePredictFault",
     "maybe_poison_rows", "maybe_flip_labels", "maybe_regress_model",
     "snapshot_model_text", "FAULT_TABLE", "FAULT_NAMES",
 ]
@@ -149,6 +150,11 @@ FAULT_TABLE: Dict[str, Dict[str, str]] = {
         "injects_at": "continuous trainer's publish seam, AFTER the "
                       "eval gate (maybe_regress_model on cycle K's "
                       "model text)"},
+    "die_at_spawn": {
+        "arg": "K",
+        "injects_at": "ServingRuntime.start, after the prewarm pass and "
+                      "BEFORE /healthz readiness (maybe_die_at_spawn on "
+                      "the K-th fleet spawn ordinal)"},
 }
 
 FAULT_NAMES = tuple(FAULT_TABLE)
@@ -326,6 +332,34 @@ def maybe_die_at_publish(publish_count: int) -> None:
     sys.stderr.write("[%s] FAULT die_at_publish: abrupt exit mid-publish "
                      "#%d (generation renamed, manifest stale)\n"
                      % (wallclock(), publish_count))
+    sys.stderr.flush()
+    os._exit(137)
+
+
+def maybe_die_at_spawn(spawn_ordinal: Optional[int] = None) -> None:
+    """`die_at_spawn:K` kills a serving replica AFTER its prewarm pass and
+    BEFORE /healthz flips ready (ISSUE 17) — the window where a fleet
+    controller has paid the spawn cost but admitted no traffic.  The
+    controller must detect the dead child and relaunch without ever
+    routing to it.
+
+    ``spawn_ordinal`` is the fleet-wide 1-based spawn sequence number,
+    normally delivered by the spawner through ``LGBM_TPU_SPAWN_ORDINAL``
+    (each replica is a fresh process, so a process-local counter could
+    never reach K > 1)."""
+    if not fault_active("die_at_spawn"):
+        return
+    if spawn_ordinal is None:
+        try:
+            spawn_ordinal = int(os.environ.get("LGBM_TPU_SPAWN_ORDINAL",
+                                               "1") or 1)
+        except ValueError:
+            spawn_ordinal = 1
+    if int(fault_arg("die_at_spawn", "1")) != int(spawn_ordinal):
+        return
+    sys.stderr.write("[%s] FAULT die_at_spawn: abrupt exit during spawn "
+                     "#%d (prewarmed, never ready)\n"
+                     % (wallclock(), spawn_ordinal))
     sys.stderr.flush()
     os._exit(137)
 

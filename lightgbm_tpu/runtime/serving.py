@@ -302,6 +302,7 @@ class ServingRuntime:
                  metrics_port: Optional[int] = None,
                  priority_levels: int = 3,
                  quotas: Optional[Dict[str, float]] = None,
+                 max_resident: int = 0,
                  policy=None,
                  canary_fraction: float = 0.0,
                  canary_policy=None,
@@ -323,7 +324,23 @@ class ServingRuntime:
         `runtime.policy.AutoscaleShedPolicy`: a background thread feeds
         it the queue-depth fraction; its decisions retune
         `batch_window_s` and flip load-shed mode for the lowest class
-        (rejection `load_shed`, retryable).
+        (rejection `load_shed`, retryable).  A ``"*"`` key in `quotas`
+        is the default per-tenant share for every model id without an
+        explicit entry — the knob that makes quota-fair admission
+        tractable across hundreds of registered tenants (ISSUE 17).
+
+        ISSUE 17 model-zoo residency: `max_resident` > 0 bounds how many
+        registered models hold a LOADED entry at once.  Admission for a
+        registered-but-paged-out tenant marks it *wanted*; its requests
+        answer with the retryable ``no_model`` rejection until the
+        poller pages it in, evicting the least-recently-used resident
+        model first.  A model with queued or in-flight requests is NEVER
+        evicted (pinned in tests); when every resident model is busy the
+        page-in defers to the next poll instead of overshooting the
+        bound.  Evicting exports the victim's per-tenant warm manifest
+        (best effort), so the next page-in — here or on any replica —
+        prewarms from the manifest instead of compiling cold.  The
+        default 0 keeps every registered model resident (legacy).
 
         ISSUE 12 canary knobs: `canary_fraction` > 0 turns newly
         published generations into CANARIES — the poller loads them
@@ -428,6 +445,16 @@ class ServingRuntime:
         self._queued_by_model: "collections.Counter[str]" = \
             collections.Counter()
         self._shed_low = False
+        # ISSUE 17 bounded model-zoo residency (0 = unbounded/legacy):
+        # LRU stamps per tenant (touched at admission), demand marks for
+        # paged-out tenants, and in-flight counts (the never-evict pin's
+        # second leg — queued is the first)
+        self.max_resident = max(int(max_resident or 0), 0)
+        self._lru: Dict[str, float] = {}
+        self._wanted: Dict[str, float] = {}
+        self._inflight_by_model: "collections.Counter[str]" = \
+            collections.Counter()
+        self.residency_events: List[Dict[str, Any]] = []
 
         # serving stage trail: PR 4 watchdog in thread mode with a
         # bounded flight recorder (one stage per batch — unbounded
@@ -503,13 +530,20 @@ class ServingRuntime:
             os.environ.setdefault("JAX_PLATFORMS", backend)
         if self._static is not None:
             self._swap_in("default", self._static, generation=0, meta={})
-        for mid in self._dirs:
+        # default first: under bounded residency the lineage model must
+        # win a residency slot before any zoo tenant claims one
+        for mid in sorted(self._dirs, key=lambda m: (m != "default", m)):
             self._poll_model(mid)       # best effort; poller keeps trying
         # prewarm-before-admit (ISSUE 15): precompile the shape buckets
         # the lineage's manifest names BEFORE readiness opens.  Bounded
         # and guarded — a bad manifest degrades to the smallest-bucket
         # prewarm _swap_in already did, never blocks serving.
         self._prewarm_start()
+        # fleet fault seam (ISSUE 17): `die_at_spawn:K` kills the K-th
+        # spawned replica exactly here — prewarm paid, /healthz never
+        # ready — so a FleetController's relaunch path is exercised on
+        # the most expensive death window
+        resilience.maybe_die_at_spawn()
         self._ready.set()
         self._executor = self._spawn_executor()
         self._batcher = threading.Thread(target=self._batcher_loop,
@@ -596,10 +630,37 @@ class ServingRuntime:
                             "compile" if prewarm_compiles else "hit",
                             max(prewarm_compiles, 1))
         with self._entries_lock:
+            fresh = model_id not in self._entries
             self._entries[model_id] = entry
+            resident = len(self._entries)
         with self._stats_lock:
             self._stats["swaps"] += 1
         telemetry.counter("lgbm_serve_swaps_total").inc()
+        telemetry.gauge("lgbm_serve_resident_models").set(resident)
+        if self.max_resident > 0 and fresh:
+            # a zoo tenant just paged in: clear its demand mark, stamp
+            # its LRU slot, and prewarm from its per-tenant manifest so
+            # the first live request doesn't pay the bucket compiles
+            self._wanted.pop(model_id, None)
+            self._lru.setdefault(model_id, time.monotonic())
+            telemetry.counter("lgbm_serve_residency_events_total").inc(
+                event="page_in")
+            self.residency_events.append({
+                "event": "page_in", "model": model_id,
+                "generation": generation, "resident": resident,
+                "wallclock": resilience.wallclock()})
+            if self.prewarm_manifest and self._ready.is_set():
+                pub_dir = self._dirs.get(model_id)
+                try:
+                    sec, _ = (warmup.read_manifest(pub_dir, "serving")
+                              if pub_dir else (None, "static"))
+                    if sec is not None and warmup.classify_serving_section(
+                            sec, num_features=entry.num_features,
+                            newest_generation=generation) == "ok":
+                        self._prewarm_buckets(entry, sec["row_buckets"])
+                except Exception as e:  # noqa: BLE001 — never block page-in
+                    self.log.warning("serve: page-in prewarm of %s failed:"
+                                     " %s", model_id, e)
         # sink end of the publish→subscriber flow arrow (ISSUE 14): the
         # flow id re-derives from the SAME meta fields the publisher
         # used, so the merged timeline links this swap back to the
@@ -622,6 +683,8 @@ class ServingRuntime:
         sub = self._subs.get(model_id)
         if sub is None:
             return
+        if not self._residency_admit(model_id):
+            return
         rec = sub.resolve_once()
         if rec is None:
             return
@@ -639,6 +702,75 @@ class ServingRuntime:
             return
         self._canary_in(model_id, rec)
 
+    # -- bounded model-zoo residency (ISSUE 17) ------------------------------
+    def _residency_admit(self, model_id: str) -> bool:
+        """Gate a (re)load of `model_id` against the residency bound.
+        Resident models always pass (generation swaps replace in place,
+        no net growth).  A paged-out tenant passes only when it is
+        WANTED (a request touched it since the last poll — the default
+        lineage model is always wanted) AND a slot is free or an idle
+        LRU victim can give one up."""
+        if self.max_resident <= 0:
+            return True
+        with self._entries_lock:
+            if model_id in self._entries:
+                return True
+            room = len(self._entries) < self.max_resident
+        if model_id != "default" and model_id not in self._wanted:
+            return False
+        if room:
+            return True
+        return self._evict_lru(model_id)
+
+    def _evict_lru(self, incoming: str) -> bool:
+        """Evict the least-recently-used resident model to make room for
+        `incoming`.  The never-evict invariant: a model with queued OR
+        in-flight requests is not a candidate — its clients have been
+        admitted and must complete on a loaded entry.  When every
+        resident model is busy, the page-in DEFERS (returns False)
+        rather than overshooting the bound; the poller retries next
+        cycle.  The victim's per-tenant warm manifest exports first
+        (best effort) so its next page-in starts warm."""
+        with self._cond:
+            busy = {m for m, n in self._queued_by_model.items() if n > 0}
+            busy |= {m for m, n in self._inflight_by_model.items()
+                     if n > 0}
+        with self._entries_lock:
+            candidates = [m for m in self._entries
+                          if m != incoming and m not in busy]
+        if not candidates:
+            telemetry.counter("lgbm_serve_residency_events_total").inc(
+                event="defer")
+            self.residency_events.append({
+                "event": "defer", "model": incoming,
+                "wallclock": resilience.wallclock()})
+            return False
+        victim = min(candidates, key=lambda m: self._lru.get(m, 0.0))
+        if self.export_manifest:
+            try:
+                self.export_warmup_manifest(victim)
+            except Exception as e:          # noqa: BLE001 — best effort
+                self.log.warning("serve: eviction manifest export for %s "
+                                 "failed: %s", victim, e)
+        with self._entries_lock:
+            self._entries.pop(victim, None)
+            resident = len(self._entries)
+        self._canary.pop(victim, None)
+        self._lru.pop(victim, None)
+        telemetry.counter("lgbm_serve_residency_events_total").inc(
+            event="evict")
+        telemetry.gauge("lgbm_serve_resident_models").set(resident)
+        event = {"event": "evict", "model": victim, "for": incoming,
+                 "resident": resident,
+                 "wallclock": resilience.wallclock()}
+        self.residency_events.append(event)
+        with self._wd_lock:
+            self.wd.annotate("residency_evict", event)
+        self.log.info("serve: evicted %s (LRU) to page in %s (%d/%d "
+                      "resident)", victim, incoming, resident,
+                      self.max_resident)
+        return True
+
     # -- warm start (ISSUE 15): manifest prewarm + manifest export ----------
     def _prewarm_start(self) -> None:
         """Read each publish dir's ``warmup.json`` and precompile the
@@ -650,6 +782,10 @@ class ServingRuntime:
         if not self.prewarm_manifest:
             return
         for mid, pub_dir in self._dirs.items():
+            if self.max_resident > 0 and mid not in self._entries:
+                # paged-out zoo tenant: its page-in prewarms from its
+                # own per-tenant manifest when demand arrives
+                continue
             t0 = time.monotonic()
             entry = self._entries.get(mid)
             outcome, buckets = "legacy", []
@@ -886,6 +1022,25 @@ class ServingRuntime:
                     rec["action"], rec["window_s"], rec["shed_active"],
                     rec["depth_frac"] * 100)
 
+    def set_shed_allowed(self, allowed: bool) -> None:
+        """Grant/revoke the autoscale policy's shed permission (ISSUE 17:
+        a fleet controller grants it only once the fleet is at max
+        replicas — shedding is the LAST resort, after scale-up).  A
+        revoke while shed is latched releases it immediately under the
+        admission lock.  No-op without a policy."""
+        pol = self.policy
+        if pol is None or not hasattr(pol, "allow_shed"):
+            return
+        decisions = pol.allow_shed(allowed)
+        with self._cond:
+            self._shed_low = bool(pol.shed_active)
+        for rec in decisions:
+            with self._wd_lock:
+                self.wd.annotate("policy_decision", rec)
+            self.log.warning("serve: fleet %s shed permission (shed=%s)",
+                             "granted" if allowed else "revoked",
+                             pol.shed_active)
+
     def generation(self, model_id: str = "default") -> Optional[int]:
         entry = self._entries.get(model_id)
         return entry.generation if entry is not None else None
@@ -988,7 +1143,11 @@ class ServingRuntime:
                     "load_shed", retryable=True, priority=prio,
                     queue_depth=len(self._queue), retry_after_s=0.1,
                     detail="policy shed mode active for the lowest class")
-            quota = self.quotas.get(model_id)
+            # per-tenant quota, with "*" as the default share for every
+            # registered tenant without an explicit entry (ISSUE 17:
+            # quota-fair admission across hundreds of tenants without
+            # hundreds of config lines)
+            quota = self.quotas.get(model_id, self.quotas.get("*"))
             if quota is not None and self._queued_by_model[model_id] >= \
                     max(int(quota * self.max_queue), 1):
                 self._count_rejection("quota_exceeded", priority=prio)
@@ -1010,6 +1169,16 @@ class ServingRuntime:
             self._queue.append(req)
             self._queued_by_model[model_id] += 1
             depth = len(self._queue)
+            if self.max_resident > 0:
+                # residency bookkeeping (ISSUE 17): every admission
+                # touches the tenant's LRU stamp; a registered-but-
+                # paged-out tenant is marked wanted so the poller pages
+                # it in (this request retries through retryable
+                # no_model rejections until the entry lands)
+                self._lru[model_id] = req.enqueued
+                if model_id in self._dirs \
+                        and model_id not in self._entries:
+                    self._wanted[model_id] = req.enqueued
             self._cond.notify()
         with self._stats_lock:
             self._stats["admitted"] += 1
@@ -1144,6 +1313,12 @@ class ServingRuntime:
             batch = self._next_batch()
             if batch is None:
                 return
+            mid = batch[0].model_id
+            # in-flight mark (ISSUE 17): between batch pop and response
+            # drain the model is pinned against LRU eviction exactly
+            # like a queued request would pin it
+            with self._cond:
+                self._inflight_by_model[mid] += len(batch)
             try:
                 self._serve_batch(batch)
             except BaseException as e:       # noqa: BLE001 — must not die
@@ -1152,6 +1327,9 @@ class ServingRuntime:
                         req.error = e
                         req.done.set()
                 self.log.warning("serve: batch failed terminally: %s", e)
+            finally:
+                with self._cond:
+                    self._inflight_by_model[mid] -= len(batch)
 
     def _serve_batch(self, batch: List[_Request]) -> None:
         model_id = batch[0].model_id
@@ -1392,6 +1570,18 @@ class ServingRuntime:
                                 decisions_tail=self.policy.decisions[-16:])
         st["generations"] = {mid: e.generation
                              for mid, e in self._entries.items()}
+        if self.max_resident > 0:
+            st["residency"] = {
+                "max_resident": self.max_resident,
+                "resident": len(self._entries),
+                "registered": len(self._dirs),
+                "wanted": sorted(self._wanted),
+                "events_tail": self.residency_events[-16:],
+                "page_ins": sum(1 for e in self.residency_events
+                                if e["event"] == "page_in"),
+                "evictions": sum(1 for e in self.residency_events
+                                 if e["event"] == "evict"),
+            }
         if self.canary_fraction > 0:
             st["canary_fraction"] = self.canary_fraction
             st["canary_generations"] = {mid: e.generation

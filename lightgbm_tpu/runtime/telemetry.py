@@ -283,6 +283,32 @@ METRIC_TABLE: Dict[str, Dict[str, Any]] = {
                 "(compile loaded from disk)/miss (fresh compile wrote an "
                 "entry)/evict (LRU sweep past the size budget) "
                 "(runtime/warmup.py seam over jax_compilation_cache_dir)"},
+    "lgbm_fleet_replicas": {
+        "type": "gauge", "labels": ("state",),
+        "help": "Serving replica processes as the fleet controller sees "
+                "them, state=target/alive/ready (runtime/fleet.py "
+                "control loop)"},
+    "lgbm_fleet_scale_events_total": {
+        "type": "counter", "labels": ("action",),
+        "help": "Fleet controller actions applied, action=spawn/retire/"
+                "relaunch/shed_on/shed_off (scale decisions come from "
+                "runtime/policy.FleetScalePolicy)"},
+    "lgbm_fleet_reaction_seconds": {
+        "type": "histogram", "labels": (),
+        "help": "Scale-up reaction time: first SLO-breach sample of a "
+                "pressure streak to the first scrape with windowed p99 "
+                "back under the SLO (the ISSUE 17 acceptance number)"},
+    "lgbm_serve_resident_models": {
+        "type": "gauge", "labels": (),
+        "help": "Model entries currently loaded in this serving runtime "
+                "(bounded by max_resident when the model-zoo residency "
+                "manager is on)"},
+    "lgbm_serve_residency_events_total": {
+        "type": "counter", "labels": ("event",),
+        "help": "Model-zoo residency transitions, event=page_in (tenant "
+                "loaded on demand)/evict (LRU victim dropped, manifest "
+                "exported)/defer (every resident model busy; page-in "
+                "retries next poll)"},
 }
 
 # ---------------------------------------------------------------------------

@@ -140,12 +140,19 @@ def pack_header(msg_type: int, model_id: str, n_rows: int, n_cols: int,
                         len(payload), zlib.crc32(payload) & 0xFFFFFFFF)
 
 
-def pack_request(X: np.ndarray, model_id: str = "default") -> bytes:
-    """One request frame from a [B, F] float32 matrix (cast if needed)."""
+def pack_request(X: np.ndarray, model_id: str = "default",
+                 priority: int = 0) -> bytes:
+    """One request frame from a [B, F] float32 matrix (cast if needed).
+
+    `priority` rides the header's flags byte (low nibble, 0 = highest):
+    the server feeds it to the same per-class admission reservations as
+    the JSON path (ISSUE 17 — the fleet loadgen's classed traffic uses
+    the binary plane).  The wire ABI is unchanged: flags was always in
+    the header, and 0 keeps the legacy highest-class behavior."""
     X = np.ascontiguousarray(np.atleast_2d(X), np.float32)
     payload = X.tobytes()
     return pack_header(MSG_REQUEST, model_id, X.shape[0], X.shape[1],
-                       payload) + payload
+                       payload, flags=int(priority) & 0x0F) + payload
 
 
 def pack_response(values: np.ndarray, generation: int, model_id: str,
@@ -302,6 +309,82 @@ class _BucketBuffers:
         return buf
 
 
+class _ResponseScratch:
+    """Per-connection reusable RESPONSE buffer (ISSUE 17 perf fix).
+
+    `pack_response` built three fresh bytes objects per response on the
+    hot path (meta block, meta+values payload, header+payload frame) —
+    measurable allocator traffic at wire rates.  This scratch packs the
+    header and meta block INTO one preallocated bytearray with
+    `Struct.pack_into`, copies the float32 values right behind them, and
+    hands the socket a memoryview of the result: zero per-response
+    buffer allocations (asserted in tests/test_serving.py).  The buffer
+    grows by power-of-two bucket when a response outgrows it (amortized,
+    never per-response); values that arrive as float64 (the legacy
+    response surface) cast into a reusable per-bucket float32 arena.
+    Model-id padding is memoized per id.
+
+    Single-threaded by construction: one scratch per connection handler,
+    one frame in flight per socket."""
+
+    __slots__ = ("_buf", "_mids", "_f32")
+
+    def __init__(self):
+        self._buf = bytearray(HEADER_SIZE + RESP_META_SIZE + (1 << 12))
+        self._mids: Dict[str, bytes] = {}
+        self._f32: Dict[int, np.ndarray] = {}
+
+    def _model(self, model_id: str) -> bytes:
+        mid = self._mids.get(model_id)
+        if mid is None:
+            if len(self._mids) > 256:      # hostile id churn: stay bounded
+                self._mids.clear()
+            mid = self._mids[model_id] = _pad_model_id(model_id)
+        return mid
+
+    def _as_f32(self, values: np.ndarray) -> np.ndarray:
+        """`values` as a C-contiguous float32 matrix — returned AS IS
+        when it already is one (the response_dtype="float32" runtime),
+        else cast into a reusable per-bucket conversion arena."""
+        if values.dtype == np.float32 and values.flags["C_CONTIGUOUS"]:
+            return values
+        n = int(values.size)
+        bucket = max(1 << max(n - 1, 1).bit_length(), 1 << 8)
+        arena = self._f32.get(bucket)
+        if arena is None:
+            arena = self._f32[bucket] = np.empty(bucket, np.float32)
+        dst = arena[:n].reshape(values.shape)
+        np.copyto(dst, values, casting="same_kind")
+        return dst
+
+    def pack_response(self, values: np.ndarray, generation: int,
+                      model_id: str, served_by: str, latency_s: float,
+                      stages: Dict[str, float],
+                      compiled: bool) -> memoryview:
+        """Same frame bytes as module-level `pack_response` (parity is
+        test-pinned), valid until the next call on this scratch."""
+        vals = self._as_f32(np.atleast_2d(values))
+        nbytes = vals.size * 4
+        total = HEADER_SIZE + RESP_META_SIZE + nbytes
+        if len(self._buf) < total:
+            self._buf = bytearray(1 << max(total - 1, 1).bit_length())
+        buf = self._buf
+        _RESP_META.pack_into(
+            buf, HEADER_SIZE, int(generation), float(latency_s),
+            float(stages.get("queue_wait_s", 0.0)),
+            float(stages.get("batch_gather_s", 0.0)),
+            float(stages.get("device_s", 0.0)),
+            float(stages.get("drain_s", 0.0)),
+            1 if served_by == "device" else 0, 1 if compiled else 0)
+        mv = memoryview(buf)
+        mv[HEADER_SIZE + RESP_META_SIZE:total] = memoryview(vals).cast("B")
+        crc = zlib.crc32(mv[HEADER_SIZE:total]) & 0xFFFFFFFF
+        _HEADER.pack_into(buf, 0, MAGIC, VERSION, MSG_RESPONSE, DTYPE_F32,
+                          0, self._model(model_id), vals.shape[0],
+                          vals.shape[1], RESP_META_SIZE + nbytes, crc)
+        return mv[:total]
+
+
 # ---------------------------------------------------------------------------
 # servers
 # ---------------------------------------------------------------------------
@@ -318,6 +401,7 @@ class _WireHandler(socketserver.StreamRequestHandler):
         bytes_total = telemetry.counter("lgbm_serve_bytes_total")
         frames_total = telemetry.counter("lgbm_serve_frames_total")
         buffers = _BucketBuffers()
+        scratch = _ResponseScratch()
         from .serving import ServeRejected
         while True:
             try:
@@ -337,7 +421,7 @@ class _WireHandler(socketserver.StreamRequestHandler):
             if frame is None:
                 return                            # clean EOF
             hdr, payload = frame
-            (_m, _v, _t, _d, _f, model_raw, n_rows, n_cols, plen,
+            (_m, _v, _t, _d, flags, model_raw, n_rows, n_cols, plen,
              _crc) = hdr
             bytes_total.inc(HEADER_SIZE + plen, path=path, dir="rx")
             model_id = _unpad_model_id(model_raw)
@@ -347,17 +431,19 @@ class _WireHandler(socketserver.StreamRequestHandler):
                               count=n_rows * n_cols).reshape(n_rows,
                                                              n_cols)
             try:
-                rec = rt.submit_view(X, model_id=model_id).wait(
+                rec = rt.submit_view(X, model_id=model_id,
+                                     priority=flags & 0x0F).wait(
                     timeout=rt.default_deadline_s
                     + rt.predict_deadline_s + 10.0)
                 # response values are always [n_rows, n_outputs] on the
                 # wire (a squeezed 1-class vector reshapes, multiclass
-                # passes through)
+                # passes through); the frame packs into the connection's
+                # reusable scratch — zero per-response allocations
                 vals = np.asarray(rec.values)
-                out = pack_response(vals.reshape(n_rows, -1),
-                                    rec.generation, model_id,
-                                    rec.served_by, rec.latency_s,
-                                    rec.stages, rec.compiled)
+                out = scratch.pack_response(vals.reshape(n_rows, -1),
+                                            rec.generation, model_id,
+                                            rec.served_by, rec.latency_s,
+                                            rec.stages, rec.compiled)
                 frames_total.inc(outcome="completed")
             except ServeRejected as e:
                 out = pack_reject(e.reason, retryable=e.retryable,
@@ -373,7 +459,7 @@ class _WireHandler(socketserver.StreamRequestHandler):
             if not self._send(out, bytes_total, path):
                 return
 
-    def _send(self, out: bytes, bytes_total, path: str) -> bool:
+    def _send(self, out, bytes_total, path: str) -> bool:
         try:
             self.wfile.write(out)
             self.wfile.flush()
@@ -467,11 +553,11 @@ class WireClient:
     def __exit__(self, *exc) -> None:
         self.close()
 
-    def request_once(self, X: np.ndarray,
-                     model_id: str = "default") -> Dict[str, Any]:
+    def request_once(self, X: np.ndarray, model_id: str = "default",
+                     priority: int = 0) -> Dict[str, Any]:
         """One round trip; returns the decoded response dict (values as
         a float32 view valid until the NEXT call on this client)."""
-        self._sock.sendall(pack_request(X, model_id))
+        self._sock.sendall(pack_request(X, model_id, priority=priority))
         frame = read_frame(self._rfile, self._buffers)
         if frame is None:
             raise WireFrameError("connection_closed")
@@ -479,10 +565,10 @@ class WireClient:
         return unpack_response(hdr, bytes(payload))
 
     def predict(self, X: np.ndarray, model_id: str = "default",
-                attempts: int = 3) -> Dict[str, Any]:
+                attempts: int = 3, priority: int = 0) -> Dict[str, Any]:
         last: Optional[Dict[str, Any]] = None
         for a in range(max(attempts, 1)):
-            out = self.request_once(X, model_id)
+            out = self.request_once(X, model_id, priority=priority)
             if "error" not in out:
                 return out
             last = out

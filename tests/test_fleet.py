@@ -1,0 +1,246 @@
+"""Elastic fleet throughput (ISSUE 17).
+
+Layers under test:
+
+* runtime/policy.py — `FleetScalePolicy`: the hysteresis state machine
+  over fleet load (no-flap deadband pin, the reaction-time bound at a
+  synthetic load step, shed-as-last-resort latch ordering);
+* runtime/serving.py — bounded model-zoo residency: the LRU
+  never-evicts-queued invariant the prod sim's zero-mismatch claim
+  leans on;
+* runtime/fleet.py — the controller/replica/client trio end to end:
+  one replica spawned as a real subprocess, served through the binary
+  wire, byte-verified, drained gracefully;
+* runtime/resilience.py — `die_at_spawn`: the replica that prewarms
+  and dies BEFORE /healthz ever answers ready (the relaunch-path fault
+  the fleet prod-sim soak arms for every spawn).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+from lightgbm_tpu.runtime import publish
+from lightgbm_tpu.runtime.policy import FleetScalePolicy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "exp"))
+
+
+def _synth_model(seed=1):
+    from bench import synth_serving_model
+    return synth_serving_model(12, 15, 6, seed=seed).save_model_to_string()
+
+
+def _published(tmp_path, name="pub", seed=1):
+    d = str(tmp_path / name)
+    text = _synth_model(seed=seed)
+    publish.ModelPublisher(d).publish(text)
+    return d, text
+
+
+# ---------------------------------------------------------------------------
+# FleetScalePolicy: the hysteresis pins
+# ---------------------------------------------------------------------------
+
+def test_fleet_scale_policy_no_flap_in_deadband():
+    """The no-flap pin: samples alternating between pressure and the
+    deadband (or slack and the deadband) NEVER accumulate a streak —
+    the deadband resets both counters, so an oscillating signal cannot
+    flap the target."""
+    pol = FleetScalePolicy(min_replicas=1, max_replicas=4, slo_p99_s=0.3,
+                           high_watermark=0.5, low_watermark=0.2,
+                           patience=2, scale_down_patience=2)
+    for i in range(40):
+        assert pol.observe(0.6 if i % 2 == 0 else 0.35) == []
+    assert pol.target == 1 and pol.decisions == []
+    # climb to 3, then oscillate slack <-> deadband: no scale_down ever
+    for _ in range(2):
+        pol.observe(0.9)
+        pol.observe(0.9)
+    assert pol.target == 3
+    for i in range(40):
+        assert pol.observe(0.1 if i % 2 == 0 else 0.35) == []
+    assert pol.target == 3
+
+
+def test_fleet_scale_policy_reaction_bound_at_load_step():
+    """Synthetic load step: after arbitrarily long quiet, a sustained
+    breach must produce scale_up in EXACTLY `patience` samples — the
+    decision half of the prod-sim reaction gate (patience * interval
+    is the policy's contribution to load-step -> p99-under-SLO)."""
+    interval = 0.5
+    pol = FleetScalePolicy(min_replicas=1, max_replicas=4, slo_p99_s=0.3,
+                           high_watermark=0.25, low_watermark=0.15,
+                           patience=3, scale_down_patience=6,
+                           interval_s=interval)
+    for _ in range(50):
+        assert pol.observe(0.02, p99_s=0.01) == []
+    samples, decisions = 0, []
+    while not decisions:
+        decisions = pol.observe(0.9, p99_s=1.0)
+        samples += 1
+        assert samples <= 3, "scale_up must land within patience samples"
+    assert samples == 3
+    assert decisions[0]["action"] == "scale_up"
+    assert pol.target == 2
+    # a p99 breach alone (depth fine) is pressure too: SLO-driven
+    pol2 = FleetScalePolicy(min_replicas=1, max_replicas=2,
+                            slo_p99_s=0.3, high_watermark=0.5,
+                            low_watermark=0.1, patience=2,
+                            scale_down_patience=2, interval_s=interval)
+    assert pol2.observe(0.05, p99_s=0.9) == []
+    out = pol2.observe(0.05, p99_s=0.9)
+    assert out and out[0]["action"] == "scale_up"
+    # the policy-side reaction bound backing the <=15s artifact gate
+    assert 3 * interval <= 15.0
+
+
+def test_fleet_scale_policy_shed_last_resort_latch_order():
+    """Shed latches ONLY once the target is pinned at max_replicas and
+    pressure persists; on recovery the grant is returned BEFORE any
+    capacity is retired."""
+    pol = FleetScalePolicy(min_replicas=1, max_replicas=2, slo_p99_s=0.3,
+                           high_watermark=0.5, low_watermark=0.2,
+                           patience=1, scale_down_patience=1)
+    up = pol.observe(0.9)
+    assert [d["action"] for d in up] == ["scale_up"] and pol.target == 2
+    shed = pol.observe(0.9)
+    assert [d["action"] for d in shed] == ["shed_on"]
+    assert pol.shed_latched and shed[0]["target"] == pol.max_replicas
+    # pressure at max with shed already latched: hold, never re-latch
+    assert pol.observe(0.9) == []
+    first = pol.observe(0.05)
+    assert [d["action"] for d in first] == ["shed_off"]
+    assert not pol.shed_latched and pol.target == 2
+    second = pol.observe(0.05)
+    assert [d["action"] for d in second] == ["scale_down"]
+    assert pol.target == 1
+
+
+# ---------------------------------------------------------------------------
+# bounded model-zoo residency: the never-evict pin
+# ---------------------------------------------------------------------------
+
+def test_lru_never_evicts_model_with_queued_requests(tmp_path):
+    """The LRU candidate set excludes any model with queued or in-flight
+    requests — admitted clients must complete on a loaded entry.  With
+    every resident model busy the page-in defers instead of evicting."""
+    from lightgbm_tpu.runtime.serving import ServingRuntime
+    d1, _ = _published(tmp_path, "m1", seed=1)
+    d2, _ = _published(tmp_path, "m2", seed=2)
+    with ServingRuntime(models={"m1": d1, "m2": d2}, max_resident=2,
+                        poll_interval_s=0.05) as rt:
+        # demand-mark both tenants (admission would do this on first
+        # touch) so the poller pages them in
+        rt._wanted["m1"] = time.monotonic()         # noqa: SLF001
+        rt._wanted["m2"] = time.monotonic()         # noqa: SLF001
+        deadline = time.monotonic() + 20
+        while set(rt._entries) != {"m1", "m2"}:    # noqa: SLF001 — pin
+            assert time.monotonic() < deadline, "models never loaded"
+            time.sleep(0.05)
+        # m1 is the stale LRU slot AND has a queued request: the evict
+        # for an incoming tenant must skip it and take idle m2
+        with rt._cond:                              # noqa: SLF001
+            rt._queued_by_model["m1"] += 1          # noqa: SLF001
+        rt._lru["m1"] = 0.0                         # noqa: SLF001
+        rt._lru["m2"] = time.monotonic()            # noqa: SLF001
+        assert rt._evict_lru("m3") is True          # noqa: SLF001
+        assert "m1" in rt._entries                  # noqa: SLF001
+        assert "m2" not in rt._entries              # noqa: SLF001
+        # only busy models left: the page-in DEFERS, nothing evicted
+        assert rt._evict_lru("m2") is False         # noqa: SLF001
+        assert "m1" in rt._entries                  # noqa: SLF001
+        events = [e["event"] for e in rt.residency_events]
+        assert "defer" in events and events.count("evict") == 1
+        with rt._cond:                              # noqa: SLF001
+            rt._queued_by_model["m1"] -= 1          # noqa: SLF001
+
+
+# ---------------------------------------------------------------------------
+# die_at_spawn: dies during prewarm, BEFORE /healthz ever answers ready
+# ---------------------------------------------------------------------------
+
+def test_die_at_spawn_fault_exits_before_ready(tmp_path):
+    """`die_at_spawn:1` with spawn ordinal 1: the replica process runs
+    its prewarm and exits 137 WITHOUT ever publishing its endpoint —
+    the never-ready corpse the fleet controller's relaunch path is
+    measured against in the prod-sim soak."""
+    d, _ = _published(tmp_path)
+    spec_path = str(tmp_path / "replica.json")
+    ep_path = str(tmp_path / "replica.endpoint.json")
+    with open(spec_path, "w") as fh:
+        json.dump({"models": {"default": d}, "shed_policy": False,
+                   "batch_window_s": 0.001}, fh)
+    env = dict(os.environ)
+    env.update({"LGBM_TPU_FAULT": "die_at_spawn:1",
+                "LGBM_TPU_SPAWN_ORDINAL": "1",
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + os.pathsep
+                + env.get("PYTHONPATH", "")})
+    p = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.runtime.fleet",
+         "--replica", spec_path, "--endpoint", ep_path],
+        env=env, timeout=180, capture_output=True)
+    assert p.returncode == 137, p.stderr.decode()[-500:]
+    assert not os.path.exists(ep_path), \
+        "replica published its endpoint despite dying at spawn"
+
+
+# ---------------------------------------------------------------------------
+# the fleet smoke: controller + wire client round trip, graceful drain
+# ---------------------------------------------------------------------------
+
+def test_fleet_controller_round_trip_and_graceful_stop(tmp_path):
+    """One replica under the controller: spawned, healthz-gated ready,
+    served through `FleetClient` with byte-verified float32 values,
+    then drained gracefully — the report carries the spawn/ready events
+    and the replica-seconds the efficiency metric divides by."""
+    from lightgbm_tpu.basic import Booster
+    from lightgbm_tpu.runtime.fleet import FleetClient, FleetController
+    d, text = _published(tmp_path)
+    spec = {"models": {"default": d}, "response_dtype": "float32",
+            "max_queue": 64, "batch_window_s": 0.002,
+            "shed_policy": False}
+    pol = FleetScalePolicy(min_replicas=1, max_replicas=1,
+                           slo_p99_s=5.0, high_watermark=0.95,
+                           low_watermark=0.0, patience=10 ** 6,
+                           scale_down_patience=10 ** 6, interval_s=0.2)
+    ctl = FleetController(str(tmp_path / "fleet"), spec, policy=pol,
+                          interval_s=0.2)
+    cli = None
+    try:
+        ctl.start()
+        assert ctl.wait_ready(1, timeout=120) == 1
+        # f32-exact probe: the client's wire cast is lossless, so the
+        # offline f64 references narrow to the served bytes exactly
+        probe = np.random.default_rng(7).standard_normal(
+            (16, 6)).astype(np.float32).astype(np.float64)
+        bst = Booster(model_str=text)
+        ref = {"device": bst.predict(probe, device=True)
+               .astype(np.float32),
+               "host": bst.predict(probe, device=False)
+               .astype(np.float32)}
+        cli = FleetClient(ctl, workers=2, predict_deadline_s=10,
+                          request_timeout_s=20)
+        futs = [(cli.submit(probe[i:i + 2]), i) for i in range(0, 16, 2)]
+        for fut, i in futs:
+            rec = fut.wait(timeout=30)
+            assert rec.generation == 1
+            assert rec.served_by in ("device", "host")
+            assert np.array_equal(rec.values, ref[rec.served_by][i:i + 2])
+    finally:
+        if cli is not None:
+            cli.close()
+        rep = ctl.stop()
+    assert rep["replica_seconds"] > 0
+    actions = [e["action"] for e in rep["events"]]
+    assert "spawn" in actions and "ready" in actions
+    assert rep["relaunches"] == 0 and rep["scale_ups"] == 0
+    ready_evt = next(e for e in rep["events"] if e["action"] == "ready")
+    assert ready_evt["spawn_to_ready_s"] > 0
+    assert all(h.proc.poll() is not None for h in ctl.retired)
+    assert not ctl.replicas

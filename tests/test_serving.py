@@ -684,3 +684,99 @@ def test_submit_view_serves_f32_without_conversion(tmp_path):
         base2 = g2.base if g2.base is not None else g2
         assert base1 is base2
         assert g1.dtype == np.float32
+
+
+def test_wire_response_scratch_parity_and_zero_allocation():
+    """The ISSUE 17 response-path perf fix: `_ResponseScratch` must emit
+    byte-identical frames to module-level `pack_response` (f32 fast
+    path, f64 legacy cast, growth, reuse-after-growth) while never
+    allocating per response — the SAME bytearray backs every same-bucket
+    frame and f64 values cast into a reused per-bucket arena."""
+    from lightgbm_tpu.runtime import wire
+    rng = np.random.default_rng(21)
+    scratch = wire._ResponseScratch()
+    stages = {"queue_wait_s": 0.001, "batch_gather_s": 0.0002,
+              "device_s": 0.003, "drain_s": 0.0001}
+    cases = [
+        # (values, generation, model_id, served_by, compiled)
+        (rng.standard_normal((4, 1)).astype(np.float32), 3, "default",
+         "device", True),                       # f32 fast path (no cast)
+        (rng.standard_normal((4, 1)), 3, "default", "device", True),
+        (rng.standard_normal((7, 3)), 12, "tenant-042", "host", False),
+        (rng.standard_normal(5), 1, "default", "device", False),  # 1-D
+        (rng.standard_normal((700, 4)), 2, "big", "device", True),  # grow
+        (rng.standard_normal((2, 2)), 9, "default", "host", True),  # after
+    ]
+    for vals, gen, mid, by, compiled in cases:
+        want = wire.pack_response(vals, gen, mid, by, 0.0125, stages,
+                                  compiled)
+        got = bytes(scratch.pack_response(vals, gen, mid, by, 0.0125,
+                                          stages, compiled))
+        assert got == want, (vals.shape, vals.dtype)
+
+    # zero per-response allocations, leg 1: once sized, the SAME
+    # bytearray backs every same-bucket response (no growth => no alloc)
+    buf = scratch._buf
+    small = rng.standard_normal((8, 2))
+    for _ in range(200):
+        scratch.pack_response(small, 5, "default", "device", 0.001,
+                              stages, True)
+        assert scratch._buf is buf
+    # leg 2: f64 values cast into a REUSED per-bucket float32 arena
+    arenas = dict(scratch._f32)
+    for _ in range(50):
+        scratch.pack_response(small, 5, "default", "device", 0.001,
+                              stages, True)
+    assert dict(scratch._f32) == arenas          # no new arenas...
+    for bucket, arr in scratch._f32.items():     # ...same objects
+        assert arenas[bucket] is arr
+    # leg 3: f32 C-contiguous values bypass the arena entirely
+    f32 = np.ascontiguousarray(small, np.float32)
+    out = scratch._as_f32(f32)
+    assert out is f32
+    # growth is power-of-two bucketed (amortized, never per response)
+    scratch.pack_response(rng.standard_normal((4096, 8)), 1, "default",
+                          "device", 0.0, stages, True)
+    grown = scratch._buf
+    assert grown is not buf and len(grown) & (len(grown) - 1) == 0
+    scratch.pack_response(rng.standard_normal((4096, 8)), 1, "default",
+                          "device", 0.0, stages, True)
+    assert scratch._buf is grown
+
+
+def test_wire_server_success_path_allocates_no_response_frames(
+        tmp_path, monkeypatch):
+    """The live-server pin behind the zero-allocation claim: with
+    module-level `pack_response` booby-trapped, every successful wire
+    response must still arrive — proving the handler serves success
+    frames solely from its per-connection scratch (rejects still use
+    `pack_reject`, which is off the per-response hot path)."""
+    from lightgbm_tpu.runtime import wire
+    text = _synth_model(seed=16)
+    probe = np.random.default_rng(11).standard_normal((6, 6)).astype(
+        np.float32)
+
+    def _boom(*a, **k):
+        raise AssertionError(
+            "module-level pack_response reached from the server success "
+            "path — the per-connection scratch must own it")
+    monkeypatch.setattr(wire, "pack_response", _boom)
+    with ServingRuntime(model_str=text, batch_window_s=0.0,
+                        response_dtype="float32") as rt:
+        ref = np.asarray(rt.predict(np.asarray(probe, np.float64),
+                                    ).values)
+        srv = wire.WireTCPServer(rt, port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            with wire.WireClient(("127.0.0.1", srv.port)) as c:
+                for _ in range(8):
+                    out = c.predict(probe)
+                    assert np.array_equal(
+                        out["values"].reshape(ref.shape), ref)
+                # and a reject frame still works with the trap armed
+                # (pack_reject is off the per-response hot path)
+                rej = c.request_once(probe, model_id="no-such-tenant")
+                assert rej.get("error") == "rejected"
+        finally:
+            srv.shutdown()
+            srv.server_close()
