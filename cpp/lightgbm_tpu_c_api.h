@@ -208,6 +208,8 @@ int LGBM_FastConfigFree(FastConfigHandle fast_config);
 #define LGBM_WIRE_MSG_REQUEST (1)
 #define LGBM_WIRE_MSG_RESPONSE (2)
 #define LGBM_WIRE_MSG_REJECT (3)
+#define LGBM_WIRE_MSG_SHM_SETUP (4)
+#define LGBM_WIRE_MSG_SHM_OK (5)
 #define LGBM_WIRE_DTYPE_F32 (0)
 #define LGBM_WIRE_HEADER_SIZE (40)
 
@@ -224,6 +226,56 @@ typedef struct LGBMWireFrameHeader {
   uint32_t payload_len; /* bytes following the header */
   uint32_t crc32;       /* zlib CRC32 of the payload */
 } LGBMWireFrameHeader;
+#pragma pack(pop)
+
+/* Shared-memory ring transport (ISSUE 20; runtime/shm_ring.py).
+ *
+ * A client on the UDS plane sends LGBM_WIRE_MSG_SHM_SETUP whose payload
+ * is the 40-byte segment header below, receives an SHM_OK ack, passes
+ * the segment fd plus two eventfd doorbells over the socket with
+ * SCM_RIGHTS, and after a second SHM_OK the segment's two SPSC rings
+ * carry ordinary wire frames with ZERO syscalls on the data path.
+ * Segment layout: header at 0 (padded to 64), request-ring control at
+ * req_ctrl, response-ring control at resp_ctrl (each 3 cache lines:
+ * tail u64 | head u64 @ +64 | waiter u32 @ +128, free-running
+ * counters, position = counter & (capacity-1)), ring data at
+ * req_offset/resp_offset.  A frame that cannot fit before the segment
+ * boundary is preceded by the 4-byte wrap marker LGBM_WIRE_RING_WRAP
+ * (or an implicit skip when fewer than 4 bytes remain); frames are
+ * always contiguous.  Capacities are powers of two.
+ *
+ * The field layout is pinned token-for-token against the Python
+ * RING_HEADER_FIELDS tuple by helper/check_wire_abi.py — edit both
+ * together or the lint fails the build.
+ *
+ * WIRE_RING_FIELDS: magic:4s version:B flags:B reserved:H seg_size:Q
+ *   req_ctrl:I req_offset:I req_capacity:I resp_ctrl:I resp_offset:I
+ *   resp_capacity:I
+ */
+#define LGBM_WIRE_RING_MAGIC "LGBR"
+#define LGBM_WIRE_RING_VERSION (1)
+#define LGBM_WIRE_RING_HEADER_SIZE (40)
+#define LGBM_WIRE_RING_CTRL_SIZE (192)
+#define LGBM_WIRE_RING_REQ_CTRL (64)
+#define LGBM_WIRE_RING_RESP_CTRL (256)
+#define LGBM_WIRE_RING_DATA (448)
+#define LGBM_WIRE_RING_WRAP (0xFFFFFFFFu)
+#define LGBM_WIRE_RING_DEFAULT_CAP (1u << 20)
+
+#pragma pack(push, 1)
+typedef struct LGBMWireRingHeader {
+  char magic[4];           /* "LGBR" */
+  uint8_t version;         /* LGBM_WIRE_RING_VERSION */
+  uint8_t flags;           /* reserved, 0 */
+  uint16_t reserved;       /* reserved, 0 */
+  uint64_t seg_size;       /* total segment bytes */
+  uint32_t req_ctrl;       /* request-ring control offset (64) */
+  uint32_t req_offset;     /* request-ring data offset (448) */
+  uint32_t req_capacity;   /* request-ring bytes, power of two */
+  uint32_t resp_ctrl;      /* response-ring control offset (256) */
+  uint32_t resp_offset;    /* response-ring data offset */
+  uint32_t resp_capacity;  /* response-ring bytes, power of two */
+} LGBMWireRingHeader;
 #pragma pack(pop)
 
 /* Sparse (CSR) prediction: indptr[nindptr] row offsets (int32 or int64 by

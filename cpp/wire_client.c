@@ -21,12 +21,27 @@
  *       once, then drive LGBM_BoosterPredictForMatSingleRowFast in a
  *       closed loop — the compiled-caller contract of the C API.
  *
+ *   wire_client shm SOCKPATH --probes F32FILE --ncols N [options]
+ *       Shared-memory ring transport (ISSUE 20): handshake over the
+ *       UDS plane (MSG_SHM_SETUP + SCM_RIGHTS fd pass), then a
+ *       pipelined request loop that writes frames straight into the
+ *       mapped request ring and reads responses off the response ring
+ *       with ZERO syscalls in the spin-hot steady state.  Same frame
+ *       format, CRC checks, and --expect byte-verification as the
+ *       socket modes; extra knobs --pipeline D (frames in flight),
+ *       --spin S (doorbell spin budget, seconds), --warmup W (seconds
+ *       excluded from the syscall-window counters), --req-cap /
+ *       --resp-cap (ring bytes, powers of two).
+ *
  * Emits one JSON line on stdout (exp/bench_wire.py parses it).
- * Plain C99; crc32 is computed locally (zlib polynomial) so the binary
- * links against nothing beyond pthread/dl/m.
+ * Plain C99 + GNU syscall numbers for memfd_create (shm_open
+ * fallback); crc32 is computed locally (zlib polynomial) so the
+ * binary links against nothing beyond pthread/dl/m/rt.
  */
-#define _POSIX_C_SOURCE 200809L
+#define _GNU_SOURCE
 #include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
 #include <pthread.h>
 #include <stdint.h>
 #include <stdio.h>
@@ -36,7 +51,11 @@
 #include <unistd.h>
 #include <dlfcn.h>
 #include <netdb.h>
+#include <sys/eventfd.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
 #include <sys/un.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -391,6 +410,444 @@ static int run_socket(int argc, char **argv, int is_uds) {
   return (errors > 0 || completed == 0 || mismatch > 0) ? 1 : 0;
 }
 
+/* ------------------------------------------------------------- shm mode */
+/* SPSC ring over a memfd segment shared with the server; layout and
+ * counter protocol mirror runtime/shm_ring.py._Ring exactly (pinned by
+ * the LGBMWireRingHeader ABI block in lightgbm_tpu_c_api.h).  Counters
+ * are free-running u64s, position = counter & (capacity-1); a frame
+ * that would straddle the segment boundary is preceded by the 4-byte
+ * LGBM_WIRE_RING_WRAP marker (implicit skip when < 4 bytes remain). */
+
+typedef struct {
+  uint8_t *data;
+  uint64_t cap, mask;
+  volatile uint64_t *tail, *head;
+  volatile uint32_t *waiter;
+} ring_t;
+
+static void ring_init(ring_t *r, uint8_t *seg, uint32_t ctrl, uint32_t off,
+                      uint32_t cap) {
+  r->data = seg + off;
+  r->cap = cap;
+  r->mask = (uint64_t)cap - 1;
+  r->tail = (volatile uint64_t *)(seg + ctrl);
+  r->head = (volatile uint64_t *)(seg + ctrl + 64);
+  r->waiter = (volatile uint32_t *)(seg + ctrl + 128);
+}
+
+/* producer: reserve `need` contiguous bytes; fills out_tail/out_pad
+ * and returns the frame's byte offset inside the ring data, or -1 when
+ * the ring is full (caller drains responses and retries). */
+static int64_t ring_reserve(ring_t *r, uint64_t need, uint64_t *out_tail,
+                            uint64_t *out_pad) {
+  uint64_t tail = __atomic_load_n(r->tail, __ATOMIC_SEQ_CST);
+  uint64_t head = __atomic_load_n(r->head, __ATOMIC_SEQ_CST);
+  uint64_t pos = tail & r->mask;
+  uint64_t room = r->cap - pos;
+  uint64_t pad = (room < need) ? room : 0;
+  if (need + pad > r->cap - (tail - head)) return -1;
+  *out_tail = tail;
+  *out_pad = pad;
+  return (int64_t)((tail + pad) & r->mask);
+}
+
+static void ring_publish(ring_t *r, uint64_t tail, uint64_t pad,
+                         uint64_t need) {
+  if (pad >= 4) {
+    uint32_t wrap = LGBM_WIRE_RING_WRAP;
+    memcpy(r->data + (tail & r->mask), &wrap, 4);
+  }
+  __atomic_store_n(r->tail, tail + pad + need, __ATOMIC_SEQ_CST);
+}
+
+/* producer-side doorbell: wake the peer only if it advertised that it
+ * is sleeping — zero syscalls while both sides stay in their spin. */
+static void ring_bell(ring_t *r, int efd, long *db_rings) {
+  if (__atomic_load_n(r->waiter, __ATOMIC_SEQ_CST)) {
+    __atomic_store_n(r->waiter, 0u, __ATOMIC_SEQ_CST);
+    uint64_t one = 1;
+    (*db_rings)++;
+    if (write(efd, &one, 8) < 0 && errno != EAGAIN)
+      perror("doorbell write");
+  }
+}
+
+/* consumer-side wait: bounded spin, then advertise via the waiter flag
+ * and poll the eventfd (plus the control socket, whose readability
+ * means the server went away).  Returns 0 when data is available, -1
+ * on peer death / poll error. */
+static int ring_wait(ring_t *r, int efd, int ctrl_sock, double spin_s,
+                     long *db_waits, long *db_drains) {
+  double spin_until = now_s() + spin_s;
+  int iters = 0;
+  for (;;) {
+    if (__atomic_load_n(r->tail, __ATOMIC_SEQ_CST) !=
+        __atomic_load_n(r->head, __ATOMIC_SEQ_CST))
+      return 0;
+    if (++iters >= 256) {
+      iters = 0;
+      if (now_s() >= spin_until) break;
+    }
+  }
+  for (;;) {
+    __atomic_store_n(r->waiter, 1u, __ATOMIC_SEQ_CST);
+    if (__atomic_load_n(r->tail, __ATOMIC_SEQ_CST) !=
+        __atomic_load_n(r->head, __ATOMIC_SEQ_CST)) {
+      __atomic_store_n(r->waiter, 0u, __ATOMIC_SEQ_CST);
+      return 0;
+    }
+    struct pollfd pfd[2] = {{efd, POLLIN, 0}, {ctrl_sock, POLLIN, 0}};
+    (*db_waits)++;
+    int n = poll(pfd, 2, 250);
+    __atomic_store_n(r->waiter, 0u, __ATOMIC_SEQ_CST);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    if (pfd[1].revents) return -1; /* control socket: server closed */
+    if (pfd[0].revents & POLLIN) {
+      uint64_t v;
+      (*db_drains)++;
+      if (read(efd, &v, 8) < 0 && errno != EAGAIN) return -1;
+    }
+  }
+}
+
+#ifndef MFD_CLOEXEC
+#define MFD_CLOEXEC 0x0001U
+#endif
+
+static int make_seg_fd(uint64_t size) {
+  int fd = -1;
+#ifdef SYS_memfd_create
+  fd = (int)syscall(SYS_memfd_create, "lgbm-shm-ring", (unsigned)MFD_CLOEXEC);
+#endif
+  if (fd < 0) { /* pre-memfd kernels: anonymous POSIX shm */
+    char name[64];
+    snprintf(name, sizeof name, "/lgbm-shm-ring-%d", (int)getpid());
+    fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd >= 0) shm_unlink(name);
+  }
+  if (fd < 0) return -1;
+  if (ftruncate(fd, (off_t)size) != 0) {
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+static int send_three_fds(int sock, int seg_fd, int efd_req, int efd_resp) {
+  char data = 'F';
+  struct iovec iov = {&data, 1};
+  union {
+    struct cmsghdr hdr;
+    char buf[CMSG_SPACE(3 * sizeof(int))];
+  } u;
+  memset(&u, 0, sizeof u);
+  struct msghdr msg;
+  memset(&msg, 0, sizeof msg);
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  msg.msg_control = u.buf;
+  msg.msg_controllen = sizeof u.buf;
+  struct cmsghdr *c = CMSG_FIRSTHDR(&msg);
+  c->cmsg_level = SOL_SOCKET;
+  c->cmsg_type = SCM_RIGHTS;
+  c->cmsg_len = CMSG_LEN(3 * sizeof(int));
+  int fds[3] = {seg_fd, efd_req, efd_resp};
+  memcpy(CMSG_DATA(c), fds, sizeof fds);
+  return (sendmsg(sock, &msg, 0) == 1) ? 0 : -1;
+}
+
+static int expect_shm_ok(int fd) {
+  LGBMWireFrameHeader h;
+  if (read_full(fd, &h, sizeof h) != 0) return -1;
+  if (memcmp(h.magic, LGBM_WIRE_MAGIC, 4) != 0 ||
+      h.payload_len > MAX_PAYLOAD)
+    return -1;
+  uint8_t *pl = (uint8_t *)malloc(h.payload_len ? h.payload_len : 1);
+  int rc = read_full(fd, pl, h.payload_len);
+  if (rc == 0 && h.msg_type != LGBM_WIRE_MSG_SHM_OK) {
+    fprintf(stderr, "shm handshake refused (msg_type %u)\n",
+            (unsigned)h.msg_type);
+    rc = -1;
+  }
+  free(pl);
+  return rc;
+}
+
+static int run_shm(int argc, char **argv) {
+  const char *path = argv[2];
+  const char *probes_path = NULL, *expect_path = NULL;
+  const char *model_id = "default";
+  int ncols = 0, rows = 1, n_out = 1, pipeline = 16;
+  long expect_gen = -1;
+  double secs = 5.0, spin_s = 0.002, warmup = 1.0;
+  uint64_t req_cap = LGBM_WIRE_RING_DEFAULT_CAP;
+  uint64_t resp_cap = LGBM_WIRE_RING_DEFAULT_CAP;
+  for (int arg = 3; arg < argc; arg++) {
+    if (!strcmp(argv[arg], "--probes")) probes_path = argv[++arg];
+    else if (!strcmp(argv[arg], "--expect")) expect_path = argv[++arg];
+    else if (!strcmp(argv[arg], "--expect-gen")) expect_gen = atol(argv[++arg]);
+    else if (!strcmp(argv[arg], "--ncols")) ncols = atoi(argv[++arg]);
+    else if (!strcmp(argv[arg], "--n-out")) n_out = atoi(argv[++arg]);
+    else if (!strcmp(argv[arg], "--rows")) rows = atoi(argv[++arg]);
+    else if (!strcmp(argv[arg], "--secs")) secs = atof(argv[++arg]);
+    else if (!strcmp(argv[arg], "--model")) model_id = argv[++arg];
+    else if (!strcmp(argv[arg], "--pipeline")) pipeline = atoi(argv[++arg]);
+    else if (!strcmp(argv[arg], "--spin")) spin_s = atof(argv[++arg]);
+    else if (!strcmp(argv[arg], "--warmup")) warmup = atof(argv[++arg]);
+    else if (!strcmp(argv[arg], "--req-cap")) req_cap = strtoull(argv[++arg], NULL, 0);
+    else if (!strcmp(argv[arg], "--resp-cap")) resp_cap = strtoull(argv[++arg], NULL, 0);
+    else { fprintf(stderr, "unknown arg %s\n", argv[arg]); return 2; }
+  }
+  if (!probes_path || ncols <= 0) {
+    fprintf(stderr, "--probes FILE and --ncols N are required\n");
+    return 2;
+  }
+  if (pipeline < 1) pipeline = 1;
+  long n_vals = 0;
+  float *probes = load_f32(probes_path, &n_vals);
+  if (!probes || n_vals % ncols) {
+    fprintf(stderr, "bad probes file %s\n", probes_path);
+    return 2;
+  }
+  long n_probes = n_vals / ncols;
+  float *expect = NULL;
+  if (expect_path) {
+    long en = 0;
+    expect = load_f32(expect_path, &en);
+    if (!expect || en != n_probes * n_out) {
+      fprintf(stderr, "expect file size mismatch (%ld vs %ld)\n", en,
+              n_probes * n_out);
+      return 2;
+    }
+  }
+
+  /* ---- handshake: setup frame, ack, fd pass, ack ---- */
+  int sock = connect_uds(path);
+  if (sock < 0) {
+    fprintf(stderr, "connect %s: %s\n", path, strerror(errno));
+    return 1;
+  }
+  LGBMWireRingHeader cfg;
+  memset(&cfg, 0, sizeof cfg);
+  memcpy(cfg.magic, LGBM_WIRE_RING_MAGIC, 4);
+  cfg.version = LGBM_WIRE_RING_VERSION;
+  cfg.seg_size = (uint64_t)LGBM_WIRE_RING_DATA + req_cap + resp_cap;
+  cfg.req_ctrl = LGBM_WIRE_RING_REQ_CTRL;
+  cfg.req_offset = LGBM_WIRE_RING_DATA;
+  cfg.req_capacity = (uint32_t)req_cap;
+  cfg.resp_ctrl = LGBM_WIRE_RING_RESP_CTRL;
+  cfg.resp_offset = (uint32_t)(LGBM_WIRE_RING_DATA + req_cap);
+  cfg.resp_capacity = (uint32_t)resp_cap;
+  uint8_t setup[LGBM_WIRE_HEADER_SIZE + LGBM_WIRE_RING_HEADER_SIZE];
+  memcpy(setup + LGBM_WIRE_HEADER_SIZE, &cfg, sizeof cfg);
+  put_header(setup, LGBM_WIRE_MSG_SHM_SETUP, "shm", 0, 0,
+             setup + LGBM_WIRE_HEADER_SIZE, LGBM_WIRE_RING_HEADER_SIZE);
+  if (write_full(sock, setup, sizeof setup) != 0 ||
+      expect_shm_ok(sock) != 0) {
+    fprintf(stderr, "shm setup rejected by server\n");
+    close(sock);
+    return 1;
+  }
+  int seg_fd = make_seg_fd(cfg.seg_size);
+  if (seg_fd < 0) {
+    fprintf(stderr, "segment create: %s\n", strerror(errno));
+    close(sock);
+    return 1;
+  }
+  uint8_t *seg = (uint8_t *)mmap(NULL, cfg.seg_size,
+                                 PROT_READ | PROT_WRITE, MAP_SHARED,
+                                 seg_fd, 0);
+  if (seg == MAP_FAILED) {
+    fprintf(stderr, "mmap: %s\n", strerror(errno));
+    close(seg_fd);
+    close(sock);
+    return 1;
+  }
+  memcpy(seg, &cfg, sizeof cfg); /* segment header the server verifies */
+  int efd_req = eventfd(0, EFD_NONBLOCK);
+  int efd_resp = eventfd(0, EFD_NONBLOCK);
+  if (efd_req < 0 || efd_resp < 0 ||
+      send_three_fds(sock, seg_fd, efd_req, efd_resp) != 0 ||
+      expect_shm_ok(sock) != 0) {
+    fprintf(stderr, "shm fd pass failed\n");
+    close(sock);
+    return 1;
+  }
+  close(seg_fd); /* server holds its own reference now */
+
+  ring_t req, resp;
+  ring_init(&req, seg, cfg.req_ctrl, cfg.req_offset, cfg.req_capacity);
+  ring_init(&resp, seg, cfg.resp_ctrl, cfg.resp_offset, cfg.resp_capacity);
+
+  /* ---- pipelined produce/consume loop ---- */
+  uint32_t req_payload = (uint32_t)(rows * ncols) * 4u;
+  uint64_t frame_total = (uint64_t)LGBM_WIRE_HEADER_SIZE + req_payload;
+  if (frame_total + 4 > req_cap) {
+    fprintf(stderr, "request frame (%llu B) does not fit the ring\n",
+            (unsigned long long)frame_total);
+    return 1;
+  }
+  long *fl_probe = (long *)malloc((size_t)pipeline * sizeof(long));
+  double *fl_t0 = (double *)malloc((size_t)pipeline * sizeof(double));
+  int fl_head = 0, inflight = 0;
+  double *lat = (double *)malloc((size_t)MAX_LAT * sizeof(double));
+  long lat_n = 0;
+  long sent = 0, completed = 0, rejected = 0, errors = 0;
+  long checked = 0, mismatch = 0;
+  long db_rings = 0, db_waits = 0, db_drains = 0;
+  long win0_completed = 0, win0_syscalls = 0;
+  double win0_t = 0.0;
+  int snapped = 0;
+  long probe = 0;
+  double t0 = now_s();
+
+  for (;;) {
+    double now = now_s();
+    int timeup = (now - t0) >= secs;
+    if (!snapped && (now - t0) >= warmup) {
+      snapped = 1;
+      win0_completed = completed;
+      win0_syscalls = db_rings + db_waits + db_drains;
+      win0_t = now;
+    }
+    if (timeup && inflight == 0) break;
+    /* fill the pipeline straight into the request ring */
+    while (!timeup && inflight < pipeline) {
+      uint64_t tail, pad;
+      int64_t off = ring_reserve(&req, frame_total, &tail, &pad);
+      if (off < 0) break; /* ring full: backpressure, drain a response */
+      uint8_t *fp = req.data + off;
+      float *dst = (float *)(fp + LGBM_WIRE_HEADER_SIZE);
+      for (int r = 0; r < rows; r++) {
+        long idx = (probe + r) % n_probes;
+        memcpy(dst + (size_t)r * ncols, probes + idx * ncols,
+               (size_t)ncols * 4);
+      }
+      put_header(fp, LGBM_WIRE_MSG_REQUEST, model_id, (uint32_t)rows,
+                 (uint32_t)ncols, fp + LGBM_WIRE_HEADER_SIZE, req_payload);
+      ring_publish(&req, tail, pad, frame_total);
+      ring_bell(&req, efd_req, &db_rings);
+      fl_probe[(fl_head + inflight) % pipeline] = probe;
+      fl_t0[(fl_head + inflight) % pipeline] = now_s();
+      inflight++;
+      sent++;
+      probe = (probe + rows) % n_probes;
+    }
+    if (inflight == 0) continue; /* time up between fills */
+    /* consume the oldest response (server completes strictly in order) */
+    if (ring_wait(&resp, efd_resp, sock, spin_s, &db_waits, &db_drains)
+        != 0) {
+      fprintf(stderr, "server went away mid-session\n");
+      errors++;
+      break;
+    }
+    uint64_t head = __atomic_load_n(resp.head, __ATOMIC_SEQ_CST);
+    uint64_t tail = __atomic_load_n(resp.tail, __ATOMIC_SEQ_CST);
+    uint64_t pos = head & resp.mask;
+    uint64_t room = resp.cap - pos;
+    uint64_t skip = 0;
+    if (room < 4) {
+      skip = room;
+    } else {
+      uint32_t mark;
+      memcpy(&mark, resp.data + pos, 4);
+      if (mark == LGBM_WIRE_RING_WRAP) skip = room;
+    }
+    pos = (head + skip) & resp.mask;
+    uint64_t avail = tail - head - skip;
+    LGBMWireFrameHeader rh;
+    if (avail < sizeof rh) {
+      fprintf(stderr, "torn response frame (%llu bytes)\n",
+              (unsigned long long)avail);
+      errors++;
+      break;
+    }
+    memcpy(&rh, resp.data + pos, sizeof rh);
+    uint64_t total = sizeof rh + rh.payload_len;
+    if (memcmp(rh.magic, LGBM_WIRE_MAGIC, 4) != 0 ||
+        rh.version != LGBM_WIRE_VERSION || rh.payload_len > MAX_PAYLOAD ||
+        avail < total) {
+      fprintf(stderr, "bad response frame in ring\n");
+      errors++;
+      break;
+    }
+    const uint8_t *pl = resp.data + pos + sizeof rh;
+    if (crc32_buf(pl, rh.payload_len) != rh.crc32) {
+      errors++;
+      __atomic_store_n(resp.head, head + skip + total, __ATOMIC_SEQ_CST);
+      break;
+    }
+    long oldest_probe = fl_probe[fl_head];
+    double dt = now_s() - fl_t0[fl_head];
+    if (rh.msg_type == LGBM_WIRE_MSG_RESPONSE) {
+      completed++;
+      if (lat_n < MAX_LAT) lat[lat_n++] = dt;
+      if (expect && rh.n_rows == (uint32_t)rows &&
+          rh.n_cols == (uint32_t)n_out) {
+        int64_t gen;
+        memcpy(&gen, pl, 8);
+        if (gen == (int64_t)expect_gen) {
+          const float *vals = (const float *)(pl + 32);
+          for (int r = 0; r < rows; r++) {
+            long idx = (oldest_probe + r) % n_probes;
+            checked++;
+            if (memcmp(vals + (size_t)r * n_out, expect + idx * n_out,
+                       (size_t)n_out * 4) != 0)
+              mismatch++;
+          }
+        }
+      }
+    } else if (rh.msg_type == LGBM_WIRE_MSG_REJECT) {
+      rejected++;
+      uint8_t retryable = rh.payload_len >= 8 ? pl[4] : 0;
+      if (!retryable) {
+        errors++;
+        __atomic_store_n(resp.head, head + skip + total, __ATOMIC_SEQ_CST);
+        break;
+      }
+    } else {
+      errors++;
+      __atomic_store_n(resp.head, head + skip + total, __ATOMIC_SEQ_CST);
+      break;
+    }
+    __atomic_store_n(resp.head, head + skip + total, __ATOMIC_SEQ_CST);
+    fl_head = (fl_head + 1) % pipeline;
+    inflight--;
+  }
+  double elapsed = now_s() - t0;
+  long syscalls = db_rings + db_waits + db_drains;
+  long win_completed = snapped ? completed - win0_completed : completed;
+  long win_syscalls = snapped ? syscalls - win0_syscalls : syscalls;
+  double win_elapsed = snapped ? now_s() - win0_t : elapsed;
+
+  qsort(lat, (size_t)lat_n, sizeof(double), cmp_double);
+  double p50 = lat_n ? lat[(long)(0.50 * (double)(lat_n - 1))] : 0.0;
+  double p99 = lat_n ? lat[(long)(0.99 * (double)(lat_n - 1))] : 0.0;
+  printf("{\"mode\":\"shm\",\"conns\":1,\"rows\":%d,\"pipeline\":%d,"
+         "\"elapsed_s\":%.3f,\"sent\":%ld,\"completed\":%ld,"
+         "\"rejected\":%ld,\"errors\":%ld,"
+         "\"verify_checked\":%ld,\"verify_mismatch\":%ld,"
+         "\"req_per_sec\":%.1f,\"rows_per_sec\":%.1f,"
+         "\"p50_ms\":%.4f,\"p99_ms\":%.4f,"
+         "\"db_rings\":%ld,\"db_waits\":%ld,\"db_drains\":%ld,"
+         "\"transport_syscalls\":%ld,"
+         "\"win_completed\":%ld,\"win_syscalls\":%ld,"
+         "\"win_elapsed_s\":%.3f}\n",
+         rows, pipeline, elapsed, sent, completed, rejected, errors,
+         checked, mismatch, (double)completed / elapsed,
+         (double)(completed * rows) / elapsed, p50 * 1e3, p99 * 1e3,
+         db_rings, db_waits, db_drains, syscalls, win_completed,
+         win_syscalls, win_elapsed);
+  close(sock);
+  munmap(seg, cfg.seg_size);
+  close(efd_req);
+  close(efd_resp);
+  return (errors > 0 || completed == 0 || mismatch > 0) ? 1 : 0;
+}
+
 /* ------------------------------------------------------ fastconfig mode */
 typedef int (*create_fn)(const char *, int *, BoosterHandle *);
 typedef int (*nclass_fn)(BoosterHandle, int *);
@@ -487,11 +944,12 @@ int main(int argc, char **argv) {
   if (argc < 2) {
     fprintf(stderr,
             "usage: wire_client tcp HOST PORT ... | uds PATH ... | "
-            "fastconfig LIB MODEL ...\n");
+            "shm PATH ... | fastconfig LIB MODEL ...\n");
     return 2;
   }
   if (!strcmp(argv[1], "tcp") && argc >= 4) return run_socket(argc, argv, 0);
   if (!strcmp(argv[1], "uds") && argc >= 3) return run_socket(argc, argv, 1);
+  if (!strcmp(argv[1], "shm") && argc >= 3) return run_shm(argc, argv);
   if (!strcmp(argv[1], "fastconfig")) return run_fastconfig(argc, argv);
   fprintf(stderr, "unknown mode %s\n", argv[1]);
   return 2;

@@ -19,12 +19,24 @@ artifact:
   (cpp/wire_client.c) driving the UDS socket protocol and the
   in-process `LGBM_BoosterPredictForMatSingleRowFast` ABI: proof from
   OUTSIDE Python, with client-side CRC + byte verification.
+* **binary_shm** — the ISSUE 20 shared-memory ring transport
+  (runtime/shm_ring.py): same frames, written straight into a mapped
+  SPSC ring pair instead of a socket, closed-loop from Python at the
+  same shape as the socket paths.
 * **offered** — an open-throttle overload phase against a deliberately
   small admission queue: clients hammer without honoring backoff so
   the OFFERED rate (completed + rejected frames) exceeds the
   acceptance bar while every rejection stays a machine-readable frame;
   the p99 of the requests that did complete is recorded under that
   load.
+* **shm_plane** — the ring transport's own claim, proved from OUTSIDE
+  Python by the compiled client: single-row single-connection UDS
+  closed loop vs the pipelined shm ring at the same shape, with a
+  post-warmup syscall window (every doorbell syscall the client makes
+  is counted; the spin-hot steady state must make ZERO) and the
+  server-side ring allocation ledger (the rx path admits mapped views
+  and must never allocate; the tx scratch is sized once per session,
+  never per request).
 * **predictor** — the flattened branchless device engine measured
   directly (f64 vs f32 response surfaces vs int8-quantized leaves)
   with the quantization error vs the f64 host path, feeding the
@@ -35,6 +47,12 @@ Gates (all must hold or the artifact is INVALID):
   offered_ge_10k          offered phase >= 10k req/s on this host
   c_client_green          compiled client rc 0, zero mismatches
   zero_mismatches         no sampled response anywhere disagreed
+  shm_ge_2x_uds           pipelined shm ring >= 2x the UDS socket
+                          closed loop at the same single-row shape
+  shm_zero_syscalls       zero transport syscalls over the client's
+                          post-warmup window (spin-hot steady state)
+  shm_zero_allocs         zero per-request ring allocations server-side
+                          (no rx buffers ever; tx scratch <= 1/session)
 
 Usage:
     python exp/bench_wire.py [--quick] [--out OUT.json]
@@ -44,7 +62,10 @@ Env knobs: BENCH_WIRE_TREES/LEAVES/FEAT (model shape, default
 40/31/28 — small enough that the plane, not predict, is measured),
 BENCH_WIRE_SECS (per-phase seconds, default 5), BENCH_WIRE_CONNS
 (closed-loop connections, default 8), BENCH_WIRE_ROWS (rows per
-request, default 512 — bulk-scoring frames where zero-copy pays).
+request, default 512 — bulk-scoring frames where zero-copy pays),
+BENCH_WIRE_SHM_SPIN (doorbell spin budget for the shm_plane phase,
+seconds, default 2.0 — long enough that the steady state never
+sleeps, which is what the zero-syscall window proves).
 
 The artifact is schema-validated (`helper.bench_history.
 validate_wire_artifact`) before it is written and collated by
@@ -68,11 +89,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from lightgbm_tpu.basic import Booster                     # noqa: E402
+from lightgbm_tpu.runtime import shm_ring                  # noqa: E402
 from lightgbm_tpu.runtime import wire                      # noqa: E402
 from lightgbm_tpu.runtime.serving import (ServingRuntime,  # noqa: E402
                                           ServingServer)
 
-SCHEMA_VERSION = 1
+#: v2 adds the shm transport (binary_shm path + shm_plane section with
+#: its three gates); helper/bench_history.py requires them from v2 on
+SCHEMA_VERSION = 2
 
 
 def _pct(sorted_vals: List[float], q: float) -> float:
@@ -227,6 +251,127 @@ def bench_binary(address, probes: np.ndarray, refs: _Refs, conns: int,
     r = _closed_loop(conns, secs, make_worker)
     return _summary(r["lat"], r["completed"], r["rejected"],
                     r["mismatches"], r["elapsed"], rows)
+
+
+def bench_shm(uds_path: str, probes: np.ndarray, refs: _Refs, conns: int,
+              rows: int, secs: float) -> Dict[str, Any]:
+    """The ring transport at the socket paths' shape: conns ShmClient
+    sessions, one request in flight each, byte-verified like the rest
+    of the four-way."""
+    windows = [(s, np.ascontiguousarray(probes[s:s + rows]))
+               for s in range(0, len(probes) - rows + 1, rows)]
+
+    def make_worker(i, stop, out):
+        def work():
+            comp = rej = mis = 0
+            lat: List[float] = []
+            with shm_ring.ShmClient(uds_path, timeout=30) as c:
+                k = i % len(windows)
+                while not stop.is_set():
+                    start, X = windows[k]
+                    k = (k + 1) % len(windows)
+                    t0 = time.monotonic()
+                    resp = c.request_once(X)
+                    lat_s = time.monotonic() - t0
+                    if "values" in resp:
+                        comp += 1
+                        lat.append(lat_s)
+                        mis += refs.check(start, resp["values"],
+                                          resp["served_by"])
+                    else:
+                        rej += 1
+                        time.sleep(float(resp.get("retry_after_s")
+                                         or 0.001))
+            out[i] = (comp, rej, mis, lat)
+        return work
+
+    r = _closed_loop(conns, secs, make_worker)
+    return _summary(r["lat"], r["completed"], r["rejected"],
+                    r["mismatches"], r["elapsed"], rows)
+
+
+def bench_shm_plane(uds_path: str, workdir: str, probes: np.ndarray,
+                    refs: _Refs, secs: float) -> Dict[str, Any]:
+    """The tentpole's own numbers, from OUTSIDE Python: the compiled
+    client drives single-row requests over (a) a single-connection UDS
+    closed loop and (b) the pipelined shm ring, same frames and byte
+    verification both ways.  Both sides' doorbells get a spin budget
+    longer than any steady-state gap so the post-warmup window counts
+    ZERO transport syscalls; the server-side ring ledger delta proves
+    the rx path allocated nothing and the tx scratch was sized at most
+    once per session."""
+    client = os.path.join(REPO, "cpp", "wire_client")
+    probes_f = os.path.join(workdir, "probes.f32")
+    expect_f = os.path.join(workdir, "expect.f32")
+    if not os.path.exists(probes_f):
+        probes.astype(np.float32).tofile(probes_f)
+        refs.device.tofile(expect_f)
+    common = ["--probes", probes_f, "--expect", expect_f,
+              "--expect-gen", "0", "--ncols", str(probes.shape[1]),
+              "--n-out", str(refs.n_out), "--rows", "1",
+              "--secs", str(secs)]
+
+    def _one(cmd):
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=secs * 6 + 60)
+        try:
+            parsed = json.loads(proc.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            parsed = {"error":
+                      (proc.stderr or proc.stdout).strip()[-300:]}
+        parsed["rc"] = proc.returncode
+        return parsed
+
+    u = _one([client, "uds", uds_path, "--conns", "1"] + common)
+    stats0 = shm_ring.stats_snapshot()
+    spin = os.environ.get("BENCH_WIRE_SHM_SPIN", "2.0")
+    old_spin = os.environ.get("LGBM_TPU_SHM_SPIN_S")
+    os.environ["LGBM_TPU_SHM_SPIN_S"] = spin   # server-side sessions
+    try:
+        s = _one([client, "shm", uds_path, "--pipeline", "64",
+                  "--spin", spin, "--warmup",
+                  str(max(1.0, secs * 0.4))] + common)
+    finally:
+        if old_spin is None:
+            os.environ.pop("LGBM_TPU_SHM_SPIN_S", None)
+        else:
+            os.environ["LGBM_TPU_SHM_SPIN_S"] = old_spin
+    # let the session thread notice peer exit and tear down before the
+    # ledger is read (its doorbell spin can outlive the client by the
+    # spin budget)
+    deadline = time.monotonic() + float(spin) + 5.0
+    while time.monotonic() < deadline:
+        now = shm_ring.stats_snapshot()
+        if now["closed"] + now["reclaimed"] + now["torn"] >= \
+                stats0["closed"] + stats0["reclaimed"] + stats0["torn"] \
+                + 1:
+            break
+        time.sleep(0.1)
+    stats1 = shm_ring.stats_snapshot()
+    delta = {k: stats1[k] - stats0[k] for k in stats1}
+
+    u_rps = float(u.get("req_per_sec") or 0.0)
+    s_rps = float(s.get("req_per_sec") or 0.0)
+    win_completed = int(s.get("win_completed") or 0)
+    win_syscalls = int(s.get("win_syscalls") or 0)
+    verified = bool(
+        u.get("rc") == 0 and s.get("rc") == 0
+        and (u.get("verify_checked") or 0) > 0
+        and (s.get("verify_checked") or 0) > 0)
+    mismatches = int(u.get("verify_mismatch") or 0) \
+        + int(s.get("verify_mismatch") or 0)
+    return {
+        "uds_single_conn": u, "shm": s,
+        "rows_per_request": 1, "pipeline": 64,
+        "speedup_shm_over_uds": round(s_rps / u_rps, 2) if u_rps else 0.0,
+        "win_completed": win_completed,
+        "win_syscalls": win_syscalls,
+        "syscalls_per_request": round(win_syscalls / win_completed, 6)
+        if win_completed else None,
+        "ring_stats_delta": delta,
+        "verified": verified,
+        "prediction_mismatches": mismatches,
+    }
 
 
 def bench_offered(uds_path: str, workdir: str, probes: np.ndarray,
@@ -445,6 +590,13 @@ def run(quick: bool = False, workdir: Optional[str] = None
             rec["paths"]["c_client_uds"] = bench_c_client(
                 uds_path, workdir, probes, refs, model_file, conns, rows,
                 secs)
+            print("bench_wire: binary_shm...", file=sys.stderr,
+                  flush=True)
+            rec["paths"]["binary_shm"] = bench_shm(
+                uds_path, probes, refs, conns, rows, secs)
+            print("bench_wire: shm_plane...", file=sys.stderr, flush=True)
+            rec["shm_plane"] = bench_shm_plane(
+                uds_path, workdir, probes, refs, secs)
         finally:
             for s in (jsrv, tsrv, usrv):
                 s.shutdown()
@@ -479,16 +631,20 @@ def run(quick: bool = False, workdir: Optional[str] = None
     uds_rps = rec["paths"]["binary_uds"]["req_per_sec"]
     c_rps = rec["paths"]["c_client_uds"].get("req_per_sec", 0.0)
     best_uds = max(uds_rps, c_rps)
+    plane = rec["shm_plane"]
     rec["speedup"] = {
         "binary_uds_over_json": round(best_uds / jrps, 2) if jrps else 0.0,
         "binary_tcp_over_json": round(
             rec["paths"]["binary_tcp"]["req_per_sec"] / jrps, 2)
         if jrps else 0.0,
+        "shm_over_uds": plane["speedup_shm_over_uds"],
     }
     all_mis = sum(int(p.get("prediction_mismatches") or 0)
                   for p in rec["paths"].values())
     all_mis += int(rec["offered"].get("prediction_mismatches") or 0)
+    all_mis += int(plane.get("prediction_mismatches") or 0)
     c = rec["paths"]["c_client_uds"]
+    ring_delta = plane.get("ring_stats_delta") or {}
     rec["gates"] = {
         "binary_uds_ge_5x_json": bool(best_uds >= 5.0 * jrps),
         "offered_ge_10k": bool(
@@ -499,6 +655,16 @@ def run(quick: bool = False, workdir: Optional[str] = None
             and c.get("verify_checked", 0) > 0
             and c.get("prediction_mismatches") == 0),
         "zero_mismatches": bool(all_mis == 0),
+        "shm_ge_2x_uds": bool(
+            plane["verified"]
+            and plane["speedup_shm_over_uds"] >= 2.0),
+        "shm_zero_syscalls": bool(
+            plane["win_completed"] > 0 and plane["win_syscalls"] == 0),
+        "shm_zero_allocs": bool(
+            ring_delta.get("sessions", 0) >= 1
+            and ring_delta.get("rx_buffer_allocs", 1) == 0
+            and ring_delta.get("tx_buffer_allocs", 1)
+            <= ring_delta.get("sessions", 0)),
     }
     rec["ok"] = all(rec["gates"].values())
     return rec

@@ -283,3 +283,23 @@ PROD_SIM_DURATION=60 timeout 900 \
   python exp/prod_sim.py /tmp/sim_fleet_tpu.json --fleet \
   && python -c "import json; d=json.load(open('/tmp/sim_fleet_tpu.json')); print(json.dumps({k: {'ok': v['ok'], 'ups': v['fleet']['scale_ups'], 'downs': v['fleet']['scale_downs'], 'relaunches': v['fleet']['relaunches'], 'reaction_s': v['fleet']['scale_up_reaction_s_max'], 'rs_per_1M': v['fleet']['replica_seconds_per_million_verified'], 'x_r11': v['fleet']['offered_x_r11']} for k, v in d['scenarios'].items()}, indent=1))" \
   || echo "   fleet soak FAILED on hardware — /tmp/sim_fleet_tpu.json + replica logs in the tempdir have the ledger"
+echo "=== 17. shared-memory ring plane on hardware (ISSUE 20) ==="
+echo "    (the CPU-committed BENCH_WIRE_r20.json proved the ring plane"
+echo "     >=2x the single-connection binary-UDS req/s with ZERO"
+echo "     steady-state syscalls and ZERO per-request allocations in"
+echo "     either ring direction, every response byte-verified — but on"
+echo "     ONE core the spinning consumer and the predict loop fight for"
+echo "     the same cycles, so the pipelined latency there is queueing,"
+echo "     not transport.  On hardware the predict dispatch leaves the"
+echo "     host and the doorbell spin gets its own core: expect the"
+echo "     sub-millisecond p50 the title promises and a wider shm-vs-uds"
+echo "     gap.  Raise LGBM_TPU_SHM_SPIN_S only for the measurement"
+echo "     window (an idle client must cost nothing).  The shm_plane"
+echo "     section of the same BENCH_WIRE artifact carries it; COMMIT as"
+echo "     BENCH_WIRE_r<round>.json — helper/bench_history.py gates the"
+echo "     shm series and requires the zero-mismatch + byte-verified"
+echo "     flags.)"
+timeout 900 \
+  python exp/bench_wire.py --out /tmp/bench_wire_shm_tpu.json \
+  && python -c "import json; d=json.load(open('/tmp/bench_wire_shm_tpu.json')); p=d['shm_plane']; print(json.dumps({'ok': d['ok'], 'speedup_shm_over_uds': p['speedup_shm_over_uds'], 'win_syscalls': p['win_syscalls'], 'ring_stats_delta': p['ring_stats_delta'], 'gates': d['gates']}, indent=1))" \
+  || echo "   shm ring bench FAILED on hardware — /tmp/bench_wire_shm_tpu.json + stderr have the ledger"

@@ -35,6 +35,13 @@ binary/offered p99 tails (lower is better) under the same same-shape
 response or any JSON-vs-binary prediction mismatch an INVALID
 artifact — throughput at wrong answers is not throughput.
 
+ISSUE 20 extends the wire treatment to the shared-memory ring
+transport: a ``binary_shm`` path series (req/s higher-better, p99
+lower-better) plus the ``speedup_shm_over_uds`` trajectory column,
+and — from artifact schema v2 on — a hard gate that the ``shm_plane``
+section is present, byte-verified, and carries exactly zero prediction
+mismatches (v1 artifacts from r16 stay valid without it).
+
 Artifact shape (bench): the driver wraps each round's bench stdout as
 ``{"n": round, "rc": ..., "parsed": <bench JSON>, "tail": ...}``; when
 ``parsed`` is missing the last JSON-looking line of ``tail`` is tried.
@@ -561,7 +568,11 @@ WIRE_SERIES: Tuple[Tuple[str, Tuple[str, ...], bool], ...] = (
      ("paths", "c_client_uds", "req_per_sec"), True),
     ("fastconfig_req_per_sec",
      ("paths", "c_fastconfig", "req_per_sec"), True),
+    # shared-memory ring transport (ISSUE 20, artifact schema v2) —
+    # absent from pre-ring (v1) artifacts and silently skipped there
+    ("shm_req_per_sec", ("paths", "binary_shm", "req_per_sec"), True),
     ("binary_uds_p99_ms", ("paths", "binary_uds", "p99_ms"), False),
+    ("shm_p99_ms", ("paths", "binary_shm", "p99_ms"), False),
     ("offered_p99_ms", ("offered", "p99_ms"), False),
 )
 
@@ -591,7 +602,13 @@ def validate_wire_artifact(rec: Any) -> List[str]:
     if not isinstance(paths, dict) or not paths:
         problems.append("paths missing or empty")
         return problems
-    for pname in ("json_tcp", "binary_tcp", "binary_uds"):
+    sv = rec.get("schema_version")
+    required_paths = ["json_tcp", "binary_tcp", "binary_uds"]
+    if isinstance(sv, int) and sv >= 2:
+        # the shm ring transport (ISSUE 20) is part of the contract
+        # from schema v2 on; r16-era v1 artifacts stay valid without it
+        required_paths.append("binary_shm")
+    for pname in required_paths:
         sec = paths.get(pname)
         if not isinstance(sec, dict):
             problems.append("path %r missing" % pname)
@@ -608,6 +625,20 @@ def validate_wire_artifact(rec: Any) -> List[str]:
                             "wire bytes disagreed with the offline "
                             "predictor" % (pname,
                                            sec["prediction_mismatches"]))
+    if isinstance(sv, int) and sv >= 2:
+        plane = rec.get("shm_plane")
+        if not isinstance(plane, dict):
+            problems.append("shm_plane section missing (required from "
+                            "schema v2)")
+        else:
+            if plane.get("verified") is not True:
+                problems.append("shm_plane: responses were NOT "
+                                "byte-verified against the offline "
+                                "predictor")
+            if plane.get("prediction_mismatches") != 0:
+                problems.append("shm_plane: prediction_mismatches must "
+                                "be exactly 0, got %r"
+                                % (plane.get("prediction_mismatches"),))
     for pname, sec in paths.items():
         if isinstance(sec, dict) and sec.get("prediction_mismatches"):
             if not any(pname in p for p in problems):
@@ -668,6 +699,8 @@ def wire_trajectory(rounds: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             "conns": rec.get("conns"), "ok": rec.get("ok"),
             "speedup_binary_uds_over_json": _get(
                 rec, ("speedup", "binary_uds_over_json")),
+            "speedup_shm_over_uds": _get(
+                rec, ("speedup", "shm_over_uds")),
             "offered_per_sec": _get(rec, ("offered", "offered_per_sec")),
         }
         for name, path, _ in WIRE_SERIES:

@@ -21,6 +21,13 @@ against themselves.  This lint pins the two layouts field-for-field:
    part of the contract, and a header edit that breaks its build is
    drift even if the token line still matches.
 
+The shared-memory ring transport (ISSUE 20) adds a second pinned
+layout: the 40-byte segment header both sides map at offset 0.  The
+same three checks run against the header's ``WIRE_RING_FIELDS:``
+token line + ``LGBMWireRingHeader`` struct vs the Python
+``RING_HEADER_FIELDS`` tuple in ``runtime/shm_ring.py`` and the
+``LGBM_WIRE_RING_HEADER_SIZE`` macro.
+
 Run standalone (``python helper/check_wire_abi.py``; exit 1 on drift)
 or through ``helper/ci_checks.py``; ``tests/test_ci_checks.py`` pins a
 negative (a doctored header MUST fail) so the comparator cannot rot
@@ -39,23 +46,36 @@ from typing import List, Tuple
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HEADER = os.path.join(REPO, "cpp", "lightgbm_tpu_c_api.h")
 WIRE = os.path.join(REPO, "lightgbm_tpu", "runtime", "wire.py")
+SHM = os.path.join(REPO, "lightgbm_tpu", "runtime", "shm_ring.py")
 
-#: the C header's canonical token line: "WIRE_FRAME_FIELDS:" then
+#: the C header's canonical token lines: "WIRE_FRAME_FIELDS:" (frame
+#: header) / "WIRE_RING_FIELDS:" (shm segment header) then
 #: whitespace-separated name:fmt tokens, possibly wrapped over several
 #: comment lines (continuation lines start with "*").
 _C_BLOCK_RE = re.compile(
     r"WIRE_FRAME_FIELDS:\s*((?:[\w]+:[\w]+[ \t]*|\n\s*\*\s*)+)")
+_C_RING_RE = re.compile(
+    r"WIRE_RING_FIELDS:\s*((?:[\w]+:[\w]+[ \t]*|\n\s*\*\s*)+)")
 _TOKEN_RE = re.compile(r"(\w+):(\w+)")
 
-#: Python side: the ("name", "fmt") pairs of the HEADER_FIELDS tuple.
-#: Matched textually (not imported) so the lint needs no jax and sees
-#: exactly what is committed.
+#: Python side: the ("name", "fmt") pairs of the HEADER_FIELDS /
+#: RING_HEADER_FIELDS tuples.  Matched textually (not imported) so the
+#: lint needs no jax and sees exactly what is committed.
 _PY_PAIR_RE = re.compile(r"\(\s*\"(\w+)\"\s*,\s*\"(\w+)\"\s*\)")
 _SIZE_MACRO_RE = re.compile(r"#define\s+LGBM_WIRE_HEADER_SIZE\s*\((\d+)\)")
+_RING_SIZE_MACRO_RE = re.compile(
+    r"#define\s+LGBM_WIRE_RING_HEADER_SIZE\s*\((\d+)\)")
 
 
 def c_header_fields(header_text: str) -> List[Tuple[str, str]]:
     m = _C_BLOCK_RE.search(header_text)
+    if not m:
+        return []
+    return _TOKEN_RE.findall(m.group(1))
+
+
+def c_ring_fields(header_text: str) -> List[Tuple[str, str]]:
+    m = _C_RING_RE.search(header_text)
     if not m:
         return []
     return _TOKEN_RE.findall(m.group(1))
@@ -69,8 +89,49 @@ def py_header_fields(wire_text: str) -> List[Tuple[str, str]]:
     return _PY_PAIR_RE.findall(m.group(1))
 
 
+def py_ring_fields(shm_text: str) -> List[Tuple[str, str]]:
+    m = re.search(r"RING_HEADER_FIELDS[^=]*=\s*\((.*?)\n\)", shm_text,
+                  re.DOTALL)
+    if not m:
+        return []
+    return _PY_PAIR_RE.findall(m.group(1))
+
+
+def _compare(c_fields: List[Tuple[str, str]],
+             py_fields: List[Tuple[str, str]], what: str,
+             py_home: str, problems: List[str]) -> None:
+    if c_fields and py_fields and c_fields != py_fields:
+        for i in range(max(len(c_fields), len(py_fields))):
+            c = c_fields[i] if i < len(c_fields) else None
+            p = py_fields[i] if i < len(py_fields) else None
+            if c != p:
+                problems.append(
+                    "%s field %d drifted: C header says %s, %s says %s"
+                    % (what, i, c and "%s:%s" % c, py_home,
+                       p and "%s:%s" % p))
+
+
+def _check_size(py_fields: List[Tuple[str, str]], header_text: str,
+                macro_re, macro_name: str, tuple_name: str,
+                problems: List[str]) -> None:
+    fmt = "<" + "".join(f for _n, f in py_fields)
+    try:
+        size = struct.calcsize(fmt)
+    except struct.error as e:
+        size = -1
+        problems.append("%s does not form a valid struct format (%s): %s"
+                        % (tuple_name, fmt, e))
+    m = macro_re.search(header_text)
+    if not m:
+        problems.append("%s macro missing from the C header" % macro_name)
+    elif size >= 0 and int(m.group(1)) != size:
+        problems.append(
+            "%s is %s but the Python layout packs to %d bytes"
+            % (macro_name, m.group(1), size))
+
+
 def run(header_text: str = None, wire_text: str = None,
-        build: bool = True) -> List[str]:
+        build: bool = True, shm_text: str = None) -> List[str]:
     """Returns the list of drift problems (empty = clean)."""
     problems: List[str] = []
     if header_text is None:
@@ -79,6 +140,9 @@ def run(header_text: str = None, wire_text: str = None,
     if wire_text is None:
         with open(WIRE) as fh:
             wire_text = fh.read()
+    if shm_text is None:
+        with open(SHM) as fh:
+            shm_text = fh.read()
 
     c_fields = c_header_fields(header_text)
     py_fields = py_header_fields(wire_text)
@@ -87,32 +151,26 @@ def run(header_text: str = None, wire_text: str = None,
                         "header")
     if not py_fields:
         problems.append("no HEADER_FIELDS tuple found in runtime/wire.py")
-    if c_fields and py_fields and c_fields != py_fields:
-        for i in range(max(len(c_fields), len(py_fields))):
-            c = c_fields[i] if i < len(c_fields) else None
-            p = py_fields[i] if i < len(py_fields) else None
-            if c != p:
-                problems.append(
-                    "frame header field %d drifted: C header says %s, "
-                    "wire.py says %s" % (i, c and "%s:%s" % c,
-                                         p and "%s:%s" % p))
-
+    _compare(c_fields, py_fields, "frame header", "wire.py", problems)
     if py_fields:
-        fmt = "<" + "".join(f for _n, f in py_fields)
-        try:
-            size = struct.calcsize(fmt)
-        except struct.error as e:
-            size = -1
-            problems.append("HEADER_FIELDS does not form a valid struct "
-                            "format (%s): %s" % (fmt, e))
-        m = _SIZE_MACRO_RE.search(header_text)
-        if not m:
-            problems.append("LGBM_WIRE_HEADER_SIZE macro missing from the "
-                            "C header")
-        elif size >= 0 and int(m.group(1)) != size:
-            problems.append(
-                "LGBM_WIRE_HEADER_SIZE is %s but the Python layout packs "
-                "to %d bytes" % (m.group(1), size))
+        _check_size(py_fields, header_text, _SIZE_MACRO_RE,
+                    "LGBM_WIRE_HEADER_SIZE", "HEADER_FIELDS", problems)
+
+    # the shm segment header (ISSUE 20) — same three checks against
+    # runtime/shm_ring.py's RING_HEADER_FIELDS
+    c_ring = c_ring_fields(header_text)
+    py_ring = py_ring_fields(shm_text)
+    if not c_ring:
+        problems.append("no WIRE_RING_FIELDS token line found in the C "
+                        "header")
+    if not py_ring:
+        problems.append("no RING_HEADER_FIELDS tuple found in "
+                        "runtime/shm_ring.py")
+    _compare(c_ring, py_ring, "ring header", "shm_ring.py", problems)
+    if py_ring:
+        _check_size(py_ring, header_text, _RING_SIZE_MACRO_RE,
+                    "LGBM_WIRE_RING_HEADER_SIZE", "RING_HEADER_FIELDS",
+                    problems)
 
     if build and not os.environ.get("CHECK_WIRE_ABI_NO_BUILD"):
         proc = subprocess.run(
@@ -128,9 +186,12 @@ def run(header_text: str = None, wire_text: str = None,
 
 def main(argv=None) -> int:
     problems = run()
-    fields = c_header_fields(open(HEADER).read())
-    print("check_wire_abi: %d frame header fields, C header vs wire.py"
-          % len(fields))
+    header_text = open(HEADER).read()
+    fields = c_header_fields(header_text)
+    ring = c_ring_fields(header_text)
+    print("check_wire_abi: %d frame header fields + %d ring header "
+          "fields, C header vs wire.py/shm_ring.py"
+          % (len(fields), len(ring)))
     for p in problems:
         print("DRIFT: %s" % p)
     if not problems:

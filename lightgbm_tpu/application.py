@@ -343,6 +343,7 @@ class Application:
         endpoint; 0 = ephemeral, printed on stdout — see
         docs/OBSERVABILITY.md), the ISSUE-16 binary data-plane knobs
         `serve_wire_port` / `serve_wire_uds` / `serve_response_dtype`
+        plus the ISSUE-20 `serve_wire_shm` shared-memory-ring toggle
         (docs/SERVING.md wire-protocol section), and the ISSUE-12
         canary knobs
         `canary_fraction` (0 = off) with `canary_min_samples`,
@@ -368,6 +369,10 @@ class Application:
         # the response payloads (exact downcast of the f64 surface)
         wire_port = params.pop("serve_wire_port", None)
         wire_uds = params.pop("serve_wire_uds", None)
+        # ISSUE 20: any UDS wire connection may upgrade itself to a
+        # per-client shared-memory ring (syscall-free steady state);
+        # serve_wire_shm=false pins the socket-only data plane
+        wire_shm = bool(params.pop("serve_wire_shm", True))
         response_dtype = params.pop("serve_response_dtype", None) or None
         # ISSUE 12 canary knobs: canary_fraction=F routes F of batches
         # to each newly published generation until the CanaryPolicy
@@ -410,7 +415,8 @@ class Application:
                                               port=int(wire_port or 0)))
         if wire_uds:
             from .runtime.wire import WireUnixServer
-            wire_servers.append(WireUnixServer(runtime, path=str(wire_uds)))
+            wire_servers.append(WireUnixServer(runtime, path=str(wire_uds),
+                                               enable_shm=wire_shm))
         stop_evt = _threading.Event()
 
         def _stop(signum, frame):

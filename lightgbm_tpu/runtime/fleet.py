@@ -159,6 +159,12 @@ class ReplicaHandle:
     def wire_port(self) -> Optional[int]:
         return self.endpoint.get("wire_port") if self.endpoint else None
 
+    @property
+    def wire_uds(self) -> Optional[str]:
+        """The replica's UDS wire path (the SHM handshake plane), when
+        it published one — same-host clients prefer it."""
+        return self.endpoint.get("wire_uds") if self.endpoint else None
+
     def replica_seconds(self, now_mono: float) -> float:
         end = self.stopped_mono if self.stopped_mono is not None \
             else now_mono
@@ -225,6 +231,9 @@ class FleetController:
         # lock-free endpoint snapshot for the client hot path (list
         # replacement is atomic; a tick-stale entry just retries a peer)
         self._eps_cache: List[Tuple[str, int]] = []
+        # endpoint -> UDS path for replicas that published one (the SHM
+        # ring handshake plane; same replacement-is-atomic discipline)
+        self._uds_cache: Dict[Tuple[str, int], str] = {}
 
     # -- state file the replicas poll ---------------------------------------
     def _write_state(self, shed_allowed: bool) -> None:
@@ -284,10 +293,11 @@ class FleetController:
         return h
 
     def _refresh_eps(self) -> None:
-        self._eps_cache = [("127.0.0.1", h.wire_port)
-                           for h in self.replicas
-                           if h.ready and not h.retiring
-                           and h.wire_port is not None]
+        live = [h for h in self.replicas
+                if h.ready and not h.retiring and h.wire_port is not None]
+        self._eps_cache = [("127.0.0.1", h.wire_port) for h in live]
+        self._uds_cache = {("127.0.0.1", h.wire_port): h.wire_uds
+                           for h in live if h.wire_uds}
 
     def _retire(self, h: ReplicaHandle) -> None:
         h.retiring = True
@@ -552,6 +562,12 @@ class FleetController:
         snapshot; a stale entry costs one retry, not a lock convoy."""
         return self._eps_cache
 
+    def uds_path_for(self, addr: Tuple[str, int]) -> Optional[str]:
+        """The replica's UDS wire path for a ready endpoint, if it
+        published one — the door to the shared-memory ring transport
+        for same-host clients (None → TCP only)."""
+        return self._uds_cache.get(addr)
+
     def stop(self) -> Dict[str, Any]:
         self._eps_cache = []
         self._stop.set()
@@ -677,9 +693,17 @@ class FleetClient:
 
     def __init__(self, controller: FleetController, workers: int = 16,
                  predict_deadline_s: float = 30.0,
-                 request_timeout_s: float = 35.0):
+                 request_timeout_s: float = 35.0,
+                 prefer_shm: bool = True):
         from .wire import WireClient            # lazy: client-side only
         self._WireClient = WireClient
+        self._ShmClient = None
+        if prefer_shm:
+            try:
+                from .shm_ring import ShmClient
+                self._ShmClient = ShmClient
+            except ImportError:
+                pass                  # non-Linux: sockets only
         self.controller = controller
         self.predict_deadline_s = float(predict_deadline_s)
         self.request_timeout_s = float(request_timeout_s)
@@ -736,8 +760,20 @@ class FleetClient:
             cli = conns.get(addr)
             try:
                 if cli is None:
-                    cli = self._WireClient(addr, timeout=self.
-                                           request_timeout_s)
+                    # same-host replicas that published a UDS path get
+                    # the shared-memory ring; ANY setup failure falls
+                    # back to the socket plane transparently (a fleet
+                    # must serve, not insist on a transport)
+                    uds = self.controller.uds_path_for(addr)
+                    if uds is not None and self._ShmClient is not None:
+                        try:
+                            cli = self._ShmClient(
+                                uds, timeout=self.request_timeout_s)
+                        except Exception:    # noqa: BLE001 — fallback
+                            cli = None
+                    if cli is None:
+                        cli = self._WireClient(addr, timeout=self.
+                                               request_timeout_s)
                     conns[addr] = cli
                 rec = cli.request_once(X, model_id=model_id,
                                        priority=priority)
@@ -788,7 +824,7 @@ def replica_main(spec_path: str, endpoint_path: str,
     polled for the shed grant, SIGTERM drains gracefully."""
     from .policy import AutoscaleShedPolicy
     from .serving import ServingRuntime
-    from .wire import WireTCPServer
+    from .wire import WireTCPServer, WireUnixServer
 
     with open(spec_path) as fh:
         spec = json.load(fh)
@@ -826,11 +862,30 @@ def replica_main(spec_path: str, endpoint_path: str,
                                   kwargs={"poll_interval": 0.2},
                                   name="replica-wire", daemon=True)
     srv_thread.start()
-    _atomic_write_json(endpoint_path, {
+    # the UDS/SHM plane beside TCP: same runtime, same frames, but
+    # same-host clients can upgrade any connection to a shared-memory
+    # ring.  AF_UNIX paths cap near 108 bytes, and a bind failure must
+    # never take the replica down — fall back to TCP-only.
+    usrv = None
+    uds_path = (endpoint_path[:-len(".endpoint.json")]
+                if endpoint_path.endswith(".endpoint.json")
+                else os.path.splitext(endpoint_path)[0]) + ".sock"
+    if bool(spec.get("wire_uds", True)) and len(uds_path) < 100:
+        try:
+            usrv = WireUnixServer(rt, uds_path)
+            threading.Thread(target=usrv.serve_forever,
+                             kwargs={"poll_interval": 0.2},
+                             name="replica-wire-uds", daemon=True).start()
+        except OSError:
+            usrv = None
+    ep = {
         "pid": os.getpid(),
         "metrics_port": rt.metrics_port,
         "wire_port": srv.port,
-        "wallclock": wallclock()})
+        "wallclock": wallclock()}
+    if usrv is not None:
+        ep["wire_uds"] = uds_path
+    _atomic_write_json(endpoint_path, ep)
     try:
         # end of the prewarm sprint: rejoin the serving plane at normal
         # priority (raising nice needs no privilege; no-op when the
@@ -867,6 +922,9 @@ def replica_main(spec_path: str, endpoint_path: str,
     # queue explicitly and exports warm manifests for the next spawn)
     srv.shutdown()
     srv.server_close()
+    if usrv is not None:
+        usrv.shutdown()
+        usrv.server_close()
     rt.stop()
     return 0
 

@@ -73,8 +73,8 @@ __all__ = [
     "fault_arg", "fault_active", "maybe_die_or_preempt",
     "maybe_probe_hang_seconds", "maybe_corrupt_snapshot",
     "maybe_inject_nan", "maybe_slow_stage", "maybe_torn_publish",
-    "maybe_die_at_publish", "maybe_die_at_spawn", "maybe_fail_predict",
-    "DevicePredictFault",
+    "maybe_die_at_publish", "maybe_die_at_spawn", "maybe_die_at_ring",
+    "maybe_fail_predict", "DevicePredictFault",
     "maybe_poison_rows", "maybe_flip_labels", "maybe_regress_model",
     "snapshot_model_text", "FAULT_TABLE", "FAULT_NAMES",
 ]
@@ -155,6 +155,12 @@ FAULT_TABLE: Dict[str, Dict[str, str]] = {
         "injects_at": "ServingRuntime.start, after the prewarm pass and "
                       "BEFORE /healthz readiness (maybe_die_at_spawn on "
                       "the K-th fleet spawn ordinal)"},
+    "die_at_ring": {
+        "arg": "K",
+        "injects_at": "ShmClient ring produce, right after the K-th "
+                      "request frame is published with its response "
+                      "unread (maybe_die_at_ring) — the crashed-client "
+                      "reclamation path"},
 }
 
 FAULT_NAMES = tuple(FAULT_TABLE)
@@ -360,6 +366,25 @@ def maybe_die_at_spawn(spawn_ordinal: Optional[int] = None) -> None:
     sys.stderr.write("[%s] FAULT die_at_spawn: abrupt exit during spawn "
                      "#%d (prewarmed, never ready)\n"
                      % (wallclock(), spawn_ordinal))
+    sys.stderr.flush()
+    os._exit(137)
+
+
+def maybe_die_at_ring(frames_in_flight: int) -> None:
+    """`die_at_ring:K` kills an SHM ring client the instant its K-th
+    request frame is PUBLISHED with the response still unread (ISSUE 20)
+    — the worst reclamation case: the server holds a mapped segment with
+    live admissions aliasing it and a peer that will never drain the
+    response ring.  The server must detect the death on the control
+    socket, drain the in-flight work, unmap with zero leaked mappings
+    and keep every other client byte-verified."""
+    if not fault_active("die_at_ring"):
+        return
+    if int(fault_arg("die_at_ring", "1")) != int(frames_in_flight):
+        return
+    sys.stderr.write("[%s] FAULT die_at_ring: abrupt client exit with "
+                     "%d frames in flight in the ring\n"
+                     % (wallclock(), frames_in_flight))
     sys.stderr.flush()
     os._exit(137)
 

@@ -273,6 +273,10 @@ class _DeviceExecutor(threading.Thread):
             except BaseException as e:      # noqa: BLE001 — ferried out
                 job.error = e
             job.done.set()
+            # drop the reference before waiting: the job closure captures
+            # the batch matrix, which may be a zero-copy view of a wire
+            # receive buffer or a mapped SHM segment awaiting unmap
+            job = None
             if self._stop:
                 return
 
@@ -1062,6 +1066,13 @@ class ServingRuntime:
         (what /healthz reports)."""
         return self._ready.is_set()
 
+    @property
+    def wire_wait_timeout_s(self) -> float:
+        """How long a wire-plane handler (socket or SHM ring) waits on
+        an admitted request's future before giving up — generous enough
+        that the runtime's own deadline machinery always fires first."""
+        return self.default_deadline_s + self.predict_deadline_s + 10.0
+
     # -- request surface -----------------------------------------------------
     def submit(self, data, deadline_s: Optional[float] = None,
                model_id: str = "default", priority: int = 0,
@@ -1330,6 +1341,12 @@ class ServingRuntime:
             finally:
                 with self._cond:
                     self._inflight_by_model[mid] -= len(batch)
+                # drop the reference BEFORE blocking for the next batch:
+                # wire-plane requests are zero-copy views of a receive
+                # buffer or a mapped SHM segment, and a stale `batch`
+                # local would pin those bytes (and the segment's unmap)
+                # for as long as the queue stays idle
+                batch = None
 
     def _serve_batch(self, batch: List[_Request]) -> None:
         model_id = batch[0].model_id
@@ -1646,8 +1663,7 @@ class _Handler(socketserver.StreamRequestHandler):
                         # cross-process context propagation (ISSUE 14):
                         # the wire carries the client's traceparent
                         traceparent=msg.get("traceparent"),
-                    ).wait(timeout=rt.default_deadline_s
-                           + rt.predict_deadline_s + 10.0)
+                    ).wait(timeout=rt.wire_wait_timeout_s)
                     out = {"values": np.asarray(rec.values).tolist(),
                            "generation": rec.generation,
                            "served_by": rec.served_by,

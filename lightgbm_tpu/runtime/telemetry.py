@@ -180,7 +180,21 @@ METRIC_TABLE: Dict[str, Dict[str, Any]] = {
     "lgbm_serve_bytes_total": {
         "type": "counter", "labels": ("path", "dir"),
         "help": "Binary wire-plane bytes moved (headers + payloads), "
-                "path=tcp/uds, dir=rx/tx"},
+                "path=tcp/uds/shm, dir=rx/tx"},
+    "lgbm_shm_sessions_total": {
+        "type": "counter", "labels": ("event",),
+        "help": "SHM ring sessions by lifecycle event: ready/closed/"
+                "reclaimed (peer died with work in flight)/torn "
+                "(protocol violation)/rejected_setup/leaked"},
+    "lgbm_shm_frames_total": {
+        "type": "counter", "labels": ("outcome",),
+        "help": "SHM ring frames by outcome: completed/rejected/"
+                "bad_crc (rejected in place, counters stay in sync)"},
+    "lgbm_shm_doorbell_syscalls_total": {
+        "type": "counter", "labels": ("op",),
+        "help": "Every syscall the ring doorbell makes, op=ring (wake "
+                "peer)/wait (poll)/drain (eventfd read) — zero in the "
+                "spin-hot steady state, which BENCH_WIRE measures"},
     "lgbm_serve_frames_total": {
         "type": "counter", "labels": ("outcome",),
         "help": "Binary wire frames by outcome: completed/rejected or "

@@ -62,9 +62,10 @@ from . import telemetry
 
 __all__ = ["HEADER_FIELDS", "HEADER_FMT", "HEADER_SIZE", "MAGIC",
            "VERSION", "MSG_REQUEST", "MSG_RESPONSE", "MSG_REJECT",
-           "DTYPE_F32", "pack_request", "pack_response", "pack_reject",
-           "read_frame", "WireFrameError", "WireTCPServer",
-           "WireUnixServer", "WireClient"]
+           "MSG_SHM_SETUP", "MSG_SHM_OK", "DTYPE_F32", "pack_request",
+           "pack_response", "pack_reject", "read_frame",
+           "WireFrameError", "WireTCPServer", "WireUnixServer",
+           "WireClient"]
 
 #: the canonical header layout — ``helper/check_wire_abi.py`` pins this
 #: tuple token-for-token against the ``WIRE_FRAME_FIELDS`` comment in
@@ -88,6 +89,11 @@ _HEADER = struct.Struct(HEADER_FMT)
 MAGIC = b"LGBW"
 VERSION = 1
 MSG_REQUEST, MSG_RESPONSE, MSG_REJECT = 1, 2, 3
+#: shared-memory ring negotiation (ISSUE 20): a client on the UDS plane
+#: sends MSG_SHM_SETUP carrying the packed ring config; the server acks
+#: with MSG_SHM_OK (twice: config accepted, then segment mapped) and the
+#: socket becomes the session's control channel — see runtime/shm_ring.py
+MSG_SHM_SETUP, MSG_SHM_OK = 4, 5
 DTYPE_F32 = 0                                      # the only wire dtype
 
 #: response meta block, written BEFORE the float32 values payload:
@@ -231,7 +237,7 @@ def _read_exact_into(rfile, view: memoryview) -> int:
 
 def read_frame(rfile, buffers: Optional["_BucketBuffers"] = None,
                max_rows: int = 1 << 20,
-               expect: Optional[int] = None):
+               expect=None):
     """Read one frame: (header tuple, payload).  With `buffers`, the
     payload lands in a preallocated per-bucket buffer and `payload` is a
     memoryview of it (zero-copy); otherwise a fresh bytes object.
@@ -256,7 +262,8 @@ def read_frame(rfile, buffers: Optional["_BucketBuffers"] = None,
         raise WireFrameError("bad_version", str(version))
     if dtype != DTYPE_F32:
         raise WireFrameError("bad_dtype", str(dtype))
-    if expect is not None and msg_type != expect:
+    if expect is not None and msg_type != expect and not (
+            isinstance(expect, tuple) and msg_type in expect):
         raise WireFrameError("unexpected_msg_type", str(msg_type))
     if payload_len > MAX_PAYLOAD or n_cols > MAX_COLS:
         raise WireFrameError("oversized",
@@ -357,6 +364,39 @@ class _ResponseScratch:
         np.copyto(dst, values, casting="same_kind")
         return dst
 
+    def pack_response_into(self, buf, off: int, values: np.ndarray,
+                           generation: int, model_id: str,
+                           served_by: str, latency_s: float,
+                           stages: Dict[str, float],
+                           compiled: bool) -> int:
+        """Pack one response frame at `buf[off:]` (any writable buffer —
+        the SHM response ring hands its mmap here so the frame lands
+        directly in the shared segment, no intermediate copy).  Returns
+        the frame's total bytes.  The caller guarantees the room."""
+        vals = self._as_f32(np.atleast_2d(values))
+        nbytes = vals.size * 4
+        total = HEADER_SIZE + RESP_META_SIZE + nbytes
+        _RESP_META.pack_into(
+            buf, off + HEADER_SIZE, int(generation), float(latency_s),
+            float(stages.get("queue_wait_s", 0.0)),
+            float(stages.get("batch_gather_s", 0.0)),
+            float(stages.get("device_s", 0.0)),
+            float(stages.get("drain_s", 0.0)),
+            1 if served_by == "device" else 0, 1 if compiled else 0)
+        mv = memoryview(buf)
+        try:
+            mv[off + HEADER_SIZE + RESP_META_SIZE:off + total] = \
+                memoryview(vals).cast("B")
+            crc = zlib.crc32(mv[off + HEADER_SIZE:off + total]) \
+                & 0xFFFFFFFF
+        finally:
+            mv.release()
+        _HEADER.pack_into(buf, off, MAGIC, VERSION, MSG_RESPONSE,
+                          DTYPE_F32, 0, self._model(model_id),
+                          vals.shape[0], vals.shape[1],
+                          RESP_META_SIZE + nbytes, crc)
+        return total
+
     def pack_response(self, values: np.ndarray, generation: int,
                       model_id: str, served_by: str, latency_s: float,
                       stages: Dict[str, float],
@@ -364,25 +404,13 @@ class _ResponseScratch:
         """Same frame bytes as module-level `pack_response` (parity is
         test-pinned), valid until the next call on this scratch."""
         vals = self._as_f32(np.atleast_2d(values))
-        nbytes = vals.size * 4
-        total = HEADER_SIZE + RESP_META_SIZE + nbytes
+        total = HEADER_SIZE + RESP_META_SIZE + vals.size * 4
         if len(self._buf) < total:
             self._buf = bytearray(1 << max(total - 1, 1).bit_length())
-        buf = self._buf
-        _RESP_META.pack_into(
-            buf, HEADER_SIZE, int(generation), float(latency_s),
-            float(stages.get("queue_wait_s", 0.0)),
-            float(stages.get("batch_gather_s", 0.0)),
-            float(stages.get("device_s", 0.0)),
-            float(stages.get("drain_s", 0.0)),
-            1 if served_by == "device" else 0, 1 if compiled else 0)
-        mv = memoryview(buf)
-        mv[HEADER_SIZE + RESP_META_SIZE:total] = memoryview(vals).cast("B")
-        crc = zlib.crc32(mv[HEADER_SIZE:total]) & 0xFFFFFFFF
-        _HEADER.pack_into(buf, 0, MAGIC, VERSION, MSG_RESPONSE, DTYPE_F32,
-                          0, self._model(model_id), vals.shape[0],
-                          vals.shape[1], RESP_META_SIZE + nbytes, crc)
-        return mv[:total]
+        total = self.pack_response_into(self._buf, 0, vals, generation,
+                                        model_id, served_by, latency_s,
+                                        stages, compiled)
+        return memoryview(self._buf)[:total]
 
 
 # ---------------------------------------------------------------------------
@@ -407,7 +435,7 @@ class _WireHandler(socketserver.StreamRequestHandler):
             try:
                 frame = read_frame(self.rfile, buffers,
                                    max_rows=server.max_rows_per_frame,
-                                   expect=MSG_REQUEST)
+                                   expect=(MSG_REQUEST, MSG_SHM_SETUP))
             except WireFrameError as e:
                 frames_total.inc(outcome=e.reason)
                 out = pack_reject(e.reason, retryable=True,
@@ -421,9 +449,22 @@ class _WireHandler(socketserver.StreamRequestHandler):
             if frame is None:
                 return                            # clean EOF
             hdr, payload = frame
-            (_m, _v, _t, _d, flags, model_raw, n_rows, n_cols, plen,
-             _crc) = hdr
+            (_m, _v, msg_type, _d, flags, model_raw, n_rows, n_cols,
+             plen, _crc) = hdr
             bytes_total.inc(HEADER_SIZE + plen, path=path, dir="rx")
+            if msg_type == MSG_SHM_SETUP:
+                # the shared-memory upgrade: fd passing needs AF_UNIX,
+                # so the TCP plane refuses (non-retryable — the client
+                # should fall back, not retry)
+                if not getattr(server, "supports_shm", False):
+                    frames_total.inc(outcome="shm_requires_uds")
+                    self._send(pack_reject("shm_requires_uds",
+                                           retryable=False),
+                               bytes_total, path)
+                    return
+                from . import shm_ring
+                shm_ring.serve_handler(self, bytes(payload))
+                return                # the socket was the control channel
             model_id = _unpad_model_id(model_raw)
             # the zero-copy hand-off: a float32 VIEW of the receive
             # buffer rides the queue; no per-request numpy allocation
@@ -433,8 +474,7 @@ class _WireHandler(socketserver.StreamRequestHandler):
             try:
                 rec = rt.submit_view(X, model_id=model_id,
                                      priority=flags & 0x0F).wait(
-                    timeout=rt.default_deadline_s
-                    + rt.predict_deadline_s + 10.0)
+                    timeout=rt.wire_wait_timeout_s)
                 # response values are always [n_rows, n_outputs] on the
                 # wire (a squeezed 1-class vector reshapes, multiclass
                 # passes through); the frame packs into the connection's
@@ -477,6 +517,7 @@ class WireTCPServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
     wire_path_label = "tcp"
+    supports_shm = False          # SCM_RIGHTS fd passing needs AF_UNIX
 
     def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0,
                  max_rows_per_frame: Optional[int] = None):
@@ -493,21 +534,49 @@ class WireTCPServer(socketserver.ThreadingTCPServer):
 class WireUnixServer(socketserver.ThreadingUnixStreamServer):
     """Binary-frame Unix-domain-socket front end: same frames as TCP,
     minus the TCP/loopback stack — the lowest-latency local data plane
-    (the BENCH_WIRE headline path)."""
+    (the BENCH_WIRE headline path).  Also the SHM ring transport's
+    handshake plane: a MSG_SHM_SETUP frame on any connection upgrades
+    it to a shared-memory session (`enable_shm=False` turns that off)."""
 
     daemon_threads = True
     allow_reuse_address = True
     wire_path_label = "uds"
 
     def __init__(self, runtime, path: str,
-                 max_rows_per_frame: Optional[int] = None):
+                 max_rows_per_frame: Optional[int] = None,
+                 enable_shm: bool = True):
         self.runtime = runtime
         self.uds_path = path
+        self.supports_shm = bool(enable_shm)
         self.max_rows_per_frame = int(max_rows_per_frame
                                       or runtime.max_batch_rows)
-        if os.path.exists(path):
-            os.unlink(path)
+        self._reap_stale_path(path)
         super().__init__(path, _WireHandler)
+
+    @staticmethod
+    def _reap_stale_path(path: str) -> None:
+        """A replica SIGKILLed mid-serve leaves its socket FILE behind,
+        and the relaunch's bind() hits EADDRINUSE.  Probe-connect first:
+        refused means nobody is listening (stale inode — unlink it),
+        success means a LIVE server owns the path (bind and fail loudly
+        rather than yank a working server's socket out from under it)."""
+        if not os.path.exists(path):
+            return
+        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        probe.settimeout(0.5)
+        try:
+            probe.connect(path)
+        except (ConnectionRefusedError, socket.timeout, OSError):
+            try:
+                os.unlink(path)       # stale: no listener behind it
+            except FileNotFoundError:
+                pass                  # raced another relaunch — fine
+        else:
+            raise OSError(
+                "wire UDS path %r is owned by a LIVE server "
+                "(probe-connect succeeded); refusing to unlink" % path)
+        finally:
+            probe.close()
 
     def server_close(self) -> None:
         super().server_close()

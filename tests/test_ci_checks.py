@@ -73,3 +73,33 @@ def test_wire_abi_requires_token_line_and_size_macro():
                                   build=False)
     assert any("WIRE_FRAME_FIELDS" in p for p in problems)
     assert any("LGBM_WIRE_HEADER_SIZE" in p for p in problems)
+
+
+def test_wire_abi_catches_ring_header_drift():
+    """The ISSUE 20 half of the comparator: doctoring the shm segment
+    header on either side must produce ring drift."""
+    with open(check_wire_abi.HEADER) as fh:
+        header = fh.read()
+    with open(check_wire_abi.SHM) as fh:
+        shm = fh.read()
+    # re-type a field on the C side only
+    doctored = header.replace("seg_size:Q", "seg_size:I")
+    assert doctored != header
+    problems = check_wire_abi.run(doctored, None, build=False)
+    assert any("ring header field" in p and "drifted" in p
+               for p in problems)
+    # re-type a field on the Python side only: drift AND the size macro
+    # stops matching the doctored layout
+    doctored = shm.replace('("resp_capacity", "I")',
+                           '("resp_capacity", "H")')
+    assert doctored != shm
+    problems = check_wire_abi.run(header, None, build=False,
+                                  shm_text=doctored)
+    assert any("ring header field" in p and "drifted" in p
+               for p in problems)
+    assert any("LGBM_WIRE_RING_HEADER_SIZE" in p for p in problems)
+    # ...and losing the token line entirely is drift, not silence
+    problems = check_wire_abi.run(
+        header.replace("WIRE_RING_FIELDS:", "WIRE_RING_XFIELDS:"),
+        None, build=False)
+    assert any("WIRE_RING_FIELDS" in p for p in problems)

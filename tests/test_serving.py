@@ -780,3 +780,72 @@ def test_wire_server_success_path_allocates_no_response_frames(
         finally:
             srv.shutdown()
             srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# stale UDS path reclamation (ISSUE 20 satellite): kill-and-relaunch
+# ---------------------------------------------------------------------------
+
+_UDS_HOLDER = """
+import socket, sys
+s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+s.bind(sys.argv[1])
+s.listen(8)
+print("ready", flush=True)
+import time; time.sleep(120)
+"""
+
+
+def test_wire_uds_rebinds_over_stale_path_after_kill(tmp_path):
+    """A replica SIGKILLed mid-serve leaves its socket FILE behind; the
+    relaunch must probe-connect, see nobody listening, unlink the stale
+    inode and bind — not die on EADDRINUSE."""
+    import signal
+    import subprocess
+    from lightgbm_tpu.runtime import wire
+    path = str(tmp_path / "replica.sock")
+    proc = subprocess.Popen([sys.executable, "-c", _UDS_HOLDER, path],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "ready"
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert os.path.exists(path)          # the stale inode SIGKILL left
+    text = _synth_model(seed=41)
+    probe = np.random.default_rng(12).standard_normal((4, 6)).astype(
+        np.float32)
+    with ServingRuntime(model_str=text, batch_window_s=0.0,
+                        response_dtype="float32") as rt:
+        usrv = wire.WireUnixServer(rt, path)     # the relaunch
+        threading.Thread(target=usrv.serve_forever, daemon=True).start()
+        try:
+            ref = np.asarray(rt.predict(
+                np.asarray(probe, np.float64)).values)
+            with wire.WireClient(path) as c:
+                out = c.predict(probe)
+            assert np.array_equal(out["values"].reshape(ref.shape), ref)
+        finally:
+            usrv.shutdown()
+            usrv.server_close()
+
+
+def test_wire_uds_refuses_to_unlink_live_server_path(tmp_path):
+    """The other half of the stale-path contract: probe-connect
+    SUCCEEDING means a live server owns the path, and the relaunch must
+    fail loudly instead of yanking the socket out from under it."""
+    from lightgbm_tpu.runtime import wire
+    path = str(tmp_path / "live.sock")
+    text = _synth_model(seed=42)
+    with ServingRuntime(model_str=text, batch_window_s=0.0) as rt:
+        usrv = wire.WireUnixServer(rt, path)
+        threading.Thread(target=usrv.serve_forever, daemon=True).start()
+        try:
+            with pytest.raises(OSError, match="LIVE"):
+                wire.WireUnixServer(rt, path)
+            assert os.path.exists(path)  # the live socket survived
+        finally:
+            usrv.shutdown()
+            usrv.server_close()
